@@ -1,0 +1,145 @@
+"""paddle_tpu: a TPU-native deep-learning framework.
+
+A ground-up JAX/XLA/Pallas re-design with the capabilities of the reference
+PaddlePaddle fork (kircle888/Paddle): an imperative ("dygraph") Tensor API with
+eager autograd, an nn.Layer module system, optimizers, bf16 AMP, trace-to-XLA
+jit capture, and first-class SPMD distributed training (dp/mp/pp/sharding/sep)
+over `jax.sharding.Mesh` device meshes.
+
+Layer map (cf. reference SURVEY.md §1):
+  - core/      Tensor over jax.Array + eager autograd tape  (≈ fluid/eager)
+  - ops/       op registry + functional tensor ops          (≈ phi/kernels + ops.yaml)
+  - nn/        Layer system + functional nn ops             (≈ python/paddle/nn)
+  - optimizer/ functional-core optimizers + LR schedulers   (≈ python/paddle/optimizer)
+  - amp/       bf16 autocast + loss scaling                 (≈ python/paddle/amp)
+  - jit/       trace-to-StableHLO capture                   (≈ paddle.jit + CINN; XLA is the compiler)
+  - distributed/ mesh, placements, collectives, parallelism (≈ python/paddle/distributed)
+  - kernels/   Pallas TPU kernels (flash attention, flashmask, ring attention)
+"""
+
+from paddle_tpu import version as _version
+
+__version__ = _version.__version__
+
+# ---- core runtime -----------------------------------------------------------
+from paddle_tpu.core.dtypes import (  # noqa: F401
+    bfloat16,
+    bool_ as bool,  # noqa: A001 - mirrors paddle.bool
+    complex64,
+    complex128,
+    dtype,
+    float16,
+    float32,
+    float64,
+    int8,
+    int16,
+    int32,
+    int64,
+    uint8,
+)
+from paddle_tpu.core.device import (  # noqa: F401
+    CPUPlace,
+    TPUPlace,
+    device,
+    get_device,
+    set_device,
+)
+from paddle_tpu.core.tensor import Tensor  # noqa: F401
+from paddle_tpu.core.autograd import enable_grad, is_grad_enabled, no_grad, set_grad_enabled  # noqa: F401
+from paddle_tpu.core.rng import get_rng_state, seed, set_rng_state  # noqa: F401
+from paddle_tpu.flags import get_flags, set_flags  # noqa: F401
+
+# ---- ops: creation + math + manipulation + ... ------------------------------
+from paddle_tpu.ops.creation import (  # noqa: F401
+    arange,
+    assign,
+    clone,
+    create_parameter,
+    diag,
+    diagflat,
+    empty,
+    empty_like,
+    eye,
+    full,
+    full_like,
+    linspace,
+    logspace,
+    meshgrid,
+    ones,
+    ones_like,
+    to_tensor,
+    tril,
+    triu,
+    zeros,
+    zeros_like,
+)
+from paddle_tpu.ops.math import *  # noqa: F401,F403
+from paddle_tpu.ops.manipulation import *  # noqa: F401,F403
+from paddle_tpu.ops.reduction import *  # noqa: F401,F403
+from paddle_tpu.ops.comparison import *  # noqa: F401,F403
+from paddle_tpu.ops.logic import *  # noqa: F401,F403
+from paddle_tpu.ops.search import *  # noqa: F401,F403
+from paddle_tpu.ops.linalg import (  # noqa: F401
+    bmm,
+    cross,
+    dist,
+    dot,
+    einsum,
+    histogram,
+    matmul,
+    mm,
+    mv,
+    norm,
+    t,
+    transpose,
+)
+from paddle_tpu.ops.random import (  # noqa: F401
+    bernoulli,
+    multinomial,
+    normal,
+    rand,
+    randint,
+    randn,
+    randperm,
+    standard_normal,
+    uniform,
+)
+
+# ---- subpackages ------------------------------------------------------------
+from paddle_tpu import amp  # noqa: F401
+from paddle_tpu import autograd  # noqa: F401
+from paddle_tpu import distributed  # noqa: F401
+from paddle_tpu import io  # noqa: F401
+from paddle_tpu import jit  # noqa: F401
+from paddle_tpu import linalg  # noqa: F401
+from paddle_tpu import metric  # noqa: F401
+from paddle_tpu import nn  # noqa: F401
+from paddle_tpu import optimizer  # noqa: F401
+from paddle_tpu import profiler  # noqa: F401
+from paddle_tpu import static  # noqa: F401
+from paddle_tpu import incubate  # noqa: F401
+
+from paddle_tpu.framework.io import load, save  # noqa: F401
+from paddle_tpu.framework.random import get_cuda_rng_state  # noqa: F401
+
+# paddle-API aliases
+from paddle_tpu.nn.layer.layers import Layer  # noqa: F401
+from paddle_tpu.core.tensor import Parameter  # noqa: F401
+from paddle_tpu.distributed.parallel import DataParallel  # noqa: F401
+
+grad = autograd.grad  # noqa: F401
+
+
+def disable_static() -> None:
+    """Dygraph is the default execution mode; kept for API parity."""
+
+
+def enable_static() -> None:  # pragma: no cover - compat stub
+    raise NotImplementedError(
+        "paddle_tpu has no legacy static-graph mode; use paddle_tpu.jit.to_static "
+        "to capture a program into a compiled XLA executable."
+    )
+
+
+def in_dynamic_mode() -> bool:
+    return True

@@ -1,0 +1,4 @@
+"""AMP: bf16/fp16 autocast + loss scaling (reference ``python/paddle/amp``)."""
+
+from paddle_tpu.amp.auto_cast import amp_guard, auto_cast, decorate  # noqa: F401
+from paddle_tpu.amp.grad_scaler import AmpScaler, GradScaler  # noqa: F401

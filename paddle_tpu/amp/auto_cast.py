@@ -1,0 +1,145 @@
+"""Autocast (reference ``python/paddle/amp/auto_cast.py`` ``amp_guard:459`` +
+``amp_lists.py`` O1 white/black lists).
+
+On TPU the native mixed-precision dtype is bfloat16 (MXU-native, no loss
+scaling required). O1 casts matmul/conv inputs to bf16 at dispatch; O2 casts
+model parameters wholesale (``decorate``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Iterable, Optional, Set, Tuple, Union
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.dtypes import convert_dtype
+
+# O1 lists (reference amp_lists.py): ops cast to low precision / kept in fp32.
+WHITE_LIST: Set[str] = {
+    "matmul", "mm", "bmm", "mv", "linear", "conv1d", "conv2d", "conv3d",
+    "conv1d_transpose", "conv2d_transpose", "conv3d_transpose", "einsum",
+    "flash_attention", "flashmask_attention", "scaled_dot_product_attention",
+}
+BLACK_LIST: Set[str] = {
+    "exp", "log", "log2", "log10", "log1p", "pow", "square", "sqrt", "rsqrt",
+    "softmax_fn", "log_softmax", "cross_entropy_fn", "mean", "sum",
+    "layer_norm_fn", "rms_norm_fn", "batch_norm_fn", "group_norm_fn",
+    "cumsum", "logsumexp", "norm", "dist",
+}
+
+_amp_state = threading.local()
+
+
+def _state() -> dict:
+    if not hasattr(_amp_state, "cfg"):
+        _amp_state.cfg = {"enabled": False, "dtype": jnp.bfloat16, "level": "O1",
+                          "custom_white": set(), "custom_black": set()}
+    return _amp_state.cfg
+
+
+def amp_enabled() -> bool:
+    return _state()["enabled"]
+
+
+def amp_dtype() -> Any:
+    return _state()["dtype"]
+
+
+def amp_cast_inputs(op_name: str, arrays: Iterable[Any]) -> Tuple[Any, ...]:
+    """Called by dispatch when autocast is active: cast white-list op float
+    inputs to the amp dtype, black-list inputs to fp32."""
+    cfg = _state()
+    white = WHITE_LIST | cfg["custom_white"]
+    black = (BLACK_LIST | cfg["custom_black"]) - cfg["custom_white"]
+    target = None
+    if op_name in white:
+        target = cfg["dtype"]
+    elif op_name in black:
+        target = jnp.float32
+    if target is None:
+        return tuple(arrays)
+    out = []
+    for a in arrays:
+        if hasattr(a, "dtype") and jnp.issubdtype(jnp.dtype(a.dtype), jnp.floating):
+            out.append(a.astype(target))
+        else:
+            out.append(a)
+    return tuple(out)
+
+
+class auto_cast:  # noqa: N801 - paddle API name
+    def __init__(
+        self,
+        enable: bool = True,
+        custom_white_list: Optional[Iterable[str]] = None,
+        custom_black_list: Optional[Iterable[str]] = None,
+        level: str = "O1",
+        dtype: str = "bfloat16",
+        use_promote: bool = True,
+    ) -> None:
+        self._cfg = {
+            "enabled": enable,
+            "dtype": convert_dtype(dtype),
+            "level": level,
+            "custom_white": set(custom_white_list or ()),
+            "custom_black": set(custom_black_list or ()),
+        }
+        self._prev: Optional[dict] = None
+
+    def __enter__(self) -> "auto_cast":
+        self._prev = dict(_state())
+        _amp_state.cfg = self._cfg
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        _amp_state.cfg = self._prev
+
+
+amp_guard = auto_cast
+
+
+def decorate(
+    models: Any,
+    optimizers: Any = None,
+    level: str = "O1",
+    dtype: str = "bfloat16",
+    master_weight: Optional[bool] = None,
+    save_dtype: Optional[str] = None,
+    master_grad: bool = False,
+    excluded_layers: Any = None,
+):
+    """O2 decoration (reference ``amp.decorate``): cast model params to the amp
+    dtype; optimizer keeps fp32 master weights (multi_precision)."""
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        excluded = set()
+        if excluded_layers:
+            excl_list = excluded_layers if isinstance(excluded_layers, (list, tuple)) else [excluded_layers]
+            for m in model_list:
+                for layer in m.sublayers(include_self=True):
+                    for e in excl_list:
+                        if isinstance(e, type) and isinstance(layer, e):
+                            excluded.add(id(layer))
+                        elif layer is e:
+                            excluded.add(id(layer))
+        from paddle_tpu.nn.layer.norm import _BatchNormBase, LayerNorm
+
+        for m in model_list:
+            for layer in m.sublayers(include_self=True):
+                if id(layer) in excluded or isinstance(layer, (_BatchNormBase, LayerNorm)):
+                    continue
+                for p in layer.parameters(include_sublayers=False):
+                    if jnp.issubdtype(jnp.dtype(p.dtype), jnp.floating):
+                        p._data = p._data.astype(convert_dtype(dtype))
+        for m in model_list:
+            m._dtype = convert_dtype(dtype)
+    if optimizers is None:
+        return models if single else model_list
+    opt_single = not isinstance(optimizers, (list, tuple))
+    opt_list = [optimizers] if opt_single else list(optimizers)
+    if level == "O2":
+        for opt in opt_list:
+            opt._multi_precision = True
+    return (models if single else model_list), (optimizers if opt_single else opt_list)

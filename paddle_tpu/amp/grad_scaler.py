@@ -1,0 +1,114 @@
+"""Dynamic loss scaling (reference ``python/paddle/amp/grad_scaler.py``
+``AmpScaler:62``). On TPU bf16 training needs no scaling (same exponent range
+as fp32) — the scaler defaults to pass-through unless fp16 is in use, matching
+the reference's behavior of disabling scaling for bf16.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu
+from paddle_tpu.core.tensor import Tensor
+
+
+class AmpScaler:
+    def __init__(
+        self,
+        enable: bool = True,
+        init_loss_scaling: float = 2.0**15,
+        incr_ratio: float = 2.0,
+        decr_ratio: float = 0.5,
+        incr_every_n_steps: int = 1000,
+        decr_every_n_nan_or_inf: int = 1,
+        use_dynamic_loss_scaling: bool = True,
+    ) -> None:
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n_nan_or_inf = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def is_enable(self) -> bool:
+        return self._enable
+
+    def scale(self, var: Tensor) -> Tensor:
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer: Any) -> None:
+        if not self._enable:
+            return
+        inv = 1.0 / self._scale
+        found = False
+        with paddle_tpu.no_grad():
+            for p in optimizer._parameters:
+                if p.grad is not None:
+                    g = p.grad.data.astype(jnp.float32) * inv
+                    finite = bool(jnp.all(jnp.isfinite(g)))
+                    found = found or (not finite)
+                    p.grad.set_value(g.astype(p.grad.dtype) if finite else jnp.zeros_like(p.grad.data))
+        self._found_inf = found
+
+    def step(self, optimizer: Any) -> None:
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer: Any, loss: Tensor) -> None:
+        self.step(optimizer)
+
+    def update(self) -> None:
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every_n_nan_or_inf:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every_n_steps:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def get_loss_scaling(self) -> float:
+        return self._scale
+
+    def set_init_loss_scaling(self, new_init_loss_scaling: float) -> None:
+        self._scale = float(new_init_loss_scaling)
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {
+            "scale": self._scale,
+            "incr_ratio": self._incr_ratio,
+            "decr_ratio": self._decr_ratio,
+            "incr_every_n_steps": self._incr_every_n_steps,
+            "decr_every_n_nan_or_inf": self._decr_every_n_nan_or_inf,
+            "good_steps": self._good_steps,
+            "bad_steps": self._bad_steps,
+        }
+
+    def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
+        self._scale = state_dict["scale"]
+        self._good_steps = state_dict.get("good_steps", 0)
+        self._bad_steps = state_dict.get("bad_steps", 0)
+
+
+class GradScaler(AmpScaler):
+    pass

@@ -1,0 +1,33 @@
+"""``paddle_tpu.autograd`` (reference ``python/paddle/autograd``)."""
+
+from paddle_tpu.autograd.py_layer import PyLayer, PyLayerContext  # noqa: F401
+from paddle_tpu.core.autograd import grad  # noqa: F401
+from paddle_tpu.core.autograd import run_backward as _run_backward
+from paddle_tpu.core.autograd import (  # noqa: F401
+    enable_grad,
+    is_grad_enabled,
+    no_grad,
+    set_grad_enabled,
+)
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """``paddle.autograd.backward`` parity (reference ``backward_mode.py``)."""
+    if grad_tensors is not None and not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+    _run_backward(list(tensors), grad_tensors, retain_graph=retain_graph)
+
+
+class saved_tensors_hooks:  # noqa: N801
+    """Compat context; residuals are managed by XLA buffers (vjp closures), so
+    pack/unpack hooks are accepted but the default implementation is identity."""
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return None

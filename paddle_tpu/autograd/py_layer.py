@@ -1,0 +1,98 @@
+"""PyLayer: user-defined forward/backward (reference
+``python/paddle/autograd/py_layer.py``).
+
+The custom backward runs eagerly at backward time (it may itself dispatch ops
+under no_grad), wired into the tape as a GradNode whose "vjp" calls the user's
+``backward`` staticmethod — mirroring the reference's PyLayer GradNode.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+import jax
+
+import paddle_tpu
+from paddle_tpu.core import autograd as _ag
+from paddle_tpu.core.tensor import Tensor
+
+
+class PyLayerContext:
+    def __init__(self) -> None:
+        self._saved: Tuple[Any, ...] = ()
+        self.not_inplace_tensors: Tuple[Any, ...] = ()
+
+    def save_for_backward(self, *tensors: Any) -> None:
+        self._saved = tensors
+
+    def saved_tensor(self) -> Tuple[Any, ...]:
+        return self._saved
+
+    saved_tensors = property(lambda self: self._saved)
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx: PyLayerContext, *args: Any, **kwargs: Any) -> Any:
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx: PyLayerContext, *grads: Any) -> Any:
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args: Any, **kwargs: Any) -> Any:
+        ctx = PyLayerContext()
+        tensor_inputs: List[Tensor] = [
+            a for a in list(args) + list(kwargs.values())
+            if isinstance(a, Tensor) and not a.stop_gradient
+        ]
+        record = _ag.is_grad_enabled() and bool(tensor_inputs)
+
+        with _ag.set_grad_enabled(False):
+            outputs = cls.forward(ctx, *args, **kwargs)
+
+        if not record:
+            return outputs
+
+        single = not isinstance(outputs, (list, tuple))
+        out_list = [outputs] if single else list(outputs)
+        out_tensors = [o for o in out_list if isinstance(o, Tensor)]
+        out_avals = [jax.ShapeDtypeStruct(tuple(o.shape), o.dtype) for o in out_tensors]
+
+        def vjp_fn(cots: Any) -> Tuple[Any, ...]:
+            cot_list = [cots] if len(out_avals) == 1 else list(cots)
+            grad_in = [Tensor(c) if c is not None else None for c in cot_list]
+            with _ag.set_grad_enabled(False):
+                result = cls.backward(ctx, *grad_in)
+            if not isinstance(result, (list, tuple)):
+                result = (result,)
+            flat = []
+            for r in result:
+                if r is None:
+                    flat.append(None)
+                else:
+                    flat.append(r.data if isinstance(r, Tensor) else r)
+            if len(flat) != len(tensor_inputs):
+                # paddle allows returning grads for all inputs incl. non-diff;
+                # keep only the positions of recorded diff inputs.
+                flat = flat[: len(tensor_inputs)]
+            return tuple(flat)
+
+        node = _ag.GradNode(cls.__name__, vjp_fn, tensor_inputs, out_avals)
+        idx = 0
+        wrapped = []
+        for o in out_list:
+            if isinstance(o, Tensor):
+                t = Tensor(o.data, stop_gradient=False)
+                t._grad_node = node
+                t._grad_output_index = idx
+                idx += 1
+                wrapped.append(t)
+            else:
+                wrapped.append(o)
+        return wrapped[0] if single else tuple(wrapped)

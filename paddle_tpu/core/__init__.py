@@ -1,0 +1,1 @@
+"""Core runtime: Tensor, autograd tape, dispatch, device, dtype, RNG."""

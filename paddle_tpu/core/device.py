@@ -1,0 +1,131 @@
+"""Places / devices.
+
+Counterpart of the reference's ``phi::Place`` + device management
+(``paddle/phi/backends/device_manager.h:134``). On TPU the PJRT client owns
+devices; a Place is a thin handle onto a ``jax.Device``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Union
+
+import jax
+
+
+class Place:
+    device_type = "unknown"
+
+    def __init__(self, device_id: int = 0) -> None:
+        self.device_id = int(device_id)
+
+    def __repr__(self) -> str:
+        return f"Place({self.device_type}:{self.device_id})"
+
+    def __eq__(self, other: object) -> bool:
+        return (
+            isinstance(other, Place)
+            and other.device_type == self.device_type
+            and other.device_id == self.device_id
+        )
+
+    def __hash__(self) -> int:
+        return hash((self.device_type, self.device_id))
+
+    def jax_device(self) -> jax.Device:
+        devices = [d for d in jax.devices() if _platform_matches(d.platform, self.device_type)]
+        if not devices:
+            # Fall back to whatever the default backend exposes (e.g. tests on CPU).
+            devices = jax.devices()
+        return devices[self.device_id % len(devices)]
+
+
+def _platform_matches(platform: str, device_type: str) -> bool:
+    if device_type == "tpu":
+        # The lab tunnel exposes the TPU chip under the experimental 'axon' platform.
+        return platform in ("tpu", "axon")
+    return platform == device_type
+
+
+class TPUPlace(Place):
+    device_type = "tpu"
+
+
+class CPUPlace(Place):
+    device_type = "cpu"
+
+    def __init__(self) -> None:
+        super().__init__(0)
+
+
+class CustomPlace(Place):
+    def __init__(self, device_type: str, device_id: int = 0) -> None:
+        super().__init__(device_id)
+        self.device_type = device_type
+
+
+_state = threading.local()
+
+
+def _default_device_str() -> str:
+    platform = jax.default_backend()
+    if platform in ("tpu", "axon"):
+        return "tpu:0"
+    return "cpu"
+
+
+def set_device(device: str) -> Place:
+    """Set the active device, e.g. ``set_device("tpu:0")``. Mirrors ``paddle.set_device``."""
+    place = _parse(device)
+    _state.place = place
+    return place
+
+
+def get_device() -> str:
+    place = getattr(_state, "place", None)
+    if place is None:
+        return _default_device_str()
+    if isinstance(place, CPUPlace):
+        return "cpu"
+    return f"{place.device_type}:{place.device_id}"
+
+
+def current_place() -> Place:
+    place = getattr(_state, "place", None)
+    if place is not None:
+        return place
+    return _parse(_default_device_str())
+
+
+def _parse(device: Union[str, Place]) -> Place:
+    if isinstance(device, Place):
+        return device
+    spec = device.lower()
+    if spec == "cpu":
+        return CPUPlace()
+    kind, _, idx = spec.partition(":")
+    device_id = int(idx) if idx else 0
+    if kind in ("tpu", "gpu", "xpu", "axon"):
+        # gpu/xpu names are accepted for script compat and map onto the accelerator.
+        return TPUPlace(device_id)
+    return CustomPlace(kind, device_id)
+
+
+class device:  # noqa: N801 - mirrors paddle.device module-as-namespace usage
+    set_device = staticmethod(set_device)
+    get_device = staticmethod(get_device)
+
+    @staticmethod
+    def device_count() -> int:
+        return len(jax.devices())
+
+    @staticmethod
+    def is_compiled_with_cuda() -> bool:
+        return False
+
+    @staticmethod
+    def synchronize() -> None:
+        """Block until all enqueued work is done (async dispatch barrier)."""
+        import jax as _jax
+
+        (_jax.device_put(0) + 0).block_until_ready()

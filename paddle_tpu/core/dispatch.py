@@ -1,0 +1,139 @@
+"""Eager op dispatch.
+
+TPU-native counterpart of the reference's dygraph dispatch path (SURVEY §3.1:
+generated ``*_ad_func`` → PHI API → KernelFactory → kernel). Here an "op" is a
+pure JAX function over arrays; dispatch (1) unwraps Tensor leaves, (2) decides
+whether gradients must be recorded, (3) either calls the function directly
+(XLA executes op-by-op with async dispatch — the DeviceContext-stream analog)
+or routes through ``jax.vjp`` to capture residuals + the backward closure on a
+``GradNode`` (≈ ``eager_gen.py:339-359`` node creation + ``SetGradOutMeta``).
+
+The NaN/Inf debug scan (``FLAGS_check_nan_inf``) mirrors
+``paddle/fluid/eager/nan_inf_utils.cc``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core import autograd as _ag
+from paddle_tpu.flags import GLOBAL_FLAGS
+
+
+def _is_tensor(x: Any) -> bool:
+    from paddle_tpu.core.tensor import Tensor
+
+    return isinstance(x, Tensor)
+
+
+def _differentiable(t: Any) -> bool:
+    return (not t.stop_gradient) and jnp.issubdtype(jnp.dtype(t.dtype), jnp.inexact)
+
+
+def _check_nan_inf(name: str, arrays: Sequence[Any]) -> None:
+    for a in arrays:
+        if hasattr(a, "dtype") and jnp.issubdtype(jnp.dtype(a.dtype), jnp.inexact):
+            finite = bool(jnp.all(jnp.isfinite(a)))
+            if not finite:
+                level = GLOBAL_FLAGS.get("check_nan_inf_level")
+                msg = f"NaN/Inf detected in output of op '{name}'"
+                if level == 0:
+                    raise FloatingPointError(msg)
+                import logging
+
+                logging.getLogger("paddle_tpu").warning(msg)
+
+
+def call_op(name: str, fn: Callable, *args: Any, **kwargs: Any) -> Any:
+    """Dispatch one op. ``fn`` is a pure function over jax arrays.
+
+    Returns Tensor (or tuple/list of Tensors mirroring fn's output structure).
+    """
+    from paddle_tpu.core.tensor import Tensor
+
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs), is_leaf=_is_tensor)
+    tensor_pos = [i for i, leaf in enumerate(leaves) if _is_tensor(leaf)]
+    record = _ag.is_grad_enabled() and any(
+        _differentiable(leaves[i]) for i in tensor_pos
+    )
+
+    # AMP autocast (O1): cast white/black-list op inputs at dispatch, the
+    # analog of the reference's generated *_ad_func autocast prologue.
+    datas = [leaves[i].data for i in tensor_pos]
+    from paddle_tpu.amp.auto_cast import amp_cast_inputs, amp_enabled
+
+    if amp_enabled():
+        datas = list(amp_cast_inputs(name, datas))
+    data_at = dict(zip(tensor_pos, datas))
+
+    if not record:
+        plain = list(leaves)
+        for i in tensor_pos:
+            plain[i] = data_at[i]
+        a, k = jax.tree_util.tree_unflatten(treedef, plain)
+        raw_out = fn(*a, **k)
+        return _wrap_outputs(name, raw_out, node=None)
+
+    diff_pos = [i for i in tensor_pos if _differentiable(leaves[i])]
+    diff_tensors = [leaves[i] for i in diff_pos]
+
+    def closed(*diff_arrays: Any) -> Any:
+        rebuilt = list(leaves)
+        for i in tensor_pos:
+            rebuilt[i] = data_at[i]
+        for pos, arr in zip(diff_pos, diff_arrays):
+            rebuilt[pos] = arr
+        a, k = jax.tree_util.tree_unflatten(treedef, rebuilt)
+        return fn(*a, **k)
+
+    primals = [data_at[i] for i in diff_pos]
+    raw_out, vjp_fn = jax.vjp(closed, *primals)
+
+    flat_out, _ = jax.tree_util.tree_flatten(raw_out)
+    out_avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in flat_out]
+    node = _ag.GradNode(name, vjp_fn, diff_tensors, out_avals)
+    return _wrap_outputs(name, raw_out, node=node)
+
+
+def _wrap_outputs(name: str, raw_out: Any, node: Optional[_ag.GradNode]) -> Any:
+    from paddle_tpu.core.tensor import Tensor
+
+    flat_out, out_treedef = jax.tree_util.tree_flatten(raw_out)
+    if GLOBAL_FLAGS.get("check_nan_inf"):
+        _check_nan_inf(name, flat_out)
+    wrapped: List[Any] = []
+    for i, o in enumerate(flat_out):
+        t = Tensor(o, stop_gradient=(node is None))
+        if node is not None:
+            t._grad_node = node
+            t._grad_output_index = i
+            # Non-inexact outputs (e.g. argmax indices) carry no gradient.
+            if not jnp.issubdtype(jnp.dtype(t.dtype), jnp.inexact):
+                t.stop_gradient = True
+        wrapped.append(t)
+    return jax.tree_util.tree_unflatten(out_treedef, wrapped)
+
+
+def defop(name: str) -> Callable[[Callable], Callable]:
+    """Decorator: turn a pure jax-array function into an eager Tensor op.
+
+    The wrapped function transparently accepts Tensors, numbers, numpy/jax
+    arrays; when called with tracer inputs (inside paddle_tpu.jit capture) it
+    behaves identically because dispatch only touches ``.data``.
+    """
+
+    def deco(fn: Callable) -> Callable:
+        @functools.wraps(fn)
+        def wrapper(*args: Any, **kwargs: Any) -> Any:
+            return call_op(name, fn, *args, **kwargs)
+
+        wrapper.__paddle_tpu_op__ = name  # type: ignore[attr-defined]
+        wrapper.raw_fn = fn  # type: ignore[attr-defined]
+        return wrapper
+
+    return deco

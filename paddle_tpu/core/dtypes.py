@@ -1,0 +1,80 @@
+"""Canonical dtypes.
+
+Counterpart of the reference's ``phi::DataType`` (``paddle/phi/common/data_type.h``)
+— here dtypes ARE jax/numpy dtypes, so everything interops with jnp directly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+dtype = jnp.dtype
+
+bfloat16 = jnp.bfloat16
+float16 = jnp.float16
+float32 = jnp.float32
+float64 = jnp.float64
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+uint8 = jnp.uint8
+bool_ = jnp.bool_
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_ALIASES = {
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "float16": float16,
+    "fp16": float16,
+    "half": float16,
+    "float32": float32,
+    "fp32": float32,
+    "float": float32,
+    "float64": float64,
+    "fp64": float64,
+    "double": float64,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int": int32,
+    "int64": int64,
+    "long": int64,
+    "uint8": uint8,
+    "bool": bool_,
+    "complex64": complex64,
+    "complex128": complex128,
+}
+
+FLOATING = (jnp.bfloat16, jnp.float16, jnp.float32, jnp.float64)
+INTEGER = (jnp.int8, jnp.int16, jnp.int32, jnp.int64, jnp.uint8)
+
+
+def convert_dtype(d: Any) -> Any:
+    """Normalize a dtype-ish (str, np.dtype, jnp dtype) to a jnp scalar type."""
+    if d is None:
+        return None
+    if isinstance(d, str):
+        key = d.lower().removeprefix("paddle.")
+        if key in _ALIASES:
+            return _ALIASES[key]
+        return jnp.dtype(key).type
+    if isinstance(d, jnp.dtype) or isinstance(d, np.dtype):
+        return jnp.dtype(d).type
+    return jnp.dtype(d).type
+
+
+def is_floating_point(d: Any) -> bool:
+    return jnp.issubdtype(jnp.dtype(convert_dtype(d)), jnp.floating)
+
+
+def is_integer(d: Any) -> bool:
+    return jnp.issubdtype(jnp.dtype(convert_dtype(d)), jnp.integer)
+
+
+def is_complex(d: Any) -> bool:
+    return jnp.issubdtype(jnp.dtype(convert_dtype(d)), jnp.complexfloating)

@@ -1,0 +1,48 @@
+"""``paddle_tpu.distributed`` (reference ``python/paddle/distributed``).
+
+SPMD-first: a mesh + placements API backed by GSPMD, shard_map parallel
+regions for explicit collectives, and fleet-style hybrid-parallel wrappers.
+"""
+
+from paddle_tpu.distributed import fleet  # noqa: F401
+from paddle_tpu.distributed.api import (  # noqa: F401
+    dtensor_from_local,
+    dtensor_to_local,
+    get_placements,
+    reshard,
+    shard_layer,
+    shard_optimizer,
+    shard_tensor,
+    unshard_dtensor,
+)
+from paddle_tpu.distributed.collective import (  # noqa: F401
+    Group,
+    ReduceOp,
+    all_gather,
+    all_gather_object,
+    all_reduce,
+    alltoall,
+    alltoall_single,
+    barrier,
+    broadcast,
+    get_group,
+    irecv,
+    isend,
+    new_group,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+    stream,
+)
+from paddle_tpu.distributed.mesh import ProcessMesh, get_mesh, init_mesh, set_mesh  # noqa: F401
+from paddle_tpu.distributed.parallel import (  # noqa: F401
+    DataParallel,
+    ParallelEnv,
+    get_rank,
+    get_world_size,
+    init_parallel_env,
+    is_initialized,
+)
+from paddle_tpu.distributed.placements import Partial, Placement, Replicate, Shard  # noqa: F401

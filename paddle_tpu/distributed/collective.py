@@ -1,0 +1,316 @@
+"""Collective communication API.
+
+Reference surface: ``python/paddle/distributed/communication/`` (all_reduce,
+all_gather, …, ``group.py`` Group objects) over ProcessGroupNCCL. TPU-native
+design (SURVEY §5.8): a single XLA-collective backend — inside ``shard_map``
+parallel regions these lower to ``lax.psum``/``all_gather``/``ppermute`` over
+ICI; on global-view (GSPMD) arrays, cross-device reduction/gather is expressed
+by resharding, which XLA implements with the same collectives. There is no
+NCCL: the compiler emits the communication.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = [
+    "ReduceOp",
+    "Group",
+    "new_group",
+    "get_group",
+    "all_reduce",
+    "all_gather",
+    "all_gather_object",
+    "reduce",
+    "reduce_scatter",
+    "broadcast",
+    "scatter",
+    "alltoall",
+    "alltoall_single",
+    "send",
+    "recv",
+    "isend",
+    "irecv",
+    "barrier",
+    "stream",
+]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+@dataclass
+class Group:
+    """Communication group ≈ a named mesh axis (reference Group over
+    ProcessGroup). ``axis_name`` binds collectives inside shard_map regions."""
+
+    id: int
+    ranks: List[int]
+    axis_name: Optional[str] = None
+
+    @property
+    def nranks(self) -> int:
+        return len(self.ranks)
+
+    @property
+    def world_size(self) -> int:
+        return len(self.ranks)
+
+    def get_group_rank(self, rank: int) -> int:
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    @property
+    def process_group(self) -> "Group":
+        return self
+
+
+_groups: Dict[int, Group] = {}
+_next_group_id = [0]
+
+
+def _default_group() -> Group:
+    if 0 not in _groups:
+        n = len(jax.devices())
+        _groups[0] = Group(0, list(range(n)), axis_name=None)
+    return _groups[0]
+
+
+def new_group(ranks: Optional[Sequence[int]] = None, backend: Optional[str] = None, timeout: Any = None, axis_name: Optional[str] = None) -> Group:
+    _next_group_id[0] += 1
+    gid = _next_group_id[0]
+    if ranks is None:
+        ranks = list(range(len(jax.devices())))
+    g = Group(gid, list(ranks), axis_name=axis_name)
+    _groups[gid] = g
+    return g
+
+
+def get_group(gid: int = 0) -> Group:
+    if gid == 0:
+        return _default_group()
+    return _groups[gid]
+
+
+def _in_parallel_trace() -> bool:
+    """True when called inside a shard_map/pmap region with named axes."""
+    try:
+        from jax._src.core import get_axis_env  # jax>=0.5 internal; fallback below
+
+        return bool(get_axis_env().axis_sizes)
+    except Exception:
+        try:
+            frame = jax.core.unsafe_get_axis_names()  # type: ignore[attr-defined]
+            return bool(frame)
+        except Exception:
+            return False
+
+
+def _axis(group: Optional[Group]) -> Optional[str]:
+    g = group or _default_group()
+    return g.axis_name
+
+
+def _apply(t: Any, fn: Any) -> Any:
+    if isinstance(t, Tensor):
+        from paddle_tpu.core.dispatch import call_op
+
+        return call_op("collective", fn, t)
+    return fn(t)
+
+
+def all_reduce(tensor: Any, op: str = ReduceOp.SUM, group: Optional[Group] = None, sync_op: bool = True) -> Any:
+    """AllReduce. Inside a shard_map region: ``lax.psum`` over the group axis.
+    On a global-view array (SPMD single-controller): values are already
+    globally consistent — identity (the reduction lives in the sharding
+    propagation), matching the DistTensor Partial→Replicate semantics."""
+    axis = _axis(group)
+    if axis is None:
+        return tensor
+
+    def fn(x: Any) -> Any:
+        if op == ReduceOp.SUM:
+            return jax.lax.psum(x, axis)
+        if op == ReduceOp.MAX:
+            return jax.lax.pmax(x, axis)
+        if op == ReduceOp.MIN:
+            return jax.lax.pmin(x, axis)
+        if op == ReduceOp.AVG:
+            return jax.lax.pmean(x, axis)
+        if op == ReduceOp.PROD:
+            return jnp.exp(jax.lax.psum(jnp.log(x), axis))
+        raise ValueError(f"unknown reduce op {op}")
+
+    result = _apply(tensor, fn)
+    if isinstance(tensor, Tensor) and isinstance(result, Tensor):
+        tensor._replace_(result)
+        return tensor
+    return result
+
+
+def all_gather(tensor_list: Optional[List[Any]], tensor: Any, group: Optional[Group] = None, sync_op: bool = True, axis: int = 0) -> Any:
+    axis_name = _axis(group)
+    if axis_name is None:
+        if tensor_list is not None:
+            tensor_list.append(tensor)
+            return tensor_list
+        return tensor
+
+    def fn(x: Any) -> Any:
+        return jax.lax.all_gather(x, axis_name, tiled=False)
+
+    gathered = _apply(tensor, fn)
+    if tensor_list is not None:
+        n = (group or _default_group()).nranks
+        from paddle_tpu.ops.manipulation import unbind
+
+        tensor_list.extend(unbind(gathered, axis=0))
+        return tensor_list
+    return gathered
+
+
+def all_gather_object(object_list: List[Any], obj: Any, group: Optional[Group] = None) -> None:
+    object_list.append(obj)
+
+
+def reduce(tensor: Any, dst: int = 0, op: str = ReduceOp.SUM, group: Optional[Group] = None, sync_op: bool = True) -> Any:
+    return all_reduce(tensor, op=op, group=group)
+
+
+def reduce_scatter(tensor: Any, tensor_list: Any = None, op: str = ReduceOp.SUM, group: Optional[Group] = None, sync_op: bool = True) -> Any:
+    axis_name = _axis(group)
+    if axis_name is None:
+        return tensor_list if tensor_list is not None else tensor
+
+    def fn(x: Any) -> Any:
+        return jax.lax.psum_scatter(x, axis_name, tiled=True)
+
+    src = tensor_list if tensor_list is not None else tensor
+    return _apply(src, fn)
+
+
+def broadcast(tensor: Any, src: int = 0, group: Optional[Group] = None, sync_op: bool = True) -> Any:
+    axis_name = _axis(group)
+    if axis_name is None:
+        return tensor
+
+    def fn(x: Any) -> Any:
+        # select src rank's value on every member
+        return jax.lax.all_gather(x, axis_name)[src]
+
+    result = _apply(tensor, fn)
+    if isinstance(tensor, Tensor) and isinstance(result, Tensor):
+        tensor._replace_(result)
+        return tensor
+    return result
+
+
+def scatter(tensor: Any, tensor_list: Any = None, src: int = 0, group: Optional[Group] = None, sync_op: bool = True) -> Any:
+    axis_name = _axis(group)
+    if axis_name is None:
+        return tensor
+
+    def fn(x: Any) -> Any:
+        idx = jax.lax.axis_index(axis_name)
+        return jax.lax.all_gather(x, axis_name)[src][idx]
+
+    return _apply(tensor_list if tensor_list is not None else tensor, fn)
+
+
+def alltoall(out_tensor_list: Any, in_tensor_list: Any, group: Optional[Group] = None, sync_op: bool = True) -> Any:
+    axis_name = _axis(group)
+    if axis_name is None:
+        if isinstance(out_tensor_list, list):
+            out_tensor_list.extend(in_tensor_list)
+        return out_tensor_list
+
+    from paddle_tpu.ops.manipulation import stack, unbind
+
+    stacked = stack(in_tensor_list, axis=0) if isinstance(in_tensor_list, list) else in_tensor_list
+
+    def fn(x: Any) -> Any:
+        return jax.lax.all_to_all(x, axis_name, split_axis=0, concat_axis=0, tiled=False)
+
+    result = _apply(stacked, fn)
+    if isinstance(out_tensor_list, list):
+        out_tensor_list.extend(unbind(result, axis=0))
+        return out_tensor_list
+    return result
+
+
+def alltoall_single(
+    out_tensor: Any,
+    in_tensor: Any,
+    in_split_sizes: Any = None,
+    out_split_sizes: Any = None,
+    group: Optional[Group] = None,
+    sync_op: bool = True,
+) -> Any:
+    axis_name = _axis(group)
+    if axis_name is None:
+        return in_tensor
+
+    def fn(x: Any) -> Any:
+        return jax.lax.all_to_all(x, axis_name, split_axis=0, concat_axis=0, tiled=True)
+
+    return _apply(in_tensor, fn)
+
+
+def send(tensor: Any, dst: int = 0, group: Optional[Group] = None, sync_op: bool = True) -> Any:
+    axis_name = _axis(group)
+    if axis_name is None:
+        return tensor
+
+    def fn(x: Any) -> Any:
+        n = jax.lax.axis_size(axis_name)
+        return jax.lax.ppermute(x, axis_name, [(i, dst) for i in range(n)])
+
+    return _apply(tensor, fn)
+
+
+def recv(tensor: Any, src: int = 0, group: Optional[Group] = None, sync_op: bool = True) -> Any:
+    axis_name = _axis(group)
+    if axis_name is None:
+        return tensor
+
+    def fn(x: Any) -> Any:
+        n = jax.lax.axis_size(axis_name)
+        return jax.lax.ppermute(x, axis_name, [(src, i) for i in range(n)])
+
+    result = _apply(tensor, fn)
+    if isinstance(tensor, Tensor) and isinstance(result, Tensor):
+        tensor._replace_(result)
+        return tensor
+    return result
+
+
+isend = send
+irecv = recv
+
+
+def barrier(group: Optional[Group] = None) -> None:
+    """Device-level barrier: flush async dispatch."""
+    from paddle_tpu.core.device import device
+
+    device.synchronize()
+
+
+class stream:  # noqa: N801 - submodule-style namespace (communication.stream parity)
+    all_reduce = staticmethod(all_reduce)
+    all_gather = staticmethod(all_gather)
+    reduce_scatter = staticmethod(reduce_scatter)
+    broadcast = staticmethod(broadcast)
+    alltoall = staticmethod(alltoall)
+    send = staticmethod(send)
+    recv = staticmethod(recv)

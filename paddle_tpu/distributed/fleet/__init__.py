@@ -1,0 +1,13 @@
+"""Fleet facade (reference ``python/paddle/distributed/fleet``)."""
+
+from paddle_tpu.distributed.fleet.base.distributed_strategy import DistributedStrategy  # noqa: F401
+from paddle_tpu.distributed.fleet.base.topology import (  # noqa: F401
+    CommunicateTopology,
+    HybridCommunicateGroup,
+)
+from paddle_tpu.distributed.fleet.fleet import (  # noqa: F401
+    distributed_model,
+    distributed_optimizer,
+    get_hybrid_communicate_group,
+    init,
+)

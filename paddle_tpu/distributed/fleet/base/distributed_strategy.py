@@ -1,0 +1,44 @@
+"""DistributedStrategy (reference ``fleet/base/distributed_strategy.py`` backed
+by ``distributed_strategy.proto``). Plain-python config object with the same
+field surface; on TPU most toggles select sharding/mesh layouts rather than
+NCCL behaviors."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+
+class DistributedStrategy:
+    def __init__(self) -> None:
+        self.amp = False
+        self.amp_configs: Dict[str, Any] = {
+            "init_loss_scaling": 32768.0,
+            "use_pure_fp16": False,
+            "use_bf16": True,
+        }
+        self.recompute = False
+        self.recompute_configs: Dict[str, Any] = {"checkpoints": []}
+        self.pipeline = False
+        self.pipeline_configs: Dict[str, Any] = {"accumulate_steps": 1, "micro_batch_size": 1}
+        self.tensor_parallel = False
+        self.tensor_parallel_configs: Dict[str, Any] = {"tensor_parallel_degree": 1}
+        self.sharding = False
+        self.sharding_configs: Dict[str, Any] = {"sharding_degree": 1, "stage": 1}
+        self.hybrid_configs: Dict[str, Any] = {
+            "dp_degree": 1,
+            "mp_degree": 1,
+            "pp_degree": 1,
+            "sharding_degree": 1,
+            "sep_degree": 1,
+            "order": ["dp", "pp", "sharding", "sep", "mp"],
+        }
+        self.gradient_merge = False
+        self.gradient_merge_configs: Dict[str, Any] = {"k_steps": 1}
+        self.lamb = False
+        self.dgc = False
+        self.find_unused_parameters = False
+        self.gradient_scale_configs: Dict[str, Any] = {"scale_strategy": "avg"}
+
+    def __repr__(self) -> str:
+        fields = {k: v for k, v in self.__dict__.items()}
+        return f"DistributedStrategy({fields})"

@@ -1,0 +1,182 @@
+"""Hybrid-parallel topology (reference ``fleet/base/topology.py``:
+``CommunicateTopology:70``, ``HybridCommunicateGroup:189``).
+
+Builds the nd-mesh over axes [dp, pp, sharding, sep, mp] and exposes per-axis
+"communication groups". TPU-native: each axis IS a mesh dimension of one
+``ProcessMesh``; a Group carries the axis name so collectives inside shard_map
+regions bind to the right ICI ring — no per-axis NCCL communicator creation.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from paddle_tpu.distributed.collective import Group, new_group
+from paddle_tpu.distributed.mesh import ProcessMesh, set_mesh
+
+
+class CommunicateTopology:
+    def __init__(
+        self,
+        hybrid_group_names: Sequence[str] = ("data", "pipe", "sharding", "sep", "model"),
+        dims: Sequence[int] = (1, 1, 1, 1, 1),
+    ) -> None:
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = itertools.product(*[range(d) for d in self._dims])
+        self._coord_map: Dict[Tuple[int, ...], int] = {}
+        self._rank_map: Dict[int, Tuple[int, ...]] = {}
+        for rank, coord in enumerate(itertools.product(*[range(d) for d in self._dims])):
+            self._coord_map[coord] = rank
+            self._rank_map[rank] = coord
+
+    def get_hybrid_group_names(self) -> List[str]:
+        return self._parallel_names
+
+    def get_dim(self, axis_name: str) -> int:
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self) -> int:
+        return int(np.prod(self._dims))
+
+    def get_rank(self, **args: int) -> int:
+        coord = tuple(args[name] for name in self._parallel_names)
+        return self._coord_map[coord]
+
+    def get_coord(self, rank: int) -> Tuple[int, ...]:
+        return self._rank_map[rank]
+
+    def get_axis_list(self, axis_name: str, index: int) -> List[int]:
+        axis = self._parallel_names.index(axis_name)
+        return sorted(r for coord, r in self._coord_map.items() if coord[axis] == index)
+
+    def get_comm_list(self, axis_name: str) -> List[List[int]]:
+        """All groups along axis_name: ranks varying along that axis only."""
+        axis = self._parallel_names.index(axis_name)
+        other_dims = [range(d) for i, d in enumerate(self._dims) if i != axis]
+        comm_list = []
+        for other_coord in itertools.product(*other_dims):
+            ranks = []
+            for i in range(self._dims[axis]):
+                coord = list(other_coord)
+                coord.insert(axis, i)
+                ranks.append(self._coord_map[tuple(coord)])
+            comm_list.append(ranks)
+        return comm_list
+
+    def get_rank_from_stage(self, global_rank: int, **kwargs: int) -> int:
+        coord = list(self.get_coord(global_rank))
+        for name, value in kwargs.items():
+            coord[self._parallel_names.index(name)] = value
+        return self._coord_map[tuple(coord)]
+
+
+class HybridCommunicateGroup:
+    """Per-axis groups + the global ProcessMesh for SPMD lowering."""
+
+    def __init__(self, topology: CommunicateTopology) -> None:
+        self._topo = topology
+        self.global_rank = 0
+        self._dp_degree = self._topo.get_dim("data")
+        self._pp_degree = self._topo.get_dim("pipe")
+        self._sharding_degree = self._topo.get_dim("sharding")
+        self._sep_degree = self._topo.get_dim("sep") if "sep" in topology.get_hybrid_group_names() else 1
+        self._mp_degree = self._topo.get_dim("model")
+        # the single SPMD mesh: axis order mirrors the reference's topology
+        names_map = {"data": "dp", "pipe": "pp", "sharding": "sharding", "sep": "sep", "model": "mp"}
+        dims, names = [], []
+        for name in self._topo.get_hybrid_group_names():
+            dims.append(self._topo.get_dim(name))
+            names.append(names_map.get(name, name))
+        self._mesh = ProcessMesh(shape=dims, dim_names=names, process_ids=list(range(int(np.prod(dims)))))
+        set_mesh(self._mesh)
+        self._dp_group = new_group(self._topo.get_comm_list("data")[0], axis_name="dp")
+        self._pp_group = new_group(self._topo.get_comm_list("pipe")[0], axis_name="pp")
+        self._sharding_group = new_group(self._topo.get_comm_list("sharding")[0], axis_name="sharding")
+        self._mp_group = new_group(self._topo.get_comm_list("model")[0], axis_name="mp")
+        self._sep_group = (
+            new_group(self._topo.get_comm_list("sep")[0], axis_name="sep") if self._sep_degree > 1 else None
+        )
+
+    @property
+    def topology(self) -> CommunicateTopology:
+        return self._topo
+
+    def get_parallel_mesh(self) -> ProcessMesh:
+        return self._mesh
+
+    # data parallel
+    def get_data_parallel_rank(self) -> int:
+        return 0
+
+    def get_data_parallel_world_size(self) -> int:
+        return self._dp_degree
+
+    def get_data_parallel_group(self) -> Group:
+        return self._dp_group
+
+    def get_data_parallel_group_src_rank(self) -> int:
+        return self._dp_group.ranks[0]
+
+    # model (tensor) parallel
+    def get_model_parallel_rank(self) -> int:
+        return 0
+
+    def get_model_parallel_world_size(self) -> int:
+        return self._mp_degree
+
+    def get_model_parallel_group(self) -> Group:
+        return self._mp_group
+
+    def get_model_parallel_group_src_rank(self) -> int:
+        return self._mp_group.ranks[0]
+
+    # pipeline parallel
+    def get_stage_id(self) -> int:
+        return 0
+
+    def get_pipe_parallel_rank(self) -> int:
+        return 0
+
+    def get_pipe_parallel_world_size(self) -> int:
+        return self._pp_degree
+
+    def get_pipe_parallel_group(self) -> Group:
+        return self._pp_group
+
+    def get_p2p_groups(self) -> Any:
+        return None
+
+    # sharding
+    def get_sharding_parallel_rank(self) -> int:
+        return 0
+
+    def get_sharding_parallel_world_size(self) -> int:
+        return self._sharding_degree
+
+    def get_sharding_parallel_group(self) -> Group:
+        return self._sharding_group
+
+    def get_sharding_parallel_group_src_rank(self) -> int:
+        return self._sharding_group.ranks[0]
+
+    # sep
+    def get_sep_parallel_rank(self) -> int:
+        return 0
+
+    def get_sep_parallel_world_size(self) -> int:
+        return self._sep_degree
+
+    def get_sep_parallel_group(self) -> Optional[Group]:
+        return self._sep_group
+
+    def get_check_parallel_group(self, sharding: bool = False) -> Group:
+        return self._mp_group
+
+    def get_rank_from_stage(self, stage_id: int, **kwargs: int) -> int:
+        return self._topo.get_rank_from_stage(self.global_rank, pipe=stage_id, **kwargs)

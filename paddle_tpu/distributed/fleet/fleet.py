@@ -1,0 +1,98 @@
+"""Fleet facade (reference ``fleet/fleet.py`` ``init:218``,
+``distributed_model`` dispatch ``fleet/model.py:133-175``,
+``distributed_optimizer:1427``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from paddle_tpu.distributed.fleet.base.distributed_strategy import DistributedStrategy
+from paddle_tpu.distributed.fleet.base.topology import (
+    CommunicateTopology,
+    HybridCommunicateGroup,
+)
+
+_hcg: Optional[HybridCommunicateGroup] = None
+_strategy: Optional[DistributedStrategy] = None
+
+
+def init(
+    role_maker: Any = None,
+    is_collective: bool = True,
+    strategy: Optional[DistributedStrategy] = None,
+) -> None:
+    """Build the hybrid topology from strategy.hybrid_configs and set the
+    global mesh (reference builds HybridCommunicateGroup + NCCL groups; here
+    one ProcessMesh + axis-named groups)."""
+    global _hcg, _strategy
+    _strategy = strategy or DistributedStrategy()
+    hc = _strategy.hybrid_configs
+    order = hc.get("order", ["dp", "pp", "sharding", "sep", "mp"])
+    name_map = {"dp": "data", "pp": "pipe", "sharding": "sharding", "sep": "sep", "mp": "model"}
+    degree_map = {
+        "dp": hc.get("dp_degree", 1),
+        "pp": hc.get("pp_degree", 1),
+        "sharding": hc.get("sharding_degree", 1),
+        "sep": hc.get("sep_degree", 1),
+        "mp": hc.get("mp_degree", 1),
+    }
+    topo = CommunicateTopology(
+        hybrid_group_names=[name_map[o] for o in order],
+        dims=[degree_map[o] for o in order],
+    )
+    _hcg = HybridCommunicateGroup(topo)
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _hcg
+
+
+def get_strategy() -> Optional[DistributedStrategy]:
+    return _strategy
+
+
+def distributed_model(model: Any) -> Any:
+    """Wrap by parallel mode (reference ``fleet/model.py:32``). With SPMD
+    shardings most wrapping is unnecessary; DP input sharding is applied when
+    dp_degree > 1 and no other parallelism needs model code cooperation."""
+    if _hcg is None:
+        init()
+    from paddle_tpu.distributed.parallel import DataParallel
+
+    if (
+        _hcg.get_data_parallel_world_size() > 1
+        and _hcg.get_model_parallel_world_size() == 1
+        and _hcg.get_pipe_parallel_world_size() == 1
+    ):
+        return DataParallel(model)
+    return model
+
+
+def distributed_optimizer(optimizer: Any, strategy: Optional[DistributedStrategy] = None) -> Any:
+    """Hybrid-parallel optimizer wrap (reference HybridParallelOptimizer):
+    sharded state when a sharding axis exists."""
+    if _hcg is not None and _hcg.get_sharding_parallel_world_size() > 1:
+        from paddle_tpu.distributed.api import shard_optimizer
+
+        return shard_optimizer(optimizer)
+    return optimizer
+
+
+class fleet_worker_utils:  # pragma: no cover - namespace stub for scripts
+    pass
+
+
+def worker_index() -> int:
+    from paddle_tpu.distributed.parallel import get_rank
+
+    return get_rank()
+
+
+def worker_num() -> int:
+    from paddle_tpu.distributed.parallel import get_world_size
+
+    return get_world_size()
+
+
+def is_first_worker() -> bool:
+    return worker_index() == 0

@@ -1,0 +1,117 @@
+"""ProcessMesh over jax.sharding.Mesh.
+
+Reference: ``python/paddle/distributed/auto_parallel/process_mesh.py`` +
+``phi::distributed::ProcessMesh`` (``process_mesh.h``). On TPU the mesh maps
+onto the physical ICI torus via jax's device assignment; DCN (multi-slice)
+axes go first (``jax.make_mesh`` handles allocation order).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+class ProcessMesh:
+    def __init__(
+        self,
+        mesh: Union[Sequence[Any], np.ndarray, None] = None,
+        dim_names: Optional[Sequence[str]] = None,
+        shape: Optional[Sequence[int]] = None,
+        process_ids: Optional[Sequence[int]] = None,
+    ) -> None:
+        if mesh is not None:
+            arr = np.asarray(mesh)
+            self._shape = list(arr.shape)
+            self._process_ids = arr.reshape(-1).tolist()
+        else:
+            self._shape = list(shape or [])
+            self._process_ids = list(process_ids or range(int(np.prod(self._shape))))
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(len(self._shape))]
+        self._dim_names = list(dim_names)
+        self._jax_mesh: Optional[Mesh] = None
+
+    @property
+    def shape(self) -> List[int]:
+        return list(self._shape)
+
+    @property
+    def ndim(self) -> int:
+        return len(self._shape)
+
+    @property
+    def dim_names(self) -> List[str]:
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self) -> List[int]:
+        return list(self._process_ids)
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self._shape)) if self._shape else 1
+
+    def get_dim_size(self, name: str) -> int:
+        return self._shape[self._dim_names.index(name)]
+
+    def get_mesh_with_dim(self, name: str) -> "ProcessMesh":
+        axis = self._dim_names.index(name)
+        perm = [axis] + [i for i in range(self.ndim) if i != axis]
+        arr = np.asarray(self._process_ids).reshape(self._shape).transpose(perm)
+        names = [self._dim_names[i] for i in perm]
+        return ProcessMesh(arr, names)
+
+    def jax_mesh(self) -> Mesh:
+        """Materialize the jax Mesh over real devices (cached)."""
+        if self._jax_mesh is None:
+            devices = jax.devices()
+            dev_map = {d.id: d for d in devices}
+            try:
+                chosen = np.asarray(
+                    [dev_map[i] for i in self._process_ids], dtype=object
+                ).reshape(self._shape)
+            except KeyError:
+                # process ids are logical ranks; fall back to positional devices
+                chosen = np.asarray(devices[: self.size], dtype=object).reshape(self._shape)
+            self._jax_mesh = Mesh(chosen, tuple(self._dim_names))
+        return self._jax_mesh
+
+    def __eq__(self, other: object) -> bool:
+        return (
+            isinstance(other, ProcessMesh)
+            and other._shape == self._shape
+            and other._process_ids == self._process_ids
+            and other._dim_names == self._dim_names
+        )
+
+    def __hash__(self) -> int:
+        return hash((tuple(self._shape), tuple(self._process_ids), tuple(self._dim_names)))
+
+    def __repr__(self) -> str:
+        return f"ProcessMesh(shape={self._shape}, dim_names={self._dim_names})"
+
+
+_global_mesh: Optional[ProcessMesh] = None
+
+
+def set_mesh(mesh: ProcessMesh) -> None:
+    global _global_mesh
+    _global_mesh = mesh
+
+
+def get_mesh() -> Optional[ProcessMesh]:
+    return _global_mesh
+
+
+def init_mesh(dim_names: Sequence[str], shape: Optional[Sequence[int]] = None) -> ProcessMesh:
+    """Build a mesh over all visible devices (``jax.make_mesh`` analog)."""
+    n = len(jax.devices())
+    if shape is None:
+        shape = [n]
+    mesh = ProcessMesh(shape=list(shape), dim_names=list(dim_names), process_ids=list(range(int(np.prod(shape)))))
+    set_mesh(mesh)
+    return mesh

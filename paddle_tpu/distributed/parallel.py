@@ -1,0 +1,152 @@
+"""Parallel environment + DataParallel.
+
+Reference: ``python/paddle/distributed/parallel.py`` (``init_parallel_env:978``,
+``DataParallel:219``). TPU-native model: single-controller SPMD — one Python
+process drives all chips; "rank" is the process index (multi-host) and
+data-parallelism is expressed by sharding the batch over a mesh axis, with
+gradient reduction handled by XLA's sharding propagation instead of an
+EagerReducer + NCCL allreduce (``paddle/fluid/distributed/collective/reducer.cc``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, List, Optional
+
+import jax
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed.mesh import ProcessMesh, get_mesh, init_mesh
+from paddle_tpu.nn.layer.layers import Layer
+
+__all__ = [
+    "init_parallel_env",
+    "get_rank",
+    "get_world_size",
+    "is_initialized",
+    "DataParallel",
+    "ParallelEnv",
+]
+
+_initialized = [False]
+
+
+def init_parallel_env() -> "ParallelEnv":
+    """Initialize the distributed runtime. Multi-host: wires
+    ``jax.distributed`` from env vars (coordination service = the TCPStore
+    analog); single-host: no-op beyond mesh defaulting."""
+    if _initialized[0]:
+        return ParallelEnv()
+    coord = os.environ.get("PADDLE_MASTER") or os.environ.get("MASTER_ADDR")
+    nnodes = int(os.environ.get("PADDLE_NNODES", "1"))
+    if coord and nnodes > 1:  # pragma: no cover - requires real multi-host
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=nnodes,
+            process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")),
+        )
+    if get_mesh() is None:
+        init_mesh(["dp"], [len(jax.devices())])
+    _initialized[0] = True
+    return ParallelEnv()
+
+
+def is_initialized() -> bool:
+    return _initialized[0]
+
+
+def get_rank(group: Any = None) -> int:
+    return jax.process_index()
+
+
+def get_world_size(group: Any = None) -> int:
+    if group is not None and hasattr(group, "nranks"):
+        return group.nranks
+    return jax.process_count()
+
+
+class ParallelEnv:
+    @property
+    def rank(self) -> int:
+        return get_rank()
+
+    @property
+    def world_size(self) -> int:
+        return get_world_size()
+
+    @property
+    def device_id(self) -> int:
+        return 0
+
+    @property
+    def nranks(self) -> int:
+        return get_world_size()
+
+    @property
+    def local_rank(self) -> int:
+        return get_rank()
+
+
+class DataParallel(Layer):
+    """Data-parallel wrapper (reference ``parallel.py:219``).
+
+    Shards the leading (batch) dim of inputs over the 'dp' mesh axis and keeps
+    parameters replicated. Gradient all-reduce is implicit: contracting a
+    batch-sharded activation against a replicated parameter in backward makes
+    XLA emit the reduction (the EagerReducer's fused allreduce, moved into the
+    compiler).
+    """
+
+    def __init__(
+        self,
+        layers: Layer,
+        strategy: Any = None,
+        comm_buffer_size: int = 25,
+        last_comm_buffer_size: int = 1,
+        find_unused_parameters: bool = False,
+        group: Any = None,
+    ) -> None:
+        super().__init__()
+        self._layers = layers
+        mesh = get_mesh()
+        if mesh is None:
+            mesh = init_mesh(["dp"], [len(jax.devices())])
+        self._mesh = mesh
+        self._dp_axis = mesh.dim_names[0]
+        # replicate parameters across the mesh
+        from paddle_tpu.distributed.api import shard_tensor
+        from paddle_tpu.distributed.placements import Replicate
+
+        import paddle_tpu
+
+        with paddle_tpu.no_grad():
+            for p in layers.parameters():
+                d = shard_tensor(p, mesh, [Replicate() for _ in range(mesh.ndim)])
+                p._data = d._data
+
+    def _shard_input(self, x: Any) -> Any:
+        if not isinstance(x, Tensor) or x.ndim == 0:
+            return x
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        spec = PartitionSpec(self._dp_axis, *([None] * (x.ndim - 1)))
+        arr = jax.device_put(x._data, NamedSharding(self._mesh.jax_mesh(), spec))
+        out = Tensor(arr, stop_gradient=x.stop_gradient)
+        return out
+
+    def forward(self, *inputs: Any, **kwargs: Any) -> Any:
+        inputs = tuple(self._shard_input(x) for x in inputs)
+        kwargs = {k: self._shard_input(v) for k, v in kwargs.items()}
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *args: Any, **kwargs: Any) -> Any:
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, *args: Any, **kwargs: Any) -> Any:
+        return self._layers.set_state_dict(*args, **kwargs)
+
+    def scale_loss(self, loss: Tensor) -> Tensor:
+        return loss
+
+    def apply_collective_grads(self) -> None:
+        """No-op: gradient reduction is emitted by XLA (see class docstring)."""

@@ -1,0 +1,105 @@
+"""Placements: Shard / Replicate / Partial.
+
+Reference: ``paddle/phi/core/distributed/auto_parallel/placement_types.h`` and
+``python/paddle/distributed`` placement API. Mapped onto
+``jax.sharding.PartitionSpec`` axes for GSPMD propagation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+from jax.sharding import NamedSharding, PartitionSpec
+
+
+class Placement:
+    def is_shard(self, dim: Optional[int] = None) -> bool:
+        return False
+
+    def is_replicated(self) -> bool:
+        return False
+
+    def is_partial(self) -> bool:
+        return False
+
+
+class Shard(Placement):
+    def __init__(self, dim: int) -> None:
+        self.dim = int(dim)
+
+    def get_dim(self) -> int:
+        return self.dim
+
+    def is_shard(self, dim: Optional[int] = None) -> bool:
+        return dim is None or dim == self.dim
+
+    def __repr__(self) -> str:
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self) -> int:
+        return hash(("shard", self.dim))
+
+
+class Replicate(Placement):
+    def is_replicated(self) -> bool:
+        return True
+
+    def __repr__(self) -> str:
+        return "Replicate()"
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, Replicate)
+
+    def __hash__(self) -> int:
+        return hash("replicate")
+
+
+class Partial(Placement):
+    """Pending-reduction placement. GSPMD materializes partial values only
+    transiently; a Partial DistTensor is represented as an unreduced value and
+    ``reshard`` inserts the psum (reference ``p_to_r_reshard_function.cc``)."""
+
+    def __init__(self, reduce_type: str = "sum") -> None:
+        self.reduce_type = reduce_type
+
+    def is_partial(self) -> bool:
+        return True
+
+    def __repr__(self) -> str:
+        return f"Partial({self.reduce_type})"
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, Partial) and other.reduce_type == self.reduce_type
+
+    def __hash__(self) -> int:
+        return hash(("partial", self.reduce_type))
+
+
+def placements_to_spec(placements: Sequence[Placement], ndim: int, mesh_dim_names: Sequence[str]) -> PartitionSpec:
+    """Convert per-mesh-dim placements to a PartitionSpec over tensor dims."""
+    entries: List[Any] = [None] * ndim
+    for mesh_dim, p in enumerate(placements):
+        if isinstance(p, Shard):
+            axis_name = mesh_dim_names[mesh_dim]
+            d = p.dim % ndim
+            if entries[d] is None:
+                entries[d] = axis_name
+            elif isinstance(entries[d], tuple):
+                entries[d] = entries[d] + (axis_name,)
+            else:
+                entries[d] = (entries[d], axis_name)
+    return PartitionSpec(*entries)
+
+
+def spec_to_placements(spec: PartitionSpec, mesh_dim_names: Sequence[str]) -> List[Placement]:
+    placements: List[Placement] = [Replicate() for _ in mesh_dim_names]
+    for tensor_dim, entry in enumerate(spec):
+        if entry is None:
+            continue
+        names = entry if isinstance(entry, tuple) else (entry,)
+        for name in names:
+            placements[list(mesh_dim_names).index(name)] = Shard(tensor_dim)
+    return placements

@@ -1,0 +1,66 @@
+"""Error taxonomy + enforce helpers.
+
+Counterpart of the reference's ``paddle/common/errors.h`` error-type taxonomy and
+``paddle/common/enforce.h`` PADDLE_ENFORCE macros: typed exceptions with
+actionable messages, and small check helpers used across the framework.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Optional, Sequence
+
+
+class PaddleTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class InvalidArgumentError(PaddleTpuError, ValueError):
+    pass
+
+
+class NotFoundError(PaddleTpuError, KeyError):
+    pass
+
+
+class OutOfRangeError(PaddleTpuError, IndexError):
+    pass
+
+
+class AlreadyExistsError(PaddleTpuError):
+    pass
+
+
+class PreconditionNotMetError(PaddleTpuError, RuntimeError):
+    pass
+
+
+class UnimplementedError(PaddleTpuError, NotImplementedError):
+    pass
+
+
+class UnavailableError(PaddleTpuError, RuntimeError):
+    pass
+
+
+class ExecutionTimeoutError(PaddleTpuError, TimeoutError):
+    pass
+
+
+def enforce(cond: Any, msg: str, exc: type = InvalidArgumentError) -> None:
+    if not cond:
+        raise exc(msg)
+
+
+def enforce_eq(a: Any, b: Any, what: str = "value") -> None:
+    if a != b:
+        raise InvalidArgumentError(f"expected {what} == {b!r}, got {a!r}")
+
+
+def enforce_in(value: Any, allowed: Sequence[Any], what: str = "value") -> None:
+    if value not in allowed:
+        raise InvalidArgumentError(f"expected {what} in {list(allowed)!r}, got {value!r}")
+
+
+def enforce_shape_rank(shape: Sequence[int], rank: int, what: str = "tensor") -> None:
+    if len(shape) != rank:
+        raise InvalidArgumentError(f"expected {what} of rank {rank}, got shape {tuple(shape)}")

@@ -1,0 +1,121 @@
+"""Process-global flag registry.
+
+TPU-native counterpart of the reference's flag system (``paddle/common/flags.cc``,
+179 ``PHI_DEFINE_EXPORTED_*`` flags; registry macros ``paddle/common/flags.h:93``):
+a typed registry of named flags, settable programmatically via
+``paddle_tpu.set_flags`` / readable via ``get_flags``, with ``FLAGS_<name>``
+environment variables honoured at first read (matching the reference's env-var
+export convention).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterable, List, Optional, Union
+
+
+@dataclass
+class _Flag:
+    name: str
+    type: type
+    default: Any
+    help: str
+    value: Any = None
+    env_read: bool = False
+
+
+class FlagRegistry:
+    """Typed flag registry; thread-safe; env ``FLAGS_<name>`` seeds the value."""
+
+    def __init__(self) -> None:
+        self._flags: Dict[str, _Flag] = {}
+        self._lock = threading.RLock()
+
+    def define(self, name: str, type_: type, default: Any, help_: str = "") -> None:
+        with self._lock:
+            if name in self._flags:
+                raise ValueError(f"flag '{name}' already defined")
+            self._flags[name] = _Flag(name=name, type=type_, default=default, help=help_, value=default)
+
+    def _coerce(self, flag: _Flag, value: Any) -> Any:
+        if flag.type is bool:
+            if isinstance(value, str):
+                return value.strip().lower() in ("1", "true", "yes", "on")
+            return bool(value)
+        return flag.type(value)
+
+    def _maybe_read_env(self, flag: _Flag) -> None:
+        if not flag.env_read:
+            env = os.environ.get(f"FLAGS_{flag.name}")
+            if env is not None:
+                flag.value = self._coerce(flag, env)
+            flag.env_read = True
+
+    def get(self, name: str) -> Any:
+        with self._lock:
+            if name not in self._flags:
+                raise KeyError(f"unknown flag '{name}'; known flags: {sorted(self._flags)}")
+            flag = self._flags[name]
+            self._maybe_read_env(flag)
+            return flag.value
+
+    def set(self, name: str, value: Any) -> None:
+        with self._lock:
+            if name not in self._flags:
+                raise KeyError(f"unknown flag '{name}'")
+            flag = self._flags[name]
+            flag.env_read = True
+            flag.value = self._coerce(flag, value)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._flags)
+
+
+GLOBAL_FLAGS = FlagRegistry()
+
+
+def _define_builtin_flags() -> None:
+    d = GLOBAL_FLAGS.define
+    d("check_nan_inf", bool, False, "Scan op outputs for NaN/Inf after every eager op (debug).")
+    d("check_nan_inf_level", int, 0, "0: raise on nan/inf; 1: warn; 3: collect stats only.")
+    d("eager_op_cache_size", int, 4096, "Max entries in the eager per-op compiled-executable cache.")
+    d("use_pallas_attention", bool, True, "Use Pallas flash-attention kernels on TPU when applicable.")
+    d("benchmark", bool, False, "Block on every op (sync dispatch) for timing.")
+    d("log_memory_stats", bool, False, "Log live/peak device memory stats per allocation event.")
+    d("allocator_strategy", str, "xla", "Allocator backing; on TPU the XLA/PJRT allocator owns HBM.")
+    d("cudnn_deterministic", bool, False, "Deterministic op selection (maps to XLA determinism flags).")
+    d("embedding_deterministic", int, 0, "Deterministic embedding grad accumulation level.")
+    d("init_allocated_mem", bool, False, "Compat no-op: PJRT zero-initialises buffers.")
+    d("max_inflight_ops", int, 256, "Async eager dispatch depth before forcing a sync.")
+    d("flash_attn_version", int, 2, "Flash-attention algorithm family for the Pallas kernels.")
+    d("dist_timeout_seconds", int, 1800, "Collective watchdog timeout (comm_task_manager parity).")
+    d("tracer_mkldnn_ops_on", str, "", "Compat no-op on TPU.")
+    d("use_stride_kernel", bool, False, "Compat: XLA owns layouts; stride kernels do not apply.")
+
+
+_define_builtin_flags()
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    """Set one or more global flags. Mirrors ``paddle.set_flags``."""
+    for k, v in flags.items():
+        GLOBAL_FLAGS.set(k.removeprefix("FLAGS_"), v)
+
+
+def get_flags(flags: Union[str, Iterable[str], None] = None) -> Dict[str, Any]:
+    """Read one, several, or all global flags. Mirrors ``paddle.get_flags``."""
+    if flags is None:
+        names: Iterable[str] = GLOBAL_FLAGS.names()
+    elif isinstance(flags, str):
+        names = [flags]
+    else:
+        names = flags
+    return {n: GLOBAL_FLAGS.get(n.removeprefix("FLAGS_")) for n in names}
+
+
+def define_flag(name: str, type_: type, default: Any, help_: str = "") -> None:
+    """Register a new flag (used by subsystems at import time)."""
+    GLOBAL_FLAGS.define(name, type_, default, help_)

@@ -1,0 +1,3 @@
+"""Framework glue: save/load IO, ParamAttr, random compat."""
+
+from paddle_tpu.framework.param_attr import ParamAttr  # noqa: F401

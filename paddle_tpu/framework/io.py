@@ -1,0 +1,49 @@
+"""paddle.save / paddle.load (reference ``python/paddle/framework/io.py:773/:1020``).
+
+Pickle-compatible state_dict serialization: Tensors are stored as numpy
+arrays (bfloat16 kept via ml_dtypes-aware numpy)."""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Dict
+
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+
+
+def _to_serializable(obj: Any) -> Any:
+    if isinstance(obj, Tensor):
+        return {"__paddle_tpu_tensor__": True, "data": np.asarray(obj.numpy()), "name": obj.name}
+    if isinstance(obj, dict):
+        return {k: _to_serializable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_serializable(v) for v in obj)
+    return obj
+
+
+def _from_serializable(obj: Any) -> Any:
+    if isinstance(obj, dict):
+        if obj.get("__paddle_tpu_tensor__"):
+            t = Tensor(obj["data"])
+            t.name = obj.get("name", t.name)
+            return t
+        return {k: _from_serializable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_from_serializable(v) for v in obj)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = 4, **configs: Any) -> None:
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_serializable(obj), f, protocol=protocol)
+
+
+def load(path: str, **configs: Any) -> Any:
+    with open(path, "rb") as f:
+        return _from_serializable(pickle.load(f))

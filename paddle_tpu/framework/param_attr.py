@@ -1,0 +1,38 @@
+"""ParamAttr: parameter attribute bundle (reference ``python/paddle/base/param_attr.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+class ParamAttr:
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        initializer: Any = None,
+        learning_rate: float = 1.0,
+        regularizer: Any = None,
+        trainable: bool = True,
+        do_model_average: bool = True,
+        need_clip: bool = True,
+    ) -> None:
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.do_model_average = do_model_average
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(attr: Any) -> Optional["ParamAttr"]:
+        if attr is None:
+            return ParamAttr()
+        if isinstance(attr, ParamAttr):
+            return attr
+        if attr is False:
+            return None
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        # an Initializer instance
+        return ParamAttr(initializer=attr)

@@ -1,0 +1,17 @@
+"""Device RNG state compat shims (reference ``python/paddle/framework/random.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+from paddle_tpu.core.rng import get_rng_state, set_rng_state
+
+
+def get_cuda_rng_state() -> List[Any]:
+    """Accelerator RNG state (name kept for script compat; returns the global
+    splittable-PRNG state)."""
+    return [get_rng_state()]
+
+
+def set_cuda_rng_state(state: List[Any]) -> None:
+    set_rng_state(state[0])

@@ -1,0 +1,5 @@
+"""``paddle_tpu.incubate`` (reference ``python/paddle/incubate``): fused-op
+functional surface. On TPU "fused" means the XLA/Pallas-fused composition —
+the API parity matters, the fusion is the compiler's job."""
+
+from paddle_tpu.incubate import nn  # noqa: F401

@@ -1,0 +1,187 @@
+"""Fused functional ops (reference ``python/paddle/incubate/nn/functional/``:
+fused_rms_norm, swiglu, fused_rotary_position_embedding, fused_bias_act, …).
+
+Each maps to a composition that XLA fuses on TPU (or a Pallas kernel where
+profiling says XLA's fusion is insufficient — see ``paddle_tpu.kernels``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.registry import defop
+from paddle_tpu.nn.functional.activation import swiglu  # noqa: F401
+from paddle_tpu.nn.functional.common import rms_norm
+
+__all__ = [
+    "fused_rms_norm",
+    "fused_layer_norm",
+    "swiglu",
+    "fused_rotary_position_embedding",
+    "fused_bias_act",
+    "fused_linear",
+    "fused_bias_dropout_residual_layer_norm",
+    "fused_dropout_add",
+]
+
+
+def fused_rms_norm(
+    x: Any,
+    norm_weight: Any,
+    norm_bias: Any = None,
+    epsilon: float = 1e-6,
+    begin_norm_axis: int = -1,
+    bias: Any = None,
+    residual: Any = None,
+    quant_scale: float = -1,
+    **kwargs: Any,
+) -> Tuple[Any, ...]:
+    """Reference ``fused_rms_norm`` (rms_norm kernel + optional bias/residual
+    add). Returns (out, residual_out) like the reference when residual given."""
+    if bias is not None:
+        x = x + bias
+    if residual is not None:
+        x = x + residual
+        residual_out = x
+    out = rms_norm(x, norm_weight, epsilon)
+    if norm_bias is not None:
+        out = out + norm_bias
+    if residual is not None:
+        return out, residual_out
+    return out
+
+
+def fused_layer_norm(
+    x: Any,
+    norm_weight: Any,
+    norm_bias: Any = None,
+    epsilon: float = 1e-5,
+    begin_norm_axis: int = -1,
+    bias: Any = None,
+    residual: Any = None,
+    **kwargs: Any,
+) -> Any:
+    from paddle_tpu.nn.functional.common import layer_norm
+
+    if bias is not None:
+        x = x + bias
+    if residual is not None:
+        x = x + residual
+        residual_out = x
+    out = layer_norm(x, None, norm_weight, norm_bias, epsilon)
+    if residual is not None:
+        return out, residual_out
+    return out
+
+
+@defop("fused_rotary_position_embedding", tensor_method=None)
+def _fused_rope_op(q, k, v, sin, cos, use_neox_rotary_style=True):
+    """RoPE (reference ``fused_ops.yaml:408`` fused_rotary_position_embedding;
+    kernel ``paddle/phi/kernels/fusion/gpu/fused_rope_kernel.cu``).
+    Layout [B, S, H, D]; sin/cos [1, S, 1, D] (or [S, D])."""
+
+    def rope(x):
+        if x is None:
+            return None
+        s = sin
+        c = cos
+        if s.ndim == 2:
+            s = s[None, :, None, :]
+            c = c[None, :, None, :]
+        s = s.astype(x.dtype)
+        c = c.astype(x.dtype)
+        if use_neox_rotary_style:
+            half = x.shape[-1] // 2
+            x1, x2 = x[..., :half], x[..., half:]
+            rotated = jnp.concatenate([-x2, x1], axis=-1)
+        else:
+            x1 = x[..., 0::2]
+            x2 = x[..., 1::2]
+            rotated = jnp.stack([-x2, x1], axis=-1).reshape(x.shape)
+        return x * c + rotated * s
+
+    return tuple(rope(t) for t in (q, k, v) if t is not None)
+
+
+def fused_rotary_position_embedding(
+    q: Any,
+    k: Any = None,
+    v: Any = None,
+    sin: Any = None,
+    cos: Any = None,
+    position_ids: Any = None,
+    use_neox_rotary_style: bool = True,
+    time_major: bool = False,
+    rotary_emb_base: float = 10000.0,
+) -> Tuple[Any, ...]:
+    if sin is None or cos is None:
+        # build sin/cos table from base
+        b, s, h, d = q.shape
+        inv = 1.0 / (rotary_emb_base ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+        t = jnp.arange(s, dtype=jnp.float32)
+        freqs = jnp.outer(t, inv)
+        emb = jnp.concatenate([freqs, freqs], axis=-1)
+        from paddle_tpu.core.tensor import Tensor
+
+        sin = Tensor(jnp.sin(emb))
+        cos = Tensor(jnp.cos(emb))
+    outs = _fused_rope_op(q, k, v, sin, cos, use_neox_rotary_style=use_neox_rotary_style)
+    result = list(outs) if isinstance(outs, (list, tuple)) else [outs]
+    while len(result) < 3:
+        result.append(None)
+    return tuple(result[:3])
+
+
+@defop("fused_bias_act", tensor_method=None)
+def fused_bias_act(x, bias=None, act_method="gelu", dequant_scales=None, shift=None, smooth=None, **kwargs):
+    """Reference ``fused_ops.yaml:201`` fused_bias_act."""
+    if bias is not None:
+        x = x + bias
+    if act_method in ("gelu",):
+        return jax.nn.gelu(x)
+    if act_method in ("relu",):
+        return jax.nn.relu(x)
+    if act_method in ("swiglu", "silu"):
+        if act_method == "swiglu":
+            a, b = jnp.split(x, 2, axis=-1)
+            return jax.nn.silu(a) * b
+        return jax.nn.silu(x)
+    raise ValueError(f"unsupported act_method {act_method}")
+
+
+@defop("fused_linear", tensor_method=None)
+def fused_linear(x, weight, bias=None, transpose_weight=False):
+    w = weight.T if transpose_weight else weight
+    out = jnp.matmul(x, w)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def fused_bias_dropout_residual_layer_norm(
+    x: Any,
+    residual: Any,
+    bias: Any = None,
+    ln_scale: Any = None,
+    ln_bias: Any = None,
+    dropout_rate: float = 0.0,
+    ln_epsilon: float = 1e-5,
+    training: bool = True,
+    mode: str = "upscale_in_train",
+) -> Any:
+    from paddle_tpu.nn.functional.common import dropout, layer_norm
+
+    if bias is not None:
+        x = x + bias
+    x = dropout(x, p=dropout_rate, training=training, mode=mode)
+    x = x + residual
+    return layer_norm(x, None, ln_scale, ln_bias, ln_epsilon)
+
+
+def fused_dropout_add(x: Any, y: Any, p: float = 0.5, training: bool = True, mode: str = "upscale_in_train") -> Any:
+    from paddle_tpu.nn.functional.common import dropout
+
+    return dropout(x, p=p, training=training, mode=mode) + y

@@ -1,0 +1,22 @@
+"""``paddle_tpu.io``: datasets + DataLoader (reference ``python/paddle/io``)."""
+
+from paddle_tpu.io.dataset import (  # noqa: F401
+    ChainDataset,
+    ComposeDataset,
+    ConcatDataset,
+    Dataset,
+    IterableDataset,
+    Subset,
+    TensorDataset,
+    random_split,
+)
+from paddle_tpu.io.sampler import (  # noqa: F401
+    BatchSampler,
+    DistributedBatchSampler,
+    RandomSampler,
+    Sampler,
+    SequenceSampler,
+    SubsetRandomSampler,
+    WeightedRandomSampler,
+)
+from paddle_tpu.io.dataloader import DataLoader, default_collate_fn  # noqa: F401

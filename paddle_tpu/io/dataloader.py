@@ -1,0 +1,122 @@
+"""DataLoader (reference ``python/paddle/io/dataloader/dataloader_iter.py``).
+
+Single-process and thread-prefetching loaders. The reference uses
+multiprocess workers feeding a blocking queue; on TPU the host→device copy
+overlaps with compute via PJRT async transfers, so a prefetch thread pool
+covers the same ground without fork-safety issues inside the PJRT client.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.io.dataset import Dataset, IterableDataset
+from paddle_tpu.io.sampler import BatchSampler
+
+
+def default_collate_fn(batch: Sequence[Any]) -> Any:
+    """Stack samples into batch arrays (reference ``collate.py``)."""
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        return Tensor(np.stack([np.asarray(s.numpy()) for s in batch]))
+    if isinstance(sample, np.ndarray):
+        return Tensor(np.stack(batch))
+    if isinstance(sample, (int, float)):
+        return Tensor(np.asarray(batch))
+    if isinstance(sample, (list, tuple)):
+        transposed = list(zip(*batch))
+        return type(sample)(default_collate_fn(list(items)) for items in transposed)
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([d[k] for d in batch]) for k in sample}
+    if isinstance(sample, (str, bytes)):
+        return list(batch)
+    return Tensor(np.asarray(batch))
+
+
+class DataLoader:
+    def __init__(
+        self,
+        dataset: Dataset,
+        feed_list: Any = None,
+        places: Any = None,
+        return_list: bool = True,
+        batch_sampler: Optional[BatchSampler] = None,
+        batch_size: int = 1,
+        shuffle: bool = False,
+        drop_last: bool = False,
+        collate_fn: Optional[Callable] = None,
+        num_workers: int = 0,
+        use_buffer_reader: bool = True,
+        prefetch_factor: int = 2,
+        use_shared_memory: bool = True,
+        timeout: int = 0,
+        worker_init_fn: Optional[Callable] = None,
+        persistent_workers: bool = False,
+    ) -> None:
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = max(prefetch_factor, 1)
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        if self._iterable_mode:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(
+                dataset, shuffle=shuffle, batch_size=batch_size, drop_last=drop_last
+            )
+
+    def __len__(self) -> int:
+        if self._iterable_mode:
+            raise TypeError("IterableDataset-backed DataLoader has no len()")
+        return len(self.batch_sampler)
+
+    def _iter_batches(self) -> Iterator[Any]:
+        if self._iterable_mode:
+            batch: List[Any] = []
+            for sample in self.dataset:  # type: ignore[arg-type]
+                batch.append(sample)
+                if len(batch) == self.batch_size:
+                    yield self.collate_fn(batch)
+                    batch = []
+            if batch and not self.drop_last:
+                yield self.collate_fn(batch)
+        else:
+            for indices in self.batch_sampler:
+                yield self.collate_fn([self.dataset[i] for i in indices])
+
+    def __iter__(self) -> Iterator[Any]:
+        if self.num_workers == 0:
+            yield from self._iter_batches()
+            return
+        # Thread-based prefetch pipeline.
+        q: "queue.Queue[Any]" = queue.Queue(maxsize=self.num_workers * self.prefetch_factor)
+        sentinel = object()
+        error_box: List[BaseException] = []
+
+        def producer() -> None:
+            try:
+                for batch in self._iter_batches():
+                    q.put(batch)
+            except BaseException as e:  # noqa: BLE001
+                error_box.append(e)
+            finally:
+                q.put(sentinel)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is sentinel:
+                break
+            yield item
+        if error_box:
+            raise error_box[0]

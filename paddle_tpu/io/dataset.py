@@ -1,0 +1,110 @@
+"""Dataset types (reference ``python/paddle/io/dataloader/dataset.py``)."""
+
+from __future__ import annotations
+
+import bisect
+from typing import Any, Iterable, Iterator, List, Sequence
+
+import numpy as np
+
+
+class Dataset:
+    def __getitem__(self, idx: int) -> Any:
+        raise NotImplementedError
+
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+
+class IterableDataset(Dataset):
+    def __iter__(self) -> Iterator[Any]:
+        raise NotImplementedError
+
+    def __getitem__(self, idx: int) -> Any:
+        raise TypeError("IterableDataset is not subscriptable")
+
+    def __len__(self) -> int:
+        raise TypeError("IterableDataset has no len()")
+
+
+class TensorDataset(Dataset):
+    def __init__(self, tensors: Sequence[Any]) -> None:
+        self.tensors = tensors
+
+    def __getitem__(self, idx: int) -> tuple:
+        return tuple(t[idx] for t in self.tensors)
+
+    def __len__(self) -> int:
+        return len(self.tensors[0])
+
+
+class ComposeDataset(Dataset):
+    def __init__(self, datasets: Sequence[Dataset]) -> None:
+        self.datasets = list(datasets)
+
+    def __getitem__(self, idx: int) -> tuple:
+        out: List[Any] = []
+        for ds in self.datasets:
+            item = ds[idx]
+            if isinstance(item, tuple):
+                out.extend(item)
+            else:
+                out.append(item)
+        return tuple(out)
+
+    def __len__(self) -> int:
+        return min(len(ds) for ds in self.datasets)
+
+
+class ChainDataset(IterableDataset):
+    def __init__(self, datasets: Sequence[IterableDataset]) -> None:
+        self.datasets = list(datasets)
+
+    def __iter__(self) -> Iterator[Any]:
+        for ds in self.datasets:
+            yield from ds
+
+
+class ConcatDataset(Dataset):
+    def __init__(self, datasets: Sequence[Dataset]) -> None:
+        self.datasets = list(datasets)
+        self.cumulative_sizes = np.cumsum([len(d) for d in self.datasets]).tolist()
+
+    def __len__(self) -> int:
+        return self.cumulative_sizes[-1]
+
+    def __getitem__(self, idx: int) -> Any:
+        if idx < 0:
+            idx += len(self)
+        ds_idx = bisect.bisect_right(self.cumulative_sizes, idx)
+        sample_idx = idx if ds_idx == 0 else idx - self.cumulative_sizes[ds_idx - 1]
+        return self.datasets[ds_idx][sample_idx]
+
+
+class Subset(Dataset):
+    def __init__(self, dataset: Dataset, indices: Sequence[int]) -> None:
+        self.dataset = dataset
+        self.indices = list(indices)
+
+    def __getitem__(self, idx: int) -> Any:
+        return self.dataset[self.indices[idx]]
+
+    def __len__(self) -> int:
+        return len(self.indices)
+
+
+def random_split(dataset: Dataset, lengths: Sequence[Any], generator: Any = None) -> List[Subset]:
+    lengths = list(lengths)
+    n = len(dataset)
+    if all(isinstance(l, float) for l in lengths):
+        counts = [int(np.floor(n * frac)) for frac in lengths]
+        counts[-1] = n - sum(counts[:-1])
+        lengths = counts
+    if sum(lengths) != n:
+        raise ValueError("sum of lengths must equal dataset size")
+    perm = np.random.permutation(n)
+    out, offset = [], 0
+    for l in lengths:  # noqa: E741
+        out.append(Subset(dataset, perm[offset : offset + l].tolist()))
+        offset += l
+    return out

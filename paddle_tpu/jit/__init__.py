@@ -1,0 +1,4 @@
+"""``paddle_tpu.jit`` (reference ``python/paddle/jit``)."""
+
+from paddle_tpu.jit.api import StaticFunction, ignore_module, not_to_static, to_static  # noqa: F401
+from paddle_tpu.jit.save_load import load, save  # noqa: F401

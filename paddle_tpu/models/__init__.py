@@ -1,0 +1,4 @@
+"""Model zoo for the BASELINE workloads (SURVEY §6):
+llama (flagship), gpt, ernie/bert, moe, unet."""
+
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM, LlamaModel  # noqa: F401

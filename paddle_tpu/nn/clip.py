@@ -1,0 +1,107 @@
+"""Gradient clipping (reference ``python/paddle/nn/clip.py``:
+``ClipGradByGlobalNorm``/``ClipGradByNorm``/``ClipGradByValue``).
+
+Under hybrid parallelism the global norm must be reduced across model-parallel
+groups — ``HybridParallelClipGrad`` in ``paddle_tpu.distributed`` wraps these.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["ClipGradBase", "ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm", "clip_grad_norm_"]
+
+
+class ClipGradBase:
+    def __call__(self, params_grads: List[Tuple[Tensor, Tensor]]) -> List[Tuple[Tensor, Tensor]]:
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max: float, min: Optional[float] = None) -> None:  # noqa: A002
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            out.append((p, Tensor(jnp.clip(g.data, self.min, self.max))))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm: float) -> None:
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            norm = jnp.sqrt(jnp.sum(jnp.square(g.data.astype(jnp.float32))))
+            factor = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            out.append((p, Tensor((g.data.astype(jnp.float32) * factor).astype(g.dtype))))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm: float = 1.0, group_name: str = "default_group", auto_skip_clip: bool = False) -> None:
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def global_norm(self, grads: List[Tensor]) -> Any:
+        sq = [jnp.sum(jnp.square(g.data.astype(jnp.float32))) for g in grads if g is not None]
+        if not sq:
+            return jnp.zeros((), jnp.float32)
+        total = sq[0]
+        for s in sq[1:]:
+            total = total + s
+        return jnp.sqrt(total)
+
+    def __call__(self, params_grads):
+        grads = [g for _, g in params_grads if g is not None]
+        if not grads:
+            return params_grads
+        gnorm = self.global_norm(grads)
+        factor = self.clip_norm / jnp.maximum(gnorm, self.clip_norm)
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            need_clip = getattr(p, "need_clip", True)
+            if not need_clip:
+                out.append((p, g))
+                continue
+            out.append((p, Tensor((g.data.astype(jnp.float32) * factor).astype(g.dtype))))
+        return out
+
+
+def clip_grad_norm_(parameters: Any, max_norm: float, norm_type: float = 2.0, error_if_nonfinite: bool = False) -> Tensor:
+    params = [parameters] if isinstance(parameters, Tensor) else list(parameters)
+    grads = [p.grad for p in params if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros((), jnp.float32))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g.data)) for g in grads]))
+    else:
+        total = jnp.power(
+            sum(jnp.sum(jnp.power(jnp.abs(g.data.astype(jnp.float32)), norm_type)) for g in grads),
+            1.0 / norm_type,
+        )
+    factor = jnp.minimum(max_norm / jnp.maximum(total, 1e-6), 1.0)
+    import paddle_tpu
+
+    with paddle_tpu.no_grad():
+        for p in params:
+            if p.grad is not None:
+                p.grad.set_value(p.grad.data * factor)
+    return Tensor(total)

@@ -1,0 +1,29 @@
+"""``paddle_tpu.nn.functional`` — functional nn API (reference
+``python/paddle/nn/functional/``)."""
+
+from paddle_tpu.nn.functional.activation import *  # noqa: F401,F403
+from paddle_tpu.nn.functional.common import *  # noqa: F401,F403
+from paddle_tpu.nn.functional.conv import *  # noqa: F401,F403
+from paddle_tpu.nn.functional.loss import *  # noqa: F401,F403
+from paddle_tpu.nn.functional.flash_attention import (  # noqa: F401
+    flash_attention,
+    flash_attn_unpadded,
+    flashmask_attention,
+    scaled_dot_product_attention,
+    sdp_kernel,
+)
+from paddle_tpu.ops.search import where  # noqa: F401
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64"):
+    """Mask [*, maxlen] with 1 for positions < length (reference sequence_mask op)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.dispatch import call_op
+    from paddle_tpu.core.dtypes import convert_dtype
+
+    def _impl(l):  # noqa: E741
+        m = int(maxlen) if maxlen is not None else int(l.max())
+        return (jnp.arange(m) < l[..., None]).astype(convert_dtype(dtype))
+
+    return call_op("sequence_mask", _impl, lengths)

@@ -1,0 +1,217 @@
+"""Activation functions (reference ``python/paddle/nn/functional/activation.py``
+over PHI activation kernels; all fuse into adjacent matmuls under XLA)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.math import sigmoid, tanh  # noqa: F401 - re-exported
+from paddle_tpu.ops.registry import defop
+
+__all__ = [
+    "relu",
+    "relu6",
+    "gelu",
+    "silu",
+    "swish",
+    "sigmoid",
+    "tanh",
+    "softmax",
+    "log_softmax",
+    "softplus",
+    "softsign",
+    "softshrink",
+    "hardshrink",
+    "hardsigmoid",
+    "hardswish",
+    "hardtanh",
+    "leaky_relu",
+    "elu",
+    "celu",
+    "selu",
+    "prelu",
+    "rrelu",
+    "mish",
+    "tanhshrink",
+    "thresholded_relu",
+    "log_sigmoid",
+    "maxout",
+    "glu",
+    "swiglu",
+    "gumbel_softmax",
+]
+
+
+@defop("relu", inplace_method="relu_")
+def relu(x):
+    return jax.nn.relu(x)
+
+
+@defop("relu6")
+def relu6(x):
+    return jnp.minimum(jax.nn.relu(x), 6.0)
+
+
+@defop("gelu")
+def gelu(x, approximate=False):
+    return jax.nn.gelu(x, approximate=bool(approximate))
+
+
+@defop("silu")
+def silu(x):
+    return jax.nn.silu(x)
+
+
+@defop("swish")
+def swish(x):
+    return jax.nn.silu(x)
+
+
+@defop("softmax_fn", tensor_method="softmax")
+def softmax(x, axis=-1, dtype=None):
+    from paddle_tpu.core.dtypes import convert_dtype
+
+    if dtype is not None:
+        x = x.astype(convert_dtype(dtype))
+    return jax.nn.softmax(x, axis=int(axis))
+
+
+@defop("log_softmax")
+def log_softmax(x, axis=-1, dtype=None):
+    from paddle_tpu.core.dtypes import convert_dtype
+
+    if dtype is not None:
+        x = x.astype(convert_dtype(dtype))
+    return jax.nn.log_softmax(x, axis=int(axis))
+
+
+@defop("softplus")
+def softplus(x, beta=1.0, threshold=20.0):
+    scaled = beta * x
+    return jnp.where(scaled > threshold, x, jax.nn.softplus(scaled) / beta)
+
+
+@defop("softsign")
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+@defop("softshrink")
+def softshrink(x, threshold=0.5):
+    return jnp.where(x > threshold, x - threshold, jnp.where(x < -threshold, x + threshold, 0.0))
+
+
+@defop("hardshrink")
+def hardshrink(x, threshold=0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+@defop("hardsigmoid")
+def hardsigmoid(x, slope=0.1666667, offset=0.5):
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+@defop("hardswish")
+def hardswish(x):
+    return x * jnp.clip(x / 6.0 + 0.5, 0.0, 1.0)
+
+
+@defop("hardtanh")
+def hardtanh(x, min=-1.0, max=1.0):  # noqa: A002
+    return jnp.clip(x, min, max)
+
+
+@defop("leaky_relu")
+def leaky_relu(x, negative_slope=0.01):
+    return jax.nn.leaky_relu(x, negative_slope)
+
+
+@defop("elu", inplace_method="elu_")
+def elu(x, alpha=1.0):
+    return jax.nn.elu(x, alpha)
+
+
+@defop("celu")
+def celu(x, alpha=1.0):
+    return jax.nn.celu(x, alpha)
+
+
+@defop("selu")
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+@defop("prelu")
+def prelu(x, weight, data_format="NCHW"):
+    w = weight
+    if w.ndim == 1 and x.ndim > 1 and w.shape[0] != 1:
+        ch_axis = 1 if data_format == "NCHW" else x.ndim - 1
+        shape = [1] * x.ndim
+        shape[ch_axis] = w.shape[0]
+        w = w.reshape(shape)
+    return jnp.where(x > 0, x, w * x)
+
+
+@defop("rrelu")
+def rrelu(x, lower=0.125, upper=0.3333333, training=True):
+    slope = (lower + upper) / 2.0
+    return jnp.where(x >= 0, x, slope * x)
+
+
+@defop("mish")
+def mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+@defop("tanhshrink")
+def tanhshrink(x):
+    return x - jnp.tanh(x)
+
+
+@defop("thresholded_relu")
+def thresholded_relu(x, threshold=1.0, value=0.0):
+    return jnp.where(x > threshold, x, value)
+
+
+@defop("log_sigmoid")
+def log_sigmoid(x):
+    return jax.nn.log_sigmoid(x)
+
+
+@defop("maxout")
+def maxout(x, groups, axis=1):
+    axis = axis % x.ndim
+    c = x.shape[axis]
+    new_shape = list(x.shape)
+    new_shape[axis : axis + 1] = [c // groups, groups]
+    return jnp.max(x.reshape(new_shape), axis=axis + 1)
+
+
+@defop("glu")
+def glu(x, axis=-1):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+@defop("swiglu")
+def swiglu(x, y=None):
+    """SwiGLU (reference ``ops.yaml:4596 swiglu``; LLM MLP gate). With one
+    input, splits it in half along the last dim."""
+    if y is None:
+        x, y = jnp.split(x, 2, axis=-1)
+    return jax.nn.silu(x) * y
+
+
+@defop("gumbel_softmax")
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1):
+    import paddle_tpu.core.rng as _rng
+
+    g = jax.random.gumbel(_rng.next_key(), x.shape, x.dtype)
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis)
+        one_hot = jax.nn.one_hot(idx, y.shape[axis], axis=axis, dtype=y.dtype)
+        # straight-through estimator: hard forward, soft backward
+        y = one_hot - jax.lax.stop_gradient(y) + y
+    return y

@@ -1,0 +1,310 @@
+"""Convolution / pooling functional ops.
+
+Reference: ``python/paddle/nn/functional/conv.py`` + ``pooling.py`` over PHI
+conv kernels (cuDNN). On TPU, ``lax.conv_general_dilated`` lowers straight to
+MXU convolutions; XLA picks layouts, so both NCHW (paddle default) and NHWC
+are accepted.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops.registry import defop
+
+__all__ = [
+    "conv1d",
+    "conv2d",
+    "conv3d",
+    "conv1d_transpose",
+    "conv2d_transpose",
+    "conv3d_transpose",
+    "max_pool1d",
+    "max_pool2d",
+    "max_pool3d",
+    "avg_pool1d",
+    "avg_pool2d",
+    "avg_pool3d",
+    "adaptive_avg_pool1d",
+    "adaptive_avg_pool2d",
+    "adaptive_avg_pool3d",
+    "adaptive_max_pool1d",
+    "adaptive_max_pool2d",
+    "adaptive_max_pool3d",
+    "interpolate",
+    "upsample",
+]
+
+
+def _tuple(v: Any, n: int) -> tuple:
+    if isinstance(v, (list, tuple)):
+        if len(v) == n:
+            return tuple(int(x) for x in v)
+        if len(v) == 2 * n:  # paddle pads as [before, after] pairs flattened
+            return tuple(int(x) for x in v)
+        return tuple(int(v[0]) for _ in range(n))
+    return tuple(int(v) for _ in range(n))
+
+
+def _padding(padding: Any, n: int) -> Any:
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, (list, tuple)) and len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    p = _tuple(padding, n)
+    return [(x, x) for x in p]
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n, data_format):
+    spatial = "DHW"[3 - n :]
+    if data_format in (f"NC{spatial}", "NCHW", "NCL", "NCDHW"):
+        lhs_spec = "NC" + spatial
+    else:
+        lhs_spec = "N" + spatial + "C"
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, weight.shape, (lhs_spec, "OI" + spatial, lhs_spec)
+    )
+    out = jax.lax.conv_general_dilated(
+        x,
+        weight,
+        window_strides=_tuple(stride, n),
+        padding=_padding(padding, n),
+        rhs_dilation=_tuple(dilation, n),
+        dimension_numbers=dn,
+        feature_group_count=groups,
+    )
+    if bias is not None:
+        ch_axis = 1 if lhs_spec.startswith("NC") else out.ndim - 1
+        shape = [1] * out.ndim
+        shape[ch_axis] = bias.shape[0]
+        out = out + bias.reshape(shape)
+    return out
+
+
+@defop("conv1d", tensor_method=None)
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCL"):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1, data_format)
+
+
+@defop("conv2d", tensor_method=None)
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCHW"):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2, data_format)
+
+
+@defop("conv3d", tensor_method=None)
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCDHW"):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3, data_format)
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, n, data_format):
+    spatial = "DHW"[3 - n :]
+    lhs_spec = "NC" + spatial if data_format.startswith("NC") else "N" + spatial + "C"
+    # weight layout [in, out/groups, *k] (paddle conv_transpose convention)
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, weight.shape, (lhs_spec, "IO" + spatial, lhs_spec)
+    )
+    pad = _padding(padding, n)
+    if isinstance(pad, str):
+        pad_cfg: Any = pad
+    else:
+        # transpose conv: effective padding = k - 1 - p on each side
+        ks = weight.shape[2:]
+        dil = _tuple(dilation, n)
+        pad_cfg = [
+            (dil[i] * (ks[i] - 1) - pad[i][0], dil[i] * (ks[i] - 1) - pad[i][1])
+            for i in range(n)
+        ]
+    out = jax.lax.conv_general_dilated(
+        x,
+        weight,
+        window_strides=(1,) * n,
+        padding=pad_cfg,
+        lhs_dilation=_tuple(stride, n),
+        rhs_dilation=_tuple(dilation, n),
+        dimension_numbers=dn,
+        feature_group_count=groups,
+        transpose_kernel=True,
+    )
+    opad = _tuple(output_padding, n)
+    if any(opad):
+        width = [(0, 0)] * 2 + [(0, p) for p in opad] if lhs_spec.startswith("NC") else [(0, 0)] + [(0, p) for p in opad] + [(0, 0)]
+        out = jnp.pad(out, width)
+    if bias is not None:
+        ch_axis = 1 if lhs_spec.startswith("NC") else out.ndim - 1
+        shape = [1] * out.ndim
+        shape[ch_axis] = bias.shape[0]
+        out = out + bias.reshape(shape)
+    return out
+
+
+@defop("conv1d_transpose", tensor_method=None)
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, dilation=1, groups=1, data_format="NCL"):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, 1, data_format)
+
+
+@defop("conv2d_transpose", tensor_method=None)
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, dilation=1, groups=1, data_format="NCHW"):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, 2, data_format)
+
+
+@defop("conv3d_transpose", tensor_method=None)
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, dilation=1, groups=1, data_format="NCDHW"):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, 3, data_format)
+
+
+def _pool(x, kernel, stride, padding, n, data_format, reducer, init, ceil_mode=False, average=False, exclusive=True):
+    ks = _tuple(kernel, n)
+    st = _tuple(stride if stride is not None else kernel, n)
+    pad = _padding(padding, n)
+    nc_layout = data_format.startswith("NC")
+    if nc_layout:
+        window = (1, 1) + ks
+        strides = (1, 1) + st
+        pads = [(0, 0), (0, 0)] + (pad if not isinstance(pad, str) else [])
+    else:
+        window = (1,) + ks + (1,)
+        strides = (1,) + st + (1,)
+        pads = [(0, 0)] + (pad if not isinstance(pad, str) else []) + [(0, 0)]
+    pad_cfg = pad if isinstance(pad, str) else pads
+    out = jax.lax.reduce_window(x, init, reducer, window, strides, pad_cfg)
+    if average:
+        if exclusive and (not isinstance(pad, str)) and any(p != (0, 0) for p in pad):
+            ones = jnp.ones_like(x)
+            counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pad_cfg)
+            out = out / counts
+        else:
+            out = out / float(np.prod(ks))
+    return out
+
+
+@defop("max_pool2d", tensor_method=None)
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, data_format="NCHW"):
+    return _pool(x, kernel_size, stride, padding, 2, data_format, jax.lax.max, -jnp.inf)
+
+
+@defop("max_pool1d", tensor_method=None)
+def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False, data_format="NCL"):
+    return _pool(x, kernel_size, stride, padding, 1, data_format, jax.lax.max, -jnp.inf)
+
+
+@defop("max_pool3d", tensor_method=None)
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, data_format="NCDHW"):
+    return _pool(x, kernel_size, stride, padding, 3, data_format, jax.lax.max, -jnp.inf)
+
+
+@defop("avg_pool2d", tensor_method=None)
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, data_format="NCHW"):
+    return _pool(x, kernel_size, stride, padding, 2, data_format, jax.lax.add, 0.0, average=True, exclusive=exclusive)
+
+
+@defop("avg_pool1d", tensor_method=None)
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False, data_format="NCL"):
+    return _pool(x, kernel_size, stride, padding, 1, data_format, jax.lax.add, 0.0, average=True, exclusive=exclusive)
+
+
+@defop("avg_pool3d", tensor_method=None)
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, data_format="NCDHW"):
+    return _pool(x, kernel_size, stride, padding, 3, data_format, jax.lax.add, 0.0, average=True, exclusive=exclusive)
+
+
+def _adaptive_pool(x, output_size, n, data_format, op):
+    os_ = _tuple(output_size, n)
+    nc_layout = data_format.startswith("NC")
+    spatial_dims = list(range(2, 2 + n)) if nc_layout else list(range(1, 1 + n))
+    out = x
+    for dim, target in zip(spatial_dims, os_):
+        size = out.shape[dim]
+        if size % target != 0:
+            # general case: average over variable windows via segment reduce
+            idx = (np.arange(size) * target) // size
+            one_hot = jax.nn.one_hot(jnp.asarray(idx), target, dtype=out.dtype)
+            moved = jnp.moveaxis(out, dim, -1)
+            if op == "avg":
+                counts = jnp.asarray(np.bincount(idx, minlength=target), out.dtype)
+                red = jnp.matmul(moved, one_hot) / counts
+            else:
+                red = jnp.max(
+                    jnp.where(
+                        one_hot.T[(None,) * (moved.ndim - 1)] > 0,
+                        moved[..., None, :],
+                        -jnp.inf,
+                    ),
+                    axis=-1,
+                )
+            out = jnp.moveaxis(red, -1, dim)
+        else:
+            k = size // target
+            new_shape = list(out.shape)
+            new_shape[dim : dim + 1] = [target, k]
+            reshaped = out.reshape(new_shape)
+            out = jnp.max(reshaped, axis=dim + 1) if op == "max" else jnp.mean(reshaped, axis=dim + 1)
+    return out
+
+
+@defop("adaptive_avg_pool2d", tensor_method=None)
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
+    return _adaptive_pool(x, output_size, 2, data_format, "avg")
+
+
+@defop("adaptive_avg_pool1d", tensor_method=None)
+def adaptive_avg_pool1d(x, output_size, data_format="NCL"):
+    return _adaptive_pool(x, output_size, 1, data_format, "avg")
+
+
+@defop("adaptive_avg_pool3d", tensor_method=None)
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW"):
+    return _adaptive_pool(x, output_size, 3, data_format, "avg")
+
+
+@defop("adaptive_max_pool2d", tensor_method=None)
+def adaptive_max_pool2d(x, output_size, data_format="NCHW"):
+    return _adaptive_pool(x, output_size, 2, data_format, "max")
+
+
+@defop("adaptive_max_pool1d", tensor_method=None)
+def adaptive_max_pool1d(x, output_size, data_format="NCL"):
+    return _adaptive_pool(x, output_size, 1, data_format, "max")
+
+
+@defop("adaptive_max_pool3d", tensor_method=None)
+def adaptive_max_pool3d(x, output_size, data_format="NCDHW"):
+    return _adaptive_pool(x, output_size, 3, data_format, "max")
+
+
+@defop("interpolate_fn", tensor_method=None)
+def interpolate(
+    x,
+    size=None,
+    scale_factor=None,
+    mode="nearest",
+    align_corners=False,
+    data_format="NCHW",
+):
+    nc_layout = data_format.startswith("NC")
+    n_spatial = x.ndim - 2
+    in_spatial = x.shape[2:] if nc_layout else x.shape[1:-1]
+    if size is None:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * n_spatial
+        size = [int(round(s * f)) for s, f in zip(in_spatial, sf)]
+    size = [int(s) for s in (size if isinstance(size, (list, tuple)) else [size] * n_spatial)]
+    if nc_layout:
+        target_shape = (x.shape[0], x.shape[1], *size)
+    else:
+        target_shape = (x.shape[0], *size, x.shape[-1])
+    method = {
+        "nearest": "nearest",
+        "bilinear": "linear",
+        "linear": "linear",
+        "trilinear": "linear",
+        "bicubic": "cubic",
+        "area": "linear",
+    }[mode]
+    return jax.image.resize(x, target_shape, method=method)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False, data_format="NCHW", name=None):
+    return interpolate(x, size=size, scale_factor=scale_factor, mode=mode, align_corners=align_corners, data_format=data_format)

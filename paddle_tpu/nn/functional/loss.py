@@ -1,0 +1,293 @@
+"""Loss functional ops (reference ``python/paddle/nn/functional/loss.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.registry import defop
+
+__all__ = [
+    "cross_entropy",
+    "softmax_with_cross_entropy",
+    "nll_loss",
+    "mse_loss",
+    "l1_loss",
+    "smooth_l1_loss",
+    "binary_cross_entropy",
+    "binary_cross_entropy_with_logits",
+    "kl_div",
+    "margin_ranking_loss",
+    "cosine_embedding_loss",
+    "triplet_margin_loss",
+    "hinge_embedding_loss",
+    "log_loss",
+    "square_error_cost",
+    "ctc_loss",
+    "sigmoid_focal_loss",
+]
+
+
+def _reduce(x, reduction):
+    if reduction == "mean":
+        return jnp.mean(x)
+    if reduction == "sum":
+        return jnp.sum(x)
+    return x
+
+
+@defop("cross_entropy_fn", tensor_method=None)
+def cross_entropy(
+    input,  # noqa: A002
+    label,
+    weight=None,
+    ignore_index=-100,
+    reduction="mean",
+    soft_label=False,
+    axis=-1,
+    use_softmax=True,
+    label_smoothing=0.0,
+):
+    """Softmax cross entropy (reference ``cross_entropy_with_softmax`` kernel +
+    ``python/paddle/nn/functional/loss.py`` cross_entropy)."""
+    logits = input
+    if use_softmax:
+        logp = jax.nn.log_softmax(logits, axis=axis)
+    else:
+        logp = jnp.log(jnp.clip(logits, 1e-15, 1.0))
+    if soft_label:
+        target = label
+        if label_smoothing > 0.0:
+            k = logp.shape[axis]
+            target = (1 - label_smoothing) * target + label_smoothing / k
+        loss = -jnp.sum(target * logp, axis=axis)
+        if weight is not None:
+            loss = loss * jnp.sum(target * weight, axis=axis)
+        return _reduce(loss, reduction)
+    lbl = label
+    if lbl.ndim == logp.ndim:
+        lbl = jnp.squeeze(lbl, axis=axis)
+    lbl = lbl.astype(jnp.int32)
+    valid = lbl != ignore_index
+    safe = jnp.where(valid, lbl, 0)
+    if label_smoothing > 0.0:
+        k = logp.shape[axis]
+        nll = -jnp.take_along_axis(logp, safe[..., None], axis=axis)[..., 0]
+        smooth = -jnp.mean(logp, axis=axis)
+        loss = (1 - label_smoothing) * nll + label_smoothing * smooth
+    else:
+        loss = -jnp.take_along_axis(logp, safe[..., None], axis=axis)[..., 0]
+    if weight is not None:
+        w = weight[safe]
+        loss = loss * w
+    loss = jnp.where(valid, loss, 0.0)
+    if reduction == "mean":
+        if weight is not None:
+            denom = jnp.sum(jnp.where(valid, weight[safe], 0.0))
+        else:
+            denom = jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+        return jnp.sum(loss) / denom
+    return _reduce(loss, reduction)
+
+
+def softmax_with_cross_entropy(
+    logits,
+    label,
+    soft_label=False,
+    ignore_index=-100,
+    numeric_stable_mode=True,
+    return_softmax=False,
+    axis=-1,
+):
+    loss = cross_entropy(
+        logits, label, soft_label=soft_label, ignore_index=ignore_index, reduction="none", axis=axis
+    )
+    if loss.ndim < logits.ndim:
+        from paddle_tpu.ops.manipulation import unsqueeze
+
+        loss = unsqueeze(loss, axis)
+    if return_softmax:
+        from paddle_tpu.nn.functional.activation import softmax as _softmax
+
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+@defop("nll_loss_fn", tensor_method=None)
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean"):  # noqa: A002
+    lbl = label.astype(jnp.int32)
+    valid = lbl != ignore_index
+    safe = jnp.where(valid, lbl, 0)
+    loss = -jnp.take_along_axis(input, safe[..., None] if input.ndim == lbl.ndim + 1 else safe, axis=1 if input.ndim > 1 else 0)
+    if input.ndim == lbl.ndim + 1:
+        loss = jnp.squeeze(loss, axis=1)
+    if weight is not None:
+        loss = loss * weight[safe]
+    loss = jnp.where(valid, loss, 0.0)
+    if reduction == "mean":
+        denom = jnp.sum(weight[safe] * valid) if weight is not None else jnp.maximum(jnp.sum(valid), 1)
+        return jnp.sum(loss) / denom
+    return _reduce(loss, reduction)
+
+
+@defop("mse_loss_fn", tensor_method=None)
+def mse_loss(input, label, reduction="mean"):  # noqa: A002
+    return _reduce(jnp.square(input - label), reduction)
+
+
+@defop("l1_loss_fn", tensor_method=None)
+def l1_loss(input, label, reduction="mean"):  # noqa: A002
+    return _reduce(jnp.abs(input - label), reduction)
+
+
+@defop("smooth_l1_loss_fn", tensor_method=None)
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0):  # noqa: A002
+    d = jnp.abs(input - label)
+    loss = jnp.where(d < delta, 0.5 * jnp.square(d) / delta, d - 0.5 * delta)
+    return _reduce(loss, reduction)
+
+
+@defop("binary_cross_entropy_fn", tensor_method=None)
+def binary_cross_entropy(input, label, weight=None, reduction="mean"):  # noqa: A002
+    eps = 1e-12
+    loss = -(label * jnp.log(jnp.clip(input, eps, 1.0)) + (1 - label) * jnp.log(jnp.clip(1 - input, eps, 1.0)))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+@defop("binary_cross_entropy_with_logits_fn", tensor_method=None)
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean", pos_weight=None):
+    max_val = jnp.clip(-logit, 0, None)
+    if pos_weight is not None:
+        log_w = (pos_weight - 1.0) * label + 1.0
+        loss = (1 - label) * logit + log_w * (jnp.log1p(jnp.exp(-jnp.abs(logit))) + max_val)
+    else:
+        loss = (1 - label) * logit + jnp.log1p(jnp.exp(-jnp.abs(logit))) + max_val
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+@defop("kl_div_fn", tensor_method=None)
+def kl_div(input, label, reduction="mean", log_target=False):  # noqa: A002
+    if log_target:
+        loss = jnp.exp(label) * (label - input)
+    else:
+        safe_label = jnp.clip(label, 1e-12, None)
+        loss = label * (jnp.log(safe_label) - input)
+        loss = jnp.where(label > 0, loss, 0.0)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / input.shape[0]
+    return _reduce(loss, reduction)
+
+
+@defop("margin_ranking_loss_fn", tensor_method=None)
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean"):  # noqa: A002
+    loss = jnp.clip(-label * (input - other) + margin, 0, None)
+    return _reduce(loss, reduction)
+
+
+@defop("cosine_embedding_loss_fn", tensor_method=None)
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean"):
+    cos = jnp.sum(input1 * input2, axis=-1) / (
+        jnp.linalg.norm(input1, axis=-1) * jnp.linalg.norm(input2, axis=-1) + 1e-12
+    )
+    loss = jnp.where(label == 1, 1 - cos, jnp.clip(cos - margin, 0, None))
+    return _reduce(loss, reduction)
+
+
+@defop("triplet_margin_loss_fn", tensor_method=None)
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, eps=1e-6, swap=False, reduction="mean"):  # noqa: A002
+    def dist(a, b):
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(a - b + eps), p), axis=-1), 1.0 / p)
+
+    d_pos = dist(input, positive)
+    d_neg = dist(input, negative)
+    if swap:
+        d_neg = jnp.minimum(d_neg, dist(positive, negative))
+    loss = jnp.clip(d_pos - d_neg + margin, 0, None)
+    return _reduce(loss, reduction)
+
+
+@defop("hinge_embedding_loss_fn", tensor_method=None)
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean"):  # noqa: A002
+    loss = jnp.where(label == 1, input, jnp.clip(margin - input, 0, None))
+    return _reduce(loss, reduction)
+
+
+@defop("log_loss_fn", tensor_method=None)
+def log_loss(input, label, epsilon=1e-4):  # noqa: A002
+    return -label * jnp.log(input + epsilon) - (1 - label) * jnp.log(1 - input + epsilon)
+
+
+@defop("square_error_cost_fn", tensor_method=None)
+def square_error_cost(input, label):  # noqa: A002
+    return jnp.square(input - label)
+
+
+@defop("sigmoid_focal_loss_fn", tensor_method=None)
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0, reduction="sum"):
+    p = jax.nn.sigmoid(logit)
+    ce = (1 - label) * logit + jnp.log1p(jnp.exp(-jnp.abs(logit))) + jnp.clip(-logit, 0, None)
+    p_t = p * label + (1 - p) * (1 - label)
+    loss = ce * jnp.power(1 - p_t, gamma)
+    if alpha >= 0:
+        alpha_t = alpha * label + (1 - alpha) * (1 - label)
+        loss = alpha_t * loss
+    if normalizer is not None:
+        loss = loss / normalizer
+    return _reduce(loss, reduction)
+
+
+@defop("ctc_loss_fn", tensor_method=None)
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction="mean", norm_by_times=False):
+    """CTC forward algorithm in log space via lax.scan (reference warpctc
+    third_party dependency replaced by a pure-XLA implementation)."""
+    # log_probs: [T, B, C] (paddle layout: max_logit_length, batch, classes)
+    T, B, C = log_probs.shape
+    S = labels.shape[1]  # max label length
+    # extended labels with blanks: [B, 2S+1]
+    ext = jnp.full((B, 2 * S + 1), blank, dtype=labels.dtype)
+    ext = ext.at[:, 1::2].set(labels)
+    ext_len = 2 * label_lengths + 1
+
+    neg_inf = -1e30
+    # alpha init at t=0
+    lp0 = log_probs[0]  # [B, C]
+    alpha0 = jnp.full((B, 2 * S + 1), neg_inf)
+    alpha0 = alpha0.at[:, 0].set(lp0[jnp.arange(B), ext[:, 0]])
+    if S > 0:
+        alpha0 = alpha0.at[:, 1].set(jnp.where(ext_len > 1, lp0[jnp.arange(B), ext[:, 1]], neg_inf))
+
+    same_as_prev2 = jnp.concatenate(
+        [jnp.ones((B, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1
+    )
+
+    def step(alpha, lp):
+        prev1 = jnp.concatenate([jnp.full((B, 1), neg_inf), alpha[:, :-1]], axis=1)
+        prev2 = jnp.concatenate([jnp.full((B, 2), neg_inf), alpha[:, :-2]], axis=1)
+        prev2 = jnp.where(same_as_prev2, neg_inf, prev2)
+        merged = jnp.logaddexp(alpha, jnp.logaddexp(prev1, prev2))
+        emit = jnp.take_along_axis(lp, ext, axis=1)
+        return merged + emit, None
+
+    def masked_step(carry, inputs):
+        alpha, t = carry
+        lp = inputs
+        new_alpha, _ = step(alpha, lp)
+        keep = (t + 1) < input_lengths  # [B]
+        alpha = jnp.where(keep[:, None], new_alpha, alpha)
+        return (alpha, t + 1), None
+
+    (alpha, _), _ = jax.lax.scan(masked_step, (alpha0, jnp.zeros((), jnp.int32)), log_probs[1:])
+    b_idx = jnp.arange(B)
+    last = alpha[b_idx, ext_len - 1]
+    last2 = jnp.where(ext_len - 2 >= 0, alpha[b_idx, jnp.clip(ext_len - 2, 0, None)], neg_inf)
+    ll = jnp.logaddexp(last, last2)
+    loss = -ll
+    if reduction == "mean":
+        return jnp.mean(loss / label_lengths.astype(loss.dtype))
+    return _reduce(loss, reduction)

@@ -1,0 +1,205 @@
+"""Parameter initializers (reference ``python/paddle/nn/initializer/``).
+
+Each initializer is a callable applied to a Parameter in-place (set_value),
+drawing from the global splittable PRNG — deterministic under ``paddle_tpu.seed``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu.core.rng as _rng
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = [
+    "Initializer",
+    "Constant",
+    "Normal",
+    "TruncatedNormal",
+    "Uniform",
+    "XavierNormal",
+    "XavierUniform",
+    "KaimingNormal",
+    "KaimingUniform",
+    "Assign",
+    "Dirac",
+    "Orthogonal",
+    "calculate_gain",
+]
+
+
+def calculate_gain(nonlinearity: str, param: Optional[float] = None) -> float:
+    gains = {
+        "sigmoid": 1.0,
+        "linear": 1.0,
+        "conv1d": 1.0,
+        "conv2d": 1.0,
+        "conv3d": 1.0,
+        "tanh": 5.0 / 3,
+        "relu": math.sqrt(2.0),
+        "leaky_relu": math.sqrt(2.0 / (1 + (param or 0.01) ** 2)),
+        "selu": 3.0 / 4,
+    }
+    return gains[nonlinearity]
+
+
+def _fans(shape: Sequence[int]) -> tuple:
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    receptive = int(np.prod(shape[2:]))
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class Initializer:
+    def __call__(self, param: Tensor, block: Any = None) -> None:
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value: float = 0.0) -> None:
+        self.value = value
+
+    def __call__(self, param: Tensor, block: Any = None) -> None:
+        param.set_value(jnp.full(tuple(param.shape), self.value, param.dtype))
+
+
+class Normal(Initializer):
+    def __init__(self, mean: float = 0.0, std: float = 1.0) -> None:
+        self.mean, self.std = mean, std
+
+    def __call__(self, param: Tensor, block: Any = None) -> None:
+        sample = self.mean + self.std * jax.random.normal(
+            _rng.next_key(), tuple(param.shape), jnp.float32
+        )
+        param.set_value(sample.astype(param.dtype))
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean: float = 0.0, std: float = 1.0, a: float = -2.0, b: float = 2.0) -> None:
+        self.mean, self.std, self.a, self.b = mean, std, a, b
+
+    def __call__(self, param: Tensor, block: Any = None) -> None:
+        sample = jax.random.truncated_normal(
+            _rng.next_key(), self.a, self.b, tuple(param.shape), jnp.float32
+        )
+        param.set_value((self.mean + self.std * sample).astype(param.dtype))
+
+
+class Uniform(Initializer):
+    def __init__(self, low: float = -1.0, high: float = 1.0) -> None:
+        self.low, self.high = low, high
+
+    def __call__(self, param: Tensor, block: Any = None) -> None:
+        sample = jax.random.uniform(
+            _rng.next_key(), tuple(param.shape), jnp.float32, self.low, self.high
+        )
+        param.set_value(sample.astype(param.dtype))
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in: Optional[float] = None, fan_out: Optional[float] = None, gain: float = 1.0) -> None:
+        self._fan_in, self._fan_out, self._gain = fan_in, fan_out, gain
+
+    def __call__(self, param: Tensor, block: Any = None) -> None:
+        fi, fo = _fans(param.shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        fo = self._fan_out if self._fan_out is not None else fo
+        std = self._gain * math.sqrt(2.0 / (fi + fo))
+        sample = std * jax.random.normal(_rng.next_key(), tuple(param.shape), jnp.float32)
+        param.set_value(sample.astype(param.dtype))
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in: Optional[float] = None, fan_out: Optional[float] = None, gain: float = 1.0) -> None:
+        self._fan_in, self._fan_out, self._gain = fan_in, fan_out, gain
+
+    def __call__(self, param: Tensor, block: Any = None) -> None:
+        fi, fo = _fans(param.shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        fo = self._fan_out if self._fan_out is not None else fo
+        limit = self._gain * math.sqrt(6.0 / (fi + fo))
+        sample = jax.random.uniform(
+            _rng.next_key(), tuple(param.shape), jnp.float32, -limit, limit
+        )
+        param.set_value(sample.astype(param.dtype))
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in: Optional[float] = None, negative_slope: float = 0.0, nonlinearity: str = "relu") -> None:
+        self._fan_in = fan_in
+        self._negative_slope = negative_slope
+        self._nonlinearity = nonlinearity
+
+    def __call__(self, param: Tensor, block: Any = None) -> None:
+        fi, _ = _fans(param.shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        gain = calculate_gain(self._nonlinearity, self._negative_slope)
+        std = gain / math.sqrt(fi)
+        sample = std * jax.random.normal(_rng.next_key(), tuple(param.shape), jnp.float32)
+        param.set_value(sample.astype(param.dtype))
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in: Optional[float] = None, negative_slope: float = 0.0, nonlinearity: str = "relu") -> None:
+        self._fan_in = fan_in
+        self._negative_slope = negative_slope
+        self._nonlinearity = nonlinearity
+
+    def __call__(self, param: Tensor, block: Any = None) -> None:
+        fi, _ = _fans(param.shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        gain = calculate_gain(self._nonlinearity, self._negative_slope)
+        limit = gain * math.sqrt(3.0 / fi)
+        sample = jax.random.uniform(
+            _rng.next_key(), tuple(param.shape), jnp.float32, -limit, limit
+        )
+        param.set_value(sample.astype(param.dtype))
+
+
+class Assign(Initializer):
+    def __init__(self, value: Any) -> None:
+        self.value = value
+
+    def __call__(self, param: Tensor, block: Any = None) -> None:
+        arr = self.value.numpy() if hasattr(self.value, "numpy") else np.asarray(self.value)
+        param.set_value(arr.astype(np.dtype(jnp.dtype(param.dtype).name)) if arr.dtype != param.dtype else arr)
+
+
+class Dirac(Initializer):
+    def __init__(self, groups: int = 1) -> None:
+        self.groups = groups
+
+    def __call__(self, param: Tensor, block: Any = None) -> None:
+        shape = param.shape
+        arr = np.zeros(shape, dtype=np.float32)
+        out_per_group = shape[0] // self.groups
+        mid = [s // 2 for s in shape[2:]]
+        for g in range(self.groups):
+            for i in range(min(out_per_group, shape[1])):
+                arr[(g * out_per_group + i, i, *mid)] = 1.0
+        param.set_value(arr)
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain: float = 1.0) -> None:
+        self.gain = gain
+
+    def __call__(self, param: Tensor, block: Any = None) -> None:
+        shape = tuple(param.shape)
+        rows = shape[0]
+        cols = int(np.prod(shape[1:]))
+        flat = jax.random.normal(_rng.next_key(), (max(rows, cols), min(rows, cols)), jnp.float32)
+        q, r = jnp.linalg.qr(flat)
+        q = q * jnp.sign(jnp.diagonal(r))
+        if rows < cols:
+            q = q.T
+        param.set_value((self.gain * q[:rows, :cols]).reshape(shape))

@@ -1,0 +1,251 @@
+"""Common layers: Linear, Embedding, Dropout, activations, etc.
+(reference ``python/paddle/nn/layer/common.py`` + ``activation.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax.numpy as jnp
+
+import paddle_tpu.nn.functional as F
+from paddle_tpu.nn.layer.layers import Layer
+
+
+class Linear(Layer):
+    """y = xW + b with W: [in_features, out_features] (paddle layout)."""
+
+    def __init__(
+        self,
+        in_features: int,
+        out_features: int,
+        weight_attr: Any = None,
+        bias_attr: Any = None,
+        name: Optional[str] = None,
+    ) -> None:
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr
+        )
+        if bias_attr is not False:
+            self.bias = self.create_parameter([out_features], attr=bias_attr, is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, x: Any) -> Any:
+        return F.linear(x, self.weight, self.bias)
+
+    def extra_repr(self) -> str:
+        return f"in_features={self.in_features}, out_features={self.out_features}"
+
+
+class Embedding(Layer):
+    def __init__(
+        self,
+        num_embeddings: int,
+        embedding_dim: int,
+        padding_idx: Optional[int] = None,
+        sparse: bool = False,
+        weight_attr: Any = None,
+        name: Optional[str] = None,
+    ) -> None:
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.padding_idx = padding_idx
+        from paddle_tpu.nn import initializer as I
+
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim],
+            attr=weight_attr,
+            default_initializer=I.Normal(0.0, 1.0) if weight_attr is None else None,
+        )
+
+    def forward(self, x: Any) -> Any:
+        return F.embedding(x, self.weight, padding_idx=self.padding_idx)
+
+    def extra_repr(self) -> str:
+        return f"{self.num_embeddings}, {self.embedding_dim}"
+
+
+class Dropout(Layer):
+    def __init__(self, p: float = 0.5, axis: Any = None, mode: str = "upscale_in_train", name: Any = None) -> None:
+        super().__init__()
+        self.p = p
+        self.axis = axis
+        self.mode = mode
+
+    def forward(self, x: Any) -> Any:
+        return F.dropout(x, p=self.p, axis=self.axis, training=self.training, mode=self.mode)
+
+    def extra_repr(self) -> str:
+        return f"p={self.p}"
+
+
+class Dropout2D(Layer):
+    def __init__(self, p: float = 0.5, data_format: str = "NCHW", name: Any = None) -> None:
+        super().__init__()
+        self.p = p
+        self.data_format = data_format
+
+    def forward(self, x: Any) -> Any:
+        return F.dropout2d(x, p=self.p, training=self.training, data_format=self.data_format)
+
+
+class Dropout3D(Layer):
+    def __init__(self, p: float = 0.5, data_format: str = "NCDHW", name: Any = None) -> None:
+        super().__init__()
+        self.p = p
+        self.data_format = data_format
+
+    def forward(self, x: Any) -> Any:
+        return F.dropout3d(x, p=self.p, training=self.training, data_format=self.data_format)
+
+
+class AlphaDropout(Layer):
+    def __init__(self, p: float = 0.5, name: Any = None) -> None:
+        super().__init__()
+        self.p = p
+
+    def forward(self, x: Any) -> Any:
+        return F.alpha_dropout(x, p=self.p, training=self.training)
+
+
+class Flatten(Layer):
+    def __init__(self, start_axis: int = 1, stop_axis: int = -1) -> None:
+        super().__init__()
+        self.start_axis = start_axis
+        self.stop_axis = stop_axis
+
+    def forward(self, x: Any) -> Any:
+        from paddle_tpu.ops.manipulation import flatten
+
+        return flatten(x, self.start_axis, self.stop_axis)
+
+
+class Identity(Layer):
+    def __init__(self, *args: Any, **kwargs: Any) -> None:
+        super().__init__()
+
+    def forward(self, x: Any) -> Any:
+        return x
+
+
+class Upsample(Layer):
+    def __init__(
+        self,
+        size: Any = None,
+        scale_factor: Any = None,
+        mode: str = "nearest",
+        align_corners: bool = False,
+        data_format: str = "NCHW",
+        name: Any = None,
+    ) -> None:
+        super().__init__()
+        self.size = size
+        self.scale_factor = scale_factor
+        self.mode = mode
+        self.align_corners = align_corners
+        self.data_format = data_format
+
+    def forward(self, x: Any) -> Any:
+        return F.interpolate(
+            x,
+            size=self.size,
+            scale_factor=self.scale_factor,
+            mode=self.mode,
+            align_corners=self.align_corners,
+            data_format=self.data_format,
+        )
+
+
+class Pad2D(Layer):
+    def __init__(self, padding: Any, mode: str = "constant", value: float = 0.0, data_format: str = "NCHW", name: Any = None) -> None:
+        super().__init__()
+        self.padding = padding
+        self.mode = mode
+        self.value = value
+        self.data_format = data_format
+
+    def forward(self, x: Any) -> Any:
+        from paddle_tpu.ops.manipulation import pad
+
+        return pad(x, self.padding, mode=self.mode, value=self.value, data_format=self.data_format)
+
+
+class CosineSimilarity(Layer):
+    def __init__(self, axis: int = 1, eps: float = 1e-8) -> None:
+        super().__init__()
+        self.axis = axis
+        self.eps = eps
+
+    def forward(self, x1: Any, x2: Any) -> Any:
+        return F.cosine_similarity(x1, x2, axis=self.axis, eps=self.eps)
+
+
+class Bilinear(Layer):
+    def __init__(self, in1_features: int, in2_features: int, out_features: int, weight_attr: Any = None, bias_attr: Any = None, name: Any = None) -> None:
+        super().__init__()
+        self.weight = self.create_parameter([out_features, in1_features, in2_features], attr=weight_attr)
+        self.bias = self.create_parameter([out_features], attr=bias_attr, is_bias=True) if bias_attr is not False else None
+
+    def forward(self, x1: Any, x2: Any) -> Any:
+        return F.bilinear(x1, x2, self.weight, self.bias)
+
+
+# -- activation layers --------------------------------------------------------
+def _act_layer(name: str, fn_name: str, **defaults: Any) -> type:
+    def __init__(self, *args: Any, **kwargs: Any) -> None:  # noqa: N807
+        Layer.__init__(self)
+        merged = dict(defaults)
+        merged.update(kwargs)
+        self._kwargs = merged
+        self._args = args
+
+    def forward(self, x: Any) -> Any:
+        return getattr(F, fn_name)(x, *self._args, **self._kwargs)
+
+    return type(name, (Layer,), {"__init__": __init__, "forward": forward})
+
+
+ReLU = _act_layer("ReLU", "relu")
+ReLU6 = _act_layer("ReLU6", "relu6")
+GELU = _act_layer("GELU", "gelu")
+Silu = _act_layer("Silu", "silu")
+Swish = _act_layer("Swish", "swish")
+Sigmoid = _act_layer("Sigmoid", "sigmoid")
+Tanh = _act_layer("Tanh", "tanh")
+Softmax = _act_layer("Softmax", "softmax")
+LogSoftmax = _act_layer("LogSoftmax", "log_softmax")
+Softplus = _act_layer("Softplus", "softplus")
+Softsign = _act_layer("Softsign", "softsign")
+Softshrink = _act_layer("Softshrink", "softshrink")
+Hardshrink = _act_layer("Hardshrink", "hardshrink")
+Hardsigmoid = _act_layer("Hardsigmoid", "hardsigmoid")
+Hardswish = _act_layer("Hardswish", "hardswish")
+Hardtanh = _act_layer("Hardtanh", "hardtanh")
+LeakyReLU = _act_layer("LeakyReLU", "leaky_relu")
+ELU = _act_layer("ELU", "elu")
+CELU = _act_layer("CELU", "celu")
+SELU = _act_layer("SELU", "selu")
+Mish = _act_layer("Mish", "mish")
+Tanhshrink = _act_layer("Tanhshrink", "tanhshrink")
+ThresholdedReLU = _act_layer("ThresholdedReLU", "thresholded_relu")
+LogSigmoid = _act_layer("LogSigmoid", "log_sigmoid")
+Maxout = _act_layer("Maxout", "maxout", groups=2)
+GLU = _act_layer("GLU", "glu")
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters: int = 1, init: float = 0.25, weight_attr: Any = None, data_format: str = "NCHW", name: Any = None) -> None:
+        super().__init__()
+        from paddle_tpu.nn import initializer as I
+
+        self.data_format = data_format
+        self.weight = self.create_parameter(
+            [num_parameters], attr=weight_attr, default_initializer=I.Constant(init)
+        )
+
+    def forward(self, x: Any) -> Any:
+        return F.prelu(x, self.weight, data_format=self.data_format)

@@ -1,0 +1,146 @@
+"""Container layers (reference ``python/paddle/nn/layer/container.py``)."""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Tuple, Union
+
+from paddle_tpu.core.tensor import Parameter
+from paddle_tpu.nn.layer.layers import Layer
+
+
+class Sequential(Layer):
+    def __init__(self, *layers: Any) -> None:
+        super().__init__()
+        if len(layers) == 1 and isinstance(layers[0], OrderedDict):
+            for name, layer in layers[0].items():
+                self.add_sublayer(name, layer)
+        else:
+            for i, layer in enumerate(layers):
+                if isinstance(layer, tuple):
+                    self.add_sublayer(layer[0], layer[1])
+                else:
+                    self.add_sublayer(str(i), layer)
+
+    def __getitem__(self, idx: Union[int, slice]) -> Any:
+        items = list(self._sub_layers.values())
+        if isinstance(idx, slice):
+            return Sequential(*items[idx])
+        return items[idx]
+
+    def __len__(self) -> int:
+        return len(self._sub_layers)
+
+    def __iter__(self) -> Iterator[Layer]:
+        return iter(self._sub_layers.values())
+
+    def forward(self, x: Any) -> Any:
+        for layer in self._sub_layers.values():
+            x = layer(x)
+        return x
+
+
+class LayerList(Layer):
+    def __init__(self, sublayers: Optional[Iterable[Layer]] = None) -> None:
+        super().__init__()
+        if sublayers is not None:
+            for i, layer in enumerate(sublayers):
+                self.add_sublayer(str(i), layer)
+
+    def __getitem__(self, idx: Union[int, slice]) -> Any:
+        items = list(self._sub_layers.values())
+        if isinstance(idx, slice):
+            return LayerList(items[idx])
+        return items[idx]
+
+    def __setitem__(self, idx: int, layer: Layer) -> None:
+        self._sub_layers[str(idx % len(self))] = layer
+
+    def __len__(self) -> int:
+        return len(self._sub_layers)
+
+    def __iter__(self) -> Iterator[Layer]:
+        return iter(self._sub_layers.values())
+
+    def append(self, layer: Layer) -> "LayerList":
+        self.add_sublayer(str(len(self)), layer)
+        return self
+
+    def insert(self, index: int, layer: Layer) -> None:
+        layers = list(self._sub_layers.values())
+        layers.insert(index, layer)
+        self._sub_layers.clear()
+        for i, l in enumerate(layers):
+            self._sub_layers[str(i)] = l
+
+    def extend(self, sublayers: Iterable[Layer]) -> "LayerList":
+        for layer in sublayers:
+            self.append(layer)
+        return self
+
+
+class ParameterList(Layer):
+    def __init__(self, parameters: Optional[Iterable[Parameter]] = None) -> None:
+        super().__init__()
+        if parameters is not None:
+            for i, p in enumerate(parameters):
+                self.add_parameter(str(i), p)
+
+    def __getitem__(self, idx: int) -> Parameter:
+        return list(self._parameters.values())[idx]
+
+    def __len__(self) -> int:
+        return len(self._parameters)
+
+    def __iter__(self) -> Iterator[Parameter]:
+        return iter(self._parameters.values())
+
+    def append(self, parameter: Parameter) -> "ParameterList":
+        self.add_parameter(str(len(self)), parameter)
+        return self
+
+
+class LayerDict(Layer):
+    def __init__(self, sublayers: Optional[Dict[str, Layer]] = None) -> None:
+        super().__init__()
+        if sublayers is not None:
+            self.update(sublayers)
+
+    def __getitem__(self, key: str) -> Layer:
+        return self._sub_layers[key]
+
+    def __setitem__(self, key: str, layer: Layer) -> None:
+        self.add_sublayer(key, layer)
+
+    def __delitem__(self, key: str) -> None:
+        del self._sub_layers[key]
+
+    def __len__(self) -> int:
+        return len(self._sub_layers)
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._sub_layers)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._sub_layers
+
+    def clear(self) -> None:
+        self._sub_layers.clear()
+
+    def pop(self, key: str) -> Layer:
+        layer = self._sub_layers[key]
+        del self._sub_layers[key]
+        return layer
+
+    def keys(self) -> Iterable[str]:
+        return self._sub_layers.keys()
+
+    def items(self) -> Iterable[Tuple[str, Layer]]:
+        return self._sub_layers.items()
+
+    def values(self) -> Iterable[Layer]:
+        return self._sub_layers.values()
+
+    def update(self, sublayers: Dict[str, Layer]) -> None:
+        for k, v in sublayers.items():
+            self.add_sublayer(k, v)

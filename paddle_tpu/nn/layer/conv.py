@@ -1,0 +1,122 @@
+"""Conv layers (reference ``python/paddle/nn/layer/conv.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Union
+
+import numpy as np
+
+import paddle_tpu.nn.functional as F
+from paddle_tpu.nn.layer.layers import Layer
+
+
+def _ntuple(v: Any, n: int) -> tuple:
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return tuple(int(v) for _ in range(n))
+
+
+class _ConvNd(Layer):
+    def __init__(
+        self,
+        in_channels: int,
+        out_channels: int,
+        kernel_size: Any,
+        ndim: int,
+        stride: Any = 1,
+        padding: Any = 0,
+        dilation: Any = 1,
+        groups: int = 1,
+        padding_mode: str = "zeros",
+        weight_attr: Any = None,
+        bias_attr: Any = None,
+        data_format: str = "NCHW",
+        transpose: bool = False,
+        output_padding: Any = 0,
+    ) -> None:
+        super().__init__()
+        from paddle_tpu.nn import initializer as I
+
+        self._ndim = ndim
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        self.kernel_size = _ntuple(kernel_size, ndim)
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+        self.groups = groups
+        self.data_format = data_format
+        self.output_padding = output_padding
+        self._transpose = transpose
+        if transpose:
+            shape = [in_channels, out_channels // groups, *self.kernel_size]
+        else:
+            shape = [out_channels, in_channels // groups, *self.kernel_size]
+        fan_in = (in_channels // groups) * int(np.prod(self.kernel_size))
+        self.weight = self.create_parameter(
+            shape,
+            attr=weight_attr,
+            default_initializer=I.KaimingUniform(fan_in=fan_in, negative_slope=np.sqrt(5.0), nonlinearity="leaky_relu"),
+        )
+        if bias_attr is not False:
+            bound = 1.0 / np.sqrt(fan_in)
+            self.bias = self.create_parameter(
+                [out_channels], attr=bias_attr, is_bias=True,
+                default_initializer=I.Uniform(-bound, bound),
+            )
+        else:
+            self.bias = None
+
+    def extra_repr(self) -> str:
+        return (
+            f"{self.in_channels}, {self.out_channels}, kernel_size={self.kernel_size}, "
+            f"stride={self.stride}, padding={self.padding}"
+        )
+
+
+class Conv1D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0, dilation=1, groups=1, padding_mode="zeros", weight_attr=None, bias_attr=None, data_format="NCL") -> None:
+        super().__init__(in_channels, out_channels, kernel_size, 1, stride, padding, dilation, groups, padding_mode, weight_attr, bias_attr, data_format)
+
+    def forward(self, x: Any) -> Any:
+        return F.conv1d(x, self.weight, self.bias, self.stride, self.padding, self.dilation, self.groups, self.data_format)
+
+
+class Conv2D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0, dilation=1, groups=1, padding_mode="zeros", weight_attr=None, bias_attr=None, data_format="NCHW") -> None:
+        super().__init__(in_channels, out_channels, kernel_size, 2, stride, padding, dilation, groups, padding_mode, weight_attr, bias_attr, data_format)
+
+    def forward(self, x: Any) -> Any:
+        return F.conv2d(x, self.weight, self.bias, self.stride, self.padding, self.dilation, self.groups, self.data_format)
+
+
+class Conv3D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0, dilation=1, groups=1, padding_mode="zeros", weight_attr=None, bias_attr=None, data_format="NCDHW") -> None:
+        super().__init__(in_channels, out_channels, kernel_size, 3, stride, padding, dilation, groups, padding_mode, weight_attr, bias_attr, data_format)
+
+    def forward(self, x: Any) -> Any:
+        return F.conv3d(x, self.weight, self.bias, self.stride, self.padding, self.dilation, self.groups, self.data_format)
+
+
+class Conv1DTranspose(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0, output_padding=0, groups=1, dilation=1, weight_attr=None, bias_attr=None, data_format="NCL") -> None:
+        super().__init__(in_channels, out_channels, kernel_size, 1, stride, padding, dilation, groups, "zeros", weight_attr, bias_attr, data_format, transpose=True, output_padding=output_padding)
+
+    def forward(self, x: Any, output_size: Any = None) -> Any:
+        return F.conv1d_transpose(x, self.weight, self.bias, self.stride, self.padding, self.output_padding, self.dilation, self.groups, self.data_format)
+
+
+class Conv2DTranspose(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0, output_padding=0, groups=1, dilation=1, weight_attr=None, bias_attr=None, data_format="NCHW") -> None:
+        super().__init__(in_channels, out_channels, kernel_size, 2, stride, padding, dilation, groups, "zeros", weight_attr, bias_attr, data_format, transpose=True, output_padding=output_padding)
+
+    def forward(self, x: Any, output_size: Any = None) -> Any:
+        return F.conv2d_transpose(x, self.weight, self.bias, self.stride, self.padding, self.output_padding, self.dilation, self.groups, self.data_format)
+
+
+class Conv3DTranspose(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0, output_padding=0, groups=1, dilation=1, weight_attr=None, bias_attr=None, data_format="NCDHW") -> None:
+        super().__init__(in_channels, out_channels, kernel_size, 3, stride, padding, dilation, groups, "zeros", weight_attr, bias_attr, data_format, transpose=True, output_padding=output_padding)
+
+    def forward(self, x: Any, output_size: Any = None) -> Any:
+        return F.conv3d_transpose(x, self.weight, self.bias, self.stride, self.padding, self.output_padding, self.dilation, self.groups, self.data_format)

@@ -1,0 +1,362 @@
+"""Layer: the module base class.
+
+Reference: ``python/paddle/nn/layer/layers.py`` (2.7k lines) — parameter
+registration via ``__setattr__``, sublayer tree, state_dict, train/eval,
+forward hooks, ``to()`` casting. Parameters here are eager Tensors whose
+buffers live on device (PJRT); a Layer is also directly traceable by
+``paddle_tpu.jit`` because forward only touches Tensor ops.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.dtypes import convert_dtype
+from paddle_tpu.core.tensor import Parameter, Tensor
+from paddle_tpu.errors import InvalidArgumentError
+from paddle_tpu.framework.param_attr import ParamAttr
+
+
+class HookRemoveHelper:
+    def __init__(self, hooks: Dict[int, Callable], hook_id: int) -> None:
+        self._hooks = hooks
+        self._hook_id = hook_id
+
+    def remove(self) -> None:
+        self._hooks.pop(self._hook_id, None)
+
+
+class Layer:
+    def __init__(self, name_scope: Optional[str] = None, dtype: Any = "float32") -> None:
+        self.training = True
+        self._dtype = convert_dtype(dtype)
+        self._parameters: Dict[str, Optional[Parameter]] = collections.OrderedDict()
+        self._sub_layers: Dict[str, Optional["Layer"]] = collections.OrderedDict()
+        self._buffers: Dict[str, Optional[Tensor]] = collections.OrderedDict()
+        self._non_persistable_buffer_names: set = set()
+        self._forward_pre_hooks: Dict[int, Callable] = collections.OrderedDict()
+        self._forward_post_hooks: Dict[int, Callable] = collections.OrderedDict()
+        self._hook_id = 0
+        self._name_scope = name_scope or self.__class__.__name__.lower()
+
+    # -- registration ---------------------------------------------------------
+    def __setattr__(self, name: str, value: Any) -> None:
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call Layer.__init__() before assigning parameters")
+            _remove_from(name, layers, buffers)
+            params[name] = value
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call Layer.__init__() before assigning sublayers")
+            _remove_from(name, params, buffers)
+            layers[name] = value
+            self.__dict__.pop(name, None)
+        elif params is not None and name in params:
+            if value is None:
+                params[name] = None
+            elif isinstance(value, Tensor):
+                params[name] = Parameter(value._data, name=value.name)
+            else:
+                raise InvalidArgumentError(f"cannot assign {type(value)} to parameter {name}")
+        elif layers is not None and name in layers:
+            if value is None:
+                layers[name] = None
+            else:
+                raise InvalidArgumentError(f"cannot assign {type(value)} to sublayer {name}")
+        elif buffers is not None and name in buffers:
+            if value is None:
+                buffers[name] = None
+            else:
+                buffers[name] = value if isinstance(value, Tensor) else Tensor(value)
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name: str) -> Any:
+        if "_parameters" in self.__dict__ and name in self.__dict__["_parameters"]:
+            return self.__dict__["_parameters"][name]
+        if "_sub_layers" in self.__dict__ and name in self.__dict__["_sub_layers"]:
+            return self.__dict__["_sub_layers"][name]
+        if "_buffers" in self.__dict__ and name in self.__dict__["_buffers"]:
+            return self.__dict__["_buffers"][name]
+        raise AttributeError(f"'{type(self).__name__}' object has no attribute '{name}'")
+
+    def __delattr__(self, name: str) -> None:
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def add_parameter(self, name: str, parameter: Optional[Parameter]) -> Optional[Parameter]:
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name: str, sublayer: "Layer") -> "Layer":
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    def register_buffer(self, name: str, tensor: Optional[Tensor], persistable: bool = True) -> None:
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+
+    def create_parameter(
+        self,
+        shape: Sequence[int],
+        attr: Any = None,
+        dtype: Any = None,
+        is_bias: bool = False,
+        default_initializer: Any = None,
+    ) -> Parameter:
+        """Reference ``Layer.create_parameter``: ParamAttr + initializer →
+        device Parameter."""
+        from paddle_tpu.nn import initializer as I
+
+        attr = ParamAttr._to_attr(attr)
+        dtype = convert_dtype(dtype) if dtype is not None else self._dtype
+        init = None
+        if attr is not None and attr.initializer is not None:
+            init = attr.initializer
+        elif default_initializer is not None:
+            init = default_initializer
+        else:
+            init = I.Constant(0.0) if is_bias else I.XavierNormal()
+        p = Parameter(
+            jnp.zeros(tuple(int(s) for s in shape), dtype),
+            name=(attr.name if attr is not None else None),
+            trainable=(attr.trainable if attr is not None else True),
+        )
+        init(p)
+        if attr is not None:
+            p.optimize_attr = {"learning_rate": attr.learning_rate}
+            p.need_clip = attr.need_clip
+        return p
+
+    # -- traversal ------------------------------------------------------------
+    def children(self) -> Iterator["Layer"]:
+        for _, layer in self.named_children():
+            yield layer
+
+    def named_children(self) -> Iterator[Tuple[str, "Layer"]]:
+        seen = set()
+        for name, layer in self._sub_layers.items():
+            if layer is not None and id(layer) not in seen:
+                seen.add(id(layer))
+                yield name, layer
+
+    def sublayers(self, include_self: bool = False) -> List["Layer"]:
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def named_sublayers(
+        self, prefix: str = "", include_self: bool = False, layers_set: Optional[set] = None
+    ) -> Iterator[Tuple[str, "Layer"]]:
+        if layers_set is None:
+            layers_set = set()
+        if include_self and id(self) not in layers_set:
+            layers_set.add(id(self))
+            yield prefix, self
+        for name, layer in self.named_children():
+            if layer is None or id(layer) in layers_set:
+                continue
+            layers_set.add(id(layer))
+            sub_prefix = f"{prefix}.{name}" if prefix else name
+            yield sub_prefix, layer
+            yield from layer.named_sublayers(prefix=sub_prefix, layers_set=layers_set)
+
+    def parameters(self, include_sublayers: bool = True) -> List[Parameter]:
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_parameters(
+        self, prefix: str = "", include_sublayers: bool = True
+    ) -> Iterator[Tuple[str, Parameter]]:
+        seen = set()
+        layers = (
+            [(prefix, self)]
+            + [
+                (f"{prefix}.{n}" if prefix else n, l)
+                for n, l in self.named_sublayers()
+            ]
+            if include_sublayers
+            else [(prefix, self)]
+        )
+        for layer_prefix, layer in layers:
+            for name, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                yield (f"{layer_prefix}.{name}" if layer_prefix else name), p
+
+    def buffers(self, include_sublayers: bool = True) -> List[Tensor]:
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def named_buffers(
+        self, prefix: str = "", include_sublayers: bool = True
+    ) -> Iterator[Tuple[str, Tensor]]:
+        seen = set()
+        layers = (
+            [(prefix, self)]
+            + [(f"{prefix}.{n}" if prefix else n, l) for n, l in self.named_sublayers()]
+            if include_sublayers
+            else [(prefix, self)]
+        )
+        for layer_prefix, layer in layers:
+            for name, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                yield (f"{layer_prefix}.{name}" if layer_prefix else name), b
+
+    def apply(self, fn: Callable[["Layer"], None]) -> "Layer":
+        for layer in self.children():
+            layer.apply(fn)
+        fn(self)
+        return self
+
+    # -- modes ---------------------------------------------------------------
+    def train(self) -> "Layer":
+        self.training = True
+        for layer in self.sublayers():
+            layer.training = True
+        return self
+
+    def eval(self) -> "Layer":
+        self.training = False
+        for layer in self.sublayers():
+            layer.training = False
+        return self
+
+    # -- state dict -----------------------------------------------------------
+    def state_dict(
+        self,
+        destination: Optional[Dict[str, Tensor]] = None,
+        include_sublayers: bool = True,
+        structured_name_prefix: str = "",
+        use_hook: bool = True,
+    ) -> Dict[str, Tensor]:
+        dest: Dict[str, Tensor] = destination if destination is not None else collections.OrderedDict()
+        for name, p in self.named_parameters(prefix=structured_name_prefix.rstrip(".")):
+            dest[name] = p
+        for name, b in self.named_buffers(prefix=structured_name_prefix.rstrip(".")):
+            # skip non-persistable buffers
+            short = name.rsplit(".", 1)[-1]
+            owner = self
+            if short not in self._non_persistable_buffer_names:
+                dest[name] = b
+        return dest
+
+    def set_state_dict(self, state_dict: Dict[str, Any], use_structured_name: bool = True) -> Tuple[List[str], List[str]]:
+        """Load values into matching parameters/buffers; returns (missing, unexpected)."""
+        own = self.state_dict()
+        missing: List[str] = []
+        unexpected: List[str] = [k for k in state_dict if k not in own]
+        import paddle_tpu
+
+        with paddle_tpu.no_grad():
+            for name, target in own.items():
+                if name not in state_dict:
+                    missing.append(name)
+                    continue
+                value = state_dict[name]
+                arr = value.numpy() if hasattr(value, "numpy") else np.asarray(value)
+                target.set_value(arr)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    def to(self, device: Any = None, dtype: Any = None, blocking: Optional[bool] = None) -> "Layer":
+        import paddle_tpu
+
+        with paddle_tpu.no_grad():
+            if dtype is not None:
+                dt = convert_dtype(dtype)
+                for p in self.parameters():
+                    if jnp.issubdtype(jnp.dtype(p.dtype), jnp.floating):
+                        p._data = p._data.astype(dt)
+                for b in self.buffers():
+                    if jnp.issubdtype(jnp.dtype(b.dtype), jnp.floating):
+                        b._data = b._data.astype(dt)
+                self._dtype = dt
+            if device is not None:
+                from paddle_tpu.core.device import _parse
+
+                place = _parse(device) if isinstance(device, str) else device
+                import jax as _jax
+
+                for t in list(self.parameters()) + list(self.buffers()):
+                    t._data = _jax.device_put(t._data, place.jax_device())
+        return self
+
+    def astype(self, dtype: Any) -> "Layer":
+        return self.to(dtype=dtype)
+
+    def float(self) -> "Layer":
+        return self.to(dtype="float32")
+
+    def bfloat16(self) -> "Layer":
+        return self.to(dtype="bfloat16")
+
+    # -- hooks + call ---------------------------------------------------------
+    def register_forward_pre_hook(self, hook: Callable) -> HookRemoveHelper:
+        self._hook_id += 1
+        self._forward_pre_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, self._hook_id)
+
+    def register_forward_post_hook(self, hook: Callable) -> HookRemoveHelper:
+        self._hook_id += 1
+        self._forward_post_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_post_hooks, self._hook_id)
+
+    def forward(self, *inputs: Any, **kwargs: Any) -> Any:
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement forward()"
+        )
+
+    def __call__(self, *inputs: Any, **kwargs: Any) -> Any:
+        for hook in list(self._forward_pre_hooks.values()):
+            result = hook(self, inputs)
+            if result is not None:
+                inputs = result if isinstance(result, tuple) else (result,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in list(self._forward_post_hooks.values()):
+            result = hook(self, inputs, outputs)
+            if result is not None:
+                outputs = result
+        return outputs
+
+    def full_name(self) -> str:
+        return self._name_scope
+
+    def extra_repr(self) -> str:
+        return ""
+
+    def __repr__(self) -> str:
+        extra = self.extra_repr()
+        lines = []
+        for name, layer in self.named_children():
+            mod_str = repr(layer)
+            mod_str = "\n".join("  " + l for l in mod_str.split("\n"))
+            lines.append(f"  ({name}): {mod_str.strip()}")
+        main = f"{type(self).__name__}({extra}"
+        if lines:
+            return main + "\n" + "\n".join(lines) + "\n)"
+        return main + ")"
+
+    def clear_gradients(self) -> None:
+        for p in self.parameters():
+            p.clear_grad()
+
+
+def _remove_from(name: str, *dicts: Optional[Dict[str, Any]]) -> None:
+    for d in dicts:
+        if d is not None and name in d:
+            del d[name]

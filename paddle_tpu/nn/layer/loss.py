@@ -1,0 +1,135 @@
+"""Loss layers (reference ``python/paddle/nn/layer/loss.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import paddle_tpu.nn.functional as F
+from paddle_tpu.nn.layer.layers import Layer
+
+
+class CrossEntropyLoss(Layer):
+    def __init__(
+        self,
+        weight: Any = None,
+        ignore_index: int = -100,
+        reduction: str = "mean",
+        soft_label: bool = False,
+        axis: int = -1,
+        use_softmax: bool = True,
+        label_smoothing: float = 0.0,
+        name: Any = None,
+    ) -> None:
+        super().__init__()
+        self.weight = weight
+        self.ignore_index = ignore_index
+        self.reduction = reduction
+        self.soft_label = soft_label
+        self.axis = axis
+        self.use_softmax = use_softmax
+        self.label_smoothing = label_smoothing
+
+    def forward(self, input: Any, label: Any) -> Any:  # noqa: A002
+        return F.cross_entropy(
+            input,
+            label,
+            weight=self.weight,
+            ignore_index=self.ignore_index,
+            reduction=self.reduction,
+            soft_label=self.soft_label,
+            axis=self.axis,
+            use_softmax=self.use_softmax,
+            label_smoothing=self.label_smoothing,
+        )
+
+
+class MSELoss(Layer):
+    def __init__(self, reduction: str = "mean") -> None:
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input: Any, label: Any) -> Any:  # noqa: A002
+        return F.mse_loss(input, label, reduction=self.reduction)
+
+
+class L1Loss(Layer):
+    def __init__(self, reduction: str = "mean", name: Any = None) -> None:
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input: Any, label: Any) -> Any:  # noqa: A002
+        return F.l1_loss(input, label, reduction=self.reduction)
+
+
+class SmoothL1Loss(Layer):
+    def __init__(self, reduction: str = "mean", delta: float = 1.0, name: Any = None) -> None:
+        super().__init__()
+        self.reduction = reduction
+        self.delta = delta
+
+    def forward(self, input: Any, label: Any) -> Any:  # noqa: A002
+        return F.smooth_l1_loss(input, label, reduction=self.reduction, delta=self.delta)
+
+
+class NLLLoss(Layer):
+    def __init__(self, weight: Any = None, ignore_index: int = -100, reduction: str = "mean", name: Any = None) -> None:
+        super().__init__()
+        self.weight = weight
+        self.ignore_index = ignore_index
+        self.reduction = reduction
+
+    def forward(self, input: Any, label: Any) -> Any:  # noqa: A002
+        return F.nll_loss(input, label, weight=self.weight, ignore_index=self.ignore_index, reduction=self.reduction)
+
+
+class BCELoss(Layer):
+    def __init__(self, weight: Any = None, reduction: str = "mean", name: Any = None) -> None:
+        super().__init__()
+        self.weight = weight
+        self.reduction = reduction
+
+    def forward(self, input: Any, label: Any) -> Any:  # noqa: A002
+        return F.binary_cross_entropy(input, label, weight=self.weight, reduction=self.reduction)
+
+
+class BCEWithLogitsLoss(Layer):
+    def __init__(self, weight: Any = None, reduction: str = "mean", pos_weight: Any = None, name: Any = None) -> None:
+        super().__init__()
+        self.weight = weight
+        self.reduction = reduction
+        self.pos_weight = pos_weight
+
+    def forward(self, logit: Any, label: Any) -> Any:
+        return F.binary_cross_entropy_with_logits(
+            logit, label, weight=self.weight, reduction=self.reduction, pos_weight=self.pos_weight
+        )
+
+
+class KLDivLoss(Layer):
+    def __init__(self, reduction: str = "mean", log_target: bool = False) -> None:
+        super().__init__()
+        self.reduction = reduction
+        self.log_target = log_target
+
+    def forward(self, input: Any, label: Any) -> Any:  # noqa: A002
+        return F.kl_div(input, label, reduction=self.reduction, log_target=self.log_target)
+
+
+class MarginRankingLoss(Layer):
+    def __init__(self, margin: float = 0.0, reduction: str = "mean", name: Any = None) -> None:
+        super().__init__()
+        self.margin = margin
+        self.reduction = reduction
+
+    def forward(self, input: Any, other: Any, label: Any) -> Any:  # noqa: A002
+        return F.margin_ranking_loss(input, other, label, margin=self.margin, reduction=self.reduction)
+
+
+class CTCLoss(Layer):
+    def __init__(self, blank: int = 0, reduction: str = "mean") -> None:
+        super().__init__()
+        self.blank = blank
+        self.reduction = reduction
+
+    def forward(self, log_probs: Any, labels: Any, input_lengths: Any, label_lengths: Any, norm_by_times: bool = False) -> Any:
+        return F.ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=self.blank, reduction=self.reduction)

@@ -1,0 +1,280 @@
+"""Normalization layers (reference ``python/paddle/nn/layer/norm.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Union
+
+import jax.numpy as jnp
+
+import paddle_tpu.nn.functional as F
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn.layer.layers import Layer
+
+
+class _BatchNormBase(Layer):
+    def __init__(
+        self,
+        num_features: int,
+        momentum: float = 0.9,
+        epsilon: float = 1e-5,
+        weight_attr: Any = None,
+        bias_attr: Any = None,
+        data_format: str = "NCHW",
+        use_global_stats: Optional[bool] = None,
+        name: Any = None,
+    ) -> None:
+        super().__init__()
+        from paddle_tpu.nn import initializer as I
+
+        self.num_features = num_features
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.data_format = data_format
+        self.use_global_stats = use_global_stats
+        if weight_attr is not False:
+            self.weight = self.create_parameter(
+                [num_features], attr=weight_attr, default_initializer=I.Constant(1.0)
+            )
+        else:
+            self.weight = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter([num_features], attr=bias_attr, is_bias=True)
+        else:
+            self.bias = None
+        self.register_buffer("_mean", Tensor(jnp.zeros(num_features, jnp.float32)))
+        self.register_buffer("_variance", Tensor(jnp.ones(num_features, jnp.float32)))
+
+    def forward(self, x: Any) -> Any:
+        training = self.training and not (self.use_global_stats or False)
+        return F.batch_norm(
+            x,
+            self._mean,
+            self._variance,
+            weight=self.weight,
+            bias=self.bias,
+            training=training,
+            momentum=self.momentum,
+            epsilon=self.epsilon,
+            data_format=self.data_format,
+        )
+
+    def extra_repr(self) -> str:
+        return f"num_features={self.num_features}, momentum={self.momentum}, epsilon={self.epsilon}"
+
+
+class BatchNorm(_BatchNormBase):
+    pass
+
+
+class BatchNorm1D(_BatchNormBase):
+    pass
+
+
+class BatchNorm2D(_BatchNormBase):
+    pass
+
+
+class BatchNorm3D(_BatchNormBase):
+    pass
+
+
+class SyncBatchNorm(_BatchNormBase):
+    """Cross-replica batch norm. Under SPMD jit, XLA computes global batch
+    stats automatically when the batch axis is sharded (GSPMD all-reduces the
+    partial moments) — so this is the same computation as BatchNorm; the
+    distinction the reference draws (``nn.SyncBatchNorm`` over NCCL) is
+    compiler-handled on TPU."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer: Layer) -> Layer:
+        return layer
+
+
+class LayerNorm(Layer):
+    def __init__(
+        self,
+        normalized_shape: Any,
+        epsilon: float = 1e-5,
+        weight_attr: Any = None,
+        bias_attr: Any = None,
+        name: Any = None,
+    ) -> None:
+        super().__init__()
+        from paddle_tpu.nn import initializer as I
+
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        self.normalized_shape = list(normalized_shape)
+        self.epsilon = epsilon
+        if weight_attr is not False:
+            self.weight = self.create_parameter(
+                self.normalized_shape, attr=weight_attr, default_initializer=I.Constant(1.0)
+            )
+        else:
+            self.weight = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter(self.normalized_shape, attr=bias_attr, is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, x: Any) -> Any:
+        return F.layer_norm(x, self.normalized_shape, self.weight, self.bias, self.epsilon)
+
+    def extra_repr(self) -> str:
+        return f"normalized_shape={self.normalized_shape}, epsilon={self.epsilon}"
+
+
+class RMSNorm(Layer):
+    """RMSNorm layer (reference exposes fused rms_norm via
+    ``paddle.incubate.nn.functional.fused_rms_norm``; first-class layer here)."""
+
+    def __init__(
+        self,
+        normalized_shape: Any,
+        epsilon: float = 1e-6,
+        weight_attr: Any = None,
+        name: Any = None,
+    ) -> None:
+        super().__init__()
+        from paddle_tpu.nn import initializer as I
+
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        self.normalized_shape = list(normalized_shape)
+        self.epsilon = epsilon
+        if weight_attr is not False:
+            self.weight = self.create_parameter(
+                self.normalized_shape, attr=weight_attr, default_initializer=I.Constant(1.0)
+            )
+        else:
+            self.weight = None
+
+    def forward(self, x: Any) -> Any:
+        return F.rms_norm(x, self.weight, self.epsilon)
+
+
+class GroupNorm(Layer):
+    def __init__(
+        self,
+        num_groups: int,
+        num_channels: int,
+        epsilon: float = 1e-5,
+        weight_attr: Any = None,
+        bias_attr: Any = None,
+        data_format: str = "NCHW",
+        name: Any = None,
+    ) -> None:
+        super().__init__()
+        from paddle_tpu.nn import initializer as I
+
+        self.num_groups = num_groups
+        self.num_channels = num_channels
+        self.epsilon = epsilon
+        self.data_format = data_format
+        if weight_attr is not False:
+            self.weight = self.create_parameter(
+                [num_channels], attr=weight_attr, default_initializer=I.Constant(1.0)
+            )
+        else:
+            self.weight = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter([num_channels], attr=bias_attr, is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, x: Any) -> Any:
+        return F.group_norm(x, self.num_groups, self.weight, self.bias, self.epsilon, self.data_format)
+
+
+class _InstanceNormBase(Layer):
+    def __init__(
+        self,
+        num_features: int,
+        epsilon: float = 1e-5,
+        momentum: float = 0.9,
+        weight_attr: Any = None,
+        bias_attr: Any = None,
+        data_format: str = "NCHW",
+        name: Any = None,
+    ) -> None:
+        super().__init__()
+        from paddle_tpu.nn import initializer as I
+
+        self.epsilon = epsilon
+        if weight_attr is not False:
+            self.weight = self.create_parameter(
+                [num_features], attr=weight_attr, default_initializer=I.Constant(1.0)
+            )
+            self.bias = self.create_parameter([num_features], attr=bias_attr, is_bias=True)
+        else:
+            self.weight = None
+            self.bias = None
+
+    def forward(self, x: Any) -> Any:
+        return F.instance_norm(x, self.weight, self.bias, self.epsilon)
+
+
+class InstanceNorm1D(_InstanceNormBase):
+    pass
+
+
+class InstanceNorm2D(_InstanceNormBase):
+    pass
+
+
+class InstanceNorm3D(_InstanceNormBase):
+    pass
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size: int, alpha: float = 1e-4, beta: float = 0.75, k: float = 1.0, data_format: str = "NCHW", name: Any = None) -> None:
+        super().__init__()
+        self.size = size
+        self.alpha = alpha
+        self.beta = beta
+        self.k = k
+        self.data_format = data_format
+
+    def forward(self, x: Any) -> Any:
+        return F.local_response_norm(x, self.size, self.alpha, self.beta, self.k, self.data_format)
+
+
+class SpectralNorm(Layer):
+    def __init__(self, weight_shape: Sequence[int], dim: int = 0, power_iters: int = 1, epsilon: float = 1e-12, name: Any = None) -> None:
+        super().__init__()
+        self.dim = dim
+        self.power_iters = power_iters
+        self.epsilon = epsilon
+        import numpy as np
+
+        h = weight_shape[dim]
+        w = int(np.prod(weight_shape)) // h
+        from paddle_tpu.nn import initializer as I
+
+        self.weight_u = self.create_parameter([h], default_initializer=I.Normal(0, 1))
+        self.weight_v = self.create_parameter([w], default_initializer=I.Normal(0, 1))
+        self.weight_u.stop_gradient = True
+        self.weight_v.stop_gradient = True
+
+    def forward(self, weight: Any) -> Any:
+        import paddle_tpu
+
+        mat = weight
+        if self.dim != 0:
+            perm = [self.dim] + [d for d in range(mat.ndim) if d != self.dim]
+            from paddle_tpu.ops.linalg import transpose
+
+            mat = transpose(mat, perm)
+        h = mat.shape[0]
+        mat2d = mat.reshape([h, -1])
+        u, v = self.weight_u, self.weight_v
+        with paddle_tpu.no_grad():
+            for _ in range(self.power_iters):
+                v_new = (mat2d.T @ u)
+                v_new = v_new / (v_new.norm() + self.epsilon)
+                u_new = mat2d @ v_new
+                u_new = u_new / (u_new.norm() + self.epsilon)
+                u.set_value(u_new.data)
+                v.set_value(v_new.data)
+        sigma = (u.reshape([1, -1]) @ mat2d @ v.reshape([-1, 1])).reshape([])
+        return weight / sigma

@@ -1,0 +1,107 @@
+"""Pooling layers (reference ``python/paddle/nn/layer/pooling.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import paddle_tpu.nn.functional as F
+from paddle_tpu.nn.layer.layers import Layer
+
+
+class _PoolNd(Layer):
+    def __init__(self, kernel_size: Any, stride: Any = None, padding: Any = 0, ceil_mode: bool = False, data_format: Optional[str] = None, **kw: Any) -> None:
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.ceil_mode = ceil_mode
+        self.data_format = data_format
+
+    def extra_repr(self) -> str:
+        return f"kernel_size={self.kernel_size}, stride={self.stride}, padding={self.padding}"
+
+
+class MaxPool1D(_PoolNd):
+    def forward(self, x: Any) -> Any:
+        return F.max_pool1d(x, self.kernel_size, self.stride, self.padding, self.ceil_mode, self.data_format or "NCL")
+
+
+class MaxPool2D(_PoolNd):
+    def forward(self, x: Any) -> Any:
+        return F.max_pool2d(x, self.kernel_size, self.stride, self.padding, self.ceil_mode, self.data_format or "NCHW")
+
+
+class MaxPool3D(_PoolNd):
+    def forward(self, x: Any) -> Any:
+        return F.max_pool3d(x, self.kernel_size, self.stride, self.padding, self.ceil_mode, self.data_format or "NCDHW")
+
+
+class AvgPool1D(_PoolNd):
+    def forward(self, x: Any) -> Any:
+        return F.avg_pool1d(x, self.kernel_size, self.stride, self.padding, data_format=self.data_format or "NCL")
+
+
+class AvgPool2D(_PoolNd):
+    def forward(self, x: Any) -> Any:
+        return F.avg_pool2d(x, self.kernel_size, self.stride, self.padding, self.ceil_mode, data_format=self.data_format or "NCHW")
+
+
+class AvgPool3D(_PoolNd):
+    def forward(self, x: Any) -> Any:
+        return F.avg_pool3d(x, self.kernel_size, self.stride, self.padding, self.ceil_mode, data_format=self.data_format or "NCDHW")
+
+
+class AdaptiveAvgPool1D(Layer):
+    def __init__(self, output_size: Any, name: Any = None) -> None:
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x: Any) -> Any:
+        return F.adaptive_avg_pool1d(x, self.output_size)
+
+
+class AdaptiveAvgPool2D(Layer):
+    def __init__(self, output_size: Any, data_format: str = "NCHW", name: Any = None) -> None:
+        super().__init__()
+        self.output_size = output_size
+        self.data_format = data_format
+
+    def forward(self, x: Any) -> Any:
+        return F.adaptive_avg_pool2d(x, self.output_size, self.data_format)
+
+
+class AdaptiveAvgPool3D(Layer):
+    def __init__(self, output_size: Any, data_format: str = "NCDHW", name: Any = None) -> None:
+        super().__init__()
+        self.output_size = output_size
+        self.data_format = data_format
+
+    def forward(self, x: Any) -> Any:
+        return F.adaptive_avg_pool3d(x, self.output_size, self.data_format)
+
+
+class AdaptiveMaxPool1D(Layer):
+    def __init__(self, output_size: Any, return_mask: bool = False, name: Any = None) -> None:
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x: Any) -> Any:
+        return F.adaptive_max_pool1d(x, self.output_size)
+
+
+class AdaptiveMaxPool2D(Layer):
+    def __init__(self, output_size: Any, return_mask: bool = False, name: Any = None) -> None:
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x: Any) -> Any:
+        return F.adaptive_max_pool2d(x, self.output_size)
+
+
+class AdaptiveMaxPool3D(Layer):
+    def __init__(self, output_size: Any, return_mask: bool = False, name: Any = None) -> None:
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x: Any) -> Any:
+        return F.adaptive_max_pool3d(x, self.output_size)

@@ -1,0 +1,11 @@
+"""Functional op layer.
+
+Counterpart of the reference's PHI op library + YAML op registry
+(``paddle/phi/ops/yaml/ops.yaml``, 466 ops): every op is declared through
+``paddle_tpu.ops.registry`` which registers (1) the functional API, (2) the
+autograd rule (implicitly, via jax.vjp over the pure function), (3) abstract
+eval / shape inference (via jax.eval_shape on the same function — the
+infermeta analog), and (4) Tensor-method binding.
+"""
+
+from paddle_tpu.ops import registry  # noqa: F401

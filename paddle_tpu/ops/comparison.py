@@ -1,0 +1,64 @@
+"""Comparison ops (reference ``python/paddle/tensor/logic.py`` comparison family)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.ops.registry import defop
+
+__all__ = [
+    "equal",
+    "not_equal",
+    "less_than",
+    "less_equal",
+    "greater_than",
+    "greater_equal",
+    "equal_all",
+    "allclose",
+    "isclose",
+]
+
+
+@defop("equal")
+def equal(x, y):
+    return jnp.equal(x, y)
+
+
+@defop("not_equal")
+def not_equal(x, y):
+    return jnp.not_equal(x, y)
+
+
+@defop("less_than")
+def less_than(x, y):
+    return jnp.less(x, y)
+
+
+@defop("less_equal")
+def less_equal(x, y):
+    return jnp.less_equal(x, y)
+
+
+@defop("greater_than")
+def greater_than(x, y):
+    return jnp.greater(x, y)
+
+
+@defop("greater_equal")
+def greater_equal(x, y):
+    return jnp.greater_equal(x, y)
+
+
+@defop("equal_all")
+def equal_all(x, y):
+    return jnp.array_equal(x, y)
+
+
+@defop("allclose")
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@defop("isclose")
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)

@@ -1,0 +1,186 @@
+"""Tensor creation ops (reference ``python/paddle/tensor/creation.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.dtypes import convert_dtype
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops.registry import defop
+
+__all__ = [
+    "to_tensor",
+    "zeros",
+    "ones",
+    "full",
+    "empty",
+    "zeros_like",
+    "ones_like",
+    "full_like",
+    "empty_like",
+    "arange",
+    "linspace",
+    "logspace",
+    "eye",
+    "meshgrid",
+    "diag",
+    "diagflat",
+    "tril",
+    "triu",
+    "clone",
+    "assign",
+    "create_parameter",
+]
+
+
+def _shape(shape: Any) -> Sequence[int]:
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy().reshape(-1))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+def to_tensor(
+    data: Any,
+    dtype: Any = None,
+    place: Any = None,
+    stop_gradient: bool = True,
+) -> Tensor:
+    """``paddle.to_tensor`` parity: array-like → device Tensor."""
+    if isinstance(data, Tensor):
+        t = Tensor(data._data, dtype=dtype, place=place, stop_gradient=stop_gradient)
+        return t
+    return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)
+
+
+def zeros(shape: Any, dtype: Any = "float32", name: Any = None) -> Tensor:
+    return Tensor(jnp.zeros(_shape(shape), convert_dtype(dtype)))
+
+
+def ones(shape: Any, dtype: Any = "float32", name: Any = None) -> Tensor:
+    return Tensor(jnp.ones(_shape(shape), convert_dtype(dtype)))
+
+
+def full(shape: Any, fill_value: Any, dtype: Any = "float32", name: Any = None) -> Tensor:
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    return Tensor(jnp.full(_shape(shape), fill_value, convert_dtype(dtype)))
+
+
+def empty(shape: Any, dtype: Any = "float32", name: Any = None) -> Tensor:
+    # XLA/PJRT buffers are materialized on write; zeros is the honest "empty".
+    return zeros(shape, dtype)
+
+
+def _like_dtype(x: Tensor, dtype: Any) -> Any:
+    return convert_dtype(dtype) if dtype is not None else x.dtype
+
+
+def zeros_like(x: Tensor, dtype: Any = None, name: Any = None) -> Tensor:
+    return Tensor(jnp.zeros(x.shape, _like_dtype(x, dtype)))
+
+
+def ones_like(x: Tensor, dtype: Any = None, name: Any = None) -> Tensor:
+    return Tensor(jnp.ones(x.shape, _like_dtype(x, dtype)))
+
+
+def full_like(x: Tensor, fill_value: Any, dtype: Any = None, name: Any = None) -> Tensor:
+    return Tensor(jnp.full(x.shape, fill_value, _like_dtype(x, dtype)))
+
+
+def empty_like(x: Tensor, dtype: Any = None, name: Any = None) -> Tensor:
+    return zeros_like(x, dtype)
+
+
+def arange(start: Any = 0, end: Any = None, step: Any = 1, dtype: Any = None, name: Any = None) -> Tensor:
+    for v in (start, end, step):
+        if isinstance(v, Tensor):
+            raise TypeError("arange bounds must be python scalars")
+    if end is None:
+        start, end = 0, start
+    return Tensor(jnp.arange(start, end, step, dtype=convert_dtype(dtype) if dtype else None))
+
+
+def linspace(start: Any, stop: Any, num: int, dtype: Any = None, name: Any = None) -> Tensor:
+    return Tensor(jnp.linspace(start, stop, int(num), dtype=convert_dtype(dtype) if dtype else None))
+
+
+def logspace(start: Any, stop: Any, num: int, base: float = 10.0, dtype: Any = None, name: Any = None) -> Tensor:
+    return Tensor(jnp.logspace(start, stop, int(num), base=base, dtype=convert_dtype(dtype) if dtype else None))
+
+
+def eye(num_rows: int, num_columns: Optional[int] = None, dtype: Any = "float32", name: Any = None) -> Tensor:
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=convert_dtype(dtype)))
+
+
+def meshgrid(*args: Tensor, **kwargs: Any) -> List[Tensor]:
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    arrays = [a._data if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
+    return [Tensor(m) for m in jnp.meshgrid(*arrays, indexing="ij")]
+
+
+@defop("diag")
+def diag(x, offset=0, padding_value=0):
+    if x.ndim == 1 and padding_value != 0:
+        n = x.shape[0] + abs(offset)
+        out = jnp.full((n, n), padding_value, x.dtype)
+        return out + (jnp.diag(x, k=offset) - jnp.diag(jnp.full(x.shape, padding_value, x.dtype), k=offset))
+    return jnp.diag(x, k=offset)
+
+
+@defop("diagflat")
+def diagflat(x, offset=0):
+    return jnp.diagflat(x, k=offset)
+
+
+@defop("tril")
+def tril(x, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+@defop("triu")
+def triu(x, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+@defop("clone_fn", tensor_method=None)
+def _clone_op(x):
+    return x + jnp.zeros((), x.dtype)
+
+
+def clone(x: Tensor) -> Tensor:
+    return x.clone()
+
+
+def assign(x: Any, output: Optional[Tensor] = None) -> Tensor:
+    value = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    if output is not None:
+        output.set_value(value)
+        return output
+    return Tensor(value)
+
+
+def create_parameter(
+    shape: Sequence[int],
+    dtype: Any = "float32",
+    name: Optional[str] = None,
+    attr: Any = None,
+    is_bias: bool = False,
+    default_initializer: Any = None,
+) -> "Tensor":
+    """``paddle.create_parameter`` parity."""
+    from paddle_tpu.core.tensor import Parameter
+    from paddle_tpu.nn import initializer as I
+
+    init = default_initializer
+    if init is None:
+        init = I.Constant(0.0) if is_bias else I.XavierNormal()
+    p = Parameter(jnp.zeros(_shape(shape), convert_dtype(dtype)), name=name)
+    init(p)
+    return p

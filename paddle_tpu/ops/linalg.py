@@ -1,0 +1,338 @@
+"""Linear algebra ops (reference ``python/paddle/tensor/linalg.py`` over PHI
+matmul/blas kernels — on TPU these are MXU-native via XLA dot_general)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import register_tensor_method
+from paddle_tpu.ops.registry import defop
+
+__all__ = [
+    "matmul",
+    "mm",
+    "bmm",
+    "mv",
+    "dot",
+    "t",
+    "transpose",
+    "norm",
+    "dist",
+    "cross",
+    "einsum",
+    "histogram",
+    "cholesky",
+    "qr",
+    "svd",
+    "inv",
+    "pinv",
+    "solve",
+    "triangular_solve",
+    "cholesky_solve",
+    "det",
+    "slogdet",
+    "matrix_power",
+    "matrix_rank",
+    "eig",
+    "eigh",
+    "eigvals",
+    "eigvalsh",
+    "lu",
+    "multi_dot",
+    "cond",
+    "corrcoef",
+    "cov",
+    "trace",
+    "diagonal",
+]
+
+
+@defop("matmul")
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    """MXU matmul. The reference dispatches to cuBLAS
+    (``paddle/phi/kernels/impl/matmul_kernel_impl.h``); here XLA ``dot_general``
+    tiles directly onto the systolic array."""
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    return jnp.matmul(x, y)
+
+
+@defop("mm")
+def mm(input, mat2):  # noqa: A002
+    return jnp.matmul(input, mat2)
+
+
+@defop("bmm")
+def bmm(x, y):
+    return jnp.matmul(x, y)
+
+
+@defop("mv")
+def mv(x, vec):
+    return jnp.matmul(x, vec)
+
+
+@defop("dot")
+def dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+@defop("t")
+def t(input):  # noqa: A002
+    if input.ndim < 2:
+        return input
+    return input.T
+
+
+@defop("transpose")
+def transpose(x, perm):
+    return jnp.transpose(x, tuple(perm))
+
+
+@defop("norm", tensor_method=None)
+def _norm_op(x, p="fro", axis=None, keepdim=False):
+    if axis is None and p in ("fro", 2):
+        return jnp.sqrt(jnp.sum(jnp.square(x)))
+    if p == "fro":
+        return jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdim))
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    pv = float(p)
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(x), pv), axis=axis, keepdims=keepdim), 1.0 / pv)
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+    return _norm_op(x, p=p, axis=axis, keepdim=keepdim)
+
+
+register_tensor_method("norm", norm)
+
+
+@defop("dist", tensor_method=None)
+def _dist_op(x, y, p=2.0):
+    d = x - y
+    if p == float("inf"):
+        return jnp.max(jnp.abs(d))
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(d))
+    if p == 0:
+        return jnp.sum((d != 0).astype(d.dtype))
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(d), p)), 1.0 / p)
+
+
+def dist(x, y, p=2.0, name=None):
+    return _dist_op(x, y, p=float(p))
+
+
+register_tensor_method("dist", dist)
+
+
+@defop("cross")
+def cross(x, y, axis=-1):
+    return jnp.cross(x, y, axis=axis)
+
+
+@defop("einsum", tensor_method=None)
+def _einsum_op(equation, *operands):
+    return jnp.einsum(equation, *operands)
+
+
+def einsum(equation, *operands):
+    return _einsum_op(equation, *operands)
+
+
+@defop("histogram", tensor_method=None)
+def _histogram_op(input, bins=100, min=0, max=0, weight=None, density=False):  # noqa: A002
+    lo, hi = (min, max) if (min != 0 or max != 0) else (jnp.min(input), jnp.max(input))
+    hist, _ = jnp.histogram(
+        input.reshape(-1), bins=bins, range=(lo, hi), weights=weight, density=density
+    )
+    return hist
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=None):  # noqa: A002
+    return _histogram_op(input, bins=bins, min=min, max=max, weight=weight, density=density)
+
+
+register_tensor_method("histogram", histogram)
+
+
+@defop("cholesky")
+def cholesky(x, upper=False):
+    l = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(l, -1, -2) if upper else l
+
+
+@defop("qr", tensor_method=None)
+def _qr_op(x, mode="reduced"):
+    return jnp.linalg.qr(x, mode=mode)
+
+
+def qr(x, mode="reduced", name=None):
+    return _qr_op(x, mode=mode)
+
+
+register_tensor_method("qr", qr)
+
+
+@defop("svd", tensor_method=None)
+def _svd_op(x, full_matrices=False):
+    u, s, vh = jnp.linalg.svd(x, full_matrices=full_matrices)
+    return u, s, jnp.swapaxes(vh, -1, -2)  # paddle returns V, not V^H
+
+
+def svd(x, full_matrices=False, name=None):
+    return _svd_op(x, full_matrices=full_matrices)
+
+
+register_tensor_method("svd", svd)
+
+
+@defop("inv")
+def inv(x):
+    return jnp.linalg.inv(x)
+
+
+@defop("pinv")
+def pinv(x, rcond=1e-15, hermitian=False):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+@defop("solve")
+def solve(x, y):
+    return jnp.linalg.solve(x, y)
+
+
+@defop("triangular_solve")
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+    return jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, trans=1 if transpose else 0, unit_diagonal=unitriangular
+    )
+
+
+@defop("cholesky_solve")
+def cholesky_solve(x, y, upper=False):
+    return jax.scipy.linalg.cho_solve((y, not upper), x)
+
+
+@defop("det")
+def det(x):
+    return jnp.linalg.det(x)
+
+
+@defop("slogdet", tensor_method=None)
+def _slogdet_op(x):
+    sign, logdet = jnp.linalg.slogdet(x)
+    return jnp.stack([sign, logdet])
+
+
+def slogdet(x, name=None):
+    return _slogdet_op(x)
+
+
+register_tensor_method("slogdet", slogdet)
+
+
+@defop("matrix_power")
+def matrix_power(x, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+@defop("matrix_rank")
+def matrix_rank(x, tol=None, hermitian=False):
+    return jnp.linalg.matrix_rank(x, rtol=tol)
+
+
+@defop("eig", tensor_method=None)
+def _eig_op(x):
+    return jnp.linalg.eig(x)
+
+
+def eig(x, name=None):
+    return _eig_op(x)
+
+
+@defop("eigh", tensor_method=None)
+def _eigh_op(x, UPLO="L"):  # noqa: N803
+    return jnp.linalg.eigh(x, UPLO=UPLO)
+
+
+def eigh(x, UPLO="L", name=None):  # noqa: N803
+    return _eigh_op(x, UPLO=UPLO)
+
+
+@defop("eigvals")
+def eigvals(x):
+    return jnp.linalg.eigvals(x)
+
+
+@defop("eigvalsh")
+def eigvalsh(x, UPLO="L"):  # noqa: N803
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+@defop("lu", tensor_method=None)
+def _lu_op(x, pivot=True):
+    lu_, piv = jax.scipy.linalg.lu_factor(x)
+    return lu_, piv.astype(jnp.int32) + 1  # paddle returns 1-based pivots
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    res = _lu_op(x, pivot=pivot)
+    if get_infos:
+        from paddle_tpu.ops.creation import zeros
+
+        return res[0], res[1], zeros([1], "int32")
+    return res
+
+
+register_tensor_method("lu", lu)
+
+
+@defop("multi_dot", tensor_method=None)
+def _multi_dot_op(x):
+    return jnp.linalg.multi_dot(list(x))
+
+
+def multi_dot(x, name=None):
+    return _multi_dot_op(x)
+
+
+@defop("cond", tensor_method=None)
+def _cond_op(x, p=None):
+    return jnp.linalg.cond(x, p=p)
+
+
+def cond(x, p=None, name=None):
+    return _cond_op(x, p=p)
+
+
+@defop("corrcoef")
+def corrcoef(x, rowvar=True):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+@defop("cov")
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0, fweights=fweights, aweights=aweights)
+
+
+@defop("trace")
+def trace(x, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@defop("diagonal")
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)

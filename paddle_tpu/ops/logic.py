@@ -1,0 +1,70 @@
+"""Logical & bitwise ops (reference ``python/paddle/tensor/logic.py``, ``math.py`` bitwise)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.ops.registry import defop
+
+__all__ = [
+    "logical_and",
+    "logical_or",
+    "logical_xor",
+    "logical_not",
+    "bitwise_and",
+    "bitwise_or",
+    "bitwise_xor",
+    "bitwise_not",
+    "bitwise_left_shift",
+    "bitwise_right_shift",
+]
+
+
+@defop("logical_and")
+def logical_and(x, y):
+    return jnp.logical_and(x, y)
+
+
+@defop("logical_or")
+def logical_or(x, y):
+    return jnp.logical_or(x, y)
+
+
+@defop("logical_xor")
+def logical_xor(x, y):
+    return jnp.logical_xor(x, y)
+
+
+@defop("logical_not")
+def logical_not(x):
+    return jnp.logical_not(x)
+
+
+@defop("bitwise_and")
+def bitwise_and(x, y):
+    return jnp.bitwise_and(x, y)
+
+
+@defop("bitwise_or")
+def bitwise_or(x, y):
+    return jnp.bitwise_or(x, y)
+
+
+@defop("bitwise_xor")
+def bitwise_xor(x, y):
+    return jnp.bitwise_xor(x, y)
+
+
+@defop("bitwise_not")
+def bitwise_not(x):
+    return jnp.bitwise_not(x)
+
+
+@defop("bitwise_left_shift")
+def bitwise_left_shift(x, y):
+    return jnp.left_shift(x, y)
+
+
+@defop("bitwise_right_shift")
+def bitwise_right_shift(x, y):
+    return jnp.right_shift(x, y)

@@ -1,0 +1,504 @@
+"""Shape / layout manipulation ops (reference ``python/paddle/tensor/manipulation.py``
+over PHI kernels like ``concat``, ``split``, ``gather``, ``scatter``, ``pad``)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops.registry import defop
+
+__all__ = [
+    "reshape",
+    "flatten",
+    "squeeze",
+    "unsqueeze",
+    "concat",
+    "stack",
+    "split",
+    "chunk",
+    "tile",
+    "expand",
+    "expand_as",
+    "broadcast_to",
+    "broadcast_tensors",
+    "flip",
+    "roll",
+    "gather",
+    "gather_nd",
+    "scatter",
+    "scatter_nd_add",
+    "index_select",
+    "index_add",
+    "index_put",
+    "take_along_axis",
+    "put_along_axis",
+    "masked_select",
+    "masked_fill",
+    "unbind",
+    "unstack",
+    "repeat_interleave",
+    "pad",
+    "slice",
+    "strided_slice",
+    "crop",
+    "unique",
+    "unique_consecutive",
+    "rot90",
+    "as_strided",
+    "view",
+    "view_as",
+    "moveaxis",
+    "swapaxes",
+    "atleast_1d",
+    "atleast_2d",
+    "atleast_3d",
+    "tensor_split",
+    "hsplit",
+    "vsplit",
+    "dsplit",
+    "hstack",
+    "vstack",
+    "dstack",
+    "column_stack",
+    "row_stack",
+    "shard_index",
+]
+
+
+def _norm_shape(shape: Any) -> Sequence[int]:
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy().reshape(-1))
+    return tuple(int(s) for s in shape)
+
+
+@defop("reshape", inplace_method="reshape_")
+def reshape(x, shape):
+    return jnp.reshape(x, _norm_shape(shape))
+
+
+@defop("flatten")
+def flatten(x, start_axis=0, stop_axis=-1):
+    ndim = x.ndim
+    start = start_axis % ndim if ndim else 0
+    stop = stop_axis % ndim if ndim else 0
+    new_shape = (
+        tuple(x.shape[:start]) + (-1,) + tuple(x.shape[stop + 1 :]) if ndim else (-1,)
+    )
+    return jnp.reshape(x, new_shape)
+
+
+@defop("squeeze", inplace_method="squeeze_")
+def squeeze(x, axis=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    if isinstance(axis, (list, tuple)):
+        axes = tuple(a % x.ndim for a in axis if x.shape[a % x.ndim] == 1)
+        return jnp.squeeze(x, axis=axes) if axes else x
+    axis = axis % x.ndim
+    return jnp.squeeze(x, axis=axis) if x.shape[axis] == 1 else x
+
+
+@defop("unsqueeze", inplace_method="unsqueeze_")
+def unsqueeze(x, axis):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    return jnp.expand_dims(x, tuple(axes))
+
+
+@defop("concat")
+def concat(x, axis=0):
+    return jnp.concatenate(list(x), axis=int(axis))
+
+
+@defop("stack")
+def stack(x, axis=0):
+    return jnp.stack(list(x), axis=axis)
+
+
+@defop("split", tensor_method=None)
+def _split_op(x, num_or_sections, axis=0):
+    axis = int(axis)
+    if isinstance(num_or_sections, int):
+        return tuple(jnp.split(x, num_or_sections, axis=axis))
+    sizes = list(num_or_sections)
+    total = x.shape[axis]
+    if -1 in sizes:
+        known = sum(s for s in sizes if s != -1)
+        sizes[sizes.index(-1)] = total - known
+    offsets = np.cumsum(sizes)[:-1].tolist()
+    return tuple(jnp.split(x, offsets, axis=axis))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    return list(_split_op(x, num_or_sections, axis=axis))
+
+
+from paddle_tpu.core.tensor import register_tensor_method
+
+register_tensor_method("split", split)
+
+
+@defop("chunk", tensor_method=None)
+def _chunk_op(x, chunks, axis=0):
+    return tuple(jnp.array_split(x, chunks, axis=int(axis)))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return list(_chunk_op(x, chunks, axis=axis))
+
+
+register_tensor_method("chunk", chunk)
+
+
+@defop("tile")
+def tile(x, repeat_times):
+    return jnp.tile(x, _norm_shape(repeat_times))
+
+
+@defop("expand")
+def expand(x, shape):
+    shape = list(_norm_shape(shape))
+    # paddle semantics: -1 means keep that dim
+    offset = len(shape) - x.ndim
+    for i, s in enumerate(shape):
+        if s == -1:
+            shape[i] = x.shape[i - offset]
+    return jnp.broadcast_to(x, tuple(shape))
+
+
+@defop("expand_as")
+def expand_as(x, y):
+    return jnp.broadcast_to(x, y.shape)
+
+
+@defop("broadcast_to")
+def broadcast_to(x, shape):
+    return jnp.broadcast_to(x, _norm_shape(shape))
+
+
+def broadcast_tensors(inputs, name=None):
+    arrays = [t._data if isinstance(t, Tensor) else jnp.asarray(t) for t in inputs]
+    shape = jnp.broadcast_shapes(*[a.shape for a in arrays])
+    return [Tensor(jnp.broadcast_to(a, shape)) for a in arrays]
+
+
+@defop("flip")
+def flip(x, axis):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    return jnp.flip(x, axis=tuple(axes))
+
+
+@defop("roll")
+def roll(x, shifts, axis=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+@defop("gather")
+def gather(x, index, axis=0):
+    idx = index.reshape(-1) if index.ndim > 1 else index
+    return jnp.take(x, idx, axis=int(axis))
+
+
+@defop("gather_nd")
+def gather_nd(x, index):
+    index_depth = index.shape[-1]
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx] if index_depth == x.ndim else x[idx]
+
+
+@defop("scatter")
+def scatter(x, index, updates, overwrite=True):
+    idx = index.reshape(-1)
+    if overwrite:
+        return x.at[idx].set(updates)
+    # accumulate-mode: zero out target rows then add
+    zeroed = x.at[idx].set(jnp.zeros_like(updates))
+    return zeroed.at[idx].add(updates)
+
+
+@defop("scatter_nd_add")
+def scatter_nd_add(x, index, updates):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+@defop("index_select")
+def index_select(x, index, axis=0):
+    return jnp.take(x, index.reshape(-1), axis=int(axis))
+
+
+@defop("index_add")
+def index_add(x, index, axis, value):
+    moved = jnp.moveaxis(x, axis, 0)
+    moved = moved.at[index].add(jnp.moveaxis(value, axis, 0))
+    return jnp.moveaxis(moved, 0, axis)
+
+
+@defop("index_put")
+def index_put(x, indices, value, accumulate=False):
+    idx = tuple(indices)
+    if accumulate:
+        return x.at[idx].add(value)
+    return x.at[idx].set(value)
+
+
+@defop("take_along_axis")
+def take_along_axis(x, indices, axis, broadcast=True):
+    return jnp.take_along_axis(x, indices, axis=int(axis))
+
+
+@defop("put_along_axis")
+def put_along_axis(x, indices, values, axis, reduce="assign"):  # noqa: A002
+    if reduce == "assign":
+        return jnp.put_along_axis(x, indices, values, axis=int(axis), inplace=False)
+    if reduce in ("add", "sum"):
+        # scatter-add along axis
+        moved = jnp.moveaxis(x, axis, -1)
+        idx = jnp.moveaxis(jnp.broadcast_to(indices, x.shape), axis, -1)
+        vals = jnp.moveaxis(jnp.broadcast_to(values, x.shape), axis, -1)
+        flat = moved.reshape(-1, moved.shape[-1])
+        fidx = idx.reshape(-1, idx.shape[-1])
+        fval = vals.reshape(-1, vals.shape[-1])
+        rows = jnp.arange(flat.shape[0])[:, None]
+        out = flat.at[rows, fidx].add(fval)
+        return jnp.moveaxis(out.reshape(moved.shape), -1, axis)
+    if reduce in ("mul", "multiply"):
+        moved = jnp.moveaxis(x, axis, -1)
+        idx = jnp.moveaxis(jnp.broadcast_to(indices, x.shape), axis, -1)
+        vals = jnp.moveaxis(jnp.broadcast_to(values, x.shape), axis, -1)
+        flat = moved.reshape(-1, moved.shape[-1])
+        fidx = idx.reshape(-1, idx.shape[-1])
+        fval = vals.reshape(-1, vals.shape[-1])
+        rows = jnp.arange(flat.shape[0])[:, None]
+        out = flat.at[rows, fidx].multiply(fval)
+        return jnp.moveaxis(out.reshape(moved.shape), -1, axis)
+    raise ValueError(f"unsupported reduce mode {reduce!r}")
+
+
+@defop("masked_select")
+def masked_select(x, mask):
+    # Dynamic output shape: eager-only (cannot be jitted) — same restriction
+    # class as the reference's dynamic-shape ops under CINN.
+    return x[mask]
+
+
+@defop("masked_fill", inplace_method="masked_fill_")
+def masked_fill(x, mask, value):
+    v = value if not hasattr(value, "dtype") else value.astype(x.dtype)
+    return jnp.where(mask, jnp.asarray(v, x.dtype), x)
+
+
+@defop("unbind", tensor_method=None)
+def _unbind_op(x, axis=0):
+    axis = int(axis)
+    moved = jnp.moveaxis(x, axis, 0)
+    return tuple(moved[i] for i in range(moved.shape[0]))
+
+
+def unbind(x, axis=0):
+    return list(_unbind_op(x, axis=axis))
+
+
+register_tensor_method("unbind", unbind)
+
+
+def unstack(x, axis=0, num=None):
+    return unbind(x, axis=axis)
+
+
+register_tensor_method("unstack", unstack)
+
+
+@defop("repeat_interleave")
+def repeat_interleave(x, repeats, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    r = repeats._data if isinstance(repeats, Tensor) else repeats
+    return jnp.repeat(x, r, axis=int(axis))
+
+
+@defop("pad")
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):  # noqa: A002
+    pad = list(_norm_shape(pad)) if not isinstance(pad, (list, tuple)) else list(pad)
+    if len(pad) == 2 * x.ndim:
+        # full-form [before0, after0, before1, after1, ...]? paddle uses per-dim pairs
+        width = [(pad[2 * i], pad[2 * i + 1]) for i in range(x.ndim)]
+    else:
+        # partial form pads the trailing dims (paddle NCHW convention pads spatial dims)
+        n_spatial = len(pad) // 2
+        width = [(0, 0)] * (x.ndim - n_spatial) + [
+            (pad[2 * i], pad[2 * i + 1]) for i in range(n_spatial)
+        ]
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, width, mode=jmode, constant_values=value)
+    return jnp.pad(x, width, mode=jmode)
+
+
+@defop("slice", tensor_method=None)
+def slice(x, axes, starts, ends):  # noqa: A001
+    idx = [jnp.s_[:]] * x.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        idx[ax] = jnp.s_[int(s) : int(e)]
+    return x[tuple(idx)]
+
+
+@defop("strided_slice", tensor_method=None)
+def strided_slice(x, axes, starts, ends, strides):
+    idx = [jnp.s_[:]] * x.ndim
+    for ax, s, e, st in zip(axes, starts, ends, strides):
+        idx[ax] = jnp.s_[int(s) : int(e) : int(st)]
+    return x[tuple(idx)]
+
+
+@defop("crop")
+def crop(x, shape=None, offsets=None):
+    shape = _norm_shape(shape)
+    offsets = _norm_shape(offsets) if offsets is not None else [0] * x.ndim
+    idx = tuple(jnp.s_[o : o + s] for o, s in zip(offsets, shape))
+    return x[idx]
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    """Eager-only (dynamic shape)."""
+    arr = np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+    res = np.unique(
+        arr, return_index=return_index, return_inverse=return_inverse, return_counts=return_counts, axis=axis
+    )
+    if not isinstance(res, tuple):
+        return Tensor(res)
+    return tuple(Tensor(r) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, name=None):
+    arr = np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+    if axis is None:
+        arr = arr.reshape(-1)
+        axis = 0
+    keep = np.ones(arr.shape[axis], dtype=bool)
+    moved = np.moveaxis(arr, axis, 0)
+    keep[1:] = np.any(
+        moved[1:].reshape(moved.shape[0] - 1, -1) != moved[:-1].reshape(moved.shape[0] - 1, -1),
+        axis=1,
+    )
+    out = np.moveaxis(moved[keep], 0, axis)
+    results = [Tensor(out)]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        results.append(Tensor(inv.astype(np.int64)))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        counts = np.diff(np.append(idx, arr.shape[axis]))
+        results.append(Tensor(counts.astype(np.int64)))
+    return results[0] if len(results) == 1 else tuple(results)
+
+
+@defop("rot90")
+def rot90(x, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=tuple(axes))
+
+
+@defop("as_strided", tensor_method=None)
+def as_strided(x, shape, stride, offset=0):
+    # Layout is XLA-owned; emulate with gather over computed indices.
+    flat = x.reshape(-1)
+    indices = jnp.zeros(tuple(shape), jnp.int32) + offset
+    for d, (s, st) in enumerate(zip(shape, stride)):
+        r = jnp.arange(s) * st
+        r = r.reshape([-1 if i == d else 1 for i in range(len(shape))])
+        indices = indices + r
+    return flat[indices.reshape(-1)].reshape(tuple(shape))
+
+
+@defop("view", tensor_method=None)
+def view(x, shape_or_dtype):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return jnp.reshape(x, tuple(shape_or_dtype))
+    from paddle_tpu.core.dtypes import convert_dtype
+
+    return x.view(convert_dtype(shape_or_dtype)) if hasattr(x, "view") else x.astype(shape_or_dtype)
+
+
+@defop("view_as", tensor_method=None)
+def view_as(x, other):
+    return jnp.reshape(x, other.shape)
+
+
+@defop("moveaxis")
+def moveaxis(x, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+@defop("swapaxes")
+def swapaxes(x, axis0, axis1):
+    return jnp.swapaxes(x, axis0, axis1)
+
+
+@defop("atleast_1d", tensor_method=None)
+def atleast_1d(x):
+    return jnp.atleast_1d(x)
+
+
+@defop("atleast_2d", tensor_method=None)
+def atleast_2d(x):
+    return jnp.atleast_2d(x)
+
+
+@defop("atleast_3d", tensor_method=None)
+def atleast_3d(x):
+    return jnp.atleast_3d(x)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    if isinstance(num_or_indices, int):
+        return [Tensor(a) for a in jnp.array_split(x._data, num_or_indices, axis=axis)]
+    return [Tensor(a) for a in jnp.split(x._data, list(num_or_indices), axis=axis)]
+
+
+def hsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=1 if x.ndim > 1 else 0)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+@defop("hstack", tensor_method=None)
+def hstack(x):
+    return jnp.hstack(list(x))
+
+
+@defop("vstack", tensor_method=None)
+def vstack(x):
+    return jnp.vstack(list(x))
+
+
+@defop("dstack", tensor_method=None)
+def dstack(x):
+    return jnp.dstack(list(x))
+
+
+@defop("column_stack", tensor_method=None)
+def column_stack(x):
+    return jnp.column_stack(list(x))
+
+
+row_stack = vstack
+
+
+@defop("shard_index")
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):  # noqa: A002
+    """Map global ids to shard-local ids (reference ``ops.yaml`` shard_index,
+    used by distributed embedding)."""
+    shard_size = (index_num + nshards - 1) // nshards
+    in_shard = (input // shard_size) == shard_id
+    return jnp.where(in_shard, input % shard_size, ignore_value)

@@ -1,0 +1,310 @@
+"""Elementwise & scalar math ops.
+
+Counterpart of the reference's elementwise/activation PHI kernels
+(``paddle/phi/kernels/*/elementwise_*``, ``activation_kernel.*``; declared in
+``paddle/phi/ops/yaml/ops.yaml``). Every op lowers to jnp/lax and fuses under
+XLA — there is no hand-written kernel needed for elementwise math on TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.registry import defop
+
+__all__ = []  # populated below
+
+
+def _export(name: str) -> None:
+    __all__.append(name)
+
+
+def _unary(name: str, jfn, method: Optional[str] = None, inplace: Optional[str] = None):
+    op = defop(name, tensor_method=method or name, inplace_method=inplace)(lambda x: jfn(x))
+    globals()[name] = op
+    _export(name)
+    return op
+
+
+def _binary(name: str, jfn, method: Optional[str] = None, inplace: Optional[str] = None):
+    def fn(x, y):
+        return jfn(x, y)
+
+    fn.__name__ = name
+    op = defop(name, tensor_method=method or name, inplace_method=inplace)(fn)
+    globals()[name] = op
+    _export(name)
+    return op
+
+
+# ---- unary ------------------------------------------------------------------
+_unary("abs", jnp.abs, inplace="abs_")
+_unary("acos", jnp.arccos)
+_unary("acosh", jnp.arccosh)
+_unary("asin", jnp.arcsin)
+_unary("asinh", jnp.arcsinh)
+_unary("atan", jnp.arctan)
+_unary("atanh", jnp.arctanh)
+_unary("ceil", jnp.ceil, inplace="ceil_")
+_unary("conj", jnp.conj)
+_unary("cos", jnp.cos)
+_unary("cosh", jnp.cosh)
+_unary("digamma", jax.scipy.special.digamma)
+_unary("erf", jax.scipy.special.erf)
+_unary("erfinv", jax.scipy.special.erfinv)
+_unary("exp", jnp.exp, inplace="exp_")
+_unary("expm1", jnp.expm1)
+_unary("floor", jnp.floor, inplace="floor_")
+_unary("frac", lambda x: x - jnp.trunc(x))
+_unary("imag", jnp.imag)
+_unary("lgamma", jax.scipy.special.gammaln)
+_unary("log", jnp.log)
+_unary("log10", jnp.log10)
+_unary("log1p", jnp.log1p)
+_unary("log2", jnp.log2)
+_unary("logit", jax.scipy.special.logit)
+_unary("neg", jnp.negative)
+_unary("real", jnp.real)
+_unary("reciprocal", jnp.reciprocal, inplace="reciprocal_")
+_unary("round", jnp.round, inplace="round_")
+_unary("rsqrt", jax.lax.rsqrt, inplace="rsqrt_")
+_unary("sigmoid", jax.nn.sigmoid)
+_unary("sign", jnp.sign)
+_unary("sin", jnp.sin)
+_unary("sinh", jnp.sinh)
+_unary("sqrt", jnp.sqrt, inplace="sqrt_")
+_unary("square", jnp.square)
+_unary("tan", jnp.tan)
+_unary("tanh", jnp.tanh, inplace="tanh_")
+_unary("trunc", jnp.trunc)
+_unary("isfinite", jnp.isfinite)
+_unary("isinf", jnp.isinf)
+_unary("isnan", jnp.isnan)
+_unary("i0", lambda x: jax.scipy.special.i0(x))
+
+# ---- binary -----------------------------------------------------------------
+_binary("add", jnp.add, inplace="add_")
+_binary("subtract", jnp.subtract, inplace="subtract_")
+_binary("multiply", jnp.multiply, inplace="multiply_")
+_binary("divide", jnp.true_divide, inplace="divide_")
+_binary("floor_divide", jnp.floor_divide)
+_binary("remainder", jnp.remainder, inplace="remainder_")
+_binary("mod", jnp.remainder, method="mod")
+_binary("pow", jnp.power, method="pow")
+_binary("maximum", jnp.maximum)
+_binary("minimum", jnp.minimum)
+_binary("fmax", jnp.fmax)
+_binary("fmin", jnp.fmin)
+_binary("atan2", jnp.arctan2)
+_binary("logaddexp", jnp.logaddexp)
+_binary("heaviside", jnp.heaviside)
+_binary("gcd", jnp.gcd)
+_binary("lcm", jnp.lcm)
+_binary("nextafter", jnp.nextafter)
+_binary("hypot", jnp.hypot)
+_binary("copysign", jnp.copysign)
+_binary("ldexp", jnp.ldexp)
+_binary("inner", jnp.inner)
+_binary("outer", jnp.outer)
+_binary("kron", jnp.kron)
+
+
+# ---- composite / parameterized ---------------------------------------------
+@defop("scale", inplace_method="scale_")
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None):
+    """y = scale*x + bias (reference ``ops.yaml`` scale op)."""
+    if bias_after_scale:
+        out = x * jnp.asarray(scale, x.dtype) + jnp.asarray(bias, x.dtype)
+    else:
+        out = (x + jnp.asarray(bias, x.dtype)) * jnp.asarray(scale, x.dtype)
+    return out
+
+
+_export("scale")
+
+
+@defop("clip", inplace_method="clip_")
+def clip(x, min=None, max=None):  # noqa: A002
+    return jnp.clip(x, min, max)
+
+
+_export("clip")
+
+
+@defop("lerp")
+def lerp(x, y, weight):
+    return x + weight * (y - x)
+
+
+_export("lerp")
+
+
+@defop("stanh")
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+_export("stanh")
+
+
+@defop("multiplex")
+def multiplex(inputs, index):
+    stacked = jnp.stack(inputs, axis=0)  # [n, batch, ...]
+    idx = index.reshape(-1)
+    return stacked[idx, jnp.arange(idx.shape[0])]
+
+
+_export("multiplex")
+
+
+@defop("add_n")
+def add_n(inputs):
+    """Sum a list of tensors (reference ``sum`` / add_n op)."""
+    out = inputs[0]
+    for t in inputs[1:]:
+        out = out + t
+    return out
+
+
+_export("add_n")
+
+
+@defop("addmm")
+def addmm(input, x, y, beta=1.0, alpha=1.0):  # noqa: A002
+    return beta * input + alpha * (x @ y)
+
+
+_export("addmm")
+
+
+@defop("cumsum")
+def cumsum(x, axis=None, dtype=None):
+    from paddle_tpu.core.dtypes import convert_dtype
+
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jnp.cumsum(x, axis=axis, dtype=convert_dtype(dtype) if dtype else None)
+
+
+_export("cumsum")
+
+
+@defop("cumprod")
+def cumprod(x, dim=None, dtype=None):
+    from paddle_tpu.core.dtypes import convert_dtype
+
+    if dim is None:
+        x = x.reshape(-1)
+        dim = 0
+    return jnp.cumprod(x, axis=dim, dtype=convert_dtype(dtype) if dtype else None)
+
+
+_export("cumprod")
+
+
+@defop("cummax")
+def cummax(x, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    values = jax.lax.associative_scan(jnp.maximum, x, axis=axis)
+    return values, _scan_argextreme(x, axis, jnp.greater_equal)
+
+
+@defop("cummin")
+def cummin(x, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    values = jax.lax.associative_scan(jnp.minimum, x, axis=axis)
+    return values, _scan_argextreme(x, axis, jnp.less_equal)
+
+
+def _scan_argextreme(x, axis, cmp):
+    idx = jnp.arange(x.shape[axis])
+    idx = idx.reshape([-1 if i == (axis % x.ndim) else 1 for i in range(x.ndim)])
+    idx = jnp.broadcast_to(idx, x.shape)
+
+    def combine(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = cmp(bv, av)
+        return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+
+    _, indices = jax.lax.associative_scan(combine, (x, idx), axis=axis)
+    return indices
+
+
+_export("cummax")
+_export("cummin")
+
+
+@defop("logcumsumexp")
+def logcumsumexp(x, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jax.lax.associative_scan(jnp.logaddexp, x, axis=axis)
+
+
+_export("logcumsumexp")
+
+
+@defop("nan_to_num")
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+_export("nan_to_num")
+
+
+@defop("diff")
+def diff(x, n=1, axis=-1):
+    return jnp.diff(x, n=n, axis=axis)
+
+
+_export("diff")
+
+
+@defop("trapezoid")
+def trapezoid(y, x=None, dx=None, axis=-1):
+    if dx is None and x is None:
+        dx = 1.0
+    return jnp.trapezoid(y, x=x, dx=dx if dx is not None else 1.0, axis=axis)
+
+
+_export("trapezoid")
+
+
+@defop("deg2rad")
+def deg2rad(x):
+    return jnp.deg2rad(x)
+
+
+@defop("rad2deg")
+def rad2deg(x):
+    return jnp.rad2deg(x)
+
+
+_export("deg2rad")
+_export("rad2deg")
+
+
+@defop("angle")
+def angle(x):
+    return jnp.angle(x)
+
+
+_export("angle")
+
+
+@defop("increment")
+def increment(x, value=1.0):
+    return x + jnp.asarray(value, x.dtype)
+
+
+_export("increment")

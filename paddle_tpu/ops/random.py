@@ -1,0 +1,148 @@
+"""Random sampling ops.
+
+Counterpart of the reference's RNG kernels (``paddle/phi/kernels/*/uniform_*``,
+``gaussian_*``; ``phi::Generator`` seeds). Each call draws a fresh subkey from
+the global :class:`~paddle_tpu.core.rng.Generator` — stateful-API surface over
+JAX's splittable PRNG.
+
+Note: under ``paddle_tpu.jit`` capture, keys are materialized at trace time, so
+a traced program replays the same draw; use eager mode (or functional dropout
+with explicit seeds) when fresh per-step randomness is required inside a
+compiled step. Training dropout handles this via seed plumbing in
+``nn.functional.dropout``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core import rng as _rng
+from paddle_tpu.core.dtypes import convert_dtype
+from paddle_tpu.core.tensor import Tensor, register_tensor_method
+from paddle_tpu.ops.registry import defop
+
+__all__ = [
+    "uniform",
+    "normal",
+    "standard_normal",
+    "randn",
+    "rand",
+    "randint",
+    "randint_like",
+    "randperm",
+    "bernoulli",
+    "multinomial",
+    "poisson",
+    "exponential_",
+    "normal_",
+    "uniform_",
+]
+
+
+def _shape(shape: Any) -> Sequence[int]:
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy().reshape(-1))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+def uniform(shape, dtype="float32", min=-1.0, max=1.0, seed=0, name=None):  # noqa: A002
+    key = jax.random.PRNGKey(seed) if seed else _rng.next_key()
+    return Tensor(
+        jax.random.uniform(key, _shape(shape), convert_dtype(dtype), minval=min, maxval=max)
+    )
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._data if isinstance(mean, Tensor) else mean
+        s = std._data if isinstance(std, Tensor) else std
+        out_shape = jnp.broadcast_shapes(
+            getattr(m, "shape", ()), getattr(s, "shape", ())
+        )
+        noise = jax.random.normal(_rng.next_key(), out_shape, jnp.float32)
+        return Tensor(m + s * noise)
+    if shape is None:
+        shape = [1]
+    noise = jax.random.normal(_rng.next_key(), _shape(shape), jnp.float32)
+    return Tensor(mean + std * noise)
+
+
+def standard_normal(shape, dtype="float32", name=None):
+    return Tensor(jax.random.normal(_rng.next_key(), _shape(shape), convert_dtype(dtype)))
+
+
+def randn(shape, dtype="float32", name=None):
+    return standard_normal(shape, dtype)
+
+
+def rand(shape, dtype="float32", name=None):
+    return Tensor(jax.random.uniform(_rng.next_key(), _shape(shape), convert_dtype(dtype)))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(
+        jax.random.randint(_rng.next_key(), _shape(shape), low, high, convert_dtype(dtype))
+    )
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    return randint(low, high, shape=x.shape, dtype=dtype or x.dtype)
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(_rng.next_key(), int(n)).astype(convert_dtype(dtype)))
+
+
+def bernoulli(x, name=None):
+    data = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    draw = jax.random.uniform(_rng.next_key(), data.shape, jnp.float32)
+    return Tensor((draw < data).astype(data.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    data = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    key = _rng.next_key()
+    logits = jnp.log(jnp.maximum(data, 1e-30))
+    if replacement:
+        out = jax.random.categorical(key, logits, axis=-1, shape=(*data.shape[:-1], num_samples) if data.ndim > 1 else (num_samples,))
+        if data.ndim > 1:
+            out = out.reshape(*data.shape[:-1], num_samples)
+        return Tensor(out.astype(jnp.int64))
+    # without replacement: Gumbel top-k trick
+    g = jax.random.gumbel(key, data.shape)
+    _, idx = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(idx.astype(jnp.int64))
+
+
+def poisson(x, name=None):
+    data = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.poisson(_rng.next_key(), data).astype(data.dtype))
+
+
+# -- in-place random initializers (used by nn.initializer) --------------------
+def normal_(x: Tensor, mean=0.0, std=1.0) -> Tensor:
+    x.set_value(mean + std * jax.random.normal(_rng.next_key(), tuple(x.shape), jnp.float32))
+    return x
+
+
+def uniform_(x: Tensor, min=-1.0, max=1.0) -> Tensor:  # noqa: A002
+    x.set_value(jax.random.uniform(_rng.next_key(), tuple(x.shape), jnp.float32, minval=min, maxval=max))
+    return x
+
+
+def exponential_(x: Tensor, lam=1.0) -> Tensor:
+    x.set_value(jax.random.exponential(_rng.next_key(), tuple(x.shape)) / lam)
+    return x
+
+
+register_tensor_method("normal_", normal_)
+register_tensor_method("uniform_", uniform_)
+register_tensor_method("exponential_", exponential_)

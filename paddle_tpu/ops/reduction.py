@@ -1,0 +1,144 @@
+"""Reduction ops (reference ``paddle/phi/kernels/*/reduce_*`` + ``python/paddle/tensor/math.py`` reductions)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Union
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.dtypes import convert_dtype
+from paddle_tpu.ops.registry import defop
+
+__all__ = [
+    "sum",
+    "mean",
+    "max",
+    "min",
+    "amax",
+    "amin",
+    "prod",
+    "all",
+    "any",
+    "logsumexp",
+    "nansum",
+    "nanmean",
+    "std",
+    "var",
+    "median",
+    "nanmedian",
+    "quantile",
+    "count_nonzero",
+    "numel",
+]
+
+
+def _axis(axis: Any) -> Any:
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+@defop("sum")
+def sum(x, axis=None, dtype=None, keepdim=False):  # noqa: A001
+    dt = convert_dtype(dtype) if dtype else None
+    if dt is None and jnp.issubdtype(jnp.dtype(x.dtype), jnp.bool_):
+        dt = jnp.int64
+    return jnp.sum(x, axis=_axis(axis), dtype=dt, keepdims=keepdim)
+
+
+@defop("mean")
+def mean(x, axis=None, keepdim=False):
+    return jnp.mean(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@defop("max")
+def max(x, axis=None, keepdim=False):  # noqa: A001
+    return jnp.max(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@defop("min")
+def min(x, axis=None, keepdim=False):  # noqa: A001
+    return jnp.min(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@defop("amax")
+def amax(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@defop("amin")
+def amin(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@defop("prod")
+def prod(x, axis=None, keepdim=False, dtype=None):
+    dt = convert_dtype(dtype) if dtype else None
+    return jnp.prod(x, axis=_axis(axis), dtype=dt, keepdims=keepdim)
+
+
+@defop("all")
+def all(x, axis=None, keepdim=False):  # noqa: A001
+    return jnp.all(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@defop("any")
+def any(x, axis=None, keepdim=False):  # noqa: A001
+    return jnp.any(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@defop("logsumexp")
+def logsumexp(x, axis=None, keepdim=False):
+    import jax
+
+    return jax.scipy.special.logsumexp(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@defop("nansum")
+def nansum(x, axis=None, dtype=None, keepdim=False):
+    dt = convert_dtype(dtype) if dtype else None
+    return jnp.nansum(x, axis=_axis(axis), dtype=dt, keepdims=keepdim)
+
+
+@defop("nanmean")
+def nanmean(x, axis=None, keepdim=False):
+    return jnp.nanmean(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@defop("std")
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(x, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+@defop("var")
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+@defop("median")
+def median(x, axis=None, keepdim=False):
+    return jnp.median(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@defop("nanmedian")
+def nanmedian(x, axis=None, keepdim=False):
+    return jnp.nanmedian(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@defop("quantile")
+def quantile(x, q, axis=None, keepdim=False):
+    return jnp.quantile(x, jnp.asarray(q), axis=_axis(axis), keepdims=keepdim)
+
+
+@defop("count_nonzero")
+def count_nonzero(x, axis=None, keepdim=False):
+    return jnp.count_nonzero(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@defop("numel")
+def numel(x):
+    import numpy as np
+
+    return jnp.asarray(int(np.prod(x.shape)) if x.shape else 1, jnp.int64)

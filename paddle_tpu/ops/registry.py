@@ -1,0 +1,116 @@
+"""Op registry: the single-schema keystone (SURVEY §1).
+
+Each ``OpDef`` carries the pure-jax implementation plus metadata; registration
+generates the functional entry (eager dispatch through the autograd tape), the
+Tensor method binding, and exposes abstract-eval (shape/dtype inference —
+the ``infermeta`` analog) via ``infer_meta``. SPMD sharding propagation (the
+``spmd_rule:`` analog, ``paddle/phi/infermeta/spmd_rules/``) is delegated to
+GSPMD: because every op is a pure jax function, sharding rules follow from the
+XLA sharding propagation pass rather than hand-written per-op C++ rules.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+
+from paddle_tpu.core.dispatch import call_op
+from paddle_tpu.core.tensor import Tensor, register_tensor_method
+from paddle_tpu.errors import AlreadyExistsError
+
+
+@dataclass
+class OpDef:
+    name: str
+    fn: Callable  # pure function over jax arrays
+    tensor_method: Optional[str] = None  # method name to bind on Tensor (None = don't)
+    inplace_method: Optional[str] = None  # e.g. "add_" — rebinds self to result
+    doc: str = ""
+    tags: Sequence[str] = field(default_factory=tuple)
+
+
+REGISTRY: Dict[str, OpDef] = {}
+
+
+def register(opdef: OpDef) -> Callable:
+    """Register an op; returns the eager functional entry point."""
+    if opdef.name in REGISTRY:
+        raise AlreadyExistsError(f"op '{opdef.name}' already registered")
+    REGISTRY[opdef.name] = opdef
+
+    import functools
+
+    @functools.wraps(opdef.fn)
+    def entry(*args: Any, **kwargs: Any) -> Any:
+        kwargs.pop("name", None)  # paddle API compat: trailing name= arg
+        return call_op(opdef.name, opdef.fn, *args, **kwargs)
+
+    entry.__name__ = opdef.name
+    entry.__qualname__ = opdef.name
+    if opdef.doc:
+        entry.__doc__ = opdef.doc
+    entry.__paddle_tpu_op__ = opdef.name  # type: ignore[attr-defined]
+    entry.raw_fn = opdef.fn  # type: ignore[attr-defined]
+
+    if opdef.tensor_method:
+        register_tensor_method(opdef.tensor_method, entry)
+    if opdef.inplace_method:
+
+        def inplace(self: Tensor, *args: Any, **kwargs: Any) -> Tensor:
+            new = entry(self, *args, **kwargs)
+            self._replace_(new)
+            return self
+
+        inplace.__name__ = opdef.inplace_method
+        register_tensor_method(opdef.inplace_method, inplace)
+    return entry
+
+
+def defop(
+    name: str,
+    tensor_method: Optional[str] = None,
+    inplace_method: Optional[str] = None,
+    doc: str = "",
+    tags: Sequence[str] = (),
+) -> Callable[[Callable], Callable]:
+    """Decorator form of :func:`register`."""
+
+    def deco(fn: Callable) -> Callable:
+        return register(
+            OpDef(
+                name=name,
+                fn=fn,
+                tensor_method=tensor_method if tensor_method is not None else name,
+                inplace_method=inplace_method,
+                doc=doc or (fn.__doc__ or ""),
+                tags=tags,
+            )
+        )
+
+    return deco
+
+
+def infer_meta(name: str, *args: Any, **kwargs: Any) -> Any:
+    """Abstract eval (shape/dtype inference) for a registered op — the
+    ``infermeta`` analog (reference ``paddle/phi/infermeta/``), via
+    ``jax.eval_shape`` so no device compute happens."""
+    opdef = REGISTRY[name]
+
+    def unwrapped(*a: Any, **k: Any) -> Any:
+        return opdef.fn(*a, **k)
+
+    spec_args = [
+        jax.ShapeDtypeStruct(tuple(a.shape), a.dtype) if isinstance(a, Tensor) else a
+        for a in args
+    ]
+    return jax.eval_shape(unwrapped, *spec_args, **kwargs)
+
+
+def get_op(name: str) -> OpDef:
+    return REGISTRY[name]
+
+
+def list_ops() -> List[str]:
+    return sorted(REGISTRY)

@@ -1,0 +1,181 @@
+"""Search / sort / selection ops (reference ``python/paddle/tensor/search.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor, register_tensor_method
+from paddle_tpu.ops.registry import defop
+
+__all__ = [
+    "argmax",
+    "argmin",
+    "argsort",
+    "sort",
+    "topk",
+    "where",
+    "nonzero",
+    "searchsorted",
+    "kthvalue",
+    "mode",
+    "index_sample",
+    "bucketize",
+]
+
+
+@defop("argmax")
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    from paddle_tpu.core.dtypes import convert_dtype
+
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    out = jnp.argmax(x, axis=int(axis), keepdims=keepdim)
+    return out.astype(convert_dtype(dtype))
+
+
+@defop("argmin")
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    from paddle_tpu.core.dtypes import convert_dtype
+
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    out = jnp.argmin(x, axis=int(axis), keepdims=keepdim)
+    return out.astype(convert_dtype(dtype))
+
+
+@defop("argsort")
+def argsort(x, axis=-1, descending=False, stable=False):
+    out = jnp.argsort(x, axis=int(axis), stable=bool(stable), descending=bool(descending))
+    return out.astype(jnp.int64)
+
+
+@defop("sort")
+def sort(x, axis=-1, descending=False, stable=False):
+    out = jnp.sort(x, axis=int(axis), stable=bool(stable), descending=bool(descending))
+    return out
+
+
+@defop("topk", tensor_method=None)
+def _topk_op(x, k, axis=-1, largest=True, sorted=True):  # noqa: A002
+    axis = int(axis) % x.ndim
+    src = x if largest else -x
+    moved = jnp.moveaxis(src, axis, -1)
+    values, indices = jax.lax.top_k(moved, k)
+    if not largest:
+        values = -values
+    values = jnp.moveaxis(values, -1, axis)
+    indices = jnp.moveaxis(indices, -1, axis)
+    return values, indices.astype(jnp.int64)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):  # noqa: A002
+    return _topk_op(x, int(k), axis=axis, largest=largest, sorted=sorted)
+
+
+register_tensor_method("topk", topk)
+
+
+@defop("where")
+def where(condition, x=None, y=None):
+    if x is None and y is None:
+        raise ValueError("where(condition) without x/y: use paddle_tpu.nonzero")
+    return jnp.where(condition, x, y)
+
+
+def nonzero(x, as_tuple=False):
+    """Eager-only (dynamic output shape)."""
+    arr = np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+    res = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor(r.astype(np.int64)) for r in res)
+    return Tensor(np.stack(res, axis=1).astype(np.int64))
+
+
+register_tensor_method("nonzero", nonzero)
+
+
+@defop("searchsorted")
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    side = "right" if right else "left"
+    if sorted_sequence.ndim == 1:
+        out = jnp.searchsorted(sorted_sequence, values, side=side)
+    else:
+        flat_seq = sorted_sequence.reshape(-1, sorted_sequence.shape[-1])
+        flat_val = values.reshape(-1, values.shape[-1])
+        out = jax.vmap(lambda s, v: jnp.searchsorted(s, v, side=side))(flat_seq, flat_val)
+        out = out.reshape(values.shape)
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+@defop("bucketize")
+def bucketize(x, sorted_sequence, out_int32=False, right=False):
+    side = "right" if right else "left"
+    out = jnp.searchsorted(sorted_sequence, x, side=side)
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+@defop("kthvalue", tensor_method=None)
+def _kthvalue_op(x, k, axis=-1, keepdim=False):
+    axis = int(axis) % x.ndim
+    sorted_vals = jnp.sort(x, axis=axis)
+    sorted_idx = jnp.argsort(x, axis=axis)
+    vals = jnp.take(sorted_vals, k - 1, axis=axis)
+    idx = jnp.take(sorted_idx, k - 1, axis=axis)
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis)
+        idx = jnp.expand_dims(idx, axis)
+    return vals, idx.astype(jnp.int64)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    return _kthvalue_op(x, int(k), axis=axis, keepdim=keepdim)
+
+
+register_tensor_method("kthvalue", kthvalue)
+
+
+@defop("mode", tensor_method=None)
+def _mode_op(x, axis=-1, keepdim=False):
+    axis = int(axis) % x.ndim
+    moved = jnp.moveaxis(x, axis, -1)
+    sorted_v = jnp.sort(moved, axis=-1)
+    # count runs of equal values; mode = value with max run length
+    n = sorted_v.shape[-1]
+    eq = jnp.concatenate(
+        [jnp.ones(sorted_v.shape[:-1] + (1,), bool), sorted_v[..., 1:] == sorted_v[..., :-1]],
+        axis=-1,
+    )
+    run_id = jnp.cumsum(~eq, axis=-1)
+    # one-hot accumulate run lengths
+    counts = jax.nn.one_hot(run_id, n, dtype=jnp.int32).sum(axis=-2)
+    best_run = jnp.argmax(counts, axis=-1)
+    first_of_run = jnp.argmax(run_id == best_run[..., None], axis=-1)
+    values = jnp.take_along_axis(sorted_v, first_of_run[..., None], axis=-1)[..., 0]
+    # index: last occurrence in the original array
+    match = moved == values[..., None]
+    idx = (moved.shape[-1] - 1) - jnp.argmax(jnp.flip(match, axis=-1), axis=-1)
+    if keepdim:
+        values = jnp.expand_dims(values, -1)
+        idx = jnp.expand_dims(idx, -1)
+    values = jnp.moveaxis(values, -1, axis) if keepdim else values
+    idx = jnp.moveaxis(idx, -1, axis) if keepdim else idx
+    return values, idx.astype(jnp.int64)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    return _mode_op(x, axis=axis, keepdim=keepdim)
+
+
+register_tensor_method("mode", mode)
+
+
+@defop("index_sample")
+def index_sample(x, index):
+    rows = jnp.arange(x.shape[0])[:, None]
+    return x[rows, index]

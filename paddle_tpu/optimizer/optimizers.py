@@ -1,0 +1,358 @@
+"""Optimizer algorithms (reference ``python/paddle/optimizer/``: sgd.py,
+momentum.py, adam.py, adamw.py, lamb.py, …). Each defines only the functional
+core; the fused-step machinery lives in the base class."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.optimizer.optimizer import Optimizer
+
+__all__ = [
+    "SGD",
+    "Momentum",
+    "Adagrad",
+    "Adadelta",
+    "RMSProp",
+    "Adam",
+    "AdamW",
+    "Adamax",
+    "NAdam",
+    "RAdam",
+    "Lamb",
+    "ASGD",
+    "Rprop",
+]
+
+
+class SGD(Optimizer):
+    def update(self, param, grad, state, *, lr, step, weight_decay):
+        if weight_decay:
+            grad = grad + weight_decay * param
+        return param - lr * grad, state
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None, use_nesterov=False, weight_decay=None, grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def init_state(self, param):
+        return {"velocity": jnp.zeros_like(param)}
+
+    def update(self, param, grad, state, *, lr, step, weight_decay):
+        if weight_decay:
+            grad = grad + weight_decay * param
+        v = self._momentum * state["velocity"] + grad
+        if self._use_nesterov:
+            new_param = param - lr * (grad + self._momentum * v)
+        else:
+            new_param = param - lr * v
+        return new_param, {"velocity": v}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None, weight_decay=None, grad_clip=None, multi_precision=False, initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self._epsilon = epsilon
+        self._initial = initial_accumulator_value
+
+    def init_state(self, param):
+        return {"moment": jnp.full_like(param, self._initial)}
+
+    def update(self, param, grad, state, *, lr, step, weight_decay):
+        if weight_decay:
+            grad = grad + weight_decay * param
+        m = state["moment"] + jnp.square(grad)
+        return param - lr * grad / (jnp.sqrt(m) + self._epsilon), {"moment": m}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95, parameters=None, weight_decay=None, grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def init_state(self, param):
+        return {"avg_squared_grad": jnp.zeros_like(param), "avg_squared_update": jnp.zeros_like(param)}
+
+    def update(self, param, grad, state, *, lr, step, weight_decay):
+        if weight_decay:
+            grad = grad + weight_decay * param
+        g2 = self._rho * state["avg_squared_grad"] + (1 - self._rho) * jnp.square(grad)
+        upd = (
+            jnp.sqrt(state["avg_squared_update"] + self._epsilon)
+            / jnp.sqrt(g2 + self._epsilon)
+            * grad
+        )
+        u2 = self._rho * state["avg_squared_update"] + (1 - self._rho) * jnp.square(upd)
+        return param - lr * upd, {"avg_squared_grad": g2, "avg_squared_update": u2}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0, centered=False, parameters=None, weight_decay=None, grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def init_state(self, param):
+        st = {"mean_square": jnp.zeros_like(param), "momentum": jnp.zeros_like(param)}
+        if self._centered:
+            st["mean_grad"] = jnp.zeros_like(param)
+        return st
+
+    def update(self, param, grad, state, *, lr, step, weight_decay):
+        if weight_decay:
+            grad = grad + weight_decay * param
+        ms = self._rho * state["mean_square"] + (1 - self._rho) * jnp.square(grad)
+        new_state = {"mean_square": ms}
+        if self._centered:
+            mg = self._rho * state["mean_grad"] + (1 - self._rho) * grad
+            denom = jnp.sqrt(ms - jnp.square(mg) + self._epsilon)
+            new_state["mean_grad"] = mg
+        else:
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = self._momentum * state["momentum"] + lr * grad / denom
+        new_state["momentum"] = mom
+        return param - mom, new_state
+
+
+class Adam(Optimizer):
+    def __init__(
+        self,
+        learning_rate=0.001,
+        beta1=0.9,
+        beta2=0.999,
+        epsilon=1e-8,
+        parameters=None,
+        weight_decay=None,
+        grad_clip=None,
+        lazy_mode=False,
+        multi_precision=False,
+        use_multi_tensor=False,
+        amsgrad=False,
+        name=None,
+    ):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._amsgrad = amsgrad
+
+    def init_state(self, param):
+        st = {"moment1": jnp.zeros_like(param), "moment2": jnp.zeros_like(param)}
+        if self._amsgrad:
+            st["moment2_max"] = jnp.zeros_like(param)
+        return st
+
+    def _adam_update(self, param, grad, state, lr, step, decoupled_wd, l2_wd):
+        if l2_wd:
+            grad = grad + l2_wd * param
+        b1 = jnp.asarray(self._beta1, param.dtype)
+        b2 = jnp.asarray(self._beta2, param.dtype)
+        m = b1 * state["moment1"] + (1 - b1) * grad
+        v = b2 * state["moment2"] + (1 - b2) * jnp.square(grad)
+        t = step.astype(param.dtype)
+        m_hat = m / (1 - jnp.power(b1, t))
+        if self._amsgrad:
+            v_max = jnp.maximum(state["moment2_max"], v)
+            v_hat = v_max / (1 - jnp.power(b2, t))
+        else:
+            v_hat = v / (1 - jnp.power(b2, t))
+        upd = m_hat / (jnp.sqrt(v_hat) + self._epsilon)
+        if decoupled_wd:
+            upd = upd + decoupled_wd * param
+        new_param = param - lr * upd
+        new_state = {"moment1": m, "moment2": v}
+        if self._amsgrad:
+            new_state["moment2_max"] = v_max
+        return new_param, new_state
+
+    def update(self, param, grad, state, *, lr, step, weight_decay):
+        # paddle Adam applies weight_decay as L2 regularization (coupled)
+        return self._adam_update(param, grad, state, lr, step, 0.0, weight_decay)
+
+
+class AdamW(Adam):
+    def __init__(
+        self,
+        learning_rate=0.001,
+        beta1=0.9,
+        beta2=0.999,
+        epsilon=1e-8,
+        parameters=None,
+        weight_decay=0.01,
+        lr_ratio=None,
+        apply_decay_param_fun=None,
+        grad_clip=None,
+        lazy_mode=False,
+        multi_precision=False,
+        amsgrad=False,
+        name=None,
+    ):
+        super().__init__(
+            learning_rate, beta1, beta2, epsilon, parameters,
+            weight_decay=weight_decay, grad_clip=grad_clip,
+            multi_precision=multi_precision, amsgrad=amsgrad, name=name,
+        )
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._decay_param_names: Optional[set] = None
+        if apply_decay_param_fun is not None:
+            self._decay_param_names = {
+                p.name for p in self._parameters if apply_decay_param_fun(p.name)
+            }
+        self._current_param_name: Optional[str] = None
+
+    def step(self) -> None:
+        if self._apply_decay_param_fun is None:
+            super().step()
+            return
+        # split params into decay / no-decay sub-steps sharing state
+        all_params = self._parameters
+        try:
+            self._parameters = [p for p in all_params if p.name in self._decay_param_names]
+            self._wd_backup = self._weight_decay
+            super().step()
+            self._parameters = [p for p in all_params if p.name not in self._decay_param_names]
+            self._weight_decay = 0.0
+            self._step_count -= 1  # count once per logical step
+            super().step()
+        finally:
+            self._parameters = all_params
+            self._weight_decay = self._wd_backup
+
+    def update(self, param, grad, state, *, lr, step, weight_decay):
+        # decoupled weight decay (AdamW)
+        return self._adam_update(param, grad, state, lr, step, weight_decay, 0.0)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None, weight_decay=None, grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def init_state(self, param):
+        return {"moment": jnp.zeros_like(param), "inf_norm": jnp.zeros_like(param)}
+
+    def update(self, param, grad, state, *, lr, step, weight_decay):
+        if weight_decay:
+            grad = grad + weight_decay * param
+        m = self._beta1 * state["moment"] + (1 - self._beta1) * grad
+        u = jnp.maximum(self._beta2 * state["inf_norm"], jnp.abs(grad) + self._epsilon)
+        t = step.astype(param.dtype)
+        new_param = param - lr / (1 - jnp.power(self._beta1, t)) * m / u
+        return new_param, {"moment": m, "inf_norm": u}
+
+
+class NAdam(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, epsilon=1e-8, momentum_decay=0.004, parameters=None, weight_decay=None, grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._momentum_decay = momentum_decay
+
+    def init_state(self, param):
+        return {
+            "moment1": jnp.zeros_like(param),
+            "moment2": jnp.zeros_like(param),
+            "mu_product": jnp.ones((), param.dtype),
+        }
+
+    def update(self, param, grad, state, *, lr, step, weight_decay):
+        if weight_decay:
+            grad = grad + weight_decay * param
+        t = step.astype(param.dtype)
+        mu_t = self._beta1 * (1 - 0.5 * jnp.power(0.96, t * self._momentum_decay))
+        mu_t1 = self._beta1 * (1 - 0.5 * jnp.power(0.96, (t + 1) * self._momentum_decay))
+        mu_prod = state["mu_product"] * mu_t
+        m = self._beta1 * state["moment1"] + (1 - self._beta1) * grad
+        v = self._beta2 * state["moment2"] + (1 - self._beta2) * jnp.square(grad)
+        m_hat = mu_t1 * m / (1 - mu_prod * mu_t1) + (1 - mu_t) * grad / (1 - mu_prod)
+        v_hat = v / (1 - jnp.power(self._beta2, t))
+        new_param = param - lr * m_hat / (jnp.sqrt(v_hat) + self._epsilon)
+        return new_param, {"moment1": m, "moment2": v, "mu_product": mu_prod}
+
+
+class RAdam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None, weight_decay=None, grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def init_state(self, param):
+        return {"moment1": jnp.zeros_like(param), "moment2": jnp.zeros_like(param)}
+
+    def update(self, param, grad, state, *, lr, step, weight_decay):
+        if weight_decay:
+            grad = grad + weight_decay * param
+        t = step.astype(param.dtype)
+        m = self._beta1 * state["moment1"] + (1 - self._beta1) * grad
+        v = self._beta2 * state["moment2"] + (1 - self._beta2) * jnp.square(grad)
+        m_hat = m / (1 - jnp.power(self._beta1, t))
+        rho_inf = 2.0 / (1 - self._beta2) - 1
+        rho_t = rho_inf - 2 * t * jnp.power(self._beta2, t) / (1 - jnp.power(self._beta2, t))
+        r = jnp.sqrt(
+            ((rho_t - 4) * (rho_t - 2) * rho_inf)
+            / jnp.maximum((rho_inf - 4) * (rho_inf - 2) * rho_t, 1e-8)
+        )
+        v_hat = jnp.sqrt(v / (1 - jnp.power(self._beta2, t)))
+        adaptive = r * m_hat / (v_hat + self._epsilon)
+        new_param = jnp.where(rho_t > 5.0, param - lr * adaptive, param - lr * m_hat)
+        return new_param, {"moment1": m, "moment2": v}
+
+
+class Lamb(Optimizer):
+    """LAMB (reference ``python/paddle/optimizer/lamb.py`` +
+    ``distributed_fused_lamb`` fused kernel): layerwise-adaptive Adam for
+    large-batch training."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None, exclude_from_weight_decay_fn=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, lamb_weight_decay, grad_clip, multi_precision, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def init_state(self, param):
+        return {"moment1": jnp.zeros_like(param), "moment2": jnp.zeros_like(param)}
+
+    def update(self, param, grad, state, *, lr, step, weight_decay):
+        t = step.astype(param.dtype)
+        m = self._beta1 * state["moment1"] + (1 - self._beta1) * grad
+        v = self._beta2 * state["moment2"] + (1 - self._beta2) * jnp.square(grad)
+        m_hat = m / (1 - jnp.power(self._beta1, t))
+        v_hat = v / (1 - jnp.power(self._beta2, t))
+        r = m_hat / (jnp.sqrt(v_hat) + self._epsilon) + weight_decay * param
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(param)))
+        r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return param - lr * trust * r, {"moment1": m, "moment2": v}
+
+
+class ASGD(SGD):
+    pass
+
+
+class Rprop(Optimizer):
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0), parameters=None, etas=(0.5, 1.2), grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, multi_precision, name)
+        self._lr_range = learning_rate_range
+        self._etas = etas
+
+    def init_state(self, param):
+        return {
+            "prev_grad": jnp.zeros_like(param),
+            "lr": jnp.full_like(param, float(self._learning_rate) if not callable(self._learning_rate) else 0.001),
+        }
+
+    def update(self, param, grad, state, *, lr, step, weight_decay):
+        sign = jnp.sign(grad * state["prev_grad"])
+        factor = jnp.where(sign > 0, self._etas[1], jnp.where(sign < 0, self._etas[0], 1.0))
+        new_lr = jnp.clip(state["lr"] * factor, self._lr_range[0], self._lr_range[1])
+        grad = jnp.where(sign < 0, jnp.zeros_like(grad), grad)
+        new_param = param - jnp.sign(grad) * new_lr
+        return new_param, {"prev_grad": grad, "lr": new_lr}

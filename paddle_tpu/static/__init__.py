@@ -1,0 +1,40 @@
+"""``paddle_tpu.static`` — static-graph compat shims.
+
+The reference's static mode (Program + StandaloneExecutor + CINN) maps onto
+trace-and-compile: ``paddle_tpu.jit.to_static`` IS the static mode. This
+module keeps the high-traffic ``paddle.static`` surface (InputSpec, save/load
+inference model) for script portability.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.dtypes import convert_dtype
+
+__all__ = ["InputSpec", "save_inference_model", "load_inference_model"]
+
+
+class InputSpec:
+    def __init__(self, shape: Sequence[Any], dtype: Any = "float32", name: Optional[str] = None, stop_gradient: bool = True) -> None:
+        self.shape = tuple(-1 if s is None else int(s) for s in shape)
+        self.dtype = convert_dtype(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    def __repr__(self) -> str:
+        return f"InputSpec(shape={self.shape}, dtype={jnp.dtype(self.dtype).name}, name={self.name})"
+
+
+def save_inference_model(path_prefix: str, feed_vars: Any, fetch_vars: Any, executor: Any = None, **kwargs: Any) -> None:
+    raise NotImplementedError(
+        "static save_inference_model: use paddle_tpu.jit.save(layer, path, input_spec=...)"
+    )
+
+
+def load_inference_model(path_prefix: str, executor: Any = None, **kwargs: Any) -> Any:
+    from paddle_tpu.jit.save_load import load
+
+    return load(path_prefix)

@@ -1,0 +1,4 @@
+"""``paddle_tpu.vision`` (reference ``python/paddle/vision``): model zoo +
+transforms + synthetic datasets for benchmarks."""
+
+from paddle_tpu.vision import models, transforms  # noqa: F401

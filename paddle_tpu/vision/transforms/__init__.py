@@ -1,0 +1,125 @@
+"""Vision transforms (reference ``python/paddle/vision/transforms``) — numpy
+host-side preprocessing (runs in dataloader workers, off the TPU)."""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "Compose",
+    "ToTensor",
+    "Normalize",
+    "Resize",
+    "CenterCrop",
+    "RandomCrop",
+    "RandomHorizontalFlip",
+    "Transpose",
+]
+
+
+class Compose:
+    def __init__(self, transforms: Sequence[Callable]) -> None:
+        self.transforms = list(transforms)
+
+    def __call__(self, data: Any) -> Any:
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class ToTensor:
+    def __init__(self, data_format: str = "CHW") -> None:
+        self.data_format = data_format
+
+    def __call__(self, img: Any) -> Any:
+        arr = np.asarray(img, dtype=np.float32)
+        if arr.max() > 1.5:
+            arr = arr / 255.0
+        if arr.ndim == 2:
+            arr = arr[..., None]
+        if self.data_format == "CHW":
+            arr = arr.transpose(2, 0, 1)
+        from paddle_tpu.core.tensor import Tensor
+
+        return Tensor(arr)
+
+
+class Normalize:
+    def __init__(self, mean: Sequence[float], std: Sequence[float], data_format: str = "CHW", to_rgb: bool = False) -> None:
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def __call__(self, img: Any) -> Any:
+        arr = img.numpy() if hasattr(img, "numpy") else np.asarray(img, np.float32)
+        if self.data_format == "CHW":
+            arr = (arr - self.mean[:, None, None]) / self.std[:, None, None]
+        else:
+            arr = (arr - self.mean) / self.std
+        from paddle_tpu.core.tensor import Tensor
+
+        return Tensor(arr.astype(np.float32))
+
+
+class Resize:
+    def __init__(self, size: Any, interpolation: str = "bilinear") -> None:
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img: Any) -> Any:
+        arr = np.asarray(img, np.float32)
+        h, w = self.size
+        ih, iw = arr.shape[:2]
+        yi = (np.arange(h) * ih / h).astype(np.int64).clip(0, ih - 1)
+        xi = (np.arange(w) * iw / w).astype(np.int64).clip(0, iw - 1)
+        return arr[yi][:, xi]
+
+
+class CenterCrop:
+    def __init__(self, size: Any) -> None:
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img: Any) -> Any:
+        arr = np.asarray(img)
+        h, w = self.size
+        ih, iw = arr.shape[:2]
+        top = (ih - h) // 2
+        left = (iw - w) // 2
+        return arr[top : top + h, left : left + w]
+
+
+class RandomCrop:
+    def __init__(self, size: Any, padding: int = 0) -> None:
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+
+    def __call__(self, img: Any) -> Any:
+        arr = np.asarray(img)
+        if self.padding:
+            pad = [(self.padding, self.padding), (self.padding, self.padding)] + [(0, 0)] * (arr.ndim - 2)
+            arr = np.pad(arr, pad)
+        h, w = self.size
+        ih, iw = arr.shape[:2]
+        top = random.randint(0, ih - h)
+        left = random.randint(0, iw - w)
+        return arr[top : top + h, left : left + w]
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob: float = 0.5) -> None:
+        self.prob = prob
+
+    def __call__(self, img: Any) -> Any:
+        if random.random() < self.prob:
+            return np.asarray(img)[:, ::-1].copy()
+        return np.asarray(img)
+
+
+class Transpose:
+    def __init__(self, order: Sequence[int] = (2, 0, 1)) -> None:
+        self.order = tuple(order)
+
+    def __call__(self, img: Any) -> Any:
+        return np.asarray(img).transpose(self.order)

@@ -1,0 +1,39 @@
+"""Test config: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's "distributed tests without a cluster" strategy
+(SURVEY §4): the reference spawns localhost NCCL subprocesses; on TPU/XLA the
+CPU backend natively exposes N virtual devices, so multi-device SPMD tests run
+in-process.
+"""
+
+import os
+import sys
+
+# Force the CPU backend: tests must not depend on the TPU tunnel being alive.
+# The lab image's sitecustomize imports jax at interpreter startup, so env
+# vars are too late — update jax.config directly (backends are still
+# uninitialized at conftest time, so this takes effect).
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ.setdefault("JAX_DEFAULT_MATMUL_PRECISION", "highest")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+# The CPU backend's "default" matmul precision truncates to bf16-class
+# accuracy; tests compare against numpy fp32 references.
+jax.config.update("jax_default_matmul_precision", "highest")
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu
+
+    paddle_tpu.seed(2024)
+    np.random.seed(2024)
+    yield

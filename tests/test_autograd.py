@@ -1,0 +1,149 @@
+"""Eager autograd tape: backward, accumulation, hooks, no_grad, PyLayer, grad."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_simple_backward():
+    x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+def test_chain_rule_multiple_uses():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x * x + x  # dy/dx = 2x + 1 = 5
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 5.0)
+
+
+def test_grad_accumulates_across_backwards():
+    x = paddle.to_tensor(1.0, stop_gradient=False)
+    (x * 2).backward()
+    (x * 3).backward()
+    np.testing.assert_allclose(x.grad.numpy(), 5.0)
+
+
+def test_no_grad_blocks_tape():
+    x = paddle.to_tensor(1.0, stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.grad_node is None
+    assert y.stop_gradient
+
+
+def test_stop_gradient_leaf_gets_no_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    w = paddle.to_tensor([2.0], stop_gradient=True)
+    y = (x * w).sum()
+    y.backward()
+    assert w.grad is None
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_backward_through_matmul_mlp():
+    np.random.seed(0)
+    w1 = paddle.to_tensor(np.random.randn(4, 8).astype(np.float32), stop_gradient=False)
+    w2 = paddle.to_tensor(np.random.randn(8, 2).astype(np.float32), stop_gradient=False)
+    x = paddle.to_tensor(np.random.randn(3, 4).astype(np.float32))
+    h = paddle.nn.functional.relu(x @ w1)
+    out = (h @ w2).sum()
+    out.backward()
+    assert w1.grad.shape == [4, 8]
+    assert w2.grad.shape == [8, 2]
+    # closed-form check: dL/dW2 = h^T @ ones
+    h_np = np.maximum(x.numpy() @ w1.numpy(), 0)
+    expected_w2 = h_np.T @ np.ones((3, 2), np.float32)
+    np.testing.assert_allclose(w2.grad.numpy(), expected_w2, rtol=1e-5)
+
+
+def test_retain_graph():
+    x = paddle.to_tensor(3.0, stop_gradient=False)
+    y = x * x
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 12.0)
+
+
+def test_double_backward_without_retain_raises():
+    x = paddle.to_tensor(3.0, stop_gradient=False)
+    y = x * x
+    y.backward()
+    with pytest.raises(Exception):
+        y.backward()
+
+
+def test_backward_hook():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    seen = []
+    x.register_hook(lambda g: seen.append(g.numpy().copy()))
+    (x * 5).backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(seen[0], [5.0])
+
+
+def test_hook_modifies_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    x.register_hook(lambda g: g * 2)
+    (x * 3).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+
+def test_multi_output_op_grad():
+    x = paddle.to_tensor([3.0, 1.0, 2.0], stop_gradient=False)
+    values, indices = paddle.topk(x, k=2)
+    values.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1.0, 0.0, 1.0])
+
+
+def test_grad_api():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x * x * x
+    (gx,) = paddle.grad([y], [x])
+    np.testing.assert_allclose(gx.numpy(), 12.0)
+    assert x.grad is None  # paddle.grad does not pollute .grad
+
+
+def test_autograd_backward_api():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 4
+    paddle.autograd.backward([y], [paddle.ones_like(y)])
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 4.0])
+
+
+def test_pylayer():
+    class Double(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, dy):
+            (x,) = ctx.saved_tensor()
+            return dy * 2
+
+    x = paddle.to_tensor([1.5], stop_gradient=False)
+    y = Double.apply(x)
+    np.testing.assert_allclose(y.numpy(), [3.0])
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_retain_grads_intermediate():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x * 3
+    y.retain_grads()
+    z = y * 4
+    z.backward()
+    np.testing.assert_allclose(y.grad.numpy(), 4.0)
+    np.testing.assert_allclose(x.grad.numpy(), 12.0)
+
+
+def test_getitem_grad():
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]], stop_gradient=False)
+    x[0].sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[1, 1], [0, 0]])

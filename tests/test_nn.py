@@ -1,0 +1,294 @@
+"""nn: Layer mechanics, layers forward shapes/numerics, losses, attention."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def _t(a, **kw):
+    return paddle.to_tensor(np.asarray(a), **kw)
+
+
+class TestLayerBase:
+    def test_parameter_registration(self):
+        layer = nn.Linear(4, 3)
+        names = [n for n, _ in layer.named_parameters()]
+        assert set(names) == {"weight", "bias"}
+        assert layer.weight.shape == [4, 3]
+        assert not layer.weight.stop_gradient
+
+    def test_sublayer_tree_and_state_dict(self):
+        model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        sd = model.state_dict()
+        assert "0.weight" in sd and "2.bias" in sd
+        params = model.parameters()
+        assert len(params) == 4
+
+    def test_set_state_dict_roundtrip(self):
+        m1 = nn.Linear(3, 3)
+        m2 = nn.Linear(3, 3)
+        m2.set_state_dict({k: v.numpy() for k, v in m1.state_dict().items()})
+        np.testing.assert_allclose(m1.weight.numpy(), m2.weight.numpy())
+
+    def test_train_eval_recursive(self):
+        model = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        model.eval()
+        assert not model[1].training
+        model.train()
+        assert model[1].training
+
+    def test_forward_hooks(self):
+        layer = nn.Linear(2, 2)
+        calls = []
+        h = layer.register_forward_post_hook(lambda l, i, o: calls.append(1))
+        layer(_t(np.ones((1, 2), np.float32)))
+        assert calls == [1]
+        h.remove()
+        layer(_t(np.ones((1, 2), np.float32)))
+        assert calls == [1]
+
+    def test_to_dtype(self):
+        layer = nn.Linear(2, 2)
+        layer.to(dtype="bfloat16")
+        assert layer.weight.dtype == paddle.bfloat16
+
+
+class TestLayers:
+    def test_linear_numerics(self):
+        layer = nn.Linear(4, 3)
+        x = np.random.rand(5, 4).astype(np.float32)
+        out = layer(_t(x))
+        expected = x @ layer.weight.numpy() + layer.bias.numpy()
+        np.testing.assert_allclose(out.numpy(), expected, rtol=1e-5)
+
+    def test_embedding(self):
+        emb = nn.Embedding(10, 6)
+        idx = _t(np.array([[1, 2], [3, 4]]), dtype="int32")
+        out = emb(idx)
+        assert out.shape == [2, 2, 6]
+        np.testing.assert_allclose(out.numpy()[0, 0], emb.weight.numpy()[1], rtol=1e-6)
+
+    def test_conv2d_shapes(self):
+        conv = nn.Conv2D(3, 8, 3, stride=2, padding=1)
+        x = _t(np.random.rand(2, 3, 16, 16).astype(np.float32))
+        out = conv(x)
+        assert out.shape == [2, 8, 8, 8]
+
+    def test_conv2d_vs_manual(self):
+        conv = nn.Conv2D(1, 1, 2, bias_attr=False)
+        x = np.random.rand(1, 1, 3, 3).astype(np.float32)
+        out = conv(_t(x)).numpy()
+        w = conv.weight.numpy()[0, 0]
+        expected = np.zeros((2, 2), np.float32)
+        for i in range(2):
+            for j in range(2):
+                expected[i, j] = (x[0, 0, i : i + 2, j : j + 2] * w).sum()
+        np.testing.assert_allclose(out[0, 0], expected, rtol=1e-4)
+
+    def test_pools(self):
+        x = _t(np.random.rand(1, 2, 8, 8).astype(np.float32))
+        assert nn.MaxPool2D(2, 2)(x).shape == [1, 2, 4, 4]
+        assert nn.AvgPool2D(2, 2)(x).shape == [1, 2, 4, 4]
+        assert nn.AdaptiveAvgPool2D(1)(x).shape == [1, 2, 1, 1]
+        np.testing.assert_allclose(
+            nn.AdaptiveAvgPool2D(1)(x).numpy()[..., 0, 0], x.numpy().mean((2, 3)), rtol=1e-5
+        )
+
+    def test_layer_norm(self):
+        ln = nn.LayerNorm(6)
+        x = np.random.rand(2, 3, 6).astype(np.float32)
+        out = ln(_t(x)).numpy()
+        mean = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        np.testing.assert_allclose(out, (x - mean) / np.sqrt(var + 1e-5), rtol=1e-4, atol=1e-5)
+
+    def test_rms_norm(self):
+        rms = nn.RMSNorm(8)
+        x = np.random.rand(4, 8).astype(np.float32)
+        out = rms(_t(x)).numpy()
+        expected = x / np.sqrt((x**2).mean(-1, keepdims=True) + 1e-6)
+        np.testing.assert_allclose(out, expected, rtol=1e-4)
+
+    def test_batch_norm_updates_stats(self):
+        bn = nn.BatchNorm2D(3)
+        x = _t(np.random.rand(4, 3, 5, 5).astype(np.float32) + 2.0)
+        bn.train()
+        bn(x)
+        assert float(np.abs(bn._mean.numpy()).sum()) > 0
+        bn.eval()
+        out = bn(x)
+        assert out.shape == [4, 3, 5, 5]
+
+    def test_dropout_train_eval(self):
+        drop = nn.Dropout(0.5)
+        x = _t(np.ones((100, 100), np.float32))
+        drop.train()
+        y = drop(x)
+        frac_zero = float((y.numpy() == 0).mean())
+        assert 0.3 < frac_zero < 0.7
+        drop.eval()
+        np.testing.assert_allclose(drop(x).numpy(), x.numpy())
+
+    def test_sequential_and_layerlist(self):
+        model = nn.Sequential(nn.Linear(4, 4), nn.ReLU())
+        assert len(model) == 2
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        ll.append(nn.Linear(2, 2))
+        assert len(ll) == 4
+        assert len(nn.Sequential(*ll).parameters()) == 8
+
+
+class TestLosses:
+    def test_cross_entropy_matches_numpy(self):
+        logits = np.random.rand(8, 5).astype(np.float32)
+        labels = np.random.randint(0, 5, (8,))
+        loss = F.cross_entropy(_t(logits), _t(labels, dtype="int32"))
+        p = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+        expected = -np.log(p[np.arange(8), labels]).mean()
+        np.testing.assert_allclose(loss.numpy(), expected, rtol=1e-5)
+
+    def test_cross_entropy_ignore_index(self):
+        logits = np.random.rand(4, 3).astype(np.float32)
+        labels = np.array([0, -100, 2, -100])
+        loss = F.cross_entropy(_t(logits), _t(labels, dtype="int32"), ignore_index=-100)
+        p = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+        expected = -np.log(p[[0, 2], [0, 2]]).mean()
+        np.testing.assert_allclose(loss.numpy(), expected, rtol=1e-5)
+
+    def test_cross_entropy_soft_label(self):
+        logits = np.random.rand(4, 3).astype(np.float32)
+        soft = np.random.dirichlet(np.ones(3), 4).astype(np.float32)
+        loss = F.cross_entropy(_t(logits), _t(soft), soft_label=True)
+        logp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+        np.testing.assert_allclose(loss.numpy(), -(soft * logp).sum(-1).mean(), rtol=1e-5)
+
+    def test_mse_bce(self):
+        a = np.random.rand(6).astype(np.float32)
+        b = np.random.rand(6).astype(np.float32)
+        np.testing.assert_allclose(F.mse_loss(_t(a), _t(b)).numpy(), ((a - b) ** 2).mean(), rtol=1e-5)
+        p = np.clip(np.random.rand(6).astype(np.float32), 0.01, 0.99)
+        y = (np.random.rand(6) > 0.5).astype(np.float32)
+        expected = -(y * np.log(p) + (1 - y) * np.log(1 - p)).mean()
+        np.testing.assert_allclose(F.binary_cross_entropy(_t(p), _t(y)).numpy(), expected, rtol=1e-4)
+
+    def test_kl_div(self):
+        x = np.log(np.random.dirichlet(np.ones(4), 3)).astype(np.float32)
+        y = np.random.dirichlet(np.ones(4), 3).astype(np.float32)
+        expected = (y * (np.log(y) - x)).sum(-1).mean() / 4 * 4
+        got = F.kl_div(_t(x), _t(y), reduction="mean").numpy()
+        np.testing.assert_allclose(got, (y * (np.log(y) - x)).mean(), rtol=1e-4)
+
+    def test_loss_layers(self):
+        ce = nn.CrossEntropyLoss()
+        out = ce(_t(np.random.rand(4, 3).astype(np.float32)), _t(np.array([0, 1, 2, 0]), dtype="int32"))
+        assert out.shape == []
+
+
+class TestAttention:
+    def test_sdpa_matches_reference(self):
+        b, s, h, d = 2, 8, 2, 16
+        q = np.random.rand(b, s, h, d).astype(np.float32)
+        k = np.random.rand(b, s, h, d).astype(np.float32)
+        v = np.random.rand(b, s, h, d).astype(np.float32)
+        out = F.scaled_dot_product_attention(_t(q), _t(k), _t(v))
+        # numpy reference
+        qh = q.transpose(0, 2, 1, 3)
+        kh = k.transpose(0, 2, 1, 3)
+        vh = v.transpose(0, 2, 1, 3)
+        logits = qh @ kh.transpose(0, 1, 3, 2) / np.sqrt(d)
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        expected = (p @ vh).transpose(0, 2, 1, 3)
+        np.testing.assert_allclose(out.numpy(), expected, rtol=1e-4, atol=1e-5)
+
+    def test_causal_flash_attention(self):
+        b, s, h, d = 1, 6, 1, 8
+        q = np.random.rand(b, s, h, d).astype(np.float32)
+        k = np.random.rand(b, s, h, d).astype(np.float32)
+        v = np.random.rand(b, s, h, d).astype(np.float32)
+        out, _ = F.flash_attention(_t(q), _t(k), _t(v), causal=True)
+        # position 0 attends only to position 0
+        np.testing.assert_allclose(out.numpy()[0, 0, 0], v[0, 0, 0], rtol=1e-5)
+
+    def test_flashmask_matches_dense_causal(self):
+        """FlashMask with start=S (nothing masked below causal) == causal attention."""
+        b, s, h, d = 1, 8, 1, 4
+        q = np.random.rand(b, s, h, d).astype(np.float32)
+        k = np.random.rand(b, s, h, d).astype(np.float32)
+        v = np.random.rand(b, s, h, d).astype(np.float32)
+        idx = np.full((b, 1, s, 1), s, np.int32)  # no extra masking
+        out_mask = F.flashmask_attention(_t(q), _t(k), _t(v), _t(idx), causal=True)
+        out_causal, _ = F.flash_attention(_t(q), _t(k), _t(v), causal=True)
+        np.testing.assert_allclose(out_mask.numpy(), out_causal.numpy(), rtol=1e-5, atol=1e-6)
+
+    def test_flashmask_document_mask(self):
+        """Two documents packed: tokens must not attend across the boundary."""
+        b, s, h, d = 1, 8, 1, 4
+        boundary = 4
+        q = np.random.rand(b, s, h, d).astype(np.float32)
+        k = np.random.rand(b, s, h, d).astype(np.float32)
+        v = np.random.rand(b, s, h, d).astype(np.float32)
+        # causal doc mask: for key j in doc0 (j<4), mask rows >= 4
+        idx = np.zeros((b, 1, s, 1), np.int32)
+        idx[:, :, :boundary, 0] = boundary  # keys in doc0: masked for rows >= 4
+        idx[:, :, boundary:, 0] = s
+        out = F.flashmask_attention(_t(q), _t(k), _t(v), _t(idx), causal=True).numpy()
+        # row 4 (first token of doc1) attends only to key 4 ⇒ output == v[4]
+        np.testing.assert_allclose(out[0, boundary, 0], v[0, boundary, 0], rtol=1e-5)
+
+    def test_multi_head_attention_layer(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = _t(np.random.rand(2, 5, 16).astype(np.float32))
+        out = mha(x)
+        assert out.shape == [2, 5, 16]
+
+    def test_transformer_encoder(self):
+        layer = nn.TransformerEncoderLayer(d_model=16, nhead=2, dim_feedforward=32)
+        enc = nn.TransformerEncoder(layer, num_layers=2)
+        x = _t(np.random.rand(2, 5, 16).astype(np.float32))
+        out = enc(x)
+        assert out.shape == [2, 5, 16]
+
+
+class TestActivations:
+    def test_activations_numerics(self):
+        x = np.linspace(-3, 3, 13).astype(np.float32)
+        t = _t(x)
+        np.testing.assert_allclose(F.relu(t).numpy(), np.maximum(x, 0))
+        np.testing.assert_allclose(F.sigmoid(t).numpy(), 1 / (1 + np.exp(-x)), rtol=1e-5)
+        np.testing.assert_allclose(
+            F.softmax(t).numpy(), np.exp(x) / np.exp(x).sum(), rtol=1e-5
+        )
+        np.testing.assert_allclose(F.leaky_relu(t, 0.1).numpy(), np.where(x > 0, x, 0.1 * x), rtol=1e-6)
+        np.testing.assert_allclose(F.silu(t).numpy(), x / (1 + np.exp(-x)), rtol=1e-5)
+
+    def test_swiglu(self):
+        x = np.random.rand(4, 8).astype(np.float32)
+        y = np.random.rand(4, 8).astype(np.float32)
+        out = F.swiglu(_t(x), _t(y)).numpy()
+        np.testing.assert_allclose(out, x / (1 + np.exp(-x)) * y, rtol=1e-5)
+
+
+class TestInitializers:
+    def test_constant_and_assign(self):
+        from paddle_tpu.nn import initializer as I
+
+        p = paddle.create_parameter([3, 3], default_initializer=I.Constant(2.0))
+        assert (p.numpy() == 2).all()
+
+    def test_xavier_stats(self):
+        from paddle_tpu.nn import initializer as I
+
+        p = paddle.create_parameter([256, 256], default_initializer=I.XavierNormal())
+        std = p.numpy().std()
+        assert 0.05 < std < 0.08  # sqrt(2/512) ≈ 0.0625
+
+    def test_orthogonal(self):
+        from paddle_tpu.nn import initializer as I
+
+        p = paddle.create_parameter([16, 16], default_initializer=I.Orthogonal())
+        eye = p.numpy() @ p.numpy().T
+        np.testing.assert_allclose(eye, np.eye(16), atol=1e-4)

@@ -1,0 +1,221 @@
+"""Op-layer parity tests vs numpy references (OpTest methodology, SURVEY §4)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _t(arr, **kw):
+    return paddle.to_tensor(np.asarray(arr), **kw)
+
+
+class TestMathOps:
+    def test_unary_vs_numpy(self):
+        x = np.random.rand(3, 4).astype(np.float32) + 0.5
+        t = _t(x)
+        np.testing.assert_allclose(paddle.exp(t).numpy(), np.exp(x), rtol=1e-6)
+        np.testing.assert_allclose(paddle.log(t).numpy(), np.log(x), rtol=1e-6)
+        np.testing.assert_allclose(paddle.sqrt(t).numpy(), np.sqrt(x), rtol=1e-6)
+        np.testing.assert_allclose(paddle.rsqrt(t).numpy(), 1 / np.sqrt(x), rtol=1e-5)
+        np.testing.assert_allclose(paddle.tanh(t).numpy(), np.tanh(x), rtol=1e-6)
+        np.testing.assert_allclose(paddle.floor(t).numpy(), np.floor(x))
+        np.testing.assert_allclose(paddle.abs(_t(-x)).numpy(), x)
+
+    def test_binary_broadcast(self):
+        a = np.random.rand(3, 1, 4).astype(np.float32)
+        b = np.random.rand(2, 4).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.add(_t(a), _t(b)).numpy(), a + b, rtol=1e-6
+        )
+        np.testing.assert_allclose(
+            paddle.maximum(_t(a), _t(b)).numpy(), np.maximum(a, b)
+        )
+
+    def test_scale_clip(self):
+        x = np.linspace(-2, 2, 10).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.scale(_t(x), scale=3.0, bias=1.0).numpy(), 3 * x + 1, rtol=1e-6
+        )
+        np.testing.assert_allclose(paddle.clip(_t(x), -1, 1).numpy(), np.clip(x, -1, 1))
+
+    def test_cumsum_cumprod(self):
+        x = np.random.rand(4, 5).astype(np.float32)
+        np.testing.assert_allclose(paddle.cumsum(_t(x), axis=1).numpy(), np.cumsum(x, 1), rtol=1e-5)
+        np.testing.assert_allclose(paddle.cumprod(_t(x), dim=0).numpy(), np.cumprod(x, 0), rtol=1e-5)
+
+    def test_add_n(self):
+        xs = [np.random.rand(2, 2).astype(np.float32) for _ in range(3)]
+        np.testing.assert_allclose(
+            paddle.add_n([_t(x) for x in xs]).numpy(), sum(xs), rtol=1e-6
+        )
+
+
+class TestReduction:
+    def test_reductions(self):
+        x = np.random.rand(3, 4, 5).astype(np.float32)
+        t = _t(x)
+        np.testing.assert_allclose(paddle.sum(t).numpy(), x.sum(), rtol=1e-5)
+        np.testing.assert_allclose(paddle.sum(t, axis=1).numpy(), x.sum(1), rtol=1e-5)
+        np.testing.assert_allclose(paddle.mean(t, axis=[0, 2]).numpy(), x.mean((0, 2)), rtol=1e-5)
+        np.testing.assert_allclose(paddle.max(t, axis=0, keepdim=True).numpy(), x.max(0, keepdims=True))
+        np.testing.assert_allclose(paddle.prod(t, axis=2).numpy(), x.prod(2), rtol=1e-5)
+        np.testing.assert_allclose(paddle.std(t).numpy(), x.std(ddof=1), rtol=1e-4)
+        np.testing.assert_allclose(paddle.var(t, unbiased=False).numpy(), x.var(), rtol=1e-4)
+        np.testing.assert_allclose(paddle.logsumexp(t, axis=-1).numpy(),
+                                   np.log(np.exp(x).sum(-1)), rtol=1e-5)
+
+    def test_tensor_methods(self):
+        x = _t(np.arange(6, dtype=np.float32).reshape(2, 3))
+        assert x.sum().item() == 15
+        assert x.mean().item() == 2.5
+        assert x.max().item() == 5
+
+
+class TestManipulation:
+    def test_reshape_family(self):
+        x = _t(np.arange(24, dtype=np.float32))
+        assert paddle.reshape(x, [2, 3, 4]).shape == [2, 3, 4]
+        assert x.reshape([4, 6]).shape == [4, 6]
+        y = x.reshape([2, 3, 4])
+        assert paddle.flatten(y, 1, 2).shape == [2, 12]
+        assert paddle.squeeze(y.reshape([2, 1, 12]), axis=1).shape == [2, 12]
+        assert paddle.unsqueeze(x, [0, 2]).shape == [1, 24, 1]
+
+    def test_concat_split_stack(self):
+        a = _t(np.ones((2, 3), np.float32))
+        b = _t(np.zeros((2, 3), np.float32))
+        c = paddle.concat([a, b], axis=0)
+        assert c.shape == [4, 3]
+        s = paddle.stack([a, b], axis=1)
+        assert s.shape == [2, 2, 3]
+        parts = paddle.split(c, 2, axis=0)
+        assert len(parts) == 2 and parts[0].shape == [2, 3]
+        parts = paddle.split(c, [1, 3], axis=0)
+        assert parts[1].shape == [3, 3]
+        parts = paddle.split(c, [1, -1], axis=0)
+        assert parts[1].shape == [3, 3]
+
+    def test_gather_scatter(self):
+        x = _t(np.arange(12, dtype=np.float32).reshape(4, 3))
+        idx = _t(np.array([0, 2]), dtype="int32")
+        g = paddle.gather(x, idx, axis=0)
+        np.testing.assert_allclose(g.numpy(), x.numpy()[[0, 2]])
+        upd = _t(np.full((2, 3), -1, np.float32))
+        s = paddle.scatter(x, idx, upd)
+        assert (s.numpy()[[0, 2]] == -1).all()
+
+    def test_take_put_along_axis(self):
+        x = _t(np.random.rand(3, 4).astype(np.float32))
+        idx = _t(np.array([[0, 1, 2, 3], [3, 2, 1, 0], [0, 0, 0, 0]]), dtype="int32")
+        taken = paddle.take_along_axis(x, idx, axis=1)
+        np.testing.assert_allclose(taken.numpy(), np.take_along_axis(x.numpy(), idx.numpy(), 1))
+
+    def test_tile_expand_flip_roll(self):
+        x = _t(np.array([[1.0, 2.0]], np.float32))
+        assert paddle.tile(x, [2, 3]).shape == [2, 6]
+        assert paddle.expand(x, [4, 2]).shape == [4, 2]
+        np.testing.assert_allclose(paddle.flip(x, axis=1).numpy(), [[2, 1]])
+        np.testing.assert_allclose(paddle.roll(x, 1, axis=1).numpy(), [[2, 1]])
+
+    def test_pad(self):
+        x = _t(np.ones((1, 1, 2, 2), np.float32))
+        p = paddle.ops.manipulation.pad(x, [1, 1, 1, 1])
+        assert p.shape == [1, 1, 4, 4]
+        assert p.numpy()[0, 0, 0, 0] == 0
+
+    def test_unique_eager(self):
+        x = _t(np.array([3, 1, 2, 1, 3]))
+        u = paddle.ops.manipulation.unique(x)
+        assert u.numpy().tolist() == [1, 2, 3]
+
+
+class TestSearchSort:
+    def test_argmax_topk_sort(self):
+        x = np.random.rand(4, 6).astype(np.float32)
+        t = _t(x)
+        np.testing.assert_allclose(paddle.argmax(t, axis=1).numpy(), x.argmax(1))
+        v, i = paddle.topk(t, k=3, axis=1)
+        np.testing.assert_allclose(v.numpy(), -np.sort(-x, axis=1)[:, :3], rtol=1e-6)
+        np.testing.assert_allclose(paddle.sort(t, axis=1).numpy(), np.sort(x, 1), rtol=1e-6)
+        np.testing.assert_allclose(
+            paddle.argsort(t, axis=1, descending=True).numpy(), np.argsort(-x, 1)
+        )
+
+    def test_where_nonzero(self):
+        x = _t(np.array([1.0, -1.0, 2.0]))
+        y = paddle.where(x > 0, x, paddle.zeros_like(x))
+        np.testing.assert_allclose(y.numpy(), [1, 0, 2])
+        nz = paddle.ops.search.nonzero(x > 0)
+        assert nz.numpy().tolist() == [[0], [2]]
+
+
+class TestLinalg:
+    def test_matmul_transpose_flags(self):
+        a = np.random.rand(3, 4).astype(np.float32)
+        b = np.random.rand(3, 5).astype(np.float32)
+        out = paddle.matmul(_t(a), _t(b), transpose_x=True)
+        np.testing.assert_allclose(out.numpy(), a.T @ b, rtol=1e-5)
+
+    def test_einsum(self):
+        a = np.random.rand(2, 3).astype(np.float32)
+        b = np.random.rand(3, 4).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.einsum("ij,jk->ik", _t(a), _t(b)).numpy(), a @ b, rtol=1e-5
+        )
+
+    def test_norm(self):
+        x = np.random.rand(3, 4).astype(np.float32)
+        np.testing.assert_allclose(paddle.norm(_t(x)).numpy(), np.linalg.norm(x), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.norm(_t(x), p=1, axis=1).numpy(), np.abs(x).sum(1), rtol=1e-5
+        )
+
+    def test_solve_inv_det(self):
+        a = np.random.rand(3, 3).astype(np.float32) + 3 * np.eye(3, dtype=np.float32)
+        b = np.random.rand(3, 2).astype(np.float32)
+        np.testing.assert_allclose(paddle.linalg.solve(_t(a), _t(b)).numpy(), np.linalg.solve(a, b), rtol=1e-4)
+        np.testing.assert_allclose(paddle.linalg.inv(_t(a)).numpy(), np.linalg.inv(a), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(paddle.linalg.det(_t(a)).numpy(), np.linalg.det(a), rtol=1e-4)
+
+    def test_svd_qr_cholesky(self):
+        a = np.random.rand(4, 3).astype(np.float32)
+        u, s, v = paddle.linalg.svd(_t(a))
+        np.testing.assert_allclose((u.numpy() * s.numpy()) @ v.numpy().T, a, atol=1e-5)
+        q, r = paddle.linalg.qr(_t(a))
+        np.testing.assert_allclose(q.numpy() @ r.numpy(), a, atol=1e-5)
+        spd = a.T @ a + np.eye(3, dtype=np.float32)
+        l = paddle.linalg.cholesky(_t(spd))
+        np.testing.assert_allclose(l.numpy() @ l.numpy().T, spd, atol=1e-5)
+
+
+class TestRandom:
+    def test_seed_determinism(self):
+        paddle.seed(7)
+        a = paddle.randn([4, 4])
+        paddle.seed(7)
+        b = paddle.randn([4, 4])
+        np.testing.assert_allclose(a.numpy(), b.numpy())
+
+    def test_uniform_range(self):
+        x = paddle.uniform([1000], min=2.0, max=3.0)
+        assert float(x.min()) >= 2.0 and float(x.max()) <= 3.0
+
+    def test_randperm(self):
+        p = paddle.randperm(10)
+        assert sorted(p.numpy().tolist()) == list(range(10))
+
+    def test_bernoulli_multinomial(self):
+        probs = paddle.full([1000], 0.3)
+        draws = paddle.bernoulli(probs)
+        assert 0.15 < float(draws.mean()) < 0.45
+        m = paddle.multinomial(paddle.to_tensor([0.1, 0.0, 0.9]), num_samples=50, replacement=True)
+        assert 1 not in m.numpy().tolist()
+
+
+class TestInferMeta:
+    def test_abstract_eval(self):
+        from paddle_tpu.ops.registry import infer_meta
+
+        out = infer_meta("matmul", paddle.ones([7, 3]), paddle.ones([3, 9]))
+        assert tuple(out.shape) == (7, 9)

@@ -1,0 +1,91 @@
+"""Tensor basics: creation, dtype, indexing, conversion, operators."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_roundtrip():
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert x.shape == [2, 2]
+    assert str(np.dtype(x.dtype)) == "float32"
+    np.testing.assert_allclose(x.numpy(), [[1, 2], [3, 4]])
+
+
+def test_dtypes_and_cast():
+    x = paddle.to_tensor([1, 2, 3], dtype="int64")
+    y = x.astype("float32")
+    assert str(np.dtype(y.dtype)) == "float32"
+    z = y.astype(paddle.bfloat16)
+    assert z.dtype == paddle.bfloat16
+
+
+def test_arith_operators():
+    a = paddle.to_tensor([1.0, 2.0])
+    b = paddle.to_tensor([3.0, 4.0])
+    np.testing.assert_allclose((a + b).numpy(), [4, 6])
+    np.testing.assert_allclose((a - b).numpy(), [-2, -2])
+    np.testing.assert_allclose((a * b).numpy(), [3, 8])
+    np.testing.assert_allclose((b / a).numpy(), [3, 2])
+    np.testing.assert_allclose((a**2).numpy(), [1, 4])
+    np.testing.assert_allclose((2 * a).numpy(), [2, 4])
+    np.testing.assert_allclose((-a).numpy(), [-1, -2])
+    np.testing.assert_allclose((1 - a).numpy(), [0, -1])
+
+
+def test_comparison_returns_tensor():
+    a = paddle.to_tensor([1.0, 5.0])
+    b = paddle.to_tensor([3.0, 3.0])
+    assert (a < b).numpy().tolist() == [True, False]
+    assert (a == a).numpy().tolist() == [True, True]
+
+
+def test_indexing_and_setitem():
+    x = paddle.arange(12, dtype="float32").reshape([3, 4])
+    row = x[1]
+    np.testing.assert_allclose(row.numpy(), [4, 5, 6, 7])
+    sub = x[0:2, 1:3]
+    np.testing.assert_allclose(sub.numpy(), [[1, 2], [5, 6]])
+    x[0, 0] = 100.0
+    assert x.numpy()[0, 0] == 100.0
+
+
+def test_item_and_scalar_conversion():
+    x = paddle.to_tensor(3.5)
+    assert x.item() == pytest.approx(3.5)
+    assert float(x) == pytest.approx(3.5)
+    with pytest.raises(Exception):
+        bool(paddle.to_tensor([1.0, 2.0]))
+
+
+def test_matmul_operator():
+    a = paddle.ones([2, 3])
+    b = paddle.ones([3, 4])
+    c = a @ b
+    assert c.shape == [2, 4]
+    np.testing.assert_allclose(c.numpy(), np.full((2, 4), 3.0))
+
+
+def test_clone_detach():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    d = x.detach()
+    assert d.stop_gradient
+    c = x.clone()
+    assert not c.stop_gradient  # clone participates in autograd
+
+
+def test_set_value_shape_check():
+    x = paddle.zeros([2, 2])
+    with pytest.raises(Exception):
+        x.set_value(np.zeros((3, 3), dtype=np.float32))
+
+
+def test_creation_ops():
+    assert paddle.zeros([2, 3]).shape == [2, 3]
+    assert paddle.ones([4]).numpy().sum() == 4
+    assert paddle.full([2], 7.0).numpy().tolist() == [7, 7]
+    assert paddle.arange(5).numpy().tolist() == [0, 1, 2, 3, 4]
+    assert paddle.eye(3).numpy().trace() == 3
+    t = paddle.tril(paddle.ones([3, 3]))
+    assert t.numpy()[0, 2] == 0
