@@ -36,6 +36,9 @@ class AmpScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        # per-optimizer guard so manual unscale_() before step() (the grad-clip
+        # pattern) doesn't divide gradients by the scale twice
+        self._unscaled: set = set()
 
     def is_enable(self) -> bool:
         return self._enable
@@ -46,8 +49,9 @@ class AmpScaler:
         return var * self._scale
 
     def unscale_(self, optimizer: Any) -> None:
-        if not self._enable:
+        if not self._enable or id(optimizer) in self._unscaled:
             return
+        self._unscaled.add(id(optimizer))
         inv = 1.0 / self._scale
         found = False
         with paddle_tpu.no_grad():
@@ -66,6 +70,7 @@ class AmpScaler:
         self.unscale_(optimizer)
         if not self._found_inf:
             optimizer.step()
+        self._unscaled.discard(id(optimizer))
         self.update()
 
     def minimize(self, optimizer: Any, loss: Tensor) -> None:

@@ -17,6 +17,7 @@ from paddle_tpu.distributed.api import (  # noqa: F401
 )
 from paddle_tpu.distributed.collective import (  # noqa: F401
     Group,
+    P2POp,
     ReduceOp,
     all_gather,
     all_gather_object,
@@ -24,11 +25,13 @@ from paddle_tpu.distributed.collective import (  # noqa: F401
     alltoall,
     alltoall_single,
     barrier,
+    batch_isend_irecv,
     broadcast,
     get_group,
     irecv,
     isend,
     new_group,
+    ppermute,
     recv,
     reduce,
     reduce_scatter,

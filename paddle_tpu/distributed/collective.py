@@ -38,6 +38,9 @@ __all__ = [
     "recv",
     "isend",
     "irecv",
+    "ppermute",
+    "P2POp",
+    "batch_isend_irecv",
     "barrier",
     "stream",
 ]
@@ -204,10 +207,15 @@ def broadcast(tensor: Any, src: int = 0, group: Optional[Group] = None, sync_op:
     axis_name = _axis(group)
     if axis_name is None:
         return tensor
+    g = group or _default_group()
+    local_src = g.get_group_rank(src)
+    if local_src < 0:
+        raise ValueError(f"src rank {src} is not a member of group {g.ranks}")
 
     def fn(x: Any) -> Any:
-        # select src rank's value on every member
-        return jax.lax.all_gather(x, axis_name)[src]
+        # select the src member's value on every member (gathered axis is
+        # indexed by group-local position, not global rank)
+        return jax.lax.all_gather(x, axis_name)[local_src]
 
     result = _apply(tensor, fn)
     if isinstance(tensor, Tensor) and isinstance(result, Tensor):
@@ -220,10 +228,14 @@ def scatter(tensor: Any, tensor_list: Any = None, src: int = 0, group: Optional[
     axis_name = _axis(group)
     if axis_name is None:
         return tensor
+    g = group or _default_group()
+    local_src = g.get_group_rank(src)
+    if local_src < 0:
+        raise ValueError(f"src rank {src} is not a member of group {g.ranks}")
 
     def fn(x: Any) -> Any:
         idx = jax.lax.axis_index(axis_name)
-        return jax.lax.all_gather(x, axis_name)[src][idx]
+        return jax.lax.all_gather(x, axis_name)[local_src][idx]
 
     return _apply(tensor_list if tensor_list is not None else tensor, fn)
 
@@ -267,32 +279,85 @@ def alltoall_single(
     return _apply(in_tensor, fn)
 
 
-def send(tensor: Any, dst: int = 0, group: Optional[Group] = None, sync_op: bool = True) -> Any:
+def ppermute(tensor: Any, perm: Sequence[Any], group: Optional[Group] = None) -> Any:
+    """Point-to-point permutation over the group axis: ``perm`` is a list of
+    (src_group_rank, dst_group_rank) pairs (each destination at most once) —
+    the XLA collective-permute that pipeline p2p compiles to."""
     axis_name = _axis(group)
     if axis_name is None:
         return tensor
 
     def fn(x: Any) -> Any:
-        n = jax.lax.axis_size(axis_name)
-        return jax.lax.ppermute(x, axis_name, [(i, dst) for i in range(n)])
+        return jax.lax.ppermute(x, axis_name, [tuple(p) for p in perm])
 
     return _apply(tensor, fn)
 
 
-def recv(tensor: Any, src: int = 0, group: Optional[Group] = None, sync_op: bool = True) -> Any:
+def send(tensor: Any, dst: int = 0, group: Optional[Group] = None, sync_op: bool = True, src: Optional[int] = None) -> Any:
+    """Pairwise send. SPMD programs are rank-agnostic, so the source must be
+    explicit: ``send(t, dst=k, src=j)`` ≡ ``ppermute(t, [(j, k)])``. Use
+    :func:`ppermute` or :func:`batch_isend_irecv` for pipeline-style shifts
+    (reference p2p: ``pp_utils/p2p_communication.py`` batched isend/irecv)."""
     axis_name = _axis(group)
     if axis_name is None:
         return tensor
+    if src is None:
+        raise ValueError(
+            "SPMD p2p needs an explicit source: send(t, dst=k, src=j), or use "
+            "dist.ppermute/batch_isend_irecv for shift patterns"
+        )
+    g = group or _default_group()
+    return ppermute(tensor, [(g.get_group_rank(src), g.get_group_rank(dst))], group)
 
-    def fn(x: Any) -> Any:
-        n = jax.lax.axis_size(axis_name)
-        return jax.lax.ppermute(x, axis_name, [(src, i) for i in range(n)])
 
-    result = _apply(tensor, fn)
+def recv(tensor: Any, src: int = 0, group: Optional[Group] = None, sync_op: bool = True, dst: Optional[int] = None) -> Any:
+    axis_name = _axis(group)
+    if axis_name is None:
+        return tensor
+    if dst is None:
+        raise ValueError(
+            "SPMD p2p needs an explicit destination: recv(t, src=j, dst=k), or "
+            "use dist.ppermute/batch_isend_irecv for shift patterns"
+        )
+    g = group or _default_group()
+    result = ppermute(tensor, [(g.get_group_rank(src), g.get_group_rank(dst))], group)
     if isinstance(tensor, Tensor) and isinstance(result, Tensor):
         tensor._replace_(result)
         return tensor
     return result
+
+
+class P2POp:
+    """One element of a batched p2p exchange (reference
+    ``paddle.distributed.P2POp`` used by ``batch_isend_irecv``)."""
+
+    def __init__(self, op: Any, tensor: Any, peer: int, group: Optional[Group] = None, src: Optional[int] = None) -> None:
+        self.op = op  # dist.isend / dist.irecv
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+        self.src = src
+
+
+def batch_isend_irecv(p2p_op_list: Sequence[P2POp]) -> List[Any]:
+    """Fuse a list of sends/recvs into one collective-permute. Send ops
+    contribute (self→peer) pairs; each pair's source is the op's ``src``
+    (defaulting to the matching recv's peer)."""
+    if not p2p_op_list:
+        return []
+    group = p2p_op_list[0].group
+    g = group or _default_group()
+    perm = []
+    tensor = None
+    for op in p2p_op_list:
+        if op.op is send or op.op is isend:
+            src_rank = op.src if op.src is not None else 0
+            perm.append((g.get_group_rank(src_rank), g.get_group_rank(op.peer)))
+            tensor = op.tensor
+    if tensor is None:
+        tensor = p2p_op_list[0].tensor
+    result = ppermute(tensor, perm, group)
+    return [result]
 
 
 isend = send

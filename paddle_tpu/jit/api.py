@@ -67,7 +67,9 @@ class _StateSpec:
             if not p.stop_gradient:
                 opt._state_for(p)
 
-    def snapshot(self) -> Tuple[List[Any], List[Dict[str, Any]]]:
+    def snapshot(self) -> Tuple[List[Any], List[Dict[str, Any]], Any]:
+        import paddle_tpu.core.rng as _rng
+
         tensor_arrays = [t._data for t in self.tensors]
         opt_states = []
         for opt in self.optimizers:
@@ -79,9 +81,14 @@ class _StateSpec:
                 if st is not None:
                     acc[p.name] = st
             opt_states.append({"step": opt._step_buf, "acc": acc, "lr": jnp.asarray(opt.get_lr(), jnp.float32)})
-        return tensor_arrays, opt_states
+        # The global PRNG key is threaded as state so random ops (dropout)
+        # draw fresh masks on every call of the compiled program.
+        rng_key = _rng.default_generator()._key
+        return tensor_arrays, opt_states, rng_key
 
-    def bind(self, tensor_arrays: Sequence[Any], opt_states: Sequence[Dict[str, Any]], tracing: bool) -> None:
+    def bind(self, tensor_arrays: Sequence[Any], opt_states: Sequence[Dict[str, Any]], rng_key: Any, tracing: bool) -> None:
+        import paddle_tpu.core.rng as _rng
+
         for t, arr in zip(self.tensors, tensor_arrays):
             t._data = arr
         for opt, st in zip(self.optimizers, opt_states):
@@ -90,8 +97,11 @@ class _StateSpec:
                 if p.name in st["acc"]:
                     opt._accumulators[id(p)] = st["acc"][p.name]
             opt._lr_array = st["lr"] if tracing else None
+        _rng.default_generator()._key = rng_key
 
-    def readback(self) -> Tuple[List[Any], List[Dict[str, Any]]]:
+    def readback(self) -> Tuple[List[Any], List[Dict[str, Any]], Any]:
+        import paddle_tpu.core.rng as _rng
+
         tensor_arrays = [t._data for t in self.tensors]
         opt_states = []
         for opt in self.optimizers:
@@ -102,7 +112,7 @@ class _StateSpec:
                     acc[p.name] = st
             opt_states.append({"step": opt._step_buf, "acc": acc, "lr": jnp.zeros((), jnp.float32)})
             opt._lr_array = None
-        return tensor_arrays, opt_states
+        return tensor_arrays, opt_states, _rng.default_generator()._key
 
 
 def _discover_state(objs: Sequence[Any]) -> _StateSpec:
@@ -146,7 +156,9 @@ class StaticFunction:
             instance.__dict__[f"__static_{name}__"] = cached
         return cached
 
-    def _cache_key(self, flat_in: Sequence[Any], treedef: Any, state: _StateSpec) -> Any:
+    def _cache_key(self, flat_in: Sequence[Any], treedef: Any, state: _StateSpec, scan_objs: Sequence[Any]) -> Any:
+        from paddle_tpu.nn.layer.layers import Layer
+
         sig = []
         for leaf in flat_in:
             if isinstance(leaf, Tensor):
@@ -155,11 +167,14 @@ class StaticFunction:
                 sig.append(("A", tuple(leaf.shape), str(leaf.dtype)))
             else:
                 sig.append(("S", repr(leaf)))
-        training = tuple(
-            getattr(obj, "training", None)
-            for obj in ([self._bound_self] if self._bound_self is not None else [])
-        )
-        return (treedef, tuple(sig), tuple(id(t) for t in state.tensors), training)
+        # training flags of every reachable (sub)layer: train()/eval() bakes
+        # different dropout/batch-norm programs, so mode changes must retrace.
+        training = []
+        for obj in scan_objs:
+            if isinstance(obj, Layer):
+                training.append(obj.training)
+                training.extend(l.training for l in obj.sublayers())
+        return (treedef, tuple(sig), tuple(id(t) for t in state.tensors), tuple(training))
 
     def __call__(self, *args: Any, **kwargs: Any) -> Any:
         leaves, treedef = jax.tree_util.tree_flatten((args, kwargs), is_leaf=_is_tensor)
@@ -167,23 +182,26 @@ class StaticFunction:
         if self._bound_self is not None:
             scan_objs.append(self._bound_self)
         state = _discover_state(scan_objs)
-        key = self._cache_key(leaves, treedef, state)
+        key = self._cache_key(leaves, treedef, state, scan_objs)
 
         tensor_pos = [i for i, l in enumerate(leaves) if isinstance(l, (Tensor, jax.Array))]
         in_arrays = [leaves[i]._data if isinstance(leaves[i], Tensor) else leaves[i] for i in tensor_pos]
-        state_arrays, opt_states = state.snapshot()
+        state_arrays, opt_states, rng_key = state.snapshot()
 
         if key not in self._cache:
             fn = self._fn
 
-            def staged(state_arrays_, opt_states_, in_arrays_):
+            def staged(state_arrays_, opt_states_, rng_key_, in_arrays_):
+                import paddle_tpu.core.rng as _rng
+
                 saved = [(t, t._data) for t in state.tensors]
                 saved_opt = [
                     (opt, opt._step_buf, dict(opt._accumulators), opt._lr_array)
                     for opt in state.optimizers
                 ]
+                saved_rng = _rng.default_generator()._key
                 try:
-                    state.bind(state_arrays_, opt_states_, tracing=True)
+                    state.bind(state_arrays_, opt_states_, rng_key_, tracing=True)
                     rebuilt = list(leaves)
                     for pos, arr in zip(tensor_pos, in_arrays_):
                         orig = leaves[pos]
@@ -199,8 +217,8 @@ class StaticFunction:
                         out,
                         is_leaf=_is_tensor,
                     )
-                    new_state, new_opt = state.readback()
-                    return out_arrays, new_state, new_opt
+                    new_state, new_opt, new_rng = state.readback()
+                    return out_arrays, new_state, new_opt, new_rng
                 finally:
                     for t, d in saved:
                         t._data = d
@@ -208,11 +226,16 @@ class StaticFunction:
                         opt._step_buf = sb
                         opt._accumulators = acc
                         opt._lr_array = lra
+                    _rng.default_generator()._key = saved_rng
 
             self._cache[key] = jax.jit(staged, donate_argnums=(0, 1))
 
-        out_arrays, new_state, new_opt = self._cache[key](state_arrays, opt_states, in_arrays)
+        out_arrays, new_state, new_opt, new_rng = self._cache[key](
+            state_arrays, opt_states, rng_key, in_arrays
+        )
         # Commit mutated state back into the framework objects.
+        import paddle_tpu.core.rng as _rng
+
         with _ag.set_grad_enabled(False):
             for t, arr in zip(state.tensors, new_state):
                 t._data = arr
@@ -222,6 +245,7 @@ class StaticFunction:
                     if p.name in st["acc"]:
                         opt._accumulators[id(p)] = st["acc"][p.name]
                 opt._step_count += 1
+            _rng.default_generator()._key = new_rng
         return jax.tree_util.tree_map(
             lambda o: Tensor(o) if isinstance(o, jax.Array) else o, out_arrays
         )

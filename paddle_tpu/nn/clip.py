@@ -67,18 +67,20 @@ class ClipGradByGlobalNorm(ClipGradBase):
         return jnp.sqrt(total)
 
     def __call__(self, params_grads):
-        grads = [g for _, g in params_grads if g is not None]
+        # need_clip=False params are excluded from BOTH the norm sum and the
+        # scaling (reference semantics: nn/clip.py ClipGradByGlobalNorm skips
+        # params whose ParamAttr sets need_clip=False entirely).
+        grads = [
+            g for p, g in params_grads
+            if g is not None and getattr(p, "need_clip", True)
+        ]
         if not grads:
             return params_grads
         gnorm = self.global_norm(grads)
         factor = self.clip_norm / jnp.maximum(gnorm, self.clip_norm)
         out = []
         for p, g in params_grads:
-            if g is None:
-                out.append((p, g))
-                continue
-            need_clip = getattr(p, "need_clip", True)
-            if not need_clip:
+            if g is None or not getattr(p, "need_clip", True):
                 out.append((p, g))
                 continue
             out.append((p, Tensor((g.data.astype(jnp.float32) * factor).astype(g.dtype))))

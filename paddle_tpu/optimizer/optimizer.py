@@ -58,6 +58,11 @@ class Optimizer:
         self._accumulators: Dict[int, Dict[str, jax.Array]] = {}
         self._jit_step_fn: Optional[Callable] = None
 
+    def _param_weight_decay(self, p: Any, wd: float) -> float:
+        """Per-parameter weight-decay override hook (AdamW's
+        apply_decay_param_fun)."""
+        return wd
+
     @staticmethod
     def _wd_value(weight_decay: Any) -> float:
         if weight_decay is None:
@@ -113,15 +118,41 @@ class Optimizer:
             self._advance_lr()
             return
         if self._grad_clip is not None:
+            # clip over the full set (global norm spans param groups)
             params_grads = self._grad_clip(params_grads)
         if self._step_buf is None:
             self._step_buf = jnp.zeros((), jnp.int32)
-        lr = self._lr_array if self._lr_array is not None else jnp.asarray(self.get_lr(), jnp.float32)
+        base_lr = self._lr_array if self._lr_array is not None else jnp.asarray(self.get_lr(), jnp.float32)
         step = self._step_buf + 1
-        params = [p for p, _ in params_grads]
+
+        # Bucket by (group lr, group wd, per-param lr factor) so param-group
+        # overrides are honored (reference: optimizer.py _param_groups).
+        grad_of = {id(p): g for p, g in params_grads}
+        buckets: Dict[Tuple[Optional[float], float, float], List[Tensor]] = {}
+        for group in self._param_groups:
+            g_lr = group.get("learning_rate")
+            g_wd = group.get("weight_decay")
+            wd = self._weight_decay if g_wd is None else self._wd_value(g_wd)
+            for p in group["params"]:
+                if id(p) not in grad_of:
+                    continue
+                factor = float(getattr(p, "optimize_attr", {}).get("learning_rate", 1.0))
+                wd_p = self._param_weight_decay(p, wd)
+                buckets.setdefault((g_lr, wd_p, factor), []).append(p)
+
+        for (g_lr, wd, factor), params in buckets.items():
+            lr = jnp.asarray(g_lr, jnp.float32) if g_lr is not None else base_lr
+            if factor != 1.0:
+                lr = lr * factor
+            self._run_fused(params, [grad_of[id(p)] for p in params], lr, step, wd)
+        self._step_buf = step
+        self._step_count += 1
+        self._advance_lr()
+
+    def _run_fused(self, params: List[Tensor], grads: List[Tensor], lr: Any, step: Any, weight_decay: float) -> None:
         states = [self._state_for(p) for p in params]
         p_arrays = [p.data for p in params]
-        g_arrays = [g.data for _, g in params_grads]
+        g_arrays = [g.data for g in grads]
 
         if self._jit_step_fn is None:
             update = self.update
@@ -149,15 +180,12 @@ class Optimizer:
             self._jit_step_fn = jax.jit(fused, static_argnums=(5,))
 
         new_p_arrays, new_states = self._jit_step_fn(
-            p_arrays, g_arrays, states, lr, step, self._weight_decay
+            p_arrays, g_arrays, states, lr, step, weight_decay
         )
         with paddle_tpu.no_grad():
             for p, new_data, new_state in zip(params, new_p_arrays, new_states):
                 p._data = new_data
                 self._accumulators[id(p)] = new_state
-        self._step_buf = step
-        self._step_count += 1
-        self._advance_lr()
 
     def _advance_lr(self) -> None:
         from paddle_tpu.optimizer.lr import LRScheduler

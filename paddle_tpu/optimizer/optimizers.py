@@ -202,30 +202,11 @@ class AdamW(Adam):
             multi_precision=multi_precision, amsgrad=amsgrad, name=name,
         )
         self._apply_decay_param_fun = apply_decay_param_fun
-        self._decay_param_names: Optional[set] = None
-        if apply_decay_param_fun is not None:
-            self._decay_param_names = {
-                p.name for p in self._parameters if apply_decay_param_fun(p.name)
-            }
-        self._current_param_name: Optional[str] = None
 
-    def step(self) -> None:
-        if self._apply_decay_param_fun is None:
-            super().step()
-            return
-        # split params into decay / no-decay sub-steps sharing state
-        all_params = self._parameters
-        try:
-            self._parameters = [p for p in all_params if p.name in self._decay_param_names]
-            self._wd_backup = self._weight_decay
-            super().step()
-            self._parameters = [p for p in all_params if p.name not in self._decay_param_names]
-            self._weight_decay = 0.0
-            self._step_count -= 1  # count once per logical step
-            super().step()
-        finally:
-            self._parameters = all_params
-            self._weight_decay = self._wd_backup
+    def _param_weight_decay(self, p, wd):
+        if self._apply_decay_param_fun is not None and not self._apply_decay_param_fun(p.name):
+            return 0.0
+        return wd
 
     def update(self, param, grad, state, *, lr, step, weight_decay):
         # decoupled weight decay (AdamW)
