@@ -102,12 +102,18 @@ class Optimizer:
     def _state_for(self, p: Tensor) -> Dict[str, jax.Array]:
         key = id(p)
         if key not in self._accumulators:
-            state = self.init_state(p.data)
-            if self._multi_precision and jnp.dtype(p.dtype) in (
+            low_prec = jnp.dtype(p.dtype) in (
                 jnp.dtype(jnp.bfloat16),
                 jnp.dtype(jnp.float16),
-            ):
-                state["master_weight"] = p.data.astype(jnp.float32)
+            )
+            if self._multi_precision and low_prec:
+                # fp32 master weight AND fp32 moments (reference
+                # multi_precision semantics: all accumulators in fp32).
+                master = p.data.astype(jnp.float32)
+                state = self.init_state(master)
+                state["master_weight"] = master
+            else:
+                state = self.init_state(p.data)
             self._accumulators[key] = state
         return self._accumulators[key]
 
