@@ -33,7 +33,8 @@ def main() -> None:
     platform = jax.default_backend()
     if platform == "tpu":
         # ~0.5B params: Llama proportions scaled to fit one v5e chip (16G)
-        # with fp32 master weights + AdamW moments. Grows with remat/pallas.
+        # with fp32 master weights + AdamW moments; per-layer recompute keeps
+        # activations flat so batch*seq can use the full MXU.
         cfg = LlamaConfig(
             vocab_size=32000,
             hidden_size=1536,
@@ -42,8 +43,9 @@ def main() -> None:
             num_attention_heads=12,
             num_key_value_heads=12,
             max_position_embeddings=2048,
+            recompute=True,
         )
-        batch, seq, steps, warmup = 4, 1024, 10, 2
+        batch, seq, steps, warmup = 8, 2048, 10, 2
     else:  # CPU smoke mode so the script is runnable anywhere
         cfg = LlamaConfig.tiny()
         batch, seq, steps, warmup = 2, 128, 3, 1

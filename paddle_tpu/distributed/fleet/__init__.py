@@ -11,3 +11,7 @@ from paddle_tpu.distributed.fleet.fleet import (  # noqa: F401
     get_hybrid_communicate_group,
     init,
 )
+from paddle_tpu.distributed.fleet.recompute import (  # noqa: F401
+    recompute,
+    recompute_sequential,
+)

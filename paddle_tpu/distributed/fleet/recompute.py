@@ -1,0 +1,200 @@
+"""Activation checkpointing (recompute).
+
+Reference: ``python/paddle/distributed/fleet/recompute/recompute.py`` —
+PyLayer-based segment recompute with RNG-state replay. TPU-native mechanics:
+
+- **Eager**: the forward segment runs under ``no_grad`` so no tape residuals
+  are held; only the segment *inputs* are saved. Backward re-runs the segment
+  with grad recording on, then sweeps the inner tape — parameter grads
+  accumulate into ``param.grad`` (additive, so composition with grads arriving
+  from outside the segment is correct) and input grads are routed back into
+  the outer tape.
+- **Under jit capture** the same python runs with tracers, so the recomputed
+  ops are traced a second time in the backward region — i.e. the XLA program
+  itself contains the rematerialization. ``lax.optimization_barrier`` on the
+  saved inputs prevents XLA CSE from collapsing the recomputation back into
+  the forward activations (the same guard ``jax.checkpoint`` uses).
+- RNG replay: the global generator key is snapshotted at forward and restored
+  for the re-run so dropout masks match (reference replays cuda RNG states).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import autograd as _ag
+from paddle_tpu.core import rng as _rng
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["recompute", "recompute_sequential"]
+
+
+def _snapshot_rng() -> Any:
+    gen = _rng.default_generator()
+    with gen._lock:
+        return gen._key
+
+
+def _restore_rng(key: Any) -> Any:
+    gen = _rng.default_generator()
+    with gen._lock:
+        prev = gen._key
+        gen._key = key
+    return prev
+
+
+def recompute(function: Any, *args: Any, **kwargs: Any) -> Any:
+    """Run ``function(*args, **kwargs)`` without saving its intermediate
+    activations; recompute them during backward.
+
+    ``use_reentrant`` and ``preserve_rng_state`` kwargs are accepted for API
+    parity (this implementation is reentrant and always replays RNG).
+    """
+    kwargs.pop("use_reentrant", None)
+    preserve_rng = kwargs.pop("preserve_rng_state", True)
+
+    if not _ag.is_grad_enabled():
+        return function(*args, **kwargs)
+
+    # Positional AND keyword tensors are segment inputs (saved, barriered,
+    # grads routed back); everything else is replayed by value.
+    kw_keys = list(kwargs.keys())
+    flat_args: List[Any] = list(args) + [kwargs[k] for k in kw_keys]
+    tensor_inputs: List[Tensor] = [
+        a for a in flat_args if isinstance(a, Tensor) and not a.stop_gradient
+    ]
+    rng_key = _snapshot_rng() if preserve_rng else None
+    # AMP autocast state must be replayed too: backward may run outside the
+    # auto_cast context (reference recompute saves/restores amp state).
+    from paddle_tpu.amp.auto_cast import _amp_state, _state as _amp_cfg
+
+    amp_cfg = dict(_amp_cfg())
+
+    with _ag.set_grad_enabled(False):
+        outputs = function(*args, **kwargs)
+
+    single = not isinstance(outputs, (list, tuple))
+    out_list = [outputs] if single else list(outputs)
+    out_tensors = [o for o in out_list if isinstance(o, Tensor)]
+    if not out_tensors:
+        return outputs
+    out_avals = [jax.ShapeDtypeStruct(tuple(o.shape), o.dtype) for o in out_tensors]
+
+    # Save only input *arrays* (device buffers); the python args/kwargs
+    # structure is re-assembled at backward time.
+    saved_arrays = [a.data if isinstance(a, Tensor) else None for a in flat_args]
+
+    def vjp_fn(cots: Any) -> Tuple[Any, ...]:
+        cot_list = [cots] if len(out_avals) == 1 else list(cots)
+        # Barrier the saved inputs so XLA cannot CSE the recomputed segment
+        # with the original forward (which would keep activations alive).
+        barriered = list(saved_arrays)
+        arr_idx = [i for i, a in enumerate(barriered) if a is not None]
+        if arr_idx:
+            fresh = jax.lax.optimization_barrier([barriered[i] for i in arr_idx])
+            for i, arr in zip(arr_idx, fresh):
+                barriered[i] = arr
+        re_flat: List[Any] = []
+        recomputed_inputs: List[Tensor] = []
+        for a, arr in zip(flat_args, barriered):
+            if isinstance(a, Tensor):
+                t = Tensor(arr, stop_gradient=a.stop_gradient)
+                re_flat.append(t)
+                if not a.stop_gradient:
+                    recomputed_inputs.append(t)
+            else:
+                re_flat.append(a)
+        re_args = re_flat[: len(args)]
+        re_kwargs = dict(zip(kw_keys, re_flat[len(args):]))
+
+        prev_key = _restore_rng(rng_key) if preserve_rng else None
+        prev_amp = dict(_amp_cfg())
+        _amp_state.cfg = dict(amp_cfg)
+        try:
+            with _ag.set_grad_enabled(True):
+                re_out = function(*re_args, **re_kwargs)
+        finally:
+            _amp_state.cfg = prev_amp
+            if preserve_rng:
+                _restore_rng(prev_key)
+
+        re_out_list = [re_out] if not isinstance(re_out, (list, tuple)) else list(re_out)
+        re_out_tensors = [o for o in re_out_list if isinstance(o, Tensor)]
+        grad_outputs = []
+        for c, aval in zip(cot_list, out_avals):
+            if c is None or getattr(c, "dtype", None) == jax.dtypes.float0:
+                # no upstream grad for this output: seed an explicit zero
+                # (run_backward seeds ones for None, which is backward()
+                # root semantics, not ours).
+                grad_outputs.append(Tensor(jnp.zeros(aval.shape, aval.dtype)))
+            else:
+                grad_outputs.append(Tensor(c))
+        # Inner sweep: param grads accumulate in-place; input grads captured.
+        grads = _ag.grad(
+            [o for o in re_out_tensors],
+            recomputed_inputs,
+            grad_outputs=grad_outputs,
+            allow_unused=True,
+        )
+        out = tuple(g.data if g is not None else None for g in grads)
+        return out
+
+    node = _ag.GradNode("recompute", vjp_fn, tensor_inputs, out_avals)
+    idx = 0
+    wrapped: List[Any] = []
+    for o in out_list:
+        if isinstance(o, Tensor):
+            t = Tensor(o.data, stop_gradient=False)
+            t._grad_node = node
+            t._grad_output_index = idx
+            idx += 1
+            wrapped.append(t)
+        else:
+            wrapped.append(o)
+    return wrapped[0] if single else tuple(wrapped)
+
+
+def recompute_sequential(
+    ctx: Optional[dict], functions: Sequence[Any], *args: Any, **kwargs: Any
+) -> Any:
+    """Recompute a ``Sequential`` (or list of layers) in segments.
+
+    Reference ``recompute_sequential`` — ``ctx`` may carry ``segments`` (int).
+    """
+    ctx = ctx or {}
+    segments = int(ctx.get("segments", 1))
+    # kwargs here are recompute-control only (use_reentrant /
+    # preserve_rng_state); layer inputs must be positional.
+    unknown = set(kwargs) - {"use_reentrant", "preserve_rng_state"}
+    if unknown:
+        raise TypeError(
+            f"recompute_sequential only accepts recompute-control kwargs, got {sorted(unknown)}"
+        )
+    if hasattr(functions, "children"):
+        functions = list(functions.children())
+    functions = list(functions)
+    if not functions:
+        return args[0] if len(args) == 1 else args
+
+    def run_segment(fns: List[Any]):
+        def seg(*xs: Any) -> Any:
+            out = xs
+            for f in fns:
+                out = f(*out) if isinstance(out, tuple) else f(out)
+            return out
+
+        return seg
+
+    n = len(functions)
+    size = max(1, (n + segments - 1) // segments)
+    out: Any = args
+    for start in range(0, n, size):
+        fns = functions[start : start + size]
+        if isinstance(out, tuple):
+            out = recompute(run_segment(fns), *out, **kwargs)
+        else:
+            out = recompute(run_segment(fns), out, **kwargs)
+    return out

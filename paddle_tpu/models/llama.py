@@ -44,6 +44,7 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     tie_word_embeddings: bool = False
     use_flash_attention: bool = True
+    recompute: bool = False  # per-decoder-layer activation checkpointing
     dtype: str = "bfloat16"
 
     @staticmethod
@@ -181,9 +182,20 @@ class LlamaModel(nn.Layer):
     ) -> Any:
         h = self.embed_tokens(input_ids)
         new_caches = [] if use_cache else None
+        use_recompute = (
+            self.config.recompute
+            and self.training
+            and not use_cache
+            and past_key_values is None
+        )
         for i, layer in enumerate(self.layers):
             past = past_key_values[i] if past_key_values is not None else None
-            h = layer(h, startend_row_indices, past, use_cache)
+            if use_recompute:
+                from paddle_tpu.distributed.fleet import recompute
+
+                h = recompute(layer, h, startend_row_indices)
+            else:
+                h = layer(h, startend_row_indices, past, use_cache)
             if use_cache:
                 h, cache = h
                 new_caches.append(cache)

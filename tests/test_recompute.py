@@ -1,0 +1,172 @@
+"""Activation checkpointing: grad parity vs plain backward, RNG replay,
+jit-captured recompute."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed.fleet import recompute, recompute_sequential
+
+
+def _t(a, **kw):
+    return paddle.to_tensor(np.asarray(a), **kw)
+
+
+def _make_mlp():
+    paddle.seed(7)
+    return nn.Sequential(
+        nn.Linear(8, 32), nn.GELU(), nn.Linear(32, 32), nn.GELU(), nn.Linear(32, 4)
+    )
+
+
+def test_recompute_grad_parity():
+    x = np.random.RandomState(0).rand(16, 8).astype(np.float32)
+
+    m1 = _make_mlp()
+    a = _t(x)
+    a.stop_gradient = False
+    loss1 = m1(a).sum()
+    loss1.backward()
+
+    m2 = _make_mlp()
+    b = _t(x)
+    b.stop_gradient = False
+    loss2 = recompute(m2, b).sum()
+    loss2.backward()
+
+    np.testing.assert_allclose(float(loss1), float(loss2), rtol=1e-6)
+    np.testing.assert_allclose(a.grad.numpy(), b.grad.numpy(), rtol=1e-5)
+    for p1, p2 in zip(m1.parameters(), m2.parameters()):
+        np.testing.assert_allclose(p1.grad.numpy(), p2.grad.numpy(), rtol=1e-5)
+
+
+def test_recompute_param_grads_compose_with_outside_use():
+    """A param used both inside and outside the recompute segment gets the sum."""
+    paddle.seed(0)
+    lin = nn.Linear(4, 4)
+    x = _t(np.random.rand(3, 4).astype(np.float32))
+
+    loss_plain = (lin(x) + lin(x)).sum()
+    loss_plain.backward()
+    ref = lin.weight.grad.numpy().copy()
+    lin.clear_gradients()
+
+    loss_mix = (recompute(lin, x) + lin(x)).sum()
+    loss_mix.backward()
+    np.testing.assert_allclose(lin.weight.grad.numpy(), ref, rtol=1e-5)
+
+
+def test_recompute_rng_replay_dropout():
+    """Backward re-run must replay the SAME dropout mask as forward."""
+    paddle.seed(123)
+    drop = nn.Dropout(p=0.5)
+    x = _t(np.ones((64, 64), np.float32))
+    x.stop_gradient = False
+    out = recompute(drop, x)
+    mask = (out.numpy() != 0).astype(np.float32)
+    out.sum().backward()
+    # d(out)/dx = mask / keep_prob: same mask as forward iff RNG replayed
+    np.testing.assert_allclose(x.grad.numpy(), mask * 2.0, rtol=1e-6)
+
+
+def test_recompute_sequential_segments():
+    x = np.random.RandomState(1).rand(8, 8).astype(np.float32)
+    m1 = _make_mlp()
+    a = _t(x)
+    loss1 = m1(a).sum()
+    loss1.backward()
+
+    m2 = _make_mlp()
+    b = _t(x)
+    loss2 = recompute_sequential({"segments": 2}, m2, b).sum()
+    loss2.backward()
+    for p1, p2 in zip(m1.parameters(), m2.parameters()):
+        np.testing.assert_allclose(p1.grad.numpy(), p2.grad.numpy(), rtol=1e-5)
+
+
+def test_recompute_under_jit():
+    x = np.random.RandomState(2).rand(16, 8).astype(np.float32)
+    y = np.random.RandomState(3).rand(16, 4).astype(np.float32)
+
+    def make():
+        m = _make_mlp()
+        o = paddle.optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+        return m, o
+
+    m1, o1 = make()
+    for _ in range(5):
+        loss = ((m1(_t(x)) - _t(y)) ** 2).mean()
+        loss.backward()
+        o1.step()
+        o1.clear_grad()
+    ref = float(((m1(_t(x)) - _t(y)) ** 2).mean())
+
+    m2, o2 = make()
+
+    @paddle.jit.to_static
+    def step(model, opt, xx, yy):
+        pred = recompute(model, xx)
+        loss = ((pred - yy) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    for _ in range(5):
+        step(m2, o2, _t(x), _t(y))
+    got = float(((m2(_t(x)) - _t(y)) ** 2).mean())
+    np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+
+def test_recompute_no_grad_passthrough():
+    m = _make_mlp()
+    x = _t(np.random.rand(2, 8).astype(np.float32))
+    with paddle.no_grad():
+        out = recompute(m, x)
+    assert out.grad_node is None
+
+
+def test_recompute_kwarg_tensor_gets_grad():
+    """Tensors passed by keyword are segment inputs too."""
+    paddle.seed(0)
+    lin = nn.Linear(4, 4)
+
+    def f(x, scale=None):
+        return lin(x) * scale
+
+    x = _t(np.random.rand(3, 4).astype(np.float32))
+    base = _t(np.full((1,), 2.0, np.float32))
+    base.stop_gradient = False
+    scale = base * 3.0  # non-leaf: exercises routing into the outer tape
+    x.stop_gradient = False
+    out = recompute(f, x, scale=scale)
+    out.sum().backward()
+    assert x.grad is not None
+    assert base.grad is not None
+    np.testing.assert_allclose(
+        base.grad.numpy(), [3.0 * float(lin(x).sum())], rtol=1e-5
+    )
+
+
+def test_recompute_replays_amp_state():
+    """backward() outside the auto_cast context must re-run the segment
+    with the forward's autocast config."""
+    import paddle_tpu.amp as amp
+
+    paddle.seed(0)
+    lin = nn.Linear(16, 16)
+    x = _t(np.random.rand(8, 16).astype(np.float32))
+
+    with amp.auto_cast(level="O1"):
+        out_plain = lin(x)
+    with amp.auto_cast(level="O1"):
+        out_rc = recompute(lin, x)
+    loss_plain = out_plain.astype("float32").sum()
+    loss_rc = out_rc.astype("float32").sum()
+    lin.clear_gradients()
+    loss_plain.backward()
+    ref = lin.weight.grad.numpy().copy()
+    lin.clear_gradients()
+    loss_rc.backward()  # outside auto_cast: state must be replayed
+    np.testing.assert_allclose(lin.weight.grad.numpy(), ref, rtol=1e-6)
