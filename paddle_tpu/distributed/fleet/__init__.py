@@ -11,6 +11,13 @@ from paddle_tpu.distributed.fleet.fleet import (  # noqa: F401
     get_hybrid_communicate_group,
     init,
 )
+from paddle_tpu.distributed.fleet.layers.mpu import (  # noqa: F401
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    get_rng_state_tracker,
+)
 from paddle_tpu.distributed.fleet.recompute import (  # noqa: F401
     recompute,
     recompute_sequential,

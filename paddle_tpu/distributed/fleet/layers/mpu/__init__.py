@@ -1,0 +1,12 @@
+from paddle_tpu.distributed.fleet.layers.mpu import mp_ops  # noqa: F401
+from paddle_tpu.distributed.fleet.layers.mpu.mp_layers import (  # noqa: F401
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from paddle_tpu.distributed.fleet.layers.mpu.random import (  # noqa: F401
+    RNGStatesTracker,
+    get_rng_state_tracker,
+    model_parallel_random_seed,
+)

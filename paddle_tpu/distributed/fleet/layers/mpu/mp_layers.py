@@ -1,0 +1,214 @@
+"""Tensor (model) parallel layers.
+
+Reference: ``python/paddle/distributed/fleet/layers/mpu/mp_layers.py``
+(``VocabParallelEmbedding:47``, ``ColumnParallelLinear:334``,
+``RowParallelLinear:541``, ``ParallelCrossEntropy:742``).
+
+TPU-native design: the reference allocates *per-rank slices* of each weight and
+wires NCCL collectives by hand; here each layer owns the **global** parameter
+placed with a NamedSharding over the 'mp' mesh axis, and forward computes on
+global-view arrays — XLA/GSPMD partitions the matmuls onto the MXU and inserts
+the all-reduce/all-gather on ICI exactly where the reference calls
+``_mp_allreduce``/``_c_concat``. The same layer code therefore works in eager,
+under ``paddle_tpu.jit``, and in multi-host SPMD without modification.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from paddle_tpu.distributed.fleet.layers.mpu import mp_ops
+from paddle_tpu.distributed.fleet.layers.mpu.mp_ops import _get_mp_env
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn.layer.layers import Layer
+
+__all__ = [
+    "VocabParallelEmbedding",
+    "ColumnParallelLinear",
+    "RowParallelLinear",
+    "ParallelCrossEntropy",
+]
+
+
+def _shard_param(param: Any, dim: Optional[int], group: Any = None) -> None:
+    """Place a parameter over the mesh: Shard(dim) on the mp axis (dim=None →
+    replicated). In-place on the Parameter's buffer, outside the grad tape."""
+    mesh, axis, world = _get_mp_env(group)
+    if world == 1 or mesh is None:
+        return
+    from paddle_tpu.distributed.api import shard_tensor
+    from paddle_tpu.distributed.placements import Replicate, Shard
+
+    placements = []
+    for name in mesh.dim_names:
+        if name == axis and dim is not None:
+            placements.append(Shard(dim))
+        else:
+            placements.append(Replicate())
+    import paddle_tpu
+
+    with paddle_tpu.no_grad():
+        d = shard_tensor(param, mesh, placements)
+    param._data = d._data
+    param.process_mesh = mesh
+    param.placements = placements
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding with the vocabulary dimension sharded over the mp axis.
+
+    The reference masks out-of-range ids per rank and all-reduces the partial
+    lookups (``mp_layers.py:47``); GSPMD derives the identical masked-gather +
+    psum from the row-sharded table.
+    """
+
+    def __init__(
+        self,
+        num_embeddings: int,
+        embedding_dim: int,
+        weight_attr: Any = None,
+        mp_group: Any = None,
+        name: Optional[str] = None,
+    ) -> None:
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self._group = mp_group
+        _, _, self.world_size = _get_mp_env(mp_group)
+        if num_embeddings % max(self.world_size, 1) != 0:
+            raise ValueError(
+                f"num_embeddings ({num_embeddings}) must be divisible by mp world size ({self.world_size})"
+            )
+        self.weight = self.create_parameter([num_embeddings, embedding_dim], attr=weight_attr)
+        _shard_param(self.weight, 0, mp_group)
+
+    def forward(self, x: Any) -> Any:
+        out = F.embedding(x, self.weight)
+        # constrain back to replicated: the partial-lookup psum point
+        return mp_ops.mark_replicated(out, self._group)
+
+    def extra_repr(self) -> str:
+        return f"num_embeddings={self.num_embeddings}, embedding_dim={self.embedding_dim}, mp={self.world_size}"
+
+
+class ColumnParallelLinear(Layer):
+    """Linear with the output (column) dimension sharded over the mp axis.
+
+    ``gather_output=True`` constrains the result back to replicated (the
+    reference's ``_c_concat``); ``False`` leaves it column-sharded for a
+    following RowParallelLinear (the Megatron pattern).
+    Reference: ``mp_layers.py:334``.
+    """
+
+    def __init__(
+        self,
+        in_features: int,
+        out_features: int,
+        weight_attr: Any = None,
+        has_bias: bool = True,
+        gather_output: bool = True,
+        fuse_matmul_bias: bool = False,
+        mp_group: Any = None,
+        name: Optional[str] = None,
+    ) -> None:
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        self._group = mp_group
+        _, _, self.world_size = _get_mp_env(mp_group)
+        if out_features % max(self.world_size, 1) != 0:
+            raise ValueError(
+                f"out_features ({out_features}) must be divisible by mp world size ({self.world_size})"
+            )
+        self.weight = self.create_parameter([in_features, out_features], attr=weight_attr)
+        _shard_param(self.weight, 1, mp_group)
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+            _shard_param(self.bias, 0, mp_group)
+        else:
+            self.bias = None
+
+    def forward(self, x: Any) -> Any:
+        # grads of a replicated x against a column-sharded W are partial over
+        # mp — XLA emits the allreduce the reference codes as _c_identity.
+        x = mp_ops._c_identity(x, self._group)
+        y = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            return mp_ops._c_concat(y, self._group)
+        return mp_ops.mark_sharded(y, -1, self._group)
+
+    def extra_repr(self) -> str:
+        return f"in={self.in_features}, out={self.out_features}, gather_output={self.gather_output}, mp={self.world_size}"
+
+
+class RowParallelLinear(Layer):
+    """Linear with the input (row) dimension sharded over the mp axis.
+
+    With ``input_is_parallel=True`` the incoming activation is already
+    column-sharded (from a ColumnParallelLinear); the matmul produces partial
+    sums that XLA reduces over mp (the reference's ``_mp_allreduce``). Bias is
+    added after the reduction. Reference: ``mp_layers.py:541``.
+    """
+
+    def __init__(
+        self,
+        in_features: int,
+        out_features: int,
+        weight_attr: Any = None,
+        has_bias: bool = True,
+        input_is_parallel: bool = False,
+        fuse_matmul_bias: bool = False,
+        mp_group: Any = None,
+        name: Optional[str] = None,
+    ) -> None:
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self._group = mp_group
+        _, _, self.world_size = _get_mp_env(mp_group)
+        if in_features % max(self.world_size, 1) != 0:
+            raise ValueError(
+                f"in_features ({in_features}) must be divisible by mp world size ({self.world_size})"
+            )
+        self.weight = self.create_parameter([in_features, out_features], attr=weight_attr)
+        _shard_param(self.weight, 0, mp_group)
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+            _shard_param(self.bias, None, mp_group)
+        else:
+            self.bias = None
+
+    def forward(self, x: Any) -> Any:
+        if not self.input_is_parallel:
+            x = mp_ops._c_split(x, self._group)
+        else:
+            x = mp_ops.mark_sharded(x, -1, self._group)
+        y = F.linear(x, self.weight)
+        y = mp_ops._mp_allreduce(y, self._group)
+        if self.bias is not None:
+            y = y + self.bias
+        return y
+
+    def extra_repr(self) -> str:
+        return f"in={self.in_features}, out={self.out_features}, input_is_parallel={self.input_is_parallel}, mp={self.world_size}"
+
+
+class ParallelCrossEntropy(Layer):
+    """Softmax cross entropy over class-dim-sharded logits.
+
+    The reference computes per-rank max/sum partials and all-reduces them
+    (``mp_layers.py:742``); GSPMD derives the same two reductions from the
+    sharding of the class dimension, so this is the stock loss on a constrained
+    layout.
+    """
+
+    def __init__(self, mp_group: Any = None, name: Optional[str] = None, ignore_index: int = -100) -> None:
+        super().__init__()
+        self._group = mp_group
+        self.ignore_index = ignore_index
+
+    def forward(self, input: Any, label: Any) -> Any:  # noqa: A002
+        logits = mp_ops.mark_sharded(input, -1, self._group)
+        return F.softmax_with_cross_entropy(logits, label, ignore_index=self.ignore_index)

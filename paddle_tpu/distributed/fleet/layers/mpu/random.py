@@ -1,0 +1,50 @@
+"""TP-group RNG state control.
+
+Reference: ``python/paddle/distributed/fleet/layers/mpu/random.py``
+(``RNGStatesTracker``, ``model_parallel_random_seed``, ``get_rng_state_tracker``).
+The tracker itself lives in ``paddle_tpu.core.rng`` (a named-Generator registry
+over splittable JAX PRNG keys); this module provides the fleet-facing seeding
+convention: 'global_seed' shared by all ranks (dropout outside TP regions must
+be identical) and 'local_seed' offset per mp rank (dropout on sharded
+activations must differ per rank).
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.core.rng import RNGStatesTracker, get_rng_state_tracker
+
+__all__ = [
+    "RNGStatesTracker",
+    "get_rng_state_tracker",
+    "model_parallel_random_seed",
+    "MODEL_PARALLEL_RNG",
+]
+
+MODEL_PARALLEL_RNG = "local_seed"
+
+
+def model_parallel_random_seed(seed: int = 0) -> None:
+    """Install 'global_seed' and 'local_seed' states (reference
+    ``random.py`` same-name fn). The local seed is offset by the mp rank so
+    per-rank dropout masks decorrelate; under single-controller SPMD the
+    process index stands in for the rank (per-shard decorrelation inside a
+    compiled region comes from the position-dependent PRNG fold-in)."""
+    import jax
+
+    from paddle_tpu.distributed.fleet import fleet as _fleet
+
+    hcg = _fleet.get_hybrid_communicate_group()
+    mp_rank = 0
+    if hcg is not None:
+        mp_rank = hcg.get_model_parallel_rank()
+    local_seed = seed + 1024 + mp_rank + jax.process_index() * 4096
+    global_seed = seed
+
+    tracker = get_rng_state_tracker()
+    tracker.reset()
+    tracker.add("global_seed", global_seed)
+    tracker.add(MODEL_PARALLEL_RNG, local_seed)
+
+    import paddle_tpu
+
+    paddle_tpu.seed(global_seed)

@@ -1,0 +1,4 @@
+"""Meta-parallel model wrappers (reference ``fleet/meta_parallel/``)."""
+
+from paddle_tpu.distributed.fleet.meta_parallel.segment_parallel import SegmentParallel  # noqa: F401
+from paddle_tpu.distributed.fleet.meta_parallel.tensor_parallel import TensorParallel  # noqa: F401

@@ -1,0 +1,53 @@
+"""SegmentParallel (SEP) wrapper.
+
+Reference: ``fleet/meta_parallel/segment_parallel.py:26`` — broadcasts params
+across the sep group; the sequence split itself is model-side (attention must
+be written sep-aware). TPU-native: the sep axis is a mesh dimension; inputs get
+their sequence dim sharded over it, params stay replicated, and sep-aware
+attention (ring attention, ``paddle_tpu.nn.functional.ring_attention``) runs on
+the sharded sequence.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn.layer.layers import Layer
+
+
+class SegmentParallel(Layer):
+    def __init__(self, layers: Layer, hcg: Any = None, seq_axis: int = 1, **kwargs: Any) -> None:
+        super().__init__()
+        self._layers = layers
+        self._seq_axis = seq_axis
+        from paddle_tpu.distributed.fleet import fleet as _fleet
+        from paddle_tpu.distributed.mesh import get_mesh
+
+        self._hcg = hcg or _fleet.get_hybrid_communicate_group()
+        self._mesh = get_mesh()
+        self._sep_name = None
+        if self._mesh is not None and "sep" in self._mesh.dim_names and self._mesh.get_dim_size("sep") > 1:
+            self._sep_name = "sep"
+
+    def _shard_seq(self, x: Any) -> Any:
+        if self._sep_name is None or not isinstance(x, Tensor) or x.ndim <= self._seq_axis:
+            return x
+        entries: list = [None] * x.ndim
+        entries[self._seq_axis] = self._sep_name
+        arr = jax.device_put(x._data, NamedSharding(self._mesh.jax_mesh(), PartitionSpec(*entries)))
+        return Tensor(arr, stop_gradient=x.stop_gradient)
+
+    def forward(self, *inputs: Any, **kwargs: Any) -> Any:
+        inputs = tuple(self._shard_seq(x) for x in inputs)
+        kwargs = {k: self._shard_seq(v) for k, v in kwargs.items()}
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *args: Any, **kwargs: Any) -> Any:
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, *args: Any, **kwargs: Any) -> Any:
+        return self._layers.set_state_dict(*args, **kwargs)

