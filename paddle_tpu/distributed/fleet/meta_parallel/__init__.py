@@ -1,4 +1,14 @@
 """Meta-parallel model wrappers (reference ``fleet/meta_parallel/``)."""
 
+from paddle_tpu.distributed.fleet.meta_parallel.parallel_layers import (  # noqa: F401
+    LayerDesc,
+    PipelineLayer,
+    SegmentLayers,
+    SharedLayerDesc,
+)
+from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel import (  # noqa: F401
+    PipelineParallel,
+    PipelineParallelWithInterleave,
+)
 from paddle_tpu.distributed.fleet.meta_parallel.segment_parallel import SegmentParallel  # noqa: F401
 from paddle_tpu.distributed.fleet.meta_parallel.tensor_parallel import TensorParallel  # noqa: F401

@@ -1,0 +1,9 @@
+"""Pipeline-parallel layer description API (reference
+``fleet/meta_parallel/parallel_layers/``)."""
+
+from paddle_tpu.distributed.fleet.meta_parallel.parallel_layers.pp_layers import (  # noqa: F401
+    LayerDesc,
+    PipelineLayer,
+    SegmentLayers,
+    SharedLayerDesc,
+)

@@ -1,0 +1,266 @@
+"""Pipeline model description: LayerDesc / SharedLayerDesc / SegmentLayers /
+PipelineLayer.
+
+Reference: ``python/paddle/distributed/fleet/meta_parallel/parallel_layers/
+pp_layers.py`` (``LayerDesc:56``, ``SharedLayerDesc:76``, ``SegmentLayers:92``
+with 'uniform' and 'layer:<Name>' methods ``:140``, ``PipelineLayer:257``).
+
+TPU-native design: the reference instantiates only the local stage's layers on
+each pp rank and wires p2p sends between ranks. Under single-controller SPMD
+all stages are instantiated in the one global program; stage assignment
+becomes *placement*: stage ``s``'s parameters can be left replicated (pure
+grad-accumulation schedule), or — for homogeneous decoder stacks — stacked and
+sharded over the ``pp`` mesh axis and executed by the shard_map circular
+pipeline in ``spmd_pipeline.py``, which is where the 1F1B/GPipe overlap
+actually happens on hardware.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from paddle_tpu.nn.layer.layers import Layer
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "SegmentLayers", "PipelineLayer"]
+
+
+class LayerDesc:
+    """Lazy layer constructor (reference ``pp_layers.py:56``)."""
+
+    def __init__(self, layer_func: Callable[..., Any], *inputs: Any, **kwargs: Any) -> None:
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+        is_layer_cls = isinstance(layer_func, type) and issubclass(layer_func, Layer)
+        if not is_layer_cls and not callable(layer_func):
+            raise TypeError("The input of LayerDesc should be Layer or callable")
+
+    def build_layer(self) -> Any:
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self) -> str:
+        name = getattr(self.layer_func, "__name__", str(self.layer_func))
+        return f"LayerDesc({name})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """A layer whose parameters are shared between pipeline stages — the tied
+    input-embedding / output-projection pattern (reference ``pp_layers.py:76``).
+
+    The reference broadcasts the shared weight across the pp group each step;
+    in the global-view program both uses reference the *same* Parameter
+    object, so sharing is structural and gradient accumulation over both uses
+    is what autograd already does.
+    """
+
+    def __init__(
+        self,
+        key: str,
+        layer_func: Callable[..., Any],
+        forward_func: Optional[Callable[..., Any]] = None,
+        shared_weight_attr: str = "weight",
+        *inputs: Any,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    """Partition a layer list into ``num_parts`` contiguous stages
+    (reference ``pp_layers.py:92``; methods at ``:140``)."""
+
+    def __init__(
+        self,
+        layers_desc: Sequence[Any],
+        num_parts: int,
+        method: str = "uniform",
+        num_virtual_pipeline_stage: Optional[int] = None,
+    ) -> None:
+        self._layers_desc = list(layers_desc)
+        self.method = method
+        self.num_parts = num_parts
+        self.num_items = len(self._layers_desc)
+        if num_virtual_pipeline_stage is not None and num_virtual_pipeline_stage > 1:
+            self.total_parts = num_parts * num_virtual_pipeline_stage
+        else:
+            self.total_parts = num_parts
+        if self.num_items < self.total_parts:
+            raise ValueError("layer number should be greater than number of segments")
+
+    def do_segment(self) -> List[int]:
+        if self.method == "uniform":
+            return self.uniform(self.num_items, self.total_parts)
+        if self.method.startswith("layer:"):
+            name = self.method.split(":", 1)[1]
+            weights = self._gen_layer_weight(name)
+            return self.segment_with_weights(weights)
+        raise ValueError(f"unknown segment method {self.method!r}")
+
+    @staticmethod
+    def uniform(num_items: int, num_parts: int) -> List[int]:
+        result = [0] * (num_parts + 1)
+        part_size = num_items // num_parts
+        extra = num_items % num_parts
+        for i in range(1, num_parts + 1):
+            result[i] = result[i - 1] + part_size + (1 if i <= extra else 0)
+        return result
+
+    def _gen_layer_weight(self, layername: str) -> List[int]:
+        """Weight 1 for each layer whose class name matches ``layername``
+        (regex), 0 otherwise — boundaries land so each stage gets an equal
+        count of the matched (transformer-block) layers."""
+        weights = []
+        regex = re.compile(layername)
+        for desc in self._layers_desc:
+            if isinstance(desc, LayerDesc):
+                name = getattr(desc.layer_func, "__name__", "")
+            else:
+                name = desc.__class__.__name__
+            weights.append(1 if regex.match(name) else 0)
+        if sum(weights) == 0:
+            raise ValueError(f"weight method {layername!r} matched no layers")
+        return weights
+
+    def segment_with_weights(self, weights: List[int]) -> List[int]:
+        total = sum(weights)
+        per_part, extra = divmod(total, self.total_parts)
+        result = [0] * (self.total_parts + 1)
+        memory = 0
+        part = 1
+        target = per_part + (1 if part <= extra else 0)
+        for idx, w in enumerate(weights):
+            memory += w
+            if memory == target and part <= self.total_parts:
+                result[part] = idx + 1
+                part += 1
+                memory = 0
+                target = per_part + (1 if part <= extra else 0)
+        result[self.total_parts] = len(weights)
+        for i in range(1, self.total_parts + 1):
+            if result[i] == 0:
+                result[i] = result[i - 1]
+        return result
+
+
+class PipelineLayer(Layer):
+    """A model described as a flat list of layers/LayerDescs, segmented into
+    pipeline stages (reference ``pp_layers.py:257``).
+
+    Global-view semantics: ``forward`` runs every stage in order (XLA sees
+    one program). ``recompute_interval > 0`` wraps each chunk of that many
+    layers in activation checkpointing, matching the reference's
+    segment-level recompute.
+    """
+
+    def __init__(
+        self,
+        layers: Sequence[Any],
+        num_stages: Optional[int] = None,
+        topology: Any = None,
+        loss_fn: Optional[Callable] = None,
+        seg_method: str = "uniform",
+        recompute_interval: int = 0,
+        recompute_ctx: Optional[Dict[str, Any]] = None,
+        num_virtual_pipeline_stages: Optional[int] = None,
+    ) -> None:
+        super().__init__()
+        self._loss_fn = loss_fn
+        self._topo = topology
+        self._recompute_interval = recompute_interval
+        self._num_virtual_pipeline_stages = num_virtual_pipeline_stages or 1
+        if num_stages is None and topology is not None:
+            num_stages = topology.get_dim("pipe")
+        self._num_stages = int(num_stages or 1)
+
+        self._layers_desc = list(layers)
+        self.segment_parts = SegmentLayers(
+            self._layers_desc,
+            num_parts=self._num_stages,
+            method=seg_method,
+            num_virtual_pipeline_stage=self._num_virtual_pipeline_stages,
+        ).do_segment()
+
+        # build all layers (global view); shared descs built once per key
+        self.shared_layers: Dict[str, Any] = {}
+        self._built: List[Any] = []
+        self._shared_forward: Dict[int, Callable] = {}
+        for i, desc in enumerate(self._layers_desc):
+            if isinstance(desc, SharedLayerDesc):
+                if desc.layer_name not in self.shared_layers:
+                    self.shared_layers[desc.layer_name] = desc.build_layer()
+                    self.add_sublayer(f"shared_{desc.layer_name}", self.shared_layers[desc.layer_name])
+                layer = self.shared_layers[desc.layer_name]
+                if desc.forward_func is not None:
+                    self._shared_forward[i] = desc.forward_func
+                self._built.append(layer)
+            elif isinstance(desc, LayerDesc):
+                layer = desc.build_layer()
+                self.add_sublayer(str(i), layer)
+                self._built.append(layer)
+            elif isinstance(desc, Layer):
+                self.add_sublayer(str(i), desc)
+                self._built.append(desc)
+            elif callable(desc):
+                self._built.append(desc)
+            else:
+                raise TypeError(f"invalid pipeline layer entry: {desc!r}")
+
+    # --- introspection -------------------------------------------------
+    @property
+    def parts(self) -> List[int]:
+        return self.segment_parts
+
+    def get_num_stages(self) -> int:
+        return self._num_stages
+
+    def stage_of(self, layer_idx: int) -> int:
+        """Which (virtual) stage a layer index belongs to."""
+        for s in range(len(self.segment_parts) - 1):
+            if self.segment_parts[s] <= layer_idx < self.segment_parts[s + 1]:
+                return s % self._num_stages
+        raise IndexError(layer_idx)
+
+    def get_stage_layers(self, stage: int) -> List[Any]:
+        out: List[Any] = []
+        for virt in range(self._num_virtual_pipeline_stages):
+            part = virt * self._num_stages + stage
+            out.extend(self._built[self.segment_parts[part] : self.segment_parts[part + 1]])
+        return out
+
+    # --- execution -----------------------------------------------------
+    def _run_one(self, i: int, layer: Any, x: Any) -> Any:
+        if i in self._shared_forward:
+            return self._shared_forward[i](layer, x)
+        return layer(x)
+
+    def forward(self, x: Any) -> Any:
+        if self._recompute_interval <= 0:
+            for i, layer in enumerate(self._built):
+                x = self._run_one(i, layer, x)
+            return x
+        from paddle_tpu.distributed.fleet.recompute import recompute
+
+        i = 0
+        n = len(self._built)
+        while i < n:
+            j = min(i + self._recompute_interval, n)
+            chunk = list(range(i, j))
+
+            def run_chunk(x: Any, _chunk: List[int] = chunk) -> Any:
+                for k in _chunk:
+                    x = self._run_one(k, self._built[k], x)
+                return x
+
+            needs_grad = any(
+                not p.stop_gradient
+                for k in chunk
+                if isinstance(self._built[k], Layer)
+                for p in self._built[k].parameters()
+            )
+            x = recompute(run_chunk, x) if needs_grad else run_chunk(x)
+            i = j
+        return x

@@ -1,0 +1,129 @@
+"""SPMD circular pipeline: the TPU-native pipeline-parallel executor.
+
+Where the reference implements 1F1B as a per-rank Python event loop with NCCL
+p2p (``meta_parallel/pipeline_parallel.py:547``, ``pp_utils/
+p2p_communication.py:570``), on TPU the whole schedule is ONE compiled XLA
+program: stage weights are stacked along a leading axis sharded over the
+``pp`` mesh axis, and a ``lax.scan`` over pipeline ticks shifts activations
+between neighbouring stages with ``lax.ppermute`` over ICI. XLA overlaps the
+collective-permute with the next tick's stage compute (the same overlap the
+1F1B event loop hand-codes), and ``jax.grad`` through the scan gives the
+reversed schedule for backward for free.
+
+Constraints: stages must be homogeneous (same activation shape in/out), which
+holds for the decoder stacks PP is used on; embedding/head run outside the
+pipelined region (they belong to first/last stages and are small).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+shard_map = getattr(jax, "shard_map", None)
+if shard_map is None:  # pragma: no cover - jax<0.6 fallback
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+__all__ = ["pipeline", "stack_stage_params", "num_pipeline_ticks"]
+
+
+def stack_stage_params(stage_params: Sequence[Any]) -> Any:
+    """Stack S per-stage parameter pytrees into one pytree whose leaves have a
+    leading stage axis (to be sharded over the ``pp`` mesh axis)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *stage_params)
+
+
+def num_pipeline_ticks(num_microbatches: int, num_stages: int) -> int:
+    return num_microbatches + num_stages - 1
+
+
+def pipeline(
+    stage_fn: Callable[[Any, Any], Any],
+    stacked_params: Any,
+    microbatches: Any,
+    mesh: Any,
+    axis_name: str = "pp",
+    mb_spec: Optional[P] = None,
+    checkpoint_stages: bool = False,
+) -> Any:
+    """Run ``stage_fn`` as an S-stage circular pipeline over ``microbatches``.
+
+    Args:
+      stage_fn: ``(params, x) -> y`` for ONE stage; ``y.shape == x.shape``.
+      stacked_params: pytree with leading stage axis S on every leaf
+        (see :func:`stack_stage_params`), sharded ``P(axis_name)``.
+      microbatches: ``[M, microbatch...]`` array — already embedded
+        activations for a decoder stack.
+      mesh: ``ProcessMesh`` or ``jax.sharding.Mesh`` containing ``axis_name``.
+      mb_spec: PartitionSpec for the microbatch buffer over the *other* mesh
+        axes (e.g. ``P(None, 'dp', None, None)`` to keep dp sharding of the
+        batch dim); must be unsharded along ``axis_name``.
+      checkpoint_stages: rematerialize stage activations in backward.
+
+    Returns: ``[M, microbatch...]`` outputs, replicated over ``axis_name``.
+    """
+    jmesh = mesh.jax_mesh() if hasattr(mesh, "jax_mesh") else mesh
+    S = jmesh.shape[axis_name]
+    M = int(microbatches.shape[0])
+    for leaf in jax.tree.leaves(stacked_params):
+        if leaf.shape[0] != S:
+            raise ValueError(
+                f"stacked_params leading (stage) axis is {leaf.shape[0]} but the "
+                f"'{axis_name}' mesh axis has {S} devices — one stage per device"
+            )
+    if S == 1:
+        fn = jax.checkpoint(stage_fn) if checkpoint_stages else stage_fn
+        params0 = jax.tree.map(lambda a: a[0], stacked_params)
+        return jax.vmap(lambda x: fn(params0, x))(microbatches)
+    if M % S != 0:
+        raise ValueError(
+            f"num microbatches ({M}) should be a multiple of pipeline stages ({S}) "
+            "for full utilization"
+        )
+    fn = jax.checkpoint(stage_fn) if checkpoint_stages else stage_fn
+    T = num_pipeline_ticks(M, S)
+    if mb_spec is None:
+        mb_spec = P()
+    param_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
+    fwd_perm = [(i, i + 1) for i in range(S - 1)]
+
+    def local_fn(params: Any, mb: Any) -> Any:
+        params = jax.tree.map(lambda a: a[0], params)  # this device's stage
+        idx = jax.lax.axis_index(axis_name)
+        state = jnp.zeros_like(mb[0])
+        outputs = jnp.zeros_like(mb)
+
+        def tick(carry: Any, t: Any) -> Any:
+            state, outputs = carry
+            inject = jax.lax.dynamic_index_in_dim(
+                mb, jnp.minimum(t, M - 1), axis=0, keepdims=False
+            )
+            x = jnp.where(idx == 0, inject, state)
+            y = fn(params, x)
+            out_t = t - (S - 1)
+            safe_t = jnp.clip(out_t, 0, M - 1)
+            valid = jnp.logical_and(idx == S - 1, out_t >= 0)
+            cur = jax.lax.dynamic_index_in_dim(outputs, safe_t, axis=0, keepdims=False)
+            outputs = jax.lax.dynamic_update_index_in_dim(
+                outputs, jnp.where(valid, y, cur), safe_t, 0
+            )
+            state = jax.lax.ppermute(y, axis_name, fwd_perm)
+            return (state, outputs), None
+
+        (state, outputs), _ = jax.lax.scan(tick, (state, outputs), jnp.arange(T))
+        # replicate the last stage's result to every pp rank
+        outputs = jax.lax.psum(
+            jnp.where(idx == S - 1, outputs, jnp.zeros_like(outputs)), axis_name
+        )
+        return outputs
+
+    return shard_map(
+        local_fn,
+        mesh=jmesh,
+        in_specs=(param_specs, mb_spec),
+        out_specs=mb_spec,
+        check_vma=False,
+    )(stacked_params, microbatches)
