@@ -18,6 +18,17 @@ from paddle_tpu.distributed.fleet.layers.mpu import (  # noqa: F401
     VocabParallelEmbedding,
     get_rng_state_tracker,
 )
+from paddle_tpu.distributed.fleet.meta_optimizers import (  # noqa: F401
+    DygraphShardingOptimizer,
+    DygraphShardingOptimizerV2,
+    HybridParallelOptimizer,
+)
+from paddle_tpu.distributed.fleet.meta_parallel import (  # noqa: F401
+    LayerDesc,
+    PipelineLayer,
+    PipelineParallel,
+    SharedLayerDesc,
+)
 from paddle_tpu.distributed.fleet.recompute import (  # noqa: F401
     recompute,
     recompute_sequential,

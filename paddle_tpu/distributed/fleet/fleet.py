@@ -59,22 +59,32 @@ def distributed_model(model: Any) -> Any:
         init()
     from paddle_tpu.distributed.parallel import DataParallel
 
+    if _hcg.get_pipe_parallel_world_size() > 1:
+        from paddle_tpu.distributed.fleet.meta_parallel.parallel_layers.pp_layers import (
+            PipelineLayer,
+        )
+        from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel import (
+            PipelineParallel,
+        )
+
+        if isinstance(model, PipelineLayer):
+            return PipelineParallel(model, hcg=_hcg, strategy=_strategy)
+        return model
     if (
         _hcg.get_data_parallel_world_size() > 1
         and _hcg.get_model_parallel_world_size() == 1
-        and _hcg.get_pipe_parallel_world_size() == 1
     ):
         return DataParallel(model)
     return model
 
 
 def distributed_optimizer(optimizer: Any, strategy: Optional[DistributedStrategy] = None) -> Any:
-    """Hybrid-parallel optimizer wrap (reference HybridParallelOptimizer):
-    sharded state when a sharding axis exists."""
+    """Hybrid-parallel optimizer wrap (reference ``fleet.py:1427`` →
+    HybridParallelOptimizer): ZeRO-sharded state when a sharding axis exists."""
     if _hcg is not None and _hcg.get_sharding_parallel_world_size() > 1:
-        from paddle_tpu.distributed.api import shard_optimizer
+        from paddle_tpu.distributed.fleet.meta_optimizers import HybridParallelOptimizer
 
-        return shard_optimizer(optimizer)
+        return HybridParallelOptimizer(optimizer, hcg=_hcg, strategy=strategy)
     return optimizer
 
 

@@ -1,0 +1,7 @@
+"""Dygraph meta-optimizers (reference ``fleet/meta_optimizers/``)."""
+
+from paddle_tpu.distributed.fleet.meta_optimizers.dygraph_optimizer import (  # noqa: F401
+    DygraphShardingOptimizer,
+    DygraphShardingOptimizerV2,
+    HybridParallelOptimizer,
+)

@@ -1,0 +1,7 @@
+from paddle_tpu.distributed.fleet.meta_optimizers.dygraph_optimizer.dygraph_sharding_optimizer import (  # noqa: F401
+    DygraphShardingOptimizer,
+    DygraphShardingOptimizerV2,
+)
+from paddle_tpu.distributed.fleet.meta_optimizers.dygraph_optimizer.hybrid_parallel_optimizer import (  # noqa: F401
+    HybridParallelOptimizer,
+)

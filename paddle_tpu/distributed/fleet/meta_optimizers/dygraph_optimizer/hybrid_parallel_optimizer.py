@@ -1,0 +1,48 @@
+"""Hybrid-parallel optimizer wrapper.
+
+Reference: ``fleet/meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py``
+(``HybridParallelOptimizer:255``) — wraps the user optimizer so global-norm
+grad clip spans the mp/pp/sharding groups, and routes to the sharding
+optimizer when a sharding axis exists.
+
+TPU-native: gradients are global-view arrays, so a global-norm clip computed
+on them IS already reduced over every parallel group (GSPMD inserts the
+partial-norm psum). What remains is the dispatch: wrap with the ZeRO sharded
+optimizer when the topology has a sharding axis.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from paddle_tpu.distributed.fleet.meta_optimizers.dygraph_optimizer.dygraph_sharding_optimizer import (
+    DygraphShardingOptimizer,
+)
+
+__all__ = ["HybridParallelOptimizer"]
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer: Any, hcg: Any = None, strategy: Any = None) -> None:
+        self._hcg = hcg
+        self._strategy = strategy
+        self._sharding = False
+        if hcg is not None and hcg.get_sharding_parallel_world_size() > 1:
+            optimizer = DygraphShardingOptimizer(optimizer, hcg=hcg)
+            self._sharding = True
+        self._inner_opt = optimizer
+
+    def __getattr__(self, item: str) -> Any:
+        return getattr(self._inner_opt, item)
+
+    def step(self) -> None:
+        self._inner_opt.step()
+
+    def minimize(self, loss: Any, *args: Any, **kwargs: Any) -> None:
+        loss.backward()
+        self.step()
+
+    def clear_grad(self, set_to_zero: bool = False) -> None:
+        self._inner_opt.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad

@@ -29,7 +29,8 @@ __all__ = ["PipelineParallel", "PipelineParallelWithInterleave"]
 
 def _split_micro(data: Any, num: int) -> List[Any]:
     """Split a batch (tensor or tuple/list of tensors) into ``num``
-    microbatches along axis 0."""
+    microbatches along axis 0. None and python scalars are replicated;
+    array-likes must be Tensors so the split is explicit."""
     if isinstance(data, (tuple, list)):
         parts = [_split_micro(d, num) for d in data]
         return [type(data)(p[i] for p in parts) for i in range(num)]
@@ -39,7 +40,12 @@ def _split_micro(data: Any, num: int) -> List[Any]:
             raise ValueError(f"batch size {bs} not divisible by accumulate_steps {num}")
         mb = bs // num
         return [data[i * mb : (i + 1) * mb] for i in range(num)]
-    return [data] * num
+    if data is None or isinstance(data, (bool, int, float)):
+        return [data] * num
+    raise TypeError(
+        f"pipeline batch entries must be Tensors (or None/scalars), got {type(data)}; "
+        "wrap arrays with paddle.to_tensor"
+    )
 
 
 class PipelineParallel(Layer):
@@ -55,10 +61,17 @@ class PipelineParallel(Layer):
         self._strategy = strategy
         acc = 1
         if strategy is not None:
+            # accepted spellings: strategy.pipeline_configs['accumulate_steps']
+            # (this DistributedStrategy's declared field) and
+            # hybrid_configs['pp_configs'] (reference fleet spelling)
+            pipe_cfg = getattr(strategy, "pipeline_configs", None)
+            if isinstance(pipe_cfg, dict) and "accumulate_steps" in pipe_cfg:
+                acc = pipe_cfg["accumulate_steps"]
             pp_cfg = getattr(strategy, "hybrid_configs", {}).get("pp_configs", None)
-            acc = getattr(pp_cfg, "accumulate_steps", None) or (
-                pp_cfg.get("accumulate_steps", 1) if isinstance(pp_cfg, dict) else 1
-            )
+            if pp_cfg is not None:
+                acc = getattr(pp_cfg, "accumulate_steps", None) or (
+                    pp_cfg.get("accumulate_steps", acc) if isinstance(pp_cfg, dict) else acc
+                )
         self.accumulate_steps = int(acc)
         self.num_stages = layers.get_num_stages()
         self.stage_id = 0  # single-controller: every process sees all stages

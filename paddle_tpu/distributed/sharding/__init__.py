@@ -1,0 +1,11 @@
+"""Group-sharded (ZeRO) user API.
+
+Reference: ``python/paddle/distributed/sharding/group_sharded.py``
+(``group_sharded_parallel:50`` — levels 'os' / 'os_g' / 'p_g_os' mapping to
+GroupShardedStage{1,2,3}; ``save_group_sharded_model``).
+"""
+
+from paddle_tpu.distributed.sharding.group_sharded import (  # noqa: F401
+    group_sharded_parallel,
+    save_group_sharded_model,
+)

@@ -121,6 +121,10 @@ def _discover_state(objs: Sequence[Any]) -> _StateSpec:
 
     spec = _StateSpec()
     for obj in objs:
+        # unwrap optimizer wrappers (DygraphShardingOptimizer,
+        # HybridParallelOptimizer) down to the stateful inner Optimizer
+        while not isinstance(obj, Optimizer) and hasattr(obj, "_inner_opt"):
+            obj = obj._inner_opt
         if isinstance(obj, Optimizer):
             spec.add_optimizer(obj)
     for obj in objs:

@@ -158,6 +158,34 @@ class TestPipelineParallelSchedule:
         assert all(p.grad is None or np.allclose(p.grad.numpy(), 0) for p in pipe.parameters())
 
 
+class TestFleetPipelineIntegration:
+    def test_distributed_model_wraps_pipeline_layer(self):
+        import paddle_tpu.distributed.fleet as fleet
+
+        strat = fleet.DistributedStrategy()
+        strat.hybrid_configs = {"dp_degree": 1, "pp_degree": 2, "sharding_degree": 1, "mp_degree": 1}
+        strat.pipeline_configs = {"accumulate_steps": 2}
+        fleet.init(is_collective=True, strategy=strat)
+        pipe = PipelineLayer(
+            layers=[LayerDesc(nn.Linear, 4, 4) for _ in range(4)],
+            num_stages=2,
+            loss_fn=nn.MSELoss(),
+        )
+        wrapped = fleet.distributed_model(pipe)
+        assert isinstance(wrapped, PipelineParallel)
+        assert wrapped.accumulate_steps == 2
+        opt = paddle.optimizer.SGD(learning_rate=0.05, parameters=pipe.parameters())
+        loss = wrapped.train_batch((paddle.randn([4, 4]), paddle.randn([4, 4])), opt)
+        assert np.isfinite(float(loss))
+
+    def test_split_micro_rejects_raw_arrays(self):
+        from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel import _split_micro
+
+        with pytest.raises(TypeError):
+            _split_micro(np.zeros((8, 4), np.float32), 4)
+        assert _split_micro(None, 2) == [None, None]
+
+
 class TestSpmdPipeline:
     """The true TPU path: stacked stage weights over the pp mesh axis."""
 
