@@ -1,0 +1,461 @@
+"""Pallas TPU flash attention (forward + backward) with optional FlashMask
+column-sparse masking.
+
+Replaces the reference's CUDA flash-attention kernels
+(``paddle/phi/kernels/gpu/flash_attn_kernel.cu:353`` + patched
+``third_party/flashattn``) with a TPU kernel: online-softmax tiling over KV
+blocks held in VMEM, fp32 accumulation on the MXU, and a custom-VJP backward
+pair (dq kernel / dkv kernel) recomputing probabilities from the saved
+logsumexp — the standard flash-attention-2 decomposition.
+
+Layouts: public entry takes paddle's ``[B, S, H, D]``; kernels run
+``[B, H, S, D]``. Grouped-query attention is handled by BlockSpec index maps
+(kv head = q head // group), never materializing repeated KV.
+
+The FlashMask encoding (``startend_row_indices [B, Hm, Sk, C]``, C ∈ {1,2,4})
+is applied per KV block from an O(S) bounds tensor — mask memory stays linear
+in sequence length, the fork's marquee property.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30
+
+
+def _cdiv(a: int, b: int) -> int:
+    return (a + b - 1) // b
+
+
+def _pad_to(x: jax.Array, axis: int, mult: int) -> jax.Array:
+    size = x.shape[axis]
+    rem = size % mult
+    if rem == 0:
+        return x
+    pad = [(0, 0)] * x.ndim
+    pad[axis] = (0, mult - rem)
+    return jnp.pad(x, pad)
+
+
+def _mask_block(
+    rows: jax.Array,  # [blk_q, 1] global query positions
+    cols: jax.Array,  # [1, blk_k] global key positions
+    sq: int,
+    sk: int,
+    causal: bool,
+    bounds: Optional[jax.Array],  # [blk_k, C] startend_row_indices slice
+) -> jax.Array:
+    """True where the logit must be masked out."""
+    masked = cols >= sk  # padding columns
+    if causal:
+        masked = masked | (cols > rows + (sk - sq))
+    if bounds is not None:
+        c = bounds.shape[-1]
+        if c == 1:
+            masked = masked | (rows >= bounds[:, 0][None, :])
+        elif c == 2:
+            start = bounds[:, 0][None, :]
+            end = bounds[:, 1][None, :]
+            masked = masked | ((rows >= start) & (rows < end))
+        elif c == 4:
+            lts = bounds[:, 0][None, :]
+            lte = bounds[:, 1][None, :]
+            uts = bounds[:, 2][None, :]
+            ute = bounds[:, 3][None, :]
+            masked = masked | ((rows >= lts) & (rows < lte)) | ((rows >= uts) & (rows < ute))
+        else:
+            raise ValueError(f"FlashMask C must be 1/2/4, got {c}")
+    return masked
+
+
+# --------------------------------------------------------------------------
+# forward kernel
+# --------------------------------------------------------------------------
+
+
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, idx_ref, o_ref, lse_ref, *, sq, sk, scale, causal, blk_q, blk_k, num_kv_blocks
+):
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32) * scale  # [blk_q, D]
+    d = q.shape[-1]
+    rows = qi * blk_q + jax.lax.broadcasted_iota(jnp.int32, (blk_q, 1), 0)
+
+    if causal:
+        # only kv blocks touching or below the diagonal contribute
+        hi = jnp.minimum(((qi + 1) * blk_q + (sk - sq) + blk_k - 1) // blk_k, num_kv_blocks)
+        hi = jnp.maximum(hi, 0)
+    else:
+        hi = num_kv_blocks
+
+    def body(ki, carry):
+        acc, m, l = carry
+        k = k_ref[0, 0, pl.dslice(ki * blk_k, blk_k), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.dslice(ki * blk_k, blk_k), :].astype(jnp.float32)
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [blk_q, blk_k]
+        cols = ki * blk_k + jax.lax.broadcasted_iota(jnp.int32, (1, blk_k), 1)
+        bounds = None
+        if idx_ref is not None:
+            bounds = idx_ref[0, 0, pl.dslice(ki * blk_k, blk_k), :]
+        masked = _mask_block(rows, cols, sq, sk, causal, bounds)
+        logits = jnp.where(masked, NEG_INF, logits)
+        m_new = jnp.maximum(m, logits.max(axis=-1, keepdims=True))
+        p = jnp.exp(logits - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + p.sum(axis=-1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return acc, m_new, l
+
+    acc0 = jnp.zeros((blk_q, d), jnp.float32)
+    m0 = jnp.full((blk_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((blk_q, 1), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, hi, body, (acc0, m0, l0))
+    l = jnp.maximum(l, 1e-30)  # fully-masked rows: avoid 0/0
+    o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0, 0] = (m + jnp.log(l))[:, 0]
+
+
+def _run_fwd(q, k, v, idx, *, sq, sk, scale, causal, blk_q, blk_k, interpret):
+    b, h, sq_pad, d = q.shape
+    hk = k.shape[1]
+    sk_pad = k.shape[2]
+    group = h // hk
+    num_kv_blocks = sk_pad // blk_k
+    grid = (b, h, sq_pad // blk_q)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, blk_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+        pl.BlockSpec((1, 1, sk_pad, d), lambda bi, hi, qi: (bi, hi // group, 0, 0)),
+        pl.BlockSpec((1, 1, sk_pad, d), lambda bi, hi, qi: (bi, hi // group, 0, 0)),
+    ]
+    args = [q, k, v]
+    if idx is not None:
+        hm = idx.shape[1]
+        c = idx.shape[-1]
+        in_specs.append(
+            pl.BlockSpec(
+                (1, 1, sk_pad, c),
+                lambda bi, hi, qi: (bi, 0 if hm == 1 else hi, 0, 0),
+            )
+        )
+        args.append(idx)
+        kernel = functools.partial(
+            _fwd_kernel, sq=sq, sk=sk, scale=scale, causal=causal,
+            blk_q=blk_q, blk_k=blk_k, num_kv_blocks=num_kv_blocks,
+        )
+    else:
+        kernel = functools.partial(
+            lambda q_ref, k_ref, v_ref, o_ref, lse_ref, **kw: _fwd_kernel(
+                q_ref, k_ref, v_ref, None, o_ref, lse_ref, **kw
+            ),
+            sq=sq, sk=sk, scale=scale, causal=causal,
+            blk_q=blk_q, blk_k=blk_k, num_kv_blocks=num_kv_blocks,
+        )
+
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, 1, blk_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, blk_q), lambda bi, hi, qi: (bi, hi, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sq_pad, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq_pad), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*args)
+    return out, lse
+
+
+# --------------------------------------------------------------------------
+# backward kernels
+# --------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, idx_ref, g_ref, lse_ref, delta_ref, dq_ref,
+    *, sq, sk, scale, causal, blk_q, blk_k, num_kv_blocks
+):
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32)  # [blk_q, D]
+    g = g_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0][:, None]
+    delta = delta_ref[0, 0][:, None]
+    d = q.shape[-1]
+    rows = qi * blk_q + jax.lax.broadcasted_iota(jnp.int32, (blk_q, 1), 0)
+
+    if causal:
+        hi = jnp.minimum(((qi + 1) * blk_q + (sk - sq) + blk_k - 1) // blk_k, num_kv_blocks)
+        hi = jnp.maximum(hi, 0)
+    else:
+        hi = num_kv_blocks
+
+    def body(ki, dq):
+        k = k_ref[0, 0, pl.dslice(ki * blk_k, blk_k), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.dslice(ki * blk_k, blk_k), :].astype(jnp.float32)
+        logits = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        cols = ki * blk_k + jax.lax.broadcasted_iota(jnp.int32, (1, blk_k), 1)
+        bounds = None
+        if idx_ref is not None:
+            bounds = idx_ref[0, 0, pl.dslice(ki * blk_k, blk_k), :]
+        masked = _mask_block(rows, cols, sq, sk, causal, bounds)
+        p = jnp.where(masked, 0.0, jnp.exp(logits - lse))  # [blk_q, blk_k]
+        dp = jax.lax.dot_general(
+            g, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta) * scale
+        dq = dq + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return dq
+
+    dq = jax.lax.fori_loop(0, hi, body, jnp.zeros((blk_q, d), jnp.float32))
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, idx_ref, g_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    *, sq, sk, scale, causal, blk_q, blk_k, num_q_blocks, group
+):
+    ki = pl.program_id(2)
+    k = k_ref[0, 0].astype(jnp.float32)  # [blk_k, D]
+    v = v_ref[0, 0].astype(jnp.float32)
+    d = k.shape[-1]
+    cols = ki * blk_k + jax.lax.broadcasted_iota(jnp.int32, (1, blk_k), 1)
+    bounds = idx_ref[0, 0] if idx_ref is not None else None  # [blk_k, C]
+
+    if causal:
+        lo = jnp.maximum((ki * blk_k - (sk - sq)) // blk_q, 0)
+    else:
+        lo = 0
+
+    def body(qi, carry):
+        dk, dv = carry
+        q = q_ref[0, 0, pl.dslice(qi * blk_q, blk_q), :].astype(jnp.float32)
+        g = g_ref[0, 0, pl.dslice(qi * blk_q, blk_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.dslice(qi * blk_q, blk_q)][:, None]
+        delta = delta_ref[0, 0, pl.dslice(qi * blk_q, blk_q)][:, None]
+        rows = qi * blk_q + jax.lax.broadcasted_iota(jnp.int32, (blk_q, 1), 0)
+        logits = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [blk_q, blk_k]
+        masked = _mask_block(rows, cols, sq, sk, causal, bounds)
+        # padding rows (rows >= sq) contribute nothing: lse there is 0 and
+        # exp(0-0)=1, so mask them explicitly
+        masked = masked | (rows >= sq)
+        p = jnp.where(masked, 0.0, jnp.exp(logits - lse))
+        dv = dv + jax.lax.dot_general(
+            p, g, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [blk_k, D]
+        dp = jax.lax.dot_general(
+            g, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [blk_q, blk_k]
+        ds = p * (dp - delta) * scale
+        dk = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return dk, dv
+
+    dk0 = jnp.zeros((blk_k, d), jnp.float32)
+    dv0 = jnp.zeros((blk_k, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(lo, num_q_blocks, body, (dk0, dv0))
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+def _run_bwd(q, k, v, idx, g, out, lse, *, sq, sk, scale, causal, blk_q, blk_k, interpret):
+    b, h, sq_pad, d = q.shape
+    hk = k.shape[1]
+    sk_pad = k.shape[2]
+    group = h // hk
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)  # [B,H,Sq]
+
+    common = dict(sq=sq, sk=sk, scale=scale, causal=causal, blk_q=blk_q, blk_k=blk_k)
+
+    # dq: grid over q blocks
+    dq_specs = [
+        pl.BlockSpec((1, 1, blk_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),       # q
+        pl.BlockSpec((1, 1, sk_pad, d), lambda bi, hi, qi: (bi, hi // group, 0, 0)),  # k
+        pl.BlockSpec((1, 1, sk_pad, d), lambda bi, hi, qi: (bi, hi // group, 0, 0)),  # v
+    ]
+    dq_args = [q, k, v]
+    if idx is not None:
+        hm = idx.shape[1]
+        c = idx.shape[-1]
+        dq_specs.append(
+            pl.BlockSpec((1, 1, sk_pad, c), lambda bi, hi, qi: (bi, 0 if hm == 1 else hi, 0, 0))
+        )
+        dq_args.append(idx)
+        dq_kernel = functools.partial(_bwd_dq_kernel, **common, num_kv_blocks=sk_pad // blk_k)
+    else:
+        dq_kernel = functools.partial(
+            lambda q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref, **kw: _bwd_dq_kernel(
+                q_ref, k_ref, v_ref, None, g_ref, lse_ref, delta_ref, dq_ref, **kw
+            ),
+            **common,
+            num_kv_blocks=sk_pad // blk_k,
+        )
+    dq_specs += [
+        pl.BlockSpec((1, 1, blk_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),   # g
+        pl.BlockSpec((1, 1, blk_q), lambda bi, hi, qi: (bi, hi, qi)),         # lse
+        pl.BlockSpec((1, 1, blk_q), lambda bi, hi, qi: (bi, hi, qi)),         # delta
+    ]
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(b, h, sq_pad // blk_q),
+        in_specs=dq_specs,
+        out_specs=pl.BlockSpec((1, 1, blk_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, sq_pad, d), q.dtype),
+        interpret=interpret,
+    )(*dq_args, g, lse, delta)
+
+    # dk/dv: grid over kv blocks, one q-head at a time (GQA: accumulate
+    # outside over the group's q heads to avoid in-kernel atomics)
+    dkv_specs = [
+        pl.BlockSpec((1, 1, sq_pad, d), lambda bi, hi, ki: (bi, hi, 0, 0)),   # q
+        pl.BlockSpec((1, 1, blk_k, d), lambda bi, hi, ki: (bi, hi // group, ki, 0)),  # k
+        pl.BlockSpec((1, 1, blk_k, d), lambda bi, hi, ki: (bi, hi // group, ki, 0)),  # v
+    ]
+    dkv_args = [q, k, v]
+    if idx is not None:
+        hm = idx.shape[1]
+        c = idx.shape[-1]
+        dkv_specs.append(
+            pl.BlockSpec((1, 1, blk_k, c), lambda bi, hi, ki: (bi, 0 if hm == 1 else hi, ki, 0))
+        )
+        dkv_args.append(idx)
+        dkv_kernel = functools.partial(
+            _bwd_dkv_kernel, **common, num_q_blocks=sq_pad // blk_q, group=group
+        )
+    else:
+        dkv_kernel = functools.partial(
+            lambda q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dk_ref, dv_ref, **kw: _bwd_dkv_kernel(
+                q_ref, k_ref, v_ref, None, g_ref, lse_ref, delta_ref, dk_ref, dv_ref, **kw
+            ),
+            **common,
+            num_q_blocks=sq_pad // blk_q,
+            group=group,
+        )
+    dkv_specs += [
+        pl.BlockSpec((1, 1, sq_pad, d), lambda bi, hi, ki: (bi, hi, 0, 0)),   # g
+        pl.BlockSpec((1, 1, sq_pad), lambda bi, hi, ki: (bi, hi, 0)),         # lse
+        pl.BlockSpec((1, 1, sq_pad), lambda bi, hi, ki: (bi, hi, 0)),         # delta
+    ]
+    # per-q-head partial dk/dv, summed over the group afterwards
+    dk_h, dv_h = pl.pallas_call(
+        dkv_kernel,
+        grid=(b, h, sk_pad // blk_k),
+        in_specs=dkv_specs,
+        out_specs=[
+            pl.BlockSpec((1, 1, blk_k, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, blk_k, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sk_pad, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, sk_pad, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*dkv_args, g, lse, delta)
+    if group > 1:
+        dk = dk_h.reshape(b, hk, group, sk_pad, d).sum(axis=2)
+        dv = dv_h.reshape(b, hk, group, sk_pad, d).sum(axis=2)
+    else:
+        dk, dv = dk_h, dv_h
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+# --------------------------------------------------------------------------
+# public entry (custom VJP, paddle [B, S, H, D] layout)
+# --------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _make_flash_core(sq, sk, scale, causal, blk_q, blk_k, interpret):
+    """Build the custom-VJP core for one static configuration. All static
+    parameters live in this closure; the returned function takes only array
+    arguments (q, k, v [B,H,S,D] and the optional FlashMask bounds)."""
+
+    def fwd_res(q, k, v, idx):
+        qp = _pad_to(q, 2, blk_q)
+        kp = _pad_to(k, 2, blk_k)
+        vp = _pad_to(v, 2, blk_k)
+        idxp = _pad_to(idx, 2, blk_k) if idx is not None else None
+        out, lse = _run_fwd(
+            qp, kp, vp, idxp, sq=sq, sk=sk, scale=scale, causal=causal,
+            blk_q=blk_q, blk_k=blk_k, interpret=interpret,
+        )
+        return out, lse, (qp, kp, vp, idxp)
+
+    @jax.custom_vjp
+    def core(q, k, v, idx):
+        out, _, _ = fwd_res(q, k, v, idx)
+        return out[:, :, :sq]
+
+    def core_fwd(q, k, v, idx):
+        out, lse, (qp, kp, vp, idxp) = fwd_res(q, k, v, idx)
+        return out[:, :, :sq], (qp, kp, vp, idxp, out, lse)
+
+    def core_bwd(res, g):
+        import numpy as np
+
+        qp, kp, vp, idxp, outp, lse = res
+        gp = _pad_to(g, 2, blk_q)
+        dq, dk, dv = _run_bwd(
+            qp, kp, vp, idxp, gp, outp, lse,
+            sq=sq, sk=sk, scale=scale, causal=causal,
+            blk_q=blk_q, blk_k=blk_k, interpret=interpret,
+        )
+        didx = None
+        if idxp is not None:
+            # integer mask bounds carry no gradient (float0 cotangent)
+            didx = np.zeros(idxp.shape[:2] + (sk,) + idxp.shape[3:], jax.dtypes.float0)
+        return dq[:, :, :sq], dk[:, :, :sk], dv[:, :, :sk], didx
+
+    core.defvjp(core_fwd, core_bwd)
+    return core
+
+
+def flash_attention_pallas(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    startend_row_indices: Optional[jax.Array] = None,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: bool = False,
+) -> jax.Array:
+    """Flash attention over paddle layout ``[B, S, H, D]`` (optionally with a
+    FlashMask bounds tensor ``[B, Hm, Sk, C]``). Differentiable."""
+    sq, sk = q.shape[1], k.shape[1]
+    d = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / (d**0.5)
+    blk_q = min(block_q, max(_cdiv(sq, 8) * 8, 8))
+    blk_k = min(block_k, max(_cdiv(sk, 8) * 8, 8))
+    qh = jnp.moveaxis(q, 2, 1)  # [B, H, S, D]
+    kh = jnp.moveaxis(k, 2, 1)
+    vh = jnp.moveaxis(v, 2, 1)
+    core = _make_flash_core(
+        sq, sk, float(scale), bool(causal), blk_q, blk_k, bool(interpret)
+    )
+    out = core(qh, kh, vh, startend_row_indices)
+    return jnp.moveaxis(out, 1, 2)

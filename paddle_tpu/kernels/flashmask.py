@@ -1,0 +1,71 @@
+"""FlashMask attention Pallas kernel entry.
+
+The fork's marquee feature (reference ``paddle/phi/ops/yaml/ops.yaml:1909``
+``flashmask_attention``, kernel ``paddle/phi/kernels/gpu/
+flash_attn_kernel.cu:353-460``): attention with a column-sparse mask encoded
+as row bounds per key column (``startend_row_indices [B, Hm, Sk, C]``,
+C ∈ {1,2,4}) — O(S) mask memory instead of O(S²) for causal, sliding-window,
+document and global-token mask families.
+
+On TPU the encoding maps naturally onto the flash-attention KV-block loop:
+each KV block loads its ``[blk_k, C]`` bounds slice from VMEM and compares
+against the query-row iota — the dense [Sq, Sk] mask never exists. The
+reference's ``flashmask_maxmin`` block min/max precompute (used by the CUDA
+kernel to skip fully-masked blocks) corresponds here to the causal block-range
+bound already applied in the kernel loop; finer skipping is a scalar-prefetch
+optimization layered on the same kernel.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.kernels.flash_attention import flash_attention_pallas
+
+__all__ = ["flashmask_attention_pallas", "flashmask_maxmin"]
+
+
+def flashmask_attention_pallas(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    startend_row_indices: jax.Array,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """FlashMask attention over paddle layout ``[B, S, H, D]``.
+
+    ``startend_row_indices``: int32 ``[B, Hm, Sk, C]`` (Hm ∈ {1, H}):
+      - C == 1 (causal): query rows ``[start_j, Sq)`` masked for column j.
+      - C == 2 (causal): rows ``[start_j, end_j)`` masked.
+      - C == 4: ``[LTS, LTE, UTS, UTE]`` lower/upper-triangle row bands.
+    """
+    if startend_row_indices.dtype not in (jnp.int32, jnp.int64):
+        raise TypeError("startend_row_indices must be int32")
+    return flash_attention_pallas(
+        q,
+        k,
+        v,
+        startend_row_indices=startend_row_indices.astype(jnp.int32),
+        causal=causal,
+        scale=scale,
+        interpret=interpret,
+    )
+
+
+def flashmask_maxmin(startend_row_indices: jax.Array, block_size: int = 128):
+    """Per-KV-block min/max of the mask bounds (reference
+    ``flash_attn_kernel.cu:445`` ``flashmask_maxmin`` precompute). Returns
+    (min, max) arrays ``[B, Hm, num_blocks, C]`` — the block-skip metadata a
+    scalar-prefetch variant of the kernel consumes."""
+    b, hm, sk, c = startend_row_indices.shape
+    pad = (-sk) % block_size
+    idx = jnp.pad(
+        startend_row_indices, ((0, 0), (0, 0), (0, pad), (0, 0)), mode="edge"
+    )
+    blocks = idx.reshape(b, hm, -1, block_size, c)
+    return blocks.min(axis=3), blocks.max(axis=3)

@@ -1,0 +1,157 @@
+"""Fused elementwise Pallas kernels: rms_norm and rotary embedding.
+
+Reference CUDA kernels: ``paddle/phi/kernels/gpu/rms_norm_kernel``,
+``fused_rope_kernel.cu`` (``fused_ops.yaml:408``). XLA fuses these patterns
+reasonably; the Pallas versions exist to pin the fusion (one HBM round-trip)
+and as the base for bench-driven tuning. Both are differentiable: rms_norm
+via custom VJP (recompute-rstd backward), rope via its jax-level composition
+being linear in (x) and trig tables.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["fused_rms_norm_pallas", "fused_rope_pallas"]
+
+
+def _rms_fwd_kernel(x_ref, w_ref, y_ref, rstd_ref, *, eps):
+    x = x_ref[0].astype(jnp.float32)  # [blk_rows, H]
+    w = w_ref[...].astype(jnp.float32)  # [H]
+    ms = jnp.mean(x * x, axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(ms + eps)
+    y_ref[0] = (x * rstd * w[None, :]).astype(y_ref.dtype)
+    rstd_ref[0] = rstd[:, 0]
+
+
+def _rms_bwd_kernel(x_ref, w_ref, rstd_ref, g_ref, dx_ref, dwp_ref, *, eps):
+    x = x_ref[0].astype(jnp.float32)
+    w = w_ref[...].astype(jnp.float32)
+    g = g_ref[0].astype(jnp.float32)
+    rstd = rstd_ref[0][:, None]
+    h = x.shape[-1]
+    xhat = x * rstd
+    gw = g * w[None, :]
+    # dx = rstd * (gw - xhat * mean(gw * xhat))
+    dot = jnp.mean(gw * xhat, axis=-1, keepdims=True)
+    dx_ref[0] = (rstd * (gw - xhat * dot)).astype(dx_ref.dtype)
+    dwp_ref[0] = jnp.sum(g * xhat, axis=0)  # partial dw per row block
+
+
+@functools.lru_cache(maxsize=None)
+def _make_rms(rows, h, eps, blk_rows, interpret):
+    grid = (rows // blk_rows,)
+
+    def run_fwd(x, w):
+        return pl.pallas_call(
+            functools.partial(_rms_fwd_kernel, eps=eps),
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, blk_rows, h), lambda i: (0, i, 0)),
+                pl.BlockSpec((h,), lambda i: (0,)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, blk_rows, h), lambda i: (0, i, 0)),
+                pl.BlockSpec((1, blk_rows), lambda i: (0, i)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((1, rows, h), x.dtype),
+                jax.ShapeDtypeStruct((1, rows), jnp.float32),
+            ],
+            interpret=interpret,
+        )(x, w)
+
+    @jax.custom_vjp
+    def core(x, w):
+        y, _ = run_fwd(x, w)
+        return y
+
+    def core_fwd(x, w):
+        y, rstd = run_fwd(x, w)
+        return y, (x, w, rstd)
+
+    def core_bwd(res, g):
+        x, w, rstd = res
+        nblk = rows // blk_rows
+        dx, dw_part = pl.pallas_call(
+            functools.partial(_rms_bwd_kernel, eps=eps),
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, blk_rows, h), lambda i: (0, i, 0)),
+                pl.BlockSpec((h,), lambda i: (0,)),
+                pl.BlockSpec((1, blk_rows), lambda i: (0, i)),
+                pl.BlockSpec((1, blk_rows, h), lambda i: (0, i, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, blk_rows, h), lambda i: (0, i, 0)),
+                pl.BlockSpec((1, h), lambda i: (i, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((1, rows, h), x.dtype),
+                jax.ShapeDtypeStruct((nblk, h), jnp.float32),
+            ],
+            interpret=interpret,
+        )(x, w, rstd, g)
+        return dx, dw_part.sum(axis=0).astype(w.dtype)
+
+    core.defvjp(core_fwd, core_bwd)
+    return core
+
+
+def fused_rms_norm_pallas(
+    x: jax.Array, weight: jax.Array, epsilon: float = 1e-6, interpret: bool = False
+) -> jax.Array:
+    """RMSNorm over the last axis; any leading shape."""
+    h = x.shape[-1]
+    lead = x.shape[:-1]
+    rows = 1
+    for s in lead:
+        rows *= s
+    blk = 128
+    pad = (-rows) % blk
+    x2 = x.reshape(1, rows, h)
+    if pad:
+        x2 = jnp.pad(x2, ((0, 0), (0, pad), (0, 0)))
+    core = _make_rms(rows + pad, h, float(epsilon), blk, bool(interpret))
+    y = core(x2, weight)
+    return y[0, :rows].reshape(*lead, h)
+
+
+def _rope_kernel(x_ref, cos_ref, sin_ref, y_ref):
+    x = x_ref[0, 0].astype(jnp.float32)  # [S, D]
+    cos = cos_ref[0].astype(jnp.float32)  # [S, D]
+    sin = sin_ref[0].astype(jnp.float32)
+    d = x.shape[-1]
+    x1 = x[:, : d // 2]
+    x2 = x[:, d // 2 :]
+    rot = jnp.concatenate([-x2, x1], axis=-1)
+    y_ref[0, 0] = (x * cos + rot * sin).astype(y_ref.dtype)
+
+
+def fused_rope_pallas(
+    x: jax.Array, cos: jax.Array, sin: jax.Array, interpret: bool = False
+) -> jax.Array:
+    """Rotate-half rotary embedding. ``x`` [B, S, H, D]; cos/sin [S, D]."""
+    b, s, h, d = x.shape
+    xh = jnp.moveaxis(x, 2, 1).reshape(b * h, 1, s, d)  # grid over B*H
+    cos2 = cos.reshape(1, s, d)
+    sin2 = sin.reshape(1, s, d)
+    y = pl.pallas_call(
+        _rope_kernel,
+        grid=(b * h,),
+        in_specs=[
+            pl.BlockSpec((1, 1, s, d), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda i: (0, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda i: (0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, s, d), lambda i: (i, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, 1, s, d), x.dtype),
+        interpret=interpret,
+    )(xh, cos2, sin2)
+    return jnp.moveaxis(y.reshape(b, h, s, d), 1, 2)

@@ -1,0 +1,35 @@
+"""Kernel-path selection: one place deciding Pallas vs XLA-fallback.
+
+The applicability checks run BEFORE tracing so a shape the Mosaic compiler
+cannot lower never reaches jit (a lowering error inside a captured train step
+cannot be caught by the eager try/except)."""
+
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+import jax
+
+from paddle_tpu.flags import GLOBAL_FLAGS
+
+_logger = logging.getLogger("paddle_tpu.kernels")
+_warned: set = set()
+
+
+def pallas_enabled(flag: str) -> bool:
+    """Flag on AND running on a TPU backend."""
+    if not GLOBAL_FLAGS.get(flag):
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def warn_fallback(kernel: str, exc: Exception) -> None:
+    """One-time warning when a Pallas kernel fails and the XLA path is used —
+    silent permanent degradation is worse than one log line."""
+    if kernel not in _warned:
+        _warned.add(kernel)
+        _logger.warning("Pallas kernel %s failed (%s); using XLA fallback", kernel, exc)

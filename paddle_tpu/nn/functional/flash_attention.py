@@ -34,15 +34,9 @@ __all__ = [
 
 
 def _use_pallas(q) -> bool:
-    if not GLOBAL_FLAGS.get("use_pallas_attention"):
-        return False
-    try:
-        import jax
+    from paddle_tpu.kernels.select import pallas_enabled
 
-        platform = jax.default_backend()
-    except Exception:
-        return False
-    return platform in ("tpu",)
+    return pallas_enabled("use_pallas_attention")
 
 
 def _xla_attention(q, k, v, bias=None, causal=False, scale=None, window=None):
@@ -86,13 +80,15 @@ def _xla_attention(q, k, v, bias=None, causal=False, scale=None, window=None):
 
 @defop("flash_attention", tensor_method=None)
 def _flash_attention_op(q, k, v, dropout=0.0, causal=False, scale=None):
-    if _use_pallas(q):
+    if _use_pallas(q) and dropout == 0.0:
         try:
             from paddle_tpu.kernels.flash_attention import flash_attention_pallas
 
             return flash_attention_pallas(q, k, v, causal=causal, scale=scale)
-        except Exception:
-            pass
+        except Exception as exc:  # pragma: no cover - TPU-only path
+            from paddle_tpu.kernels.select import warn_fallback
+
+            warn_fallback("flash_attention", exc)
     return _xla_attention(q, k, v, causal=causal, scale=scale)
 
 
@@ -234,19 +230,18 @@ def flashmask_attention(
     if startend_row_indices is None:
         return flash_attention(query, key, value, dropout=dropout, causal=causal)[0]
 
-    if _use_pallas(query):
-        try:
-            from paddle_tpu.kernels.flashmask import flashmask_attention_pallas
-
-            return flashmask_attention_pallas(
-                query, key, value, startend_row_indices, causal=causal
-            )
-        except Exception:
-            pass
-
     from paddle_tpu.core.dispatch import call_op
 
     def _impl(q, k, v, idx):
+        if _use_pallas(q):
+            try:
+                from paddle_tpu.kernels.flashmask import flashmask_attention_pallas
+
+                return flashmask_attention_pallas(q, k, v, idx, causal=causal)
+            except Exception as exc:  # pragma: no cover - TPU-only path
+                from paddle_tpu.kernels.select import warn_fallback
+
+                warn_fallback("flashmask_attention", exc)
         bias = make_flashmask_bias(idx, q.shape[1], k.shape[1], causal)
         return _xla_attention(q, k, v, bias=bias, causal=causal)
 
