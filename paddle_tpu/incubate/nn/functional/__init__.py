@@ -86,6 +86,18 @@ def _fused_rope_op(q, k, v, sin, cos, use_neox_rotary_style=True):
     def rope(x):
         if x is None:
             return None
+        if use_neox_rotary_style and x.shape[-1] % 128 == 0:
+            from paddle_tpu.kernels.select import pallas_enabled, warn_fallback
+
+            if pallas_enabled("use_pallas_fused"):
+                try:
+                    from paddle_tpu.kernels.fused import fused_rope_pallas
+
+                    c2 = cos if cos.ndim == 2 else cos.reshape(cos.shape[1], cos.shape[-1])
+                    s2 = sin if sin.ndim == 2 else sin.reshape(sin.shape[1], sin.shape[-1])
+                    return fused_rope_pallas(x, c2, s2)
+                except Exception as exc:  # pragma: no cover - TPU-only path
+                    warn_fallback("fused_rope", exc)
         s = sin
         c = cos
         if s.ndim == 2:

@@ -5,6 +5,7 @@ from paddle_tpu.nn.functional.activation import *  # noqa: F401,F403
 from paddle_tpu.nn.functional.common import *  # noqa: F401,F403
 from paddle_tpu.nn.functional.conv import *  # noqa: F401,F403
 from paddle_tpu.nn.functional.loss import *  # noqa: F401,F403
+from paddle_tpu.nn.functional.ring_attention import ring_flash_attention  # noqa: F401
 from paddle_tpu.nn.functional.flash_attention import (  # noqa: F401
     flash_attention,
     flash_attn_unpadded,

@@ -36,10 +36,15 @@ __all__ = [
 def _use_pallas(q) -> bool:
     from paddle_tpu.kernels.select import pallas_enabled
 
+    # pre-trace applicability: Mosaic-lowerable head dim (64-lane aligned) —
+    # checked BEFORE tracing because a lowering failure inside a captured
+    # train step cannot fall back (see kernels/select.py)
+    if q.shape[-1] % 64 != 0:
+        return False
     return pallas_enabled("use_pallas_attention")
 
 
-def _xla_attention(q, k, v, bias=None, causal=False, scale=None, window=None):
+def _xla_attention(q, k, v, bias=None, causal=False, scale=None, window=None, dropout=0.0, dropout_key=None):
     """Reference attention in XLA ops. Layout: [B, S, H, D] (paddle flash
     attention layout). Computes in fp32 for softmax stability."""
     in_dtype = q.dtype
@@ -74,12 +79,15 @@ def _xla_attention(q, k, v, bias=None, causal=False, scale=None, window=None):
     if bias is not None:
         logits = logits + bias.astype(jnp.float32)
     probs = jax.nn.softmax(logits, axis=-1)
+    if dropout > 0.0 and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout), 0.0)
     out = jnp.einsum("bhst,bhtd->bhsd", probs, vh)
     return jnp.moveaxis(out, 1, 2).astype(in_dtype)
 
 
 @defop("flash_attention", tensor_method=None)
-def _flash_attention_op(q, k, v, dropout=0.0, causal=False, scale=None):
+def _flash_attention_op(q, k, v, key=None, dropout=0.0, causal=False, scale=None):
     if _use_pallas(q) and dropout == 0.0:
         try:
             from paddle_tpu.kernels.flash_attention import flash_attention_pallas
@@ -89,7 +97,7 @@ def _flash_attention_op(q, k, v, dropout=0.0, causal=False, scale=None):
             from paddle_tpu.kernels.select import warn_fallback
 
             warn_fallback("flash_attention", exc)
-    return _xla_attention(q, k, v, causal=causal, scale=scale)
+    return _xla_attention(q, k, v, causal=causal, scale=scale, dropout=dropout, dropout_key=key)
 
 
 def flash_attention(
@@ -109,7 +117,12 @@ def flash_attention(
     Layout [batch, seqlen, num_heads, head_dim]; returns (out, softmax) tuple
     like the reference (softmax is None unless return_softmax).
     """
-    out = _flash_attention_op(query, key, value, dropout=dropout, causal=causal)
+    import paddle_tpu.core.rng as _rng
+
+    drop_key = _rng.next_key() if (dropout > 0.0 and training) else None
+    out = _flash_attention_op(
+        query, key, value, drop_key, dropout=dropout if training else 0.0, causal=causal
+    )
     if return_softmax:
         return out, None
     return out, None
@@ -131,18 +144,25 @@ def scaled_dot_product_attention(
     True = keep, matching paddle semantics for bool masks).
     """
 
-    def _impl(q, k, v, mask):
+    import paddle_tpu.core.rng as _rng
+
+    drop_key = _rng.next_key() if (dropout_p > 0.0 and training) else None
+
+    def _impl(q, k, v, mask, dkey):
         bias = None
         if mask is not None:
             if mask.dtype == jnp.bool_:
                 bias = jnp.where(mask, 0.0, -1e30).astype(jnp.float32)
             else:
                 bias = mask
-        return _xla_attention(q, k, v, bias=bias, causal=is_causal)
+        return _xla_attention(
+            q, k, v, bias=bias, causal=is_causal,
+            dropout=dropout_p if training else 0.0, dropout_key=dkey,
+        )
 
     from paddle_tpu.core.dispatch import call_op
 
-    return call_op("scaled_dot_product_attention", _impl, query, key, value, attn_mask)
+    return call_op("scaled_dot_product_attention", _impl, query, key, value, attn_mask, drop_key)
 
 
 def flash_attn_unpadded(

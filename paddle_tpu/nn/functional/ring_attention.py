@@ -1,0 +1,39 @@
+"""Context-parallel (ring) attention — Tensor-level API.
+
+Beyond-reference feature (SURVEY §5.7 TPU translation): the reference's 'sep'
+axis leaves the attention exchange to model code; here ring attention is a
+first-class op. See ``paddle_tpu.kernels.ring_attention`` for the ring
+schedule itself.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+__all__ = ["ring_flash_attention"]
+
+
+def ring_flash_attention(
+    query: Any,
+    key: Any,
+    value: Any,
+    mesh: Any = None,
+    axis_name: str = "sep",
+    causal: bool = True,
+    scale: Optional[float] = None,
+    name: Optional[str] = None,
+) -> Any:
+    """Ring attention over ``[B, S, H, D]`` tensors with the sequence dim
+    sharded over ``axis_name`` of ``mesh`` (defaults to the global mesh)."""
+    from paddle_tpu.core.dispatch import call_op
+    from paddle_tpu.distributed.mesh import get_mesh
+    from paddle_tpu.kernels.ring_attention import ring_flash_attention as _ring
+
+    mesh = mesh or get_mesh()
+    if mesh is None:
+        raise ValueError("ring_flash_attention needs a mesh (dist.init_mesh/set_mesh)")
+
+    def _impl(q, k, v):
+        return _ring(q, k, v, mesh, axis_name=axis_name, causal=causal, scale=scale)
+
+    return call_op("ring_flash_attention", _impl, query, key, value)

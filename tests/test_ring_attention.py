@@ -1,0 +1,124 @@
+"""Ring attention (context parallelism) tests: parity with full attention,
+grads, causal + non-causal, GQA, Tensor-level API, jit.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.kernels.ring_attention import ring_flash_attention as ring_jax
+from paddle_tpu.nn.functional.flash_attention import _xla_attention
+
+
+def _qkv(b=2, s=64, h=4, hk=None, d=16, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    hk = hk or h
+    return (
+        jax.random.normal(ks[0], (b, s, h, d), jnp.float32),
+        jax.random.normal(ks[1], (b, s, hk, d), jnp.float32),
+        jax.random.normal(ks[2], (b, s, hk, d), jnp.float32),
+    )
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_full_attention(self, causal):
+        mesh = dist.ProcessMesh(shape=[4, 2], dim_names=["sep", "dp"])
+        q, k, v = _qkv()
+        out = ring_jax(q, k, v, mesh, axis_name="sep", causal=causal)
+        ref = _xla_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+    def test_gqa(self):
+        mesh = dist.ProcessMesh(shape=[4], dim_names=["sep"])
+        q, k, v = _qkv(h=8, hk=2)
+        out = ring_jax(q, k, v, mesh, axis_name="sep", causal=True)
+        ref = _xla_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+    def test_grads_match(self):
+        mesh = dist.ProcessMesh(shape=[4], dim_names=["sep"])
+        q, k, v = _qkv(b=1, s=32, h=2, d=8)
+
+        def f_ring(q, k, v):
+            return (ring_jax(q, k, v, mesh, axis_name="sep", causal=True) ** 2).sum()
+
+        def f_ref(q, k, v):
+            return (_xla_attention(q, k, v, causal=True) ** 2).sum()
+
+        gr = jax.grad(f_ring, argnums=(0, 1, 2))(q, k, v)
+        gf = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gr, gf):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-4)
+
+    def test_under_jit_with_sharded_inputs(self):
+        mesh = dist.ProcessMesh(shape=[8], dim_names=["sep"])
+        q, k, v = _qkv(s=128)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sh = NamedSharding(mesh.jax_mesh(), P(None, "sep", None, None))
+        qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
+        out = jax.jit(lambda a, b, c: ring_jax(a, b, c, mesh, axis_name="sep"))(qs, ks, vs)
+        ref = _xla_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+        # output stays sequence-sharded over the ring
+        assert len(out.sharding.device_set) == 8
+
+    def test_single_device_axis_fallback(self):
+        mesh = dist.ProcessMesh(shape=[1], dim_names=["sep"])
+        q, k, v = _qkv(s=16)
+        out = ring_jax(q, k, v, mesh, axis_name="sep", causal=True)
+        ref = _xla_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+    def test_indivisible_seq_raises(self):
+        mesh = dist.ProcessMesh(shape=[4], dim_names=["sep"])
+        q, k, v = _qkv(s=30)
+        with pytest.raises(ValueError):
+            ring_jax(q, k, v, mesh, axis_name="sep")
+
+
+class TestAttentionDropout:
+    def test_flash_attention_dropout_applied(self):
+        import paddle_tpu.nn.functional as F
+
+        paddle.seed(0)
+        q = paddle.randn([1, 16, 2, 8])
+        k = paddle.randn([1, 16, 2, 8])
+        v = paddle.randn([1, 16, 2, 8])
+        out_nodrop, _ = F.flash_attention(q, k, v, dropout=0.0, training=True)
+        out_drop, _ = F.flash_attention(q, k, v, dropout=0.5, training=True)
+        # dropout must change the output (was silently ignored before)
+        assert not np.allclose(out_nodrop.numpy(), out_drop.numpy())
+        out_eval, _ = F.flash_attention(q, k, v, dropout=0.5, training=False)
+        np.testing.assert_allclose(out_nodrop.numpy(), out_eval.numpy(), rtol=1e-6)
+
+    def test_sdpa_dropout_applied(self):
+        import paddle_tpu.nn.functional as F
+
+        paddle.seed(0)
+        q = paddle.randn([1, 16, 2, 8])
+        out1 = F.scaled_dot_product_attention(q, q, q, dropout_p=0.0)
+        out2 = F.scaled_dot_product_attention(q, q, q, dropout_p=0.5, training=True)
+        assert not np.allclose(out1.numpy(), out2.numpy())
+
+
+class TestRingAttentionTensorAPI:
+    def test_functional_fwd_bwd(self):
+        import paddle_tpu.nn.functional as F
+
+        mesh = dist.ProcessMesh(shape=[4], dim_names=["sep"])
+        dist.set_mesh(mesh)
+        paddle.seed(0)
+        q = paddle.randn([2, 32, 2, 8])
+        k = paddle.randn([2, 32, 2, 8])
+        v = paddle.randn([2, 32, 2, 8])
+        q.stop_gradient = False
+        out = F.ring_flash_attention(q, k, v, causal=True)
+        ref = _xla_attention(q._data, k._data, v._data, causal=True)
+        np.testing.assert_allclose(out.numpy(), np.asarray(ref), rtol=2e-5, atol=2e-5)
+        out.sum().backward()
+        assert q.grad is not None and np.isfinite(q.grad.numpy()).all()
