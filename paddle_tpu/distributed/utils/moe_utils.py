@@ -1,0 +1,66 @@
+"""MoE communication utils: global_scatter / global_gather.
+
+Reference: ``python/paddle/distributed/utils/moe_utils.py`` — thin wrappers
+over the ``global_scatter``/``global_gather`` collective ops
+(``paddle/fluid/operators/collective/global_scatter_op.cc``): tokens routed
+to per-(expert, rank) buckets via all-to-all with per-rank counts.
+
+TPU-native: inside a shard_map region these are ``lax.all_to_all`` over the
+expert-parallel axis on equal-sized capacity buckets (the GSPMD lowering of
+the MoE dispatch einsum). The functions below provide API parity for code
+ported from the reference; new code should use ``MoELayer``'s einsum
+formulation, which lets XLA fuse routing into the transfer.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from paddle_tpu.distributed.collective import Group, alltoall_single
+
+__all__ = ["global_scatter", "global_gather"]
+
+
+def _check_uniform(counts: Any, name: str) -> None:
+    """The TPU lowering runs fixed-capacity buckets; uneven per-rank counts
+    would silently land tokens in the wrong buckets — fail fast instead."""
+    if counts is None:
+        return
+    import numpy as np
+
+    vals = np.asarray(getattr(counts, "numpy", lambda: counts)())
+    if vals.size and not (vals == vals.flat[0]).all():
+        raise NotImplementedError(
+            f"{name} requires equal-sized (capacity-padded) buckets on TPU; "
+            f"got uneven counts {vals.tolist()}. Pad to capacity first or use "
+            "MoELayer's einsum dispatch."
+        )
+
+
+def global_scatter(
+    x: Any,
+    local_count: Any,
+    global_count: Any,
+    group: Optional[Group] = None,
+    use_calc_stream: bool = True,
+) -> Any:
+    """All-to-all token dispatch. With equal per-rank buckets this is one
+    ``alltoall_single``; uneven counts must be capacity-padded first (the
+    TPU formulation always runs fixed-capacity buckets)."""
+    _check_uniform(local_count, "global_scatter")
+    _check_uniform(global_count, "global_scatter")
+    return alltoall_single(None, x, group=group)
+
+
+def global_gather(
+    x: Any,
+    local_count: Any,
+    global_count: Any,
+    group: Optional[Group] = None,
+    use_calc_stream: bool = True,
+) -> Any:
+    """Inverse of :func:`global_scatter` (returns tokens to their source
+    ranks) — the same fixed-capacity all-to-all in reverse."""
+    _check_uniform(local_count, "global_gather")
+    _check_uniform(global_count, "global_gather")
+    return alltoall_single(None, x, group=group)

@@ -2,4 +2,5 @@
 functional surface. On TPU "fused" means the XLA/Pallas-fused composition —
 the API parity matters, the fusion is the compiler's job."""
 
+from paddle_tpu.incubate import distributed  # noqa: F401
 from paddle_tpu.incubate import nn  # noqa: F401

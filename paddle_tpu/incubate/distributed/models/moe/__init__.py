@@ -1,0 +1,17 @@
+"""Mixture-of-Experts with expert parallelism.
+
+Reference: ``python/paddle/incubate/distributed/models/moe/``
+(``moe_layer.py:263 MoELayer``, gates ``gate/{naive,gshard,switch}_gate.py``,
+expert-parallel all-to-all via ``global_scatter``/``global_gather`` ops).
+"""
+
+from paddle_tpu.incubate.distributed.models.moe.gate import (  # noqa: F401
+    BaseGate,
+    GShardGate,
+    NaiveGate,
+    SwitchGate,
+)
+from paddle_tpu.incubate.distributed.models.moe.moe_layer import (  # noqa: F401
+    Experts,
+    MoELayer,
+)

@@ -1,0 +1,181 @@
+"""MoE gates: naive (top-k, no aux loss), GShard (top-2 + load-balance loss +
+capacity), Switch (top-1 + load-balance loss + capacity).
+
+Reference: ``python/paddle/incubate/distributed/models/moe/gate/
+{naive,gshard,switch}_gate.py``. TPU-native: instead of producing per-rank
+index lists for ``global_scatter``, each gate produces dense one-hot
+**dispatch/combine tensors** (the GShard einsum formulation) — the layout
+GSPMD turns into the expert all-to-all when the expert axis is sharded.
+
+Shapes: input ``[T, M]`` tokens; outputs
+``combine_weights [T, E, C]`` (float), ``dispatch_mask [T, E, C]`` (bool),
+``aux_loss`` (scalar or None).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn.layer.common import Linear
+from paddle_tpu.nn.layer.layers import Layer
+
+__all__ = ["BaseGate", "NaiveGate", "GShardGate", "SwitchGate"]
+
+
+def _capacity(num_tokens: int, num_experts: int, capacity_factor: float, top_k: int) -> int:
+    # ceiling, not floor (GShard): at factor 1.0 a perfectly balanced router
+    # must not drop tokens
+    cap = -(-int(capacity_factor * top_k * num_tokens) // num_experts)
+    return max(cap, top_k)
+
+
+def _topk_dispatch(logits, top_k: int, capacity: int, jitter_key=None, renormalize: bool = True):
+    """Shared top-k → capacity-limited one-hot dispatch (raw jax arrays).
+
+    Returns (combine [T,E,C], dispatch [T,E,C] bool, gates [T,E], top1_mask
+    [T,E]) — the last two feed the load-balance aux loss."""
+    t, e = logits.shape
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)  # [T, E]
+    _, expert_idx = jax.lax.top_k(gates, top_k)  # [T, K]
+
+    combine = jnp.zeros((t, e, capacity), jnp.float32)
+    dispatch = jnp.zeros((t, e, capacity), bool)
+    # running per-expert fill count decides each token's slot, priority by
+    # token order (matches the reference's prune_gate_by_capacity semantics)
+    fill = jnp.zeros((e,), jnp.int32)
+    top1_mask = jax.nn.one_hot(expert_idx[:, 0], e, dtype=jnp.float32)
+
+    gate_vals = jnp.take_along_axis(gates, expert_idx, axis=1)  # [T, K]
+    if renormalize and top_k > 1:
+        gate_vals = gate_vals / jnp.maximum(gate_vals.sum(-1, keepdims=True), 1e-9)
+
+    for k in range(top_k):
+        sel = jax.nn.one_hot(expert_idx[:, k], e, dtype=jnp.int32)  # [T, E]
+        pos = fill[None, :] + jnp.cumsum(sel, axis=0) - sel  # slot if selected
+        within = (pos < capacity) & (sel > 0)
+        slot = jnp.clip(pos, 0, capacity - 1)
+        onehot_slot = jax.nn.one_hot(slot, capacity, dtype=jnp.float32)  # [T, E, C]
+        place = onehot_slot * within[..., None]
+        combine = combine + place * gate_vals[:, k, None, None]
+        dispatch = dispatch | (place > 0)
+        fill = fill + sel.sum(axis=0)
+    return combine, dispatch, gates, top1_mask
+
+
+class BaseGate(Layer):
+    """Gate base (reference ``gate/base_gate.py``): owns the routing linear."""
+
+    def __init__(self, d_model: int, num_expert: int, world_size: int = 1, top_k: int = 2) -> None:
+        super().__init__()
+        self.d_model = d_model
+        self.num_expert = num_expert * world_size  # total experts
+        self.top_k = top_k
+        self.wg = Linear(d_model, self.num_expert, bias_attr=False)
+        self._loss: Optional[Any] = None
+
+    def set_loss(self, loss: Any) -> None:
+        self._loss = loss
+
+    def get_loss(self, clear: bool = True) -> Optional[Any]:
+        loss = self._loss
+        if clear:
+            self._loss = None
+        return loss
+
+    def _dispatch(self, x: Any, capacity_factor: float, aux: str, jitter_eps: float = 0.0):
+        from paddle_tpu.core.dispatch import call_op
+
+        logits = self.wg(x)  # [T, E]
+        if jitter_eps > 0.0 and self.training:
+            # reference switch_gate.py: multiplicative uniform(1±eps) routing
+            # noise during training breaks early expert-collapse symmetry
+            import paddle_tpu.core.rng as _rng
+
+            jkey = _rng.next_key()
+            logits = call_op(
+                "moe_gate_jitter",
+                lambda lg, kk: lg
+                * jax.random.uniform(
+                    kk, lg.shape, jnp.float32, 1.0 - jitter_eps, 1.0 + jitter_eps
+                ),
+                logits,
+                jkey,
+            )
+        t = x.shape[0]
+        cap = _capacity(t, self.num_expert, capacity_factor, self.top_k)
+        top_k = self.top_k
+
+        def _impl(lg):
+            combine, dispatch, gates, top1 = _topk_dispatch(lg, top_k, cap)
+            if aux == "none":
+                loss = jnp.zeros((), jnp.float32)
+            else:
+                # load-balance loss: E * Σ_e mean-prob_e * mean-top1-frac_e
+                me = gates.mean(axis=0)
+                ce = top1.mean(axis=0)
+                loss = (me * ce).sum() * float(gates.shape[1])
+            return combine, dispatch.astype(jnp.float32), loss
+
+        combine, dispatch, loss = call_op("moe_gate", _impl, logits)
+        self.set_loss(loss)
+        return combine, dispatch, cap
+
+
+class NaiveGate(BaseGate):
+    """Top-k gate without load balancing (reference ``naive_gate.py``)."""
+
+    def __init__(self, d_model: int, num_expert: int, world_size: int = 1, top_k: int = 2) -> None:
+        super().__init__(d_model, num_expert, world_size, top_k)
+
+    def forward(self, x: Any, capacity_factor: float = 1.0):
+        return self._dispatch(x, capacity_factor, aux="none")
+
+
+class GShardGate(BaseGate):
+    """Top-2 gate with load-balance aux loss + capacity
+    (reference ``gshard_gate.py``)."""
+
+    def __init__(
+        self,
+        d_model: int,
+        num_expert: int,
+        world_size: int = 1,
+        top_k: int = 2,
+        capacity: Tuple[float, float] = (1.2, 2.4),
+        group: Any = None,
+    ) -> None:
+        super().__init__(d_model, num_expert, world_size, top_k=top_k)
+        self.capacity_factor_train, self.capacity_factor_eval = capacity
+
+    def forward(self, x: Any, capacity_factor: Optional[float] = None):
+        default = self.capacity_factor_train if self.training else self.capacity_factor_eval
+        return self._dispatch(x, capacity_factor or default, aux="load_balance")
+
+
+class SwitchGate(BaseGate):
+    """Top-1 switch-transformer gate (reference ``switch_gate.py``)."""
+
+    def __init__(
+        self,
+        d_model: int,
+        num_expert: int,
+        world_size: int = 1,
+        top_k: int = 1,
+        switch_eps: float = 0.1,
+        capacity: Tuple[float, float] = (1.2, 2.4),
+        group: Any = None,
+    ) -> None:
+        super().__init__(d_model, num_expert, world_size, top_k=1)
+        self.switch_eps = switch_eps
+        self.capacity_factor_train, self.capacity_factor_eval = capacity
+
+    def forward(self, x: Any, capacity_factor: Optional[float] = None):
+        default = self.capacity_factor_train if self.training else self.capacity_factor_eval
+        return self._dispatch(
+            x, capacity_factor or default, aux="load_balance", jitter_eps=self.switch_eps
+        )

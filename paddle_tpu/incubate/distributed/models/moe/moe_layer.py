@@ -1,0 +1,199 @@
+"""MoELayer: expert-parallel mixture of experts.
+
+Reference: ``python/paddle/incubate/distributed/models/moe/moe_layer.py:263``
+— its flow is gate → per-rank index build → ``global_scatter`` all-to-all →
+local experts → ``global_gather``. TPU-native flow (GShard einsum form):
+
+    dispatch:  [T,E,C] one-hot × [T,M] tokens  → [E,C,M]
+    experts:   batched over the (sharded) E axis → [E,C,M]
+    combine:   [T,E,C] weights × [E,C,M]        → [T,M]
+
+When the expert axis is sharded over an 'ep' mesh dimension, XLA lowers the
+dispatch/combine einsums to exactly the all-to-all the reference hand-codes —
+and fuses the capacity masking into them. Experts with stacked parameters
+(``Experts``) ride the same sharding; a python list of per-expert Layers is
+also accepted (compat path, runs experts sequentially).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.incubate.distributed.models.moe.gate import (
+    BaseGate,
+    GShardGate,
+    NaiveGate,
+    SwitchGate,
+)
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn.layer.layers import Layer
+
+__all__ = ["MoELayer", "Experts"]
+
+
+class Experts(Layer):
+    """E experts with stacked FFN parameters ``[E, ...]`` — batched expert
+    compute on the MXU; the E axis carries the 'ep' sharding."""
+
+    def __init__(
+        self,
+        num_experts: int,
+        d_model: int,
+        d_hidden: int,
+        activation: str = "gelu",
+    ) -> None:
+        super().__init__()
+        self.num_experts = num_experts
+        self.d_model = d_model
+        self.d_hidden = d_hidden
+        self.activation = activation
+        self.w1 = self.create_parameter([num_experts, d_model, d_hidden])
+        self.b1 = self.create_parameter([num_experts, 1, d_hidden], is_bias=True)
+        self.w2 = self.create_parameter([num_experts, d_hidden, d_model])
+        self.b2 = self.create_parameter([num_experts, 1, d_model], is_bias=True)
+
+    def shard_over(self, mesh: Any, axis: str = "ep") -> None:
+        """Place the expert dim over the mesh's ep axis (Shard(0))."""
+        from paddle_tpu.distributed.api import shard_layer, shard_tensor
+        from paddle_tpu.distributed.placements import Replicate, Shard
+
+        plc = [Shard(0) if n == axis else Replicate() for n in mesh.dim_names]
+
+        def shard_fn(name: str, sublayer: Any, m: Any) -> None:
+            for p in sublayer._parameters.values():
+                if p is None:
+                    continue
+                d = shard_tensor(p, m, plc)
+                p._data = d._data
+                p.process_mesh = m
+                p.placements = plc
+
+        shard_layer(self, mesh, shard_fn)
+
+    def forward(self, dispatched: Any) -> Any:  # [E, C, M]
+        h = paddle_matmul(dispatched, self.w1) + self.b1
+        h = F.gelu(h) if self.activation == "gelu" else F.relu(h)
+        return paddle_matmul(h, self.w2) + self.b2
+
+
+def paddle_matmul(a: Any, b: Any) -> Any:
+    import paddle_tpu
+
+    return paddle_tpu.matmul(a, b)
+
+
+class MoELayer(Layer):
+    """Reference-parity constructor: ``MoELayer(d_model, experts, gate=...,
+    moe_group=..., recompute_interval=...)``; ``gate`` may be a config dict
+    (``{"type": "gshard", "top_k": 2}``), a gate name, or a BaseGate."""
+
+    def __init__(
+        self,
+        d_model: int,
+        experts: Union[Experts, Sequence[Layer], None] = None,
+        gate: Union[BaseGate, dict, str, None] = None,
+        moe_group: Any = None,
+        mp_group: Any = None,
+        recompute_interval: int = 0,
+        recompute_ctx: Any = None,
+        num_experts: Optional[int] = None,
+        top_k: int = 2,
+        capacity_factor: float = 1.2,
+        ep_axis: str = "ep",
+    ) -> None:
+        super().__init__()
+        self.d_model = d_model
+        if experts is None:
+            raise ValueError("MoELayer needs experts (an Experts module or list of Layers)")
+        if isinstance(experts, Experts):
+            self.experts = experts
+            self.num_experts = experts.num_experts
+        else:
+            self.experts_list = list(experts)
+            for i, ex in enumerate(self.experts_list):
+                self.add_sublayer(f"expert_{i}", ex)
+            self.experts = None
+            self.num_experts = len(self.experts_list)
+        if num_experts is not None and num_experts != self.num_experts:
+            raise ValueError(f"num_experts={num_experts} != len(experts)={self.num_experts}")
+
+        if isinstance(gate, BaseGate):
+            self.gate = gate
+        else:
+            if isinstance(gate, dict):
+                gtype = gate.get("type", "gshard")
+                top_k = gate.get("top_k", top_k)
+            else:
+                gtype = gate or "gshard"
+            cls = {"gshard": GShardGate, "switch": SwitchGate, "naive": NaiveGate}[gtype]
+            self.gate = cls(d_model, self.num_experts, top_k=top_k)
+        self.top_k = self.gate.top_k
+        self.capacity_factor = capacity_factor
+        self.recompute_interval = recompute_interval
+        self._ep_axis = ep_axis
+        self._mesh = None
+        self._moe_group_mesh = moe_group if hasattr(moe_group, "dim_names") else None
+        self._resolve_mesh()
+
+    def _resolve_mesh(self) -> None:
+        """Bind the EP mesh — at construction if one is already set, else
+        lazily on first forward (supports build-then-set_mesh ordering and an
+        explicit moe_group=ProcessMesh)."""
+        if self._mesh is not None:
+            return
+        from paddle_tpu.distributed.mesh import get_mesh
+
+        mesh = self._moe_group_mesh or get_mesh()
+        if mesh is not None and self._ep_axis in mesh.dim_names and mesh.get_dim_size(self._ep_axis) > 1:
+            self._mesh = mesh
+            if isinstance(self.experts, Experts):
+                self.experts.shard_over(mesh, self._ep_axis)
+
+    # aux loss for the trainer (reference: gate.get_loss aggregated by caller)
+    def get_aux_loss(self, clear: bool = True) -> Optional[Tensor]:
+        return self.gate.get_loss(clear)
+
+    def _constrain_ep(self, t: Tensor) -> Tensor:
+        """Shard the leading expert dim over ep — the all-to-all point."""
+        if self._mesh is None:
+            return t
+        from paddle_tpu.distributed.api import shard_tensor
+        from paddle_tpu.distributed.placements import Replicate, Shard
+
+        plc = [Shard(0) if n == self._ep_axis else Replicate() for n in self._mesh.dim_names]
+        return shard_tensor(t, self._mesh, plc, stop_gradient=t.stop_gradient)
+
+    def _run_experts(self, dispatched: Any) -> Any:
+        import paddle_tpu
+
+        if self.experts is not None:
+            return self.experts(dispatched)
+        outs = [ex(dispatched[e]) for e, ex in enumerate(self.experts_list)]
+        return paddle_tpu.stack(outs, axis=0)
+
+    def forward(self, x: Any) -> Any:
+        self._resolve_mesh()
+        orig_shape = list(x.shape)
+        m = orig_shape[-1]
+        xt = x.reshape([-1, m])  # [T, M]
+        combine, dispatch, cap = self.gate(xt, self.capacity_factor)
+
+        import paddle_tpu
+
+        # dispatch: [T,E,C] × [T,M] → [E,C,M]
+        dispatched = paddle_tpu.einsum("tec,tm->ecm", dispatch.astype(xt.dtype), xt)
+        dispatched = self._constrain_ep(dispatched)
+        if self.recompute_interval > 0:
+            from paddle_tpu.distributed.fleet.recompute import recompute
+
+            expert_out = recompute(self._run_experts, dispatched)
+        else:
+            expert_out = self._run_experts(dispatched)
+        expert_out = self._constrain_ep(expert_out)
+        # combine: [T,E,C] × [E,C,M] → [T,M]
+        out = paddle_tpu.einsum("tec,ecm->tm", combine.astype(xt.dtype), expert_out)
+        return out.reshape(orig_shape)

@@ -37,3 +37,7 @@ def _seed():
     paddle_tpu.seed(2024)
     np.random.seed(2024)
     yield
+    # isolate global mesh state between tests (set_mesh leaks otherwise)
+    import paddle_tpu.distributed.mesh as _mesh
+
+    _mesh._global_mesh = None
