@@ -4,8 +4,10 @@ SPMD-first: a mesh + placements API backed by GSPMD, shard_map parallel
 regions for explicit collectives, and fleet-style hybrid-parallel wrappers.
 """
 
+from paddle_tpu.distributed import checkpoint  # noqa: F401
 from paddle_tpu.distributed import fleet  # noqa: F401
 from paddle_tpu.distributed import sharding  # noqa: F401
+from paddle_tpu.distributed import utils  # noqa: F401
 from paddle_tpu.distributed.api import (  # noqa: F401
     dtensor_from_local,
     dtensor_to_local,

@@ -1,0 +1,13 @@
+"""Distributed checkpoint: sharded save + cross-mesh reshard-on-load.
+
+Reference: ``python/paddle/distributed/checkpoint/`` —
+``save_state_dict.py:145``, ``load_state_dict.py:467``, ``metadata.py``.
+"""
+
+from paddle_tpu.distributed.checkpoint.load_state_dict import load_state_dict  # noqa: F401
+from paddle_tpu.distributed.checkpoint.metadata import (  # noqa: F401
+    LocalTensorIndex,
+    LocalTensorMetadata,
+    Metadata,
+)
+from paddle_tpu.distributed.checkpoint.save_state_dict import save_state_dict  # noqa: F401

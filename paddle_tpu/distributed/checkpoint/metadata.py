@@ -1,0 +1,40 @@
+"""Checkpoint metadata: global shape ↔ local shard mapping.
+
+Reference: ``python/paddle/distributed/checkpoint/metadata.py`` —
+``LocalTensorMetadata`` (offsets + lengths of one shard in the global
+tensor), ``LocalTensorIndex`` (which file holds it), ``Metadata`` (the global
+manifest written once by the coordinator).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class LocalTensorMetadata:
+    """One shard's placement within its global tensor."""
+
+    global_offset: Tuple[int, ...]
+    local_shape: Tuple[int, ...]
+    dtype: str
+
+
+@dataclass(frozen=True)
+class LocalTensorIndex:
+    """Identity of one shard: (tensor name, its global offset)."""
+
+    tensor_key: str
+    global_offset: Tuple[int, ...]
+
+
+@dataclass
+class Metadata:
+    """The manifest: every tensor's global shape/dtype, every shard's
+    location, and which data file stores each shard."""
+
+    state_dict_metadata: Dict[str, List[LocalTensorMetadata]] = field(default_factory=dict)
+    storage_metadata: Dict[LocalTensorIndex, str] = field(default_factory=dict)
+    global_shapes: Dict[str, Tuple[int, ...]] = field(default_factory=dict)
+    flat_mapping: Dict[str, str] = field(default_factory=dict)

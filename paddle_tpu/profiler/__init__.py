@@ -24,7 +24,10 @@ __all__ = [
     "make_scheduler",
     "export_chrome_tracing",
     "load_profiler_result",
+    "benchmark",
 ]
+
+from paddle_tpu.profiler.timer import benchmark  # noqa: E402,F401
 
 
 class ProfilerState(Enum):

@@ -1,0 +1,90 @@
+"""Throughput benchmark timer.
+
+Reference: ``python/paddle/profiler/timer.py`` — ``benchmark()`` singleton
+driven by hooks (``begin``/``step``/``end``) reporting reader cost, batch
+cost, ips (items per second) with warmup-step exclusion.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+__all__ = ["benchmark", "Benchmark"]
+
+
+class _Stat:
+    def __init__(self) -> None:
+        self.total = 0.0
+        self.count = 0
+        self.window = []
+
+    def add(self, v: float) -> None:
+        self.total += v
+        self.count += 1
+        self.window.append(v)
+        if len(self.window) > 100:
+            self.window.pop(0)
+
+    @property
+    def avg(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+    @property
+    def smoothed(self) -> float:
+        return sum(self.window) / len(self.window) if self.window else 0.0
+
+
+class Benchmark:
+    def __init__(self) -> None:
+        self.reset()
+
+    def reset(self) -> None:
+        self._last_step_t: Optional[float] = None
+        self._last_reader_t: Optional[float] = None
+        self.batch_cost = _Stat()
+        self.reader_cost = _Stat()
+        self.ips = _Stat()
+        self._num_samples: Optional[int] = None
+        self._warmup = 10
+        self._steps = 0
+
+    def begin(self) -> None:
+        self.reset()
+        self._last_step_t = time.perf_counter()
+
+    def before_reader(self) -> None:
+        self._last_reader_t = time.perf_counter()
+
+    def after_reader(self) -> None:
+        if self._last_reader_t is not None and self._steps >= self._warmup:
+            self.reader_cost.add(time.perf_counter() - self._last_reader_t)
+
+    def step(self, num_samples: Optional[int] = None) -> None:
+        now = time.perf_counter()
+        self._steps += 1
+        if self._last_step_t is not None and self._steps > self._warmup:
+            dt = now - self._last_step_t
+            self.batch_cost.add(dt)
+            if num_samples:
+                self.ips.add(num_samples / dt)
+        self._last_step_t = now
+
+    def end(self) -> Dict[str, float]:
+        return self.step_info()
+
+    def step_info(self, unit: str = "samples") -> Dict[str, float]:
+        return {
+            "reader_cost": self.reader_cost.smoothed,
+            "batch_cost": self.batch_cost.smoothed,
+            "ips": self.ips.smoothed,
+            "steps": self._steps,
+        }
+
+
+_benchmark = Benchmark()
+
+
+def benchmark() -> Benchmark:
+    """The global throughput meter (reference ``timer.py benchmark()``)."""
+    return _benchmark

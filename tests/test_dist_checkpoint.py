@@ -1,0 +1,105 @@
+"""Distributed checkpoint tests: sharded save, reshard-on-load across
+different meshes, optimizer state round-trip, plain numpy entries.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed.checkpoint import load_state_dict, save_state_dict
+from paddle_tpu.distributed.placements import Replicate, Shard
+
+
+def _sharded_tensor(shape, mesh, placements, seed=0):
+    paddle.seed(seed)
+    t = paddle.randn(shape)
+    return dist.shard_tensor(t, mesh, placements)
+
+
+class TestSaveLoadRoundTrip:
+    def test_replicated_round_trip(self, tmp_path):
+        mesh = dist.ProcessMesh(shape=[8], dim_names=["dp"])
+        dist.set_mesh(mesh)
+        t = _sharded_tensor([16, 8], mesh, [Replicate()])
+        save_state_dict({"w": t}, str(tmp_path))
+        target = dist.shard_tensor(paddle.zeros([16, 8]), mesh, [Replicate()])
+        load_state_dict({"w": target}, str(tmp_path))
+        np.testing.assert_allclose(target.numpy(), t.numpy())
+
+    def test_sharded_round_trip(self, tmp_path):
+        mesh = dist.ProcessMesh(shape=[4, 2], dim_names=["mp", "dp"])
+        dist.set_mesh(mesh)
+        t = _sharded_tensor([16, 8], mesh, [Shard(0), Replicate()], seed=1)
+        save_state_dict({"w": t}, str(tmp_path))
+        target = dist.shard_tensor(paddle.zeros([16, 8]), mesh, [Shard(0), Replicate()])
+        load_state_dict({"w": target}, str(tmp_path))
+        np.testing.assert_allclose(target.numpy(), t.numpy())
+
+    def test_reshard_on_load_cross_mesh(self, tmp_path):
+        # save sharded over mp=4 on dim 0, load sharded over mp=2 on dim 1
+        mesh_a = dist.ProcessMesh(shape=[4, 2], dim_names=["mp", "dp"])
+        t = _sharded_tensor([16, 8], mesh_a, [Shard(0), Replicate()], seed=2)
+        save_state_dict({"w": t}, str(tmp_path))
+
+        mesh_b = dist.ProcessMesh(shape=[2, 4], dim_names=["mp", "dp"])
+        target = dist.shard_tensor(paddle.zeros([16, 8]), mesh_b, [Shard(1), Replicate()])
+        load_state_dict({"w": target}, str(tmp_path))
+        np.testing.assert_allclose(target.numpy(), t.numpy())
+        # target keeps ITS sharding (dim 1 over 2 devices)
+        shard_shape = target._data.addressable_shards[0].data.shape
+        assert shard_shape == (16, 4)
+
+    def test_model_and_optimizer_state(self, tmp_path):
+        mesh = dist.ProcessMesh(shape=[4], dim_names=["sharding"])
+        dist.set_mesh(mesh)
+        paddle.seed(3)
+        m = nn.Sequential(nn.Linear(8, 16), nn.GELU(), nn.Linear(16, 8))
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2, parameters=m.parameters())
+        x = paddle.randn([4, 8])
+        (m(x) ** 2).mean().backward()
+        opt.step()
+        opt.clear_grad()
+
+        sd = {**m.state_dict(), **{f"opt.{k}": v for k, v in opt.state_dict().items() if hasattr(v, "_data")}}
+        save_state_dict(sd, str(tmp_path))
+
+        paddle.seed(99)
+        m2 = nn.Sequential(nn.Linear(8, 16), nn.GELU(), nn.Linear(16, 8))
+        sd2 = m2.state_dict()
+        load_state_dict(sd2, str(tmp_path))
+        for (k1, v1), (k2, v2) in zip(sorted(m.state_dict().items()), sorted(sd2.items())):
+            np.testing.assert_allclose(v1.numpy(), v2.numpy(), err_msg=k1)
+
+    def test_plain_numpy_entries(self, tmp_path):
+        arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+        save_state_dict({"a": arr}, str(tmp_path))
+        out = {"a": None}
+        load_state_dict(out, str(tmp_path))
+        np.testing.assert_array_equal(out["a"], arr)
+
+    def test_scalar_round_trip(self, tmp_path):
+        save_state_dict({"step": np.float32(7.5), "m": np.zeros((2, 2), np.float32)}, str(tmp_path))
+        out = {"step": None}
+        load_state_dict(out, str(tmp_path))
+        assert float(out["step"]) == 7.5
+
+    def test_resave_fewer_ranks_no_stale_mix(self, tmp_path):
+        # first save leaves files; a second save into the same dir must not
+        # mix with them
+        save_state_dict({"a": np.ones((4, 4), np.float32)}, str(tmp_path))
+        save_state_dict({"a": np.full((4, 4), 2.0, np.float32)}, str(tmp_path))
+        out = {"a": None}
+        load_state_dict(out, str(tmp_path))
+        np.testing.assert_array_equal(out["a"], np.full((4, 4), 2.0, np.float32))
+
+    def test_missing_tensor_raises(self, tmp_path):
+        save_state_dict({"a": np.zeros(3, np.float32)}, str(tmp_path))
+        with pytest.raises(KeyError):
+            load_state_dict({"b": paddle.zeros([3])}, str(tmp_path))
+
+    def test_shape_mismatch_raises(self, tmp_path):
+        save_state_dict({"a": np.zeros((3, 3), np.float32)}, str(tmp_path))
+        with pytest.raises(ValueError):
+            load_state_dict({"a": paddle.zeros([4, 4])}, str(tmp_path))

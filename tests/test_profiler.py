@@ -1,0 +1,79 @@
+"""Profiler tests: RecordEvent spans, scheduler state machine, chrome export,
+summary, throughput timer."""
+
+import json
+import time
+
+import paddle_tpu.profiler as profiler
+from paddle_tpu.profiler import (
+    Profiler,
+    ProfilerState,
+    RecordEvent,
+    benchmark,
+    make_scheduler,
+)
+
+
+class TestScheduler:
+    def test_windows(self):
+        sched = make_scheduler(closed=1, ready=1, record=2, repeat=1, skip_first=1)
+        states = [sched(i) for i in range(6)]
+        assert states[0] == ProfilerState.CLOSED  # skip_first
+        assert states[1] == ProfilerState.CLOSED
+        assert states[2] == ProfilerState.READY
+        assert states[3] == ProfilerState.RECORD
+        assert states[4] == ProfilerState.RECORD_AND_RETURN
+        assert states[5] == ProfilerState.CLOSED  # repeat exhausted
+
+
+class TestProfiler:
+    def test_record_and_export(self, tmp_path):
+        p = Profiler()
+        p.start()
+        with RecordEvent("forward"):
+            time.sleep(0.002)
+        with RecordEvent("backward"):
+            time.sleep(0.001)
+        p.step()
+        p.stop()
+        out = str(tmp_path / "trace.json")
+        p.export(out)
+        data = json.load(open(out))
+        names = [e["name"] for e in data["traceEvents"]]
+        assert "forward" in names and "backward" in names
+
+    def test_summary_aggregates(self):
+        p = Profiler()
+        p.start()
+        for _ in range(3):
+            with RecordEvent("op_x"):
+                pass
+        p.stop()
+        s = p.summary()
+        assert "op_x" in s and " 3 " in s
+
+    def test_events_outside_record_not_collected(self):
+        p = Profiler(scheduler=make_scheduler(closed=1, ready=0, record=1, repeat=1))
+        p.start()  # step 0: CLOSED
+        with RecordEvent("hidden"):
+            pass
+        p.step()  # step 1 → RECORD
+        with RecordEvent("visible"):
+            pass
+        p.stop()
+        names = [e["name"] for e in p._events]
+        assert "visible" in names and "hidden" not in names
+
+
+class TestBenchmarkTimer:
+    def test_throughput(self):
+        bm = benchmark()
+        bm.begin()
+        bm._warmup = 0
+        for _ in range(3):
+            bm.before_reader()
+            bm.after_reader()
+            bm.step(num_samples=32)
+        info = bm.end()
+        assert info["steps"] == 3
+        assert info["ips"] > 0
