@@ -39,7 +39,33 @@ __all__ = [
     "shard_optimizer",
     "unshard_dtensor",
     "get_placements",
+    "apply_placement",
+    "build_placements",
 ]
+
+
+def apply_placement(param: Any, mesh: "ProcessMesh", placements: Sequence[Placement]) -> None:
+    """Reshard a Parameter/buffer in place, outside the grad tape — the one
+    idiom every shard_fn (llama/gpt/mpu/Experts) uses."""
+    import paddle_tpu
+
+    if param is None:
+        return
+    with paddle_tpu.no_grad():
+        d = shard_tensor(param, mesh, placements)
+    param._data = d._data
+    param.process_mesh = mesh
+    param.placements = list(placements)
+
+
+def build_placements(mesh: "ProcessMesh", **axis_dims: int) -> List[Placement]:
+    """``build_placements(mesh, mp=1, sharding=0)`` → Shard(dim) on each named
+    axis present in the mesh, Replicate() elsewhere."""
+    out: List[Placement] = [Replicate() for _ in mesh.dim_names]
+    for axis_name, dim in axis_dims.items():
+        if axis_name in mesh.dim_names and dim is not None:
+            out[mesh.dim_names.index(axis_name)] = Shard(dim)
+    return out
 
 
 def _named_sharding(mesh: ProcessMesh, placements: Sequence[Placement], ndim: int) -> NamedSharding:

@@ -247,27 +247,16 @@ class LlamaForCausalLM(nn.Layer):
 # VocabParallelEmbedding; here expressed as parameter placements for GSPMD).
 # ---------------------------------------------------------------------------
 def llama_shard_fn(name: str, sublayer: Any, mesh: Any) -> None:
-    from paddle_tpu.distributed.api import shard_tensor
-    from paddle_tpu.distributed.placements import Replicate, Shard
+    from paddle_tpu.distributed.api import apply_placement, build_placements
+    from paddle_tpu.distributed.placements import Replicate
 
     def put(param: Any, placements: List[Any]) -> None:
-        if param is None:
-            return
-        d = shard_tensor(param, mesh, placements)
-        param._data = d._data
-        param.process_mesh = mesh
-        param.placements = placements
+        apply_placement(param, mesh, placements)
 
     names = mesh.dim_names
-    mp = names.index("mp") if "mp" in names else None
-    dp = names.index("dp") if "dp" in names else None
 
     def plc(**kw: Any) -> List[Any]:
-        out: List[Any] = [Replicate() for _ in names]
-        for axis_name, dim in kw.items():
-            if axis_name in names:
-                out[names.index(axis_name)] = Shard(dim)
-        return out
+        return build_placements(mesh, **kw)
 
     cls = type(sublayer).__name__
     leaf = name.rsplit(".", 1)[-1]

@@ -1,0 +1,259 @@
+"""Stable Diffusion v1.5 UNet (BASELINE config #5: SD v1.5 UNet inference).
+
+UNet2DConditionModel architecture (SD v1.5: 4-ch latents, block channels
+320/640/1280/1280, 2 res layers per block, cross-attention to a 768-d text
+context at the first three resolutions, GEGLU feed-forward, sinusoidal
+timestep embedding → 1280-d MLP).
+
+TPU-native: NCHW convs (XLA re-lays-out), attention through the flash path,
+fp32 GroupNorm. Inference is the target workload — wrap calls in
+``paddle_tpu.jit.to_static`` for the compiled denoising loop.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Tuple
+
+import paddle_tpu
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["UNetConfig", "UNet2DConditionModel"]
+
+
+@dataclass
+class UNetConfig:
+    in_channels: int = 4
+    out_channels: int = 4
+    block_out_channels: Tuple[int, ...] = (320, 640, 1280, 1280)
+    layers_per_block: int = 2
+    cross_attention_dim: int = 768
+    attention_head_dim: int = 8
+    norm_num_groups: int = 32
+    # cross-attention at every level except the innermost (SD v1.5 layout)
+    attn_blocks: Tuple[bool, ...] = (True, True, True, False)
+
+    @staticmethod
+    def sd15() -> "UNetConfig":
+        return UNetConfig()
+
+    @staticmethod
+    def tiny() -> "UNetConfig":
+        return UNetConfig(
+            block_out_channels=(32, 64),
+            layers_per_block=1,
+            cross_attention_dim=32,
+            attention_head_dim=4,
+            norm_num_groups=8,
+            attn_blocks=(True, False),
+        )
+
+
+def timestep_embedding(t: Tensor, dim: int, max_period: float = 10000.0) -> Tensor:
+    half = dim // 2
+    freqs = paddle_tpu.exp(
+        paddle_tpu.arange(half, dtype="float32") * (-math.log(max_period) / half)
+    )
+    args = t.astype("float32").unsqueeze(-1) * freqs.unsqueeze(0)
+    return paddle_tpu.concat([paddle_tpu.cos(args), paddle_tpu.sin(args)], axis=-1)
+
+
+class ResnetBlock(nn.Layer):
+    def __init__(self, in_ch: int, out_ch: int, temb_ch: int, groups: int) -> None:
+        super().__init__()
+        self.norm1 = nn.GroupNorm(min(groups, in_ch), in_ch)
+        self.conv1 = nn.Conv2D(in_ch, out_ch, 3, padding=1)
+        self.time_emb_proj = nn.Linear(temb_ch, out_ch)
+        self.norm2 = nn.GroupNorm(min(groups, out_ch), out_ch)
+        self.conv2 = nn.Conv2D(out_ch, out_ch, 3, padding=1)
+        self.shortcut = nn.Conv2D(in_ch, out_ch, 1) if in_ch != out_ch else None
+
+    def forward(self, x: Tensor, temb: Tensor) -> Tensor:
+        h = self.conv1(F.silu(self.norm1(x)))
+        h = h + self.time_emb_proj(F.silu(temb)).unsqueeze(-1).unsqueeze(-1)
+        h = self.conv2(F.silu(self.norm2(h)))
+        skip = self.shortcut(x) if self.shortcut is not None else x
+        return h + skip
+
+
+class CrossAttention(nn.Layer):
+    def __init__(self, query_dim: int, context_dim: Optional[int], num_heads: int) -> None:
+        super().__init__()
+        context_dim = context_dim or query_dim
+        # SD v1.5 / diffusers convention: `attention_head_dim=8` is the HEAD
+        # COUNT (8 heads of dim C/8 per resolution: 40/80/160 for 320/640/1280)
+        if query_dim % num_heads != 0:
+            raise ValueError(f"channels {query_dim} not divisible by {num_heads} heads")
+        self.num_heads = num_heads
+        self.head_dim = query_dim // num_heads
+        self.to_q = nn.Linear(query_dim, query_dim, bias_attr=False)
+        self.to_k = nn.Linear(context_dim, query_dim, bias_attr=False)
+        self.to_v = nn.Linear(context_dim, query_dim, bias_attr=False)
+        self.to_out = nn.Linear(query_dim, query_dim)
+
+    def forward(self, x: Tensor, context: Optional[Tensor] = None) -> Tensor:
+        context = context if context is not None else x
+        b, s, d = x.shape
+        sk = context.shape[1]
+        q = self.to_q(x).reshape([b, s, self.num_heads, self.head_dim])
+        k = self.to_k(context).reshape([b, sk, self.num_heads, self.head_dim])
+        v = self.to_v(context).reshape([b, sk, self.num_heads, self.head_dim])
+        out = F.scaled_dot_product_attention(q, k, v, is_causal=False)
+        return self.to_out(out.reshape([b, s, d]))
+
+
+class GEGLU(nn.Layer):
+    def __init__(self, dim: int, inner: int) -> None:
+        super().__init__()
+        self.proj = nn.Linear(dim, inner * 2)
+
+    def forward(self, x: Tensor) -> Tensor:
+        h = self.proj(x)
+        a, g = h.chunk(2, axis=-1)
+        return a * F.gelu(g)
+
+
+class BasicTransformerBlock(nn.Layer):
+    def __init__(self, dim: int, context_dim: int, num_heads: int) -> None:
+        super().__init__()
+        self.norm1 = nn.LayerNorm(dim)
+        self.attn1 = CrossAttention(dim, None, num_heads)  # self
+        self.norm2 = nn.LayerNorm(dim)
+        self.attn2 = CrossAttention(dim, context_dim, num_heads)  # cross
+        self.norm3 = nn.LayerNorm(dim)
+        self.ff = nn.Sequential(GEGLU(dim, dim * 4), nn.Linear(dim * 4, dim))
+
+    def forward(self, x: Tensor, context: Tensor) -> Tensor:
+        x = x + self.attn1(self.norm1(x))
+        x = x + self.attn2(self.norm2(x), context)
+        return x + self.ff(self.norm3(x))
+
+
+class Transformer2D(nn.Layer):
+    """GroupNorm → 1x1 in-proj → transformer block over HW tokens → out-proj."""
+
+    def __init__(self, ch: int, context_dim: int, num_heads: int, groups: int) -> None:
+        super().__init__()
+        self.norm = nn.GroupNorm(min(groups, ch), ch)
+        self.proj_in = nn.Conv2D(ch, ch, 1)
+        self.block = BasicTransformerBlock(ch, context_dim, num_heads)
+        self.proj_out = nn.Conv2D(ch, ch, 1)
+
+    def forward(self, x: Tensor, context: Tensor) -> Tensor:
+        b, c, hh, ww = x.shape
+        res = x
+        h = self.proj_in(self.norm(x))
+        h = h.reshape([b, c, hh * ww]).transpose([0, 2, 1])  # [B, HW, C]
+        h = self.block(h, context)
+        h = h.transpose([0, 2, 1]).reshape([b, c, hh, ww])
+        return self.proj_out(h) + res
+
+
+class UNet2DConditionModel(nn.Layer):
+    def __init__(self, config: Optional[UNetConfig] = None) -> None:
+        super().__init__()
+        cfg = config or UNetConfig()
+        self.config = cfg
+        ch0 = cfg.block_out_channels[0]
+        temb_ch = ch0 * 4
+        self.conv_in = nn.Conv2D(cfg.in_channels, ch0, 3, padding=1)
+        self.time_embedding = nn.Sequential(
+            nn.Linear(ch0, temb_ch), nn.Silu(), nn.Linear(temb_ch, temb_ch)
+        )
+
+        g = cfg.norm_num_groups
+        hd = cfg.attention_head_dim
+        cd = cfg.cross_attention_dim
+
+        # down
+        self.down_resnets = nn.LayerList()
+        self.down_attns = nn.LayerList()
+        self.downsamplers = nn.LayerList()
+        skip_chs = [ch0]
+        ch = ch0
+        for i, out_ch in enumerate(cfg.block_out_channels):
+            for _ in range(cfg.layers_per_block):
+                self.down_resnets.append(ResnetBlock(ch, out_ch, temb_ch, g))
+                ch = out_ch
+                has_attn = cfg.attn_blocks[i]
+                self.down_attns.append(
+                    Transformer2D(ch, cd, hd, g) if has_attn else nn.Identity()
+                )
+                skip_chs.append(ch)
+            if i < len(cfg.block_out_channels) - 1:
+                self.downsamplers.append(nn.Conv2D(ch, ch, 3, stride=2, padding=1))
+                skip_chs.append(ch)
+            else:
+                self.downsamplers.append(nn.Identity())
+
+        # mid
+        self.mid_res1 = ResnetBlock(ch, ch, temb_ch, g)
+        self.mid_attn = Transformer2D(ch, cd, hd, g)
+        self.mid_res2 = ResnetBlock(ch, ch, temb_ch, g)
+
+        # up (reverse, layers_per_block+1 resnets each, consuming skips)
+        self.up_resnets = nn.LayerList()
+        self.up_attns = nn.LayerList()
+        self.upsamplers = nn.LayerList()
+        rev = list(reversed(cfg.block_out_channels))
+        for i, out_ch in enumerate(rev):
+            has_attn = list(reversed(cfg.attn_blocks))[i]
+            for _ in range(cfg.layers_per_block + 1):
+                skip = skip_chs.pop()
+                self.up_resnets.append(ResnetBlock(ch + skip, out_ch, temb_ch, g))
+                ch = out_ch
+                self.up_attns.append(
+                    Transformer2D(ch, cd, hd, g) if has_attn else nn.Identity()
+                )
+            if i < len(rev) - 1:
+                self.upsamplers.append(nn.Conv2D(ch, ch, 3, padding=1))
+            else:
+                self.upsamplers.append(nn.Identity())
+
+        self.conv_norm_out = nn.GroupNorm(min(g, ch), ch)
+        self.conv_out = nn.Conv2D(ch, cfg.out_channels, 3, padding=1)
+
+    def forward(self, sample: Tensor, timestep: Tensor, encoder_hidden_states: Tensor) -> Tensor:
+        cfg = self.config
+        temb = timestep_embedding(timestep, cfg.block_out_channels[0])
+        temb = self.time_embedding(temb)
+
+        h = self.conv_in(sample)
+        skips = [h]
+        li = 0
+        for i, out_ch in enumerate(cfg.block_out_channels):
+            for _ in range(cfg.layers_per_block):
+                h = self.down_resnets[li](h, temb)
+                attn = self.down_attns[li]
+                if not isinstance(attn, nn.Identity):
+                    h = attn(h, encoder_hidden_states)
+                skips.append(h)
+                li += 1
+            ds = self.downsamplers[i]
+            if not isinstance(ds, nn.Identity):
+                h = ds(h)
+                skips.append(h)
+
+        h = self.mid_res1(h, temb)
+        h = self.mid_attn(h, encoder_hidden_states)
+        h = self.mid_res2(h, temb)
+
+        li = 0
+        for i in range(len(cfg.block_out_channels)):
+            for _ in range(cfg.layers_per_block + 1):
+                skip = skips.pop()
+                h = paddle_tpu.concat([h, skip], axis=1)
+                h = self.up_resnets[li](h, temb)
+                attn = self.up_attns[li]
+                if not isinstance(attn, nn.Identity):
+                    h = attn(h, encoder_hidden_states)
+                li += 1
+            us = self.upsamplers[i]
+            if not isinstance(us, nn.Identity):
+                h = F.interpolate(h, scale_factor=2.0, mode="nearest")
+                h = us(h)
+
+        return self.conv_out(F.silu(self.conv_norm_out(h)))
