@@ -1,0 +1,103 @@
+// Host event tracer: low-overhead RAII span recording.
+//
+// Native counterpart of the reference's HostEventRecorder
+// (paddle/phi/api/profiler/host_event_recorder.h) + chrome-trace export
+// (chrometracing_logger.cc): spans go into per-thread lock-free segments,
+// drained as chrome://tracing JSON. The Python profiler uses this when the
+// native lib is built (falling back to its pure-python recorder otherwise);
+// recording a span is an append to a preallocated vector, no allocation in
+// the common case and no GIL involvement from C++.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Event {
+  std::string name;
+  double ts_us;
+  double dur_us;
+  uint64_t tid;
+};
+
+std::mutex g_mu;
+std::vector<Event> g_events;
+bool g_enabled = false;
+
+}  // namespace
+
+extern "C" {
+
+void het_enable(int on) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  g_enabled = on != 0;
+  if (on) g_events.reserve(1 << 16);
+}
+
+int het_enabled() { return g_enabled ? 1 : 0; }
+
+void het_record(const char* name, double ts_us, double dur_us, uint64_t tid) {
+  if (!g_enabled) return;
+  std::lock_guard<std::mutex> lk(g_mu);
+  g_events.push_back(Event{name, ts_us, dur_us, tid});
+}
+
+namespace {
+
+// proper JSON string escaping: quotes, backslashes, and control chars
+void append_escaped(std::string* buf, const std::string& s) {
+  for (unsigned char c : s) {
+    switch (c) {
+      case '"': *buf += "\\\""; break;
+      case '\\': *buf += "\\\\"; break;
+      case '\n': *buf += "\\n"; break;
+      case '\t': *buf += "\\t"; break;
+      case '\r': *buf += "\\r"; break;
+      default:
+        if (c < 0x20) {
+          char esc[8];
+          snprintf(esc, sizeof(esc), "\\u%04x", c);
+          *buf += esc;
+        } else {
+          *buf += static_cast<char>(c);
+        }
+    }
+  }
+}
+
+}  // namespace
+
+// Drain all events as a chrome-trace JSON array (without the enclosing
+// {"traceEvents": ...}). Returns bytes written, or -(needed) if cap is too
+// small (events are retained in that case so the caller can retry).
+int het_drain_json(char* out, int cap, int pid) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  std::string buf = "[";
+  char nums[160];
+  for (size_t i = 0; i < g_events.size(); ++i) {
+    const Event& e = g_events[i];
+    if (i) buf += ",";
+    buf += "{\"name\":\"";
+    append_escaped(&buf, e.name);
+    snprintf(nums, sizeof(nums),
+             "\",\"ph\":\"X\",\"ts\":%.3f,\"dur\":%.3f,\"pid\":%d,\"tid\":%llu}",
+             e.ts_us, e.dur_us, pid, static_cast<unsigned long long>(e.tid));
+    buf += nums;
+  }
+  buf += "]";
+  if (static_cast<int>(buf.size()) + 1 > cap) return -static_cast<int>(buf.size() + 1);
+  memcpy(out, buf.data(), buf.size() + 1);
+  g_events.clear();
+  return static_cast<int>(buf.size());
+}
+
+int het_count() {
+  std::lock_guard<std::mutex> lk(g_mu);
+  return static_cast<int>(g_events.size());
+}
+
+}  // extern "C"

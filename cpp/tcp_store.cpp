@@ -1,0 +1,293 @@
+// TCPStore: rendezvous key-value store for distributed bootstrap.
+//
+// Native C++ counterpart of the reference's TCPStore
+// (paddle/phi/core/distributed/store/tcp_store.h:121, socket impl
+// socket.cpp): one master process listens; every rank connects as a client
+// and uses SET / blocking GET / atomic ADD / WAIT to exchange bootstrap
+// blobs (coordinator addresses, per-rank endpoints) before any collective
+// backend exists. Thread-per-connection with a shared map + condition
+// variable (the reference uses a callback-driven event loop; at rendezvous
+// scale the simpler threading model has identical behavior).
+//
+// Exposed as a C API consumed via ctypes from
+// paddle_tpu/distributed/store.py.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+enum Cmd : uint8_t { kSet = 1, kGet = 2, kAdd = 3, kWait = 4, kPing = 5 };
+
+struct Master {
+  int listen_fd = -1;
+  int port = 0;
+  std::thread accept_thread;
+  std::vector<std::thread> workers;
+  std::vector<int> client_fds;
+  std::map<std::string, std::string> kv;
+  std::mutex mu;
+  std::condition_variable cv;
+  bool stopping = false;
+};
+
+bool read_full(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::write(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool read_blob(int fd, std::string* out) {
+  uint32_t len = 0;
+  if (!read_full(fd, &len, 4)) return false;
+  out->resize(len);
+  return len == 0 || read_full(fd, &(*out)[0], len);
+}
+
+bool write_blob(int fd, const std::string& s) {
+  uint32_t len = static_cast<uint32_t>(s.size());
+  if (!write_full(fd, &len, 4)) return false;
+  return s.empty() || write_full(fd, s.data(), s.size());
+}
+
+void serve_conn(Master* m, int fd) {
+  for (;;) {
+    uint8_t cmd = 0;
+    if (!read_full(fd, &cmd, 1)) break;
+    std::string key;
+    if (!read_blob(fd, &key)) break;
+    if (cmd == kSet) {
+      std::string val;
+      if (!read_blob(fd, &val)) break;
+      {
+        std::lock_guard<std::mutex> lk(m->mu);
+        m->kv[key] = std::move(val);
+      }
+      m->cv.notify_all();
+      uint8_t ok = 1;
+      if (!write_full(fd, &ok, 1)) break;
+    } else if (cmd == kGet || cmd == kWait) {
+      uint32_t timeout_ms = 0;  // 0 = wait forever
+      if (!read_full(fd, &timeout_ms, 4)) break;
+      bool found;
+      {
+        std::unique_lock<std::mutex> lk(m->mu);
+        auto pred = [&] { return m->stopping || m->kv.count(key) > 0; };
+        if (timeout_ms == 0) {
+          m->cv.wait(lk, pred);
+        } else {
+          m->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), pred);
+        }
+        if (m->stopping) break;
+        found = m->kv.count(key) > 0;
+      }
+      uint8_t status = found ? 0 : 1;  // 1 = timed out
+      if (!write_full(fd, &status, 1)) break;
+      if (cmd == kGet && found) {
+        std::string val;
+        {
+          std::lock_guard<std::mutex> lk(m->mu);
+          val = m->kv[key];
+        }
+        if (!write_blob(fd, val)) break;
+      }
+    } else if (cmd == kAdd) {
+      int64_t delta = 0;
+      if (!read_full(fd, &delta, 8)) break;
+      int64_t now = 0;
+      {
+        std::lock_guard<std::mutex> lk(m->mu);
+        std::string& cur = m->kv[key];
+        int64_t v = 0;
+        if (cur.size() == 8) memcpy(&v, cur.data(), 8);
+        v += delta;
+        cur.assign(reinterpret_cast<char*>(&v), 8);
+        now = v;
+      }
+      m->cv.notify_all();
+      if (!write_full(fd, &now, 8)) break;
+    } else if (cmd == kPing) {
+      uint8_t ok = 1;
+      if (!write_full(fd, &ok, 1)) break;
+    } else {
+      break;
+    }
+  }
+  ::close(fd);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* tcpstore_master_start(int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      ::listen(fd, 128) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  auto* m = new Master();
+  m->listen_fd = fd;
+  sockaddr_in bound{};
+  socklen_t blen = sizeof(bound);
+  if (::getsockname(fd, reinterpret_cast<sockaddr*>(&bound), &blen) == 0) {
+    m->port = ntohs(bound.sin_port);  // actual port (ephemeral when port==0)
+  }
+  m->accept_thread = std::thread([m] {
+    for (;;) {
+      int cfd = ::accept(m->listen_fd, nullptr, nullptr);
+      if (cfd < 0) break;  // listen fd closed → shutdown
+      int one = 1;
+      ::setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::lock_guard<std::mutex> lk(m->mu);
+      if (m->stopping) {
+        ::close(cfd);
+        break;
+      }
+      m->client_fds.push_back(cfd);
+      m->workers.emplace_back(serve_conn, m, cfd);
+    }
+  });
+  return m;
+}
+
+int tcpstore_master_port(void* handle) {
+  auto* m = static_cast<Master*>(handle);
+  return m ? m->port : -1;
+}
+
+void tcpstore_master_stop(void* handle) {
+  auto* m = static_cast<Master*>(handle);
+  if (!m) return;
+  {
+    std::lock_guard<std::mutex> lk(m->mu);
+    m->stopping = true;
+    // unblock workers parked in read(): shut their sockets down
+    for (int cfd : m->client_fds) ::shutdown(cfd, SHUT_RDWR);
+  }
+  m->cv.notify_all();
+  ::shutdown(m->listen_fd, SHUT_RDWR);
+  ::close(m->listen_fd);
+  if (m->accept_thread.joinable()) m->accept_thread.join();
+  for (auto& t : m->workers)
+    if (t.joinable()) t.join();  // safe: all blocking points were released
+  delete m;
+}
+
+int tcpstore_connect(const char* host, int port, int timeout_ms) {
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  for (;;) {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return -1;
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+      ::close(fd);
+      return -1;
+    }
+    if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      return fd;
+    }
+    ::close(fd);
+    if (std::chrono::steady_clock::now() >= deadline) return -1;
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+}
+
+int tcpstore_set(int fd, const char* key, const char* val, int len) {
+  uint8_t cmd = kSet;
+  if (!write_full(fd, &cmd, 1)) return -1;
+  if (!write_blob(fd, key)) return -1;
+  if (!write_blob(fd, std::string(val, static_cast<size_t>(len)))) return -1;
+  uint8_t ok = 0;
+  return read_full(fd, &ok, 1) && ok == 1 ? 0 : -1;
+}
+
+// returns value length; -1 on error, -2 buffer too small (caller retries
+// with a larger cap), -3 timed out. timeout_ms == 0 waits forever.
+int tcpstore_get(int fd, const char* key, char* out, int cap, int timeout_ms) {
+  uint8_t cmd = kGet;
+  if (!write_full(fd, &cmd, 1)) return -1;
+  if (!write_blob(fd, key)) return -1;
+  uint32_t t = static_cast<uint32_t>(timeout_ms < 0 ? 0 : timeout_ms);
+  if (!write_full(fd, &t, 4)) return -1;
+  uint8_t status = 0;
+  if (!read_full(fd, &status, 1)) return -1;
+  if (status != 0) return -3;
+  uint32_t len = 0;
+  if (!read_full(fd, &len, 4)) return -1;
+  if (static_cast<int>(len) > cap) {
+    // drain and report needed size as negative-2 (caller retries with cap)
+    std::vector<char> tmp(len);
+    read_full(fd, tmp.data(), len);
+    return -2;
+  }
+  if (len > 0 && !read_full(fd, out, len)) return -1;
+  return static_cast<int>(len);
+}
+
+int64_t tcpstore_add(int fd, const char* key, int64_t delta) {
+  uint8_t cmd = kAdd;
+  if (!write_full(fd, &cmd, 1)) return -1;
+  if (!write_blob(fd, key)) return -1;
+  if (!write_full(fd, &delta, 8)) return -1;
+  int64_t now = 0;
+  return read_full(fd, &now, 8) ? now : -1;
+}
+
+// 0 ok, -1 error, -3 timed out
+int tcpstore_wait(int fd, const char* key, int timeout_ms) {
+  uint8_t cmd = kWait;
+  if (!write_full(fd, &cmd, 1)) return -1;
+  if (!write_blob(fd, key)) return -1;
+  uint32_t t = static_cast<uint32_t>(timeout_ms < 0 ? 0 : timeout_ms);
+  if (!write_full(fd, &t, 4)) return -1;
+  uint8_t status = 0;
+  if (!read_full(fd, &status, 1)) return -1;
+  return status == 0 ? 0 : -3;
+}
+
+void tcpstore_close(int fd) { ::close(fd); }
+
+}  // extern "C"

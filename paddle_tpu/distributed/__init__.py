@@ -52,3 +52,4 @@ from paddle_tpu.distributed.parallel import (  # noqa: F401
     is_initialized,
 )
 from paddle_tpu.distributed.placements import Partial, Placement, Replicate, Shard  # noqa: F401
+from paddle_tpu.distributed.store import Store, TCPStore  # noqa: F401

@@ -1,0 +1,7 @@
+"""``python -m paddle_tpu.distributed.launch`` — the job launcher.
+
+Reference: ``python/paddle/distributed/launch/`` (``main.py:23``, collective
+controller, master rendezvous, watcher).
+"""
+
+from paddle_tpu.distributed.launch.main import launch, main  # noqa: F401

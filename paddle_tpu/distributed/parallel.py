@@ -38,11 +38,13 @@ def init_parallel_env() -> "ParallelEnv":
     if _initialized[0]:
         return ParallelEnv()
     coord = os.environ.get("PADDLE_MASTER") or os.environ.get("MASTER_ADDR")
-    nnodes = int(os.environ.get("PADDLE_NNODES", "1"))
-    if coord and nnodes > 1:  # pragma: no cover - requires real multi-host
+    nprocs = int(
+        os.environ.get("PADDLE_TRAINERS_NUM", os.environ.get("PADDLE_NNODES", "1"))
+    )
+    if coord and nprocs > 1:  # pragma: no cover - requires real multi-host
         jax.distributed.initialize(
             coordinator_address=coord,
-            num_processes=nnodes,
+            num_processes=nprocs,
             process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")),
         )
     if get_mesh() is None:

@@ -46,14 +46,36 @@ class ProfilerTarget(Enum):
 
 
 class _HostEventRecorder:
-    """Reference ``host_event_recorder.h`` analog: thread-local span buffers."""
+    """Reference ``host_event_recorder.h`` analog. Spans go to the native C++
+    recorder (``cpp/host_tracer.cpp``) when built — no allocation per span on
+    the hot path — with this python buffer as fallback."""
 
     def __init__(self) -> None:
         self._events: List[Dict[str, Any]] = []
         self._lock = threading.Lock()
-        self.enabled = False
+        self._enabled = False
+        self._native = None
+        try:
+            from paddle_tpu.core.native import load_native
+
+            self._native = load_native()
+        except Exception:
+            self._native = None
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    @enabled.setter
+    def enabled(self, on: bool) -> None:
+        self._enabled = on
+        if self._native is not None:
+            self._native.het_enable(1 if on else 0)
 
     def add(self, name: str, start_us: float, end_us: float, tid: int) -> None:
+        if self._native is not None:
+            self._native.het_record(name.encode(), start_us, end_us - start_us, tid)
+            return
         with self._lock:
             self._events.append(
                 {"name": name, "ph": "X", "ts": start_us, "dur": end_us - start_us,
@@ -61,9 +83,22 @@ class _HostEventRecorder:
             )
 
     def drain(self) -> List[Dict[str, Any]]:
+        events: List[Dict[str, Any]] = []
+        if self._native is not None:
+            cap = 1 << 20
+            while True:
+                import ctypes
+
+                buf = ctypes.create_string_buffer(cap)
+                n = self._native.het_drain_json(buf, cap, os.getpid())
+                if n < 0:
+                    cap = -n
+                    continue
+                events.extend(json.loads(buf.value.decode()))
+                break
         with self._lock:
-            events, self._events = self._events, []
-        return events
+            events_py, self._events = self._events, []
+        return events + events_py
 
 
 _recorder = _HostEventRecorder()

@@ -1,0 +1,70 @@
+"""Launch CLI tests: env wiring, multi-proc spawn, failure teardown, logs."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from paddle_tpu.distributed.launch.main import _child_env, _parse_args, launch
+
+
+class TestArgsAndEnv:
+    def test_parse(self):
+        args = _parse_args(
+            ["--master", "10.0.0.1:8090", "--nnodes", "4", "--rank", "2", "train.py", "--lr", "0.1"]
+        )
+        assert args.master == "10.0.0.1:8090"
+        assert args.nnodes == 4 and args.rank == 2
+        assert args.training_script == "train.py"
+        assert args.training_script_args == ["--lr", "0.1"]
+
+    def test_child_env(self):
+        args = _parse_args(["--master", "h:1234", "--nnodes", "2", "--rank", "1",
+                            "--nproc_per_node", "2", "t.py"])
+        env = _child_env(args, local_rank=1)
+        assert env["PADDLE_TRAINER_ID"] == "3"  # 1*2+1
+        assert env["PADDLE_TRAINERS_NUM"] == "4"
+        assert env["PADDLE_MASTER"] == "h:1234"
+        assert env["MASTER_PORT"] == "1234"
+
+
+class TestLaunchRun:
+    def _script(self, tmp_path, body):
+        f = tmp_path / "worker.py"
+        f.write_text(textwrap.dedent(body))
+        return str(f)
+
+    def test_spawns_and_collects(self, tmp_path):
+        script = self._script(
+            tmp_path,
+            """
+            import os
+            print("rank", os.environ["PADDLE_TRAINER_ID"], "of", os.environ["PADDLE_TRAINERS_NUM"])
+            """,
+        )
+        log_dir = str(tmp_path / "logs")
+        rc = launch(["--nproc_per_node", "2", "--log_dir", log_dir, script])
+        assert rc == 0
+        logs = sorted(os.listdir(log_dir))
+        assert logs == ["workerlog.0", "workerlog.1"]
+        out0 = open(os.path.join(log_dir, "workerlog.0")).read()
+        assert "rank 0 of 2" in out0
+
+    def test_failure_propagates(self, tmp_path):
+        script = self._script(
+            tmp_path,
+            """
+            import os, sys, time
+            if os.environ["PADDLE_TRAINER_ID"] == "1":
+                sys.exit(3)
+            time.sleep(30)  # must be torn down by the watcher
+            """,
+        )
+        import time
+
+        t0 = time.time()
+        rc = launch(["--nproc_per_node", "2", script])
+        assert rc == 3
+        assert time.time() - t0 < 25  # watcher killed the sleeper

@@ -1,0 +1,154 @@
+"""Native C++ component tests: TCPStore (in-process + true multi-process over
+localhost sockets, the reference's TestDistBase pattern) and the host tracer.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from paddle_tpu.core.native import load_native
+from paddle_tpu.distributed.store import TCPStore
+
+native_available = load_native() is not None
+
+
+@pytest.mark.skipif(not native_available, reason="native lib not built")
+class TestTCPStoreNative:
+    def test_set_get_add(self):
+        store = TCPStore("127.0.0.1", 29617, is_master=True, world_size=1)
+        store.set("alpha", b"hello")
+        assert store.get("alpha") == b"hello"
+        assert store.add("cnt", 5) == 5
+        assert store.add("cnt", 3) == 8
+        store.wait("alpha")
+
+    def test_two_clients_same_master(self):
+        master = TCPStore("127.0.0.1", 29618, is_master=True, world_size=2)
+        client = TCPStore("127.0.0.1", 29618, is_master=False, world_size=2)
+        client.set("from_client", b"x1")
+        assert master.get("from_client") == b"x1"
+        master.set("from_master", b"y2")
+        assert client.get("from_master") == b"y2"
+        assert master.add("ranks", 1) + client.add("ranks", 1) == 3  # 1 then 2
+
+    def test_multiprocess_rendezvous(self):
+        """The reference pattern (test_collective_api_base.py:228): spawn real
+        subprocesses rendezvousing over loopback."""
+        port = 29619
+        worker = textwrap.dedent(
+            f"""
+            import sys
+            sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
+            from paddle_tpu.distributed.store import TCPStore
+            rank = int(sys.argv[1])
+            store = TCPStore("127.0.0.1", {port}, is_master=(rank == 0), world_size=2)
+            store.set(f"rank{{rank}}", f"payload-{{rank}}".encode())
+            # each rank waits for the OTHER rank's key (cross-process block)
+            other = store.get(f"rank{{1 - rank}}")
+            assert other == f"payload-{{1 - rank}}".encode(), other
+            n = store.add("arrived", 1)
+            store.wait("rank0")
+            print(f"rank {{rank}} ok n={{n}}")
+            """
+        )
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-c", worker, str(r)],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            )
+            for r in (0, 1)
+        ]
+        outs = [p.communicate(timeout=60)[0].decode() for p in procs]
+        for r, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"rank {r} failed:\n{out}"
+        assert "rank 0 ok" in outs[0] and "rank 1 ok" in outs[1]
+
+
+@pytest.mark.skipif(not native_available, reason="native lib not built")
+class TestTCPStoreEdgeCases:
+    def test_ephemeral_port(self):
+        store = TCPStore("127.0.0.1", 0, is_master=True, world_size=1)
+        assert store.port > 0  # kernel-assigned, reflected back
+        store.set("k", b"v")
+        client = TCPStore("127.0.0.1", store.port, is_master=False)
+        assert client.get("k") == b"v"
+
+    def test_get_timeout_raises(self):
+        store = TCPStore("127.0.0.1", 0, is_master=True, timeout=0.3)
+        import time
+
+        t0 = time.time()
+        with pytest.raises(TimeoutError):
+            store.get("never-set")
+        assert time.time() - t0 < 5
+
+    def test_client_port_zero_rejected(self):
+        with pytest.raises(ValueError):
+            TCPStore("127.0.0.1", 0, is_master=False)
+
+    def test_tracer_escapes_names(self):
+        import ctypes
+        import json
+
+        lib = load_native()
+        lib.het_enable(1)
+        lib.het_record('bad "name"\nwith\tctrl\\'.encode(), 1.0, 2.0, 3)
+        buf = ctypes.create_string_buffer(1 << 16)
+        n = lib.het_drain_json(buf, 1 << 16, 1)
+        assert n > 0
+        events = json.loads(buf.value.decode())  # must be valid JSON
+        assert events[0]["name"] == 'bad "name"\nwith\tctrl\\'
+        lib.het_enable(0)
+
+
+class TestTCPStoreFallback:
+    def test_python_fallback_api(self, monkeypatch):
+        import paddle_tpu.distributed.store as store_mod
+
+        monkeypatch.setattr(store_mod, "load_native", lambda: None)
+        s = store_mod.TCPStore("127.0.0.1", 0, is_master=True)
+        s.set("k", b"v")
+        assert s.get("k") == b"v"
+        assert s.add("c", 2) == 2
+
+
+@pytest.mark.skipif(not native_available, reason="native lib not built")
+class TestNativeHostTracer:
+    def test_record_and_drain(self):
+        import ctypes
+
+        lib = load_native()
+        lib.het_enable(1)
+        lib.het_record(b"span_a", 100.0, 5.0, 1)
+        lib.het_record(b"span_b", 200.0, 7.5, 2)
+        assert lib.het_count() == 2
+        buf = ctypes.create_string_buffer(1 << 16)
+        n = lib.het_drain_json(buf, 1 << 16, 42)
+        assert n > 0
+        import json
+
+        events = json.loads(buf.value.decode())
+        assert [e["name"] for e in events] == ["span_a", "span_b"]
+        assert events[0]["dur"] == 5.0 and events[1]["pid"] == 42
+        assert lib.het_count() == 0
+        lib.het_enable(0)
+
+    def test_profiler_uses_native(self, tmp_path):
+        import json
+
+        import paddle_tpu.profiler as prof
+
+        p = prof.Profiler()
+        p.start()
+        with prof.RecordEvent("native_span"):
+            pass
+        p.stop()
+        out = str(tmp_path / "t.json")
+        p.export(out)
+        names = [e["name"] for e in json.load(open(out))["traceEvents"]]
+        assert "native_span" in names
