@@ -13,10 +13,13 @@
 // paddle_tpu/distributed/store.py.
 
 #include <arpa/inet.h>
+#include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
 #include <unistd.h>
+
+#include <cstdlib>
 
 #include <chrono>
 #include <condition_variable>
@@ -127,10 +130,11 @@ void serve_conn(Master* m, int fd) {
       {
         std::lock_guard<std::mutex> lk(m->mu);
         std::string& cur = m->kv[key];
-        int64_t v = 0;
-        if (cur.size() == 8) memcpy(&v, cur.data(), 8);
+        // counters stored as decimal ASCII — the torch/paddle TCPStore
+        // convention, and identical to the python fallback's behavior
+        int64_t v = cur.empty() ? 0 : strtoll(cur.c_str(), nullptr, 10);
         v += delta;
-        cur.assign(reinterpret_cast<char*>(&v), 8);
+        cur = std::to_string(v);
         now = v;
       }
       m->cv.notify_all();
@@ -140,6 +144,16 @@ void serve_conn(Master* m, int fd) {
       if (!write_full(fd, &ok, 1)) break;
     } else {
       break;
+    }
+  }
+  {
+    // unregister before closing so master_stop never shuts down a reused fd
+    std::lock_guard<std::mutex> lk(m->mu);
+    for (auto it = m->client_fds.begin(); it != m->client_fds.end(); ++it) {
+      if (*it == fd) {
+        m->client_fds.erase(it);
+        break;
+      }
     }
   }
   ::close(fd);
@@ -221,8 +235,17 @@ int tcpstore_connect(const char* host, int port, int timeout_ms) {
     addr.sin_family = AF_INET;
     addr.sin_port = htons(static_cast<uint16_t>(port));
     if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
-      ::close(fd);
-      return -1;
+      // not a dotted-quad literal: DNS-resolve (hostnames, "localhost")
+      addrinfo hints{};
+      hints.ai_family = AF_INET;
+      hints.ai_socktype = SOCK_STREAM;
+      addrinfo* res = nullptr;
+      if (::getaddrinfo(host, nullptr, &hints, &res) != 0 || res == nullptr) {
+        ::close(fd);
+        return -1;
+      }
+      addr.sin_addr = reinterpret_cast<sockaddr_in*>(res->ai_addr)->sin_addr;
+      ::freeaddrinfo(res);
     }
     if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
       int one = 1;

@@ -109,10 +109,13 @@ from paddle_tpu.ops.random import (  # noqa: F401
 from paddle_tpu import amp  # noqa: F401
 from paddle_tpu import autograd  # noqa: F401
 from paddle_tpu import distributed  # noqa: F401
+from paddle_tpu import distribution  # noqa: F401
+from paddle_tpu import hapi  # noqa: F401
 from paddle_tpu import io  # noqa: F401
 from paddle_tpu import jit  # noqa: F401
 from paddle_tpu import linalg  # noqa: F401
 from paddle_tpu import metric  # noqa: F401
+from paddle_tpu.hapi import Model  # noqa: F401
 from paddle_tpu import nn  # noqa: F401
 from paddle_tpu import optimizer  # noqa: F401
 from paddle_tpu import profiler  # noqa: F401

@@ -1,4 +1,5 @@
 """AMP: bf16/fp16 autocast + loss scaling (reference ``python/paddle/amp``)."""
 
+from paddle_tpu.amp import debugging  # noqa: F401
 from paddle_tpu.amp.auto_cast import amp_guard, auto_cast, decorate  # noqa: F401
 from paddle_tpu.amp.grad_scaler import AmpScaler, GradScaler  # noqa: F401

@@ -1,0 +1,130 @@
+"""AMP numerical debugging (reference ``python/paddle/amp/debugging.py``):
+tensor-stat collection, operator stats, and the check_numerics entry.
+
+TPU-native: the per-op scan rides the eager dispatcher's
+``FLAGS_check_nan_inf`` hook (``core/dispatch.py``) — the analog of the
+reference's ``nan_inf_utils.cc`` per-kernel scan.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from dataclasses import dataclass
+from enum import Enum
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.flags import GLOBAL_FLAGS, set_flags
+
+__all__ = [
+    "DebugMode",
+    "TensorCheckerConfig",
+    "enable_tensor_checker",
+    "disable_tensor_checker",
+    "enable_operator_stats_collection",
+    "disable_operator_stats_collection",
+    "collect_operator_stats",
+    "check_numerics",
+]
+
+
+class DebugMode(Enum):
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL = 3
+
+
+@dataclass
+class TensorCheckerConfig:
+    enable: bool = True
+    debug_mode: DebugMode = DebugMode.CHECK_NAN_INF_AND_ABORT
+    output_dir: Optional[str] = None
+    checked_op_list: Optional[List[str]] = None
+    skipped_op_list: Optional[List[str]] = None
+    debug_step: Any = None
+    stack_height_limit: int = 1
+
+
+def enable_tensor_checker(checker_config: TensorCheckerConfig) -> None:
+    """Turn on the per-op NaN/Inf scan (reference ``debugging.py``
+    enable_tensor_checker → FLAGS_check_nan_inf)."""
+    level = {
+        DebugMode.CHECK_NAN_INF_AND_ABORT: 0,
+        DebugMode.CHECK_NAN_INF: 1,
+        DebugMode.CHECK_ALL: 3,
+    }[checker_config.debug_mode]
+    set_flags({"check_nan_inf": checker_config.enable, "check_nan_inf_level": level})
+
+
+def disable_tensor_checker() -> None:
+    set_flags({"check_nan_inf": False})
+
+
+# -- operator stats ---------------------------------------------------------
+_op_stats: Optional[Dict[str, Dict[str, int]]] = None
+
+
+def _record_op(name: str, arrays: Any) -> None:
+    if _op_stats is None:
+        return
+    for a in arrays:
+        dt = str(getattr(a, "dtype", "other"))
+        bucket = _op_stats.setdefault(dt, {})
+        bucket[name] = bucket.get(name, 0) + 1
+
+
+def enable_operator_stats_collection() -> None:
+    """Count op calls per dtype (reference low-precision op-stat tables used
+    to audit AMP coverage)."""
+    global _op_stats
+    _op_stats = {}
+    from paddle_tpu.core import dispatch
+
+    dispatch.op_stats_hook = _record_op
+
+
+def disable_operator_stats_collection() -> Dict[str, Dict[str, int]]:
+    global _op_stats
+    from paddle_tpu.core import dispatch
+
+    dispatch.op_stats_hook = None
+    stats, _op_stats = _op_stats or {}, None
+    # printable summary like the reference's table
+    for dtype, ops in sorted(stats.items()):
+        total = sum(ops.values())
+        print(f"<{dtype}> total calls: {total}, distinct ops: {len(ops)}")
+    return stats
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()
+
+
+def check_numerics(
+    tensor: Any,
+    op_type: str = "",
+    var_name: str = "",
+    debug_mode: DebugMode = DebugMode.CHECK_NAN_INF_AND_ABORT,
+) -> Tuple[Any, Any]:
+    """Scan one tensor; returns (num_nan, num_inf) and raises/warns per mode
+    (reference ``debugging.py check_numerics`` → accuracy_check op)."""
+    arr = tensor._data if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    num_nan = int(jnp.isnan(arr).sum())
+    num_inf = int(jnp.isinf(arr).sum())
+    if num_nan or num_inf:
+        msg = (
+            f"check_numerics: {op_type or 'tensor'} {var_name or ''} has "
+            f"{num_nan} NaN and {num_inf} Inf values"
+        )
+        if debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
+            raise FloatingPointError(msg)
+        print(msg)
+    return num_nan, num_inf

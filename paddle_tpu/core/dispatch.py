@@ -100,12 +100,17 @@ def call_op(name: str, fn: Callable, *args: Any, **kwargs: Any) -> Any:
     return _wrap_outputs(name, raw_out, node=node)
 
 
+op_stats_hook: Optional[Callable] = None  # amp.debugging operator-stat collector
+
+
 def _wrap_outputs(name: str, raw_out: Any, node: Optional[_ag.GradNode]) -> Any:
     from paddle_tpu.core.tensor import Tensor
 
     flat_out, out_treedef = jax.tree_util.tree_flatten(raw_out)
     if GLOBAL_FLAGS.get("check_nan_inf"):
         _check_nan_inf(name, flat_out)
+    if op_stats_hook is not None:
+        op_stats_hook(name, flat_out)
     wrapped: List[Any] = []
     for i, o in enumerate(flat_out):
         t = Tensor(o, stop_gradient=(node is None))

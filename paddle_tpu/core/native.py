@@ -19,25 +19,44 @@ _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
 
-def load_native() -> Optional[ctypes.CDLL]:
-    """The native lib, building it on first use; None if unavailable."""
-    global _lib, _tried
-    if _lib is not None or _tried:
-        return _lib
-    _tried = True
-    if not os.path.exists(_LIB_PATH):
-        cpp_dir = os.path.join(_REPO_ROOT, "cpp")
-        if not os.path.isdir(cpp_dir):
-            return None
-        try:
+def _build_locked(cpp_dir: str) -> bool:
+    """Run make under an exclusive file lock: concurrent ranks launched
+    together must not interleave compiles into the same build dir."""
+    import fcntl
+
+    os.makedirs(os.path.join(cpp_dir, "build"), exist_ok=True)
+    lock_path = os.path.join(cpp_dir, "build", ".build.lock")
+    try:
+        with open(lock_path, "w") as lock:
+            fcntl.flock(lock, fcntl.LOCK_EX)
+            if os.path.exists(_LIB_PATH):  # another rank built it meanwhile
+                return True
             subprocess.run(
-                ["make", "-C", cpp_dir],
-                check=True,
-                capture_output=True,
-                timeout=120,
+                ["make", "-C", cpp_dir], check=True, capture_output=True, timeout=120
             )
-        except Exception:
+            return True
+    except Exception:
+        return False
+
+
+def load_native(build: bool = True) -> Optional[ctypes.CDLL]:
+    """The native lib; with ``build=True`` compiles it on first use (under a
+    cross-process lock). ``build=False`` only loads an existing .so — used by
+    import-time consumers (profiler) so ``import paddle_tpu`` never blocks on
+    a compile."""
+    global _lib, _tried
+    if _lib is not None:
+        return _lib
+    if _tried and (not build or os.path.exists(_LIB_PATH)):
+        return _lib
+    if not os.path.exists(_LIB_PATH):
+        if not build:
             return None
+        _tried = True
+        cpp_dir = os.path.join(_REPO_ROOT, "cpp")
+        if not os.path.isdir(cpp_dir) or not _build_locked(cpp_dir):
+            return None
+    _tried = True
     try:
         lib = ctypes.CDLL(_LIB_PATH)
     except OSError:

@@ -1,0 +1,18 @@
+"""Probability distributions (reference ``python/paddle/distribution`` — 30+
+distributions over a Distribution base with sample/log_prob/entropy/kl).
+
+Core families implemented natively over jax.random; ``kl_divergence``
+dispatches on the pair of types (the reference's registered-kl pattern).
+"""
+
+from paddle_tpu.distribution.distributions import (  # noqa: F401
+    Bernoulli,
+    Categorical,
+    Distribution,
+    Exponential,
+    Gamma,
+    Laplace,
+    Normal,
+    Uniform,
+    kl_divergence,
+)

@@ -1,0 +1,303 @@
+"""Distribution implementations.
+
+Reference: ``python/paddle/distribution/{distribution,normal,uniform,
+categorical,bernoulli,exponential,gamma,laplace,kl}.py``. Sampling draws keys
+from the global generator (``paddle_tpu.core.rng``) so ``paddle.seed``
+reproducibility matches the rest of the framework.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.core.rng as _rng
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = [
+    "Distribution",
+    "Normal",
+    "Uniform",
+    "Categorical",
+    "Bernoulli",
+    "Exponential",
+    "Gamma",
+    "Laplace",
+    "kl_divergence",
+]
+
+
+def _arr(x: Any) -> jnp.ndarray:
+    if isinstance(x, Tensor):
+        return x._data.astype(jnp.float32)
+    return jnp.asarray(x, jnp.float32)
+
+
+def _shape(sample_shape: Sequence[int], batch: tuple) -> tuple:
+    return tuple(sample_shape) + batch
+
+
+class Distribution:
+    def __init__(self, batch_shape: tuple = (), event_shape: tuple = ()) -> None:
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self) -> tuple:
+        return self._batch_shape
+
+    @property
+    def event_shape(self) -> tuple:
+        return self._event_shape
+
+    @property
+    def mean(self) -> Tensor:
+        raise NotImplementedError
+
+    @property
+    def variance(self) -> Tensor:
+        raise NotImplementedError
+
+    def sample(self, shape: Sequence[int] = ()) -> Tensor:
+        raise NotImplementedError
+
+    def rsample(self, shape: Sequence[int] = ()) -> Tensor:
+        raise NotImplementedError
+
+    def log_prob(self, value: Any) -> Tensor:
+        raise NotImplementedError
+
+    def prob(self, value: Any) -> Tensor:
+        return Tensor(jnp.exp(self.log_prob(value)._data))
+
+    def entropy(self) -> Tensor:
+        raise NotImplementedError
+
+    def kl_divergence(self, other: "Distribution") -> Tensor:
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc: Any, scale: Any, name: Optional[str] = None) -> None:
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self) -> Tensor:
+        return Tensor(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self) -> Tensor:
+        return Tensor(jnp.broadcast_to(self.scale**2, self.batch_shape))
+
+    def sample(self, shape: Sequence[int] = ()) -> Tensor:
+        eps = jax.random.normal(_rng.next_key(), _shape(shape, self.batch_shape))
+        return Tensor(self.loc + self.scale * eps)
+
+    rsample = sample
+
+    def log_prob(self, value: Any) -> Tensor:
+        v = _arr(value)
+        var = self.scale**2
+        return Tensor(
+            -((v - self.loc) ** 2) / (2 * var) - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi)
+        )
+
+    def entropy(self) -> Tensor:
+        e = 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale)
+        return Tensor(jnp.broadcast_to(e, self.batch_shape))
+
+
+class Uniform(Distribution):
+    def __init__(self, low: Any, high: Any, name: Optional[str] = None) -> None:
+        self.low = _arr(low)
+        self.high = _arr(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape, self.high.shape))
+
+    @property
+    def mean(self) -> Tensor:
+        return Tensor(jnp.broadcast_to((self.low + self.high) / 2, self.batch_shape))
+
+    @property
+    def variance(self) -> Tensor:
+        return Tensor(jnp.broadcast_to((self.high - self.low) ** 2 / 12, self.batch_shape))
+
+    def sample(self, shape: Sequence[int] = ()) -> Tensor:
+        u = jax.random.uniform(_rng.next_key(), _shape(shape, self.batch_shape))
+        return Tensor(self.low + (self.high - self.low) * u)
+
+    rsample = sample
+
+    def log_prob(self, value: Any) -> Tensor:
+        v = _arr(value)
+        inside = (v >= self.low) & (v < self.high)
+        return Tensor(jnp.where(inside, -jnp.log(self.high - self.low), -jnp.inf))
+
+    def entropy(self) -> Tensor:
+        return Tensor(jnp.broadcast_to(jnp.log(self.high - self.low), self.batch_shape))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits: Any, name: Optional[str] = None) -> None:
+        self.logits = _arr(logits)
+        self._log_p = jax.nn.log_softmax(self.logits, axis=-1)
+        super().__init__(self.logits.shape[:-1])
+
+    @property
+    def probs(self) -> Tensor:
+        return Tensor(jnp.exp(self._log_p))
+
+    def sample(self, shape: Sequence[int] = ()) -> Tensor:
+        out = jax.random.categorical(
+            _rng.next_key(), self.logits, shape=_shape(shape, self.batch_shape)
+        )
+        return Tensor(out)
+
+    def log_prob(self, value: Any) -> Tensor:
+        idx = jnp.asarray(
+            value._data if isinstance(value, Tensor) else value, jnp.int32
+        )
+        return Tensor(jnp.take_along_axis(self._log_p, idx[..., None], axis=-1)[..., 0])
+
+    def entropy(self) -> Tensor:
+        p = jnp.exp(self._log_p)
+        return Tensor(-(p * self._log_p).sum(-1))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs: Any, name: Optional[str] = None) -> None:
+        self.probs_ = jnp.clip(_arr(probs), 1e-7, 1 - 1e-7)
+        super().__init__(self.probs_.shape)
+
+    @property
+    def mean(self) -> Tensor:
+        return Tensor(self.probs_)
+
+    @property
+    def variance(self) -> Tensor:
+        return Tensor(self.probs_ * (1 - self.probs_))
+
+    def sample(self, shape: Sequence[int] = ()) -> Tensor:
+        u = jax.random.bernoulli(
+            _rng.next_key(), self.probs_, _shape(shape, self.batch_shape)
+        )
+        return Tensor(u.astype(jnp.float32))
+
+    def log_prob(self, value: Any) -> Tensor:
+        v = _arr(value)
+        return Tensor(v * jnp.log(self.probs_) + (1 - v) * jnp.log(1 - self.probs_))
+
+    def entropy(self) -> Tensor:
+        p = self.probs_
+        return Tensor(-(p * jnp.log(p) + (1 - p) * jnp.log(1 - p)))
+
+
+class Exponential(Distribution):
+    def __init__(self, rate: Any, name: Optional[str] = None) -> None:
+        self.rate = _arr(rate)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self) -> Tensor:
+        return Tensor(1.0 / self.rate)
+
+    @property
+    def variance(self) -> Tensor:
+        return Tensor(1.0 / self.rate**2)
+
+    def sample(self, shape: Sequence[int] = ()) -> Tensor:
+        e = jax.random.exponential(_rng.next_key(), _shape(shape, self.batch_shape))
+        return Tensor(e / self.rate)
+
+    rsample = sample
+
+    def log_prob(self, value: Any) -> Tensor:
+        v = _arr(value)
+        return Tensor(jnp.where(v >= 0, jnp.log(self.rate) - self.rate * v, -jnp.inf))
+
+    def entropy(self) -> Tensor:
+        return Tensor(1.0 - jnp.log(self.rate))
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration: Any, rate: Any, name: Optional[str] = None) -> None:
+        self.concentration = _arr(concentration)
+        self.rate = _arr(rate)
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape, self.rate.shape))
+
+    @property
+    def mean(self) -> Tensor:
+        return Tensor(self.concentration / self.rate)
+
+    @property
+    def variance(self) -> Tensor:
+        return Tensor(self.concentration / self.rate**2)
+
+    def sample(self, shape: Sequence[int] = ()) -> Tensor:
+        g = jax.random.gamma(
+            _rng.next_key(), self.concentration, _shape(shape, self.batch_shape)
+        )
+        return Tensor(g / self.rate)
+
+    def log_prob(self, value: Any) -> Tensor:
+        v = _arr(value)
+        a, b = self.concentration, self.rate
+        return Tensor(
+            a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v - jax.lax.lgamma(a)
+        )
+
+
+class Laplace(Distribution):
+    def __init__(self, loc: Any, scale: Any, name: Optional[str] = None) -> None:
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self) -> Tensor:
+        return Tensor(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self) -> Tensor:
+        return Tensor(jnp.broadcast_to(2 * self.scale**2, self.batch_shape))
+
+    def sample(self, shape: Sequence[int] = ()) -> Tensor:
+        s = jax.random.laplace(_rng.next_key(), _shape(shape, self.batch_shape))
+        return Tensor(self.loc + self.scale * s)
+
+    rsample = sample
+
+    def log_prob(self, value: Any) -> Tensor:
+        v = _arr(value)
+        return Tensor(-jnp.abs(v - self.loc) / self.scale - jnp.log(2 * self.scale))
+
+    def entropy(self) -> Tensor:
+        return Tensor(jnp.broadcast_to(1 + jnp.log(2 * self.scale), self.batch_shape))
+
+
+def kl_divergence(p: Distribution, q: Distribution) -> Tensor:
+    """Pairwise KL (reference ``distribution/kl.py`` register_kl)."""
+    if isinstance(p, Normal) and isinstance(q, Normal):
+        var_ratio = (p.scale / q.scale) ** 2
+        t1 = ((p.loc - q.loc) / q.scale) ** 2
+        return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+    if isinstance(p, Categorical) and isinstance(q, Categorical):
+        pp = jnp.exp(p._log_p)
+        return Tensor((pp * (p._log_p - q._log_p)).sum(-1))
+    if isinstance(p, Bernoulli) and isinstance(q, Bernoulli):
+        a, b = p.probs_, q.probs_
+        return Tensor(
+            a * (jnp.log(a) - jnp.log(b)) + (1 - a) * (jnp.log(1 - a) - jnp.log(1 - b))
+        )
+    if isinstance(p, Uniform) and isinstance(q, Uniform):
+        return Tensor(jnp.log((q.high - q.low) / (p.high - p.low)))
+    if isinstance(p, Exponential) and isinstance(q, Exponential):
+        r = p.rate / q.rate
+        return Tensor(jnp.log(r) + 1.0 / r - 1.0)
+    raise NotImplementedError(
+        f"kl_divergence not registered for ({type(p).__name__}, {type(q).__name__})"
+    )

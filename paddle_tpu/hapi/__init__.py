@@ -1,0 +1,4 @@
+"""High-level training API (reference ``python/paddle/hapi``)."""
+
+from paddle_tpu.hapi.model import Model  # noqa: F401
+from paddle_tpu.hapi.callbacks import Callback, EarlyStopping, LRScheduler  # noqa: F401

@@ -1,0 +1,97 @@
+"""Training callbacks (reference ``python/paddle/hapi/callbacks.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+__all__ = ["Callback", "EarlyStopping", "LRScheduler", "ProgBarLogger"]
+
+
+class Callback:
+    def set_model(self, model: Any) -> None:
+        self.model = model
+
+    def on_train_begin(self, logs: Optional[Dict] = None) -> None: ...
+    def on_train_end(self, logs: Optional[Dict] = None) -> None: ...
+    def on_epoch_begin(self, epoch: int, logs: Optional[Dict] = None) -> None: ...
+    def on_epoch_end(self, epoch: int, logs: Optional[Dict] = None) -> None: ...
+    def on_train_batch_begin(self, step: int, logs: Optional[Dict] = None) -> None: ...
+    def on_train_batch_end(self, step: int, logs: Optional[Dict] = None) -> None: ...
+    def on_eval_begin(self, logs: Optional[Dict] = None) -> None: ...
+    def on_eval_end(self, logs: Optional[Dict] = None) -> None: ...
+
+
+class EarlyStopping(Callback):
+    def __init__(
+        self,
+        monitor: str = "loss",
+        mode: str = "auto",
+        patience: int = 0,
+        min_delta: float = 0.0,
+        baseline: Optional[float] = None,
+        save_best_model: bool = True,
+    ) -> None:
+        self.monitor = monitor
+        self.patience = patience
+        self.min_delta = abs(min_delta)
+        self.wait = 0
+        self.best: Optional[float] = baseline
+        self.stopped_epoch = 0
+        self.stop_training = False
+        if mode == "auto":
+            mode = "max" if "acc" in monitor else "min"
+        self.mode = mode
+
+    def _better(self, cur: float) -> bool:
+        if self.best is None:
+            return True
+        if self.mode == "min":
+            return cur < self.best - self.min_delta
+        return cur > self.best + self.min_delta
+
+    def on_eval_end(self, logs: Optional[Dict] = None) -> None:
+        logs = logs or {}
+        cur = logs.get(self.monitor)
+        if cur is None:
+            return
+        cur = float(cur[0] if isinstance(cur, (list, tuple)) else cur)
+        if self._better(cur):
+            self.best = cur
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.stop_training = True
+
+
+class LRScheduler(Callback):
+    def __init__(self, by_step: bool = True, by_epoch: bool = False) -> None:
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+
+    def _sched(self):
+        from paddle_tpu.optimizer.lr import LRScheduler as Sched
+
+        opt = getattr(self.model, "_optimizer", None)
+        lr = getattr(opt, "_learning_rate", None)
+        return lr if isinstance(lr, Sched) else None
+
+    def on_train_batch_end(self, step: int, logs: Optional[Dict] = None) -> None:
+        if self.by_step and (s := self._sched()) is not None:
+            s.step()
+
+    def on_epoch_end(self, epoch: int, logs: Optional[Dict] = None) -> None:
+        if self.by_epoch and (s := self._sched()) is not None:
+            s.step()
+
+
+class ProgBarLogger(Callback):
+    def __init__(self, log_freq: int = 10, verbose: int = 1) -> None:
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_train_batch_end(self, step: int, logs: Optional[Dict] = None) -> None:
+        if self.verbose and step % self.log_freq == 0:
+            items = ", ".join(f"{k}: {v:.4f}" if isinstance(v, float) else f"{k}: {v}"
+                              for k, v in (logs or {}).items())
+            print(f"step {step} - {items}")

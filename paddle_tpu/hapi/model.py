@@ -1,0 +1,199 @@
+"""hapi Model: fit / evaluate / predict.
+
+Reference: ``python/paddle/hapi/model.py`` — the Keras-style facade over a
+Layer: ``prepare(optimizer, loss, metrics)`` then ``fit``/``evaluate``/
+``predict``/``save``/``load``. The train step runs under ``jit.to_static``
+(one compiled XLA program per shape signature) — the hapi path gets the
+compiled-executor behavior the reference gets from static graphs.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+import paddle_tpu
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.hapi.callbacks import Callback, ProgBarLogger
+
+__all__ = ["Model"]
+
+
+def _to_batches(data: Any, batch_size: int, shuffle: bool, seed: int = 0):
+    """Accept a DataLoader-like iterable or an (inputs, labels) array pair.
+    The range stop drops any last partial batch (keeps one compiled shape)
+    while a dataset smaller than one batch still yields once."""
+    if hasattr(data, "__iter__") and not isinstance(data, (tuple, list)):
+        yield from data
+        return
+    xs, ys = data
+    xs = np.asarray(xs)
+    ys = np.asarray(ys)
+    n = xs.shape[0]
+    idx = np.arange(n)
+    if shuffle:
+        np.random.default_rng(seed).shuffle(idx)
+    for i in range(0, n - n % batch_size or n, batch_size):
+        sel = idx[i : i + batch_size]
+        yield xs[sel], ys[sel]
+
+
+class Model:
+    def __init__(self, network: Any, inputs: Any = None, labels: Any = None) -> None:
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Any] = []
+        self._train_step = None
+
+    def prepare(
+        self,
+        optimizer: Any = None,
+        loss: Any = None,
+        metrics: Any = None,
+        amp_configs: Any = None,
+    ) -> None:
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = list(metrics) if metrics is not None else []
+
+        net, opt, loss_fn = self.network, optimizer, loss
+
+        @paddle_tpu.jit.to_static
+        def train_step(net: Any, opt: Any, x: Tensor, y: Tensor) -> Tensor:
+            out = net(x)
+            l = loss_fn(out, y)
+            l.backward()
+            opt.step()
+            opt.clear_grad()
+            return l
+
+        self._train_step = train_step
+
+    # -- training ----------------------------------------------------------
+    def fit(
+        self,
+        train_data: Any = None,
+        eval_data: Any = None,
+        batch_size: int = 1,
+        epochs: int = 1,
+        eval_freq: int = 1,
+        log_freq: int = 10,
+        save_dir: Optional[str] = None,
+        shuffle: bool = True,
+        verbose: int = 1,
+        callbacks: Optional[Sequence[Callback]] = None,
+    ) -> Dict[str, List[float]]:
+        assert self._optimizer is not None, "call prepare() first"
+        import types
+
+        if isinstance(train_data, types.GeneratorType):
+            if epochs > 1:
+                # a generator is one-shot: epochs 2..N would silently train
+                # zero batches — materialize once instead
+                train_data = list(train_data)
+        cbs = list(callbacks or [])
+        if verbose and not any(isinstance(cb, ProgBarLogger) for cb in cbs):
+            cbs.append(ProgBarLogger(log_freq=log_freq, verbose=verbose))
+        for cb in cbs:
+            cb.set_model(self)
+            cb.on_train_begin()
+        history: Dict[str, List[float]] = {"loss": []}
+        step = 0
+        for epoch in range(epochs):
+            for cb in cbs:
+                cb.on_epoch_begin(epoch)
+            self.network.train()
+            epoch_losses = []
+            for bx, by in _to_batches(train_data, batch_size, shuffle, seed=epoch):
+                x = paddle_tpu.to_tensor(bx)
+                y = paddle_tpu.to_tensor(by)
+                loss = self._train_step(self.network, self._optimizer, x, y)
+                lval = float(loss)
+                epoch_losses.append(lval)
+                step += 1
+                for cb in cbs:
+                    cb.on_train_batch_end(step, {"loss": lval})
+            history["loss"].append(float(np.mean(epoch_losses)) if epoch_losses else 0.0)
+            logs: Dict[str, Any] = {"loss": history["loss"][-1]}
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_data, batch_size=batch_size, verbose=0)
+                logs.update(eval_logs)
+                for cb in cbs:
+                    cb.on_eval_end(logs)
+            for cb in cbs:
+                cb.on_epoch_end(epoch, logs)
+            if any(getattr(cb, "stop_training", False) for cb in cbs):
+                break
+            if save_dir:
+                self.save(os.path.join(save_dir, f"epoch_{epoch}"))
+        for cb in cbs:
+            cb.on_train_end()
+        return history
+
+    def evaluate(
+        self, eval_data: Any, batch_size: int = 1, log_freq: int = 10, verbose: int = 1,
+        callbacks: Any = None,
+    ) -> Dict[str, float]:
+        self.network.eval()
+        losses = []
+        for m in self._metrics:
+            m.reset()
+        with paddle_tpu.no_grad():
+            for bx, by in _to_batches(eval_data, batch_size, shuffle=False):
+                x = paddle_tpu.to_tensor(bx)
+                y = paddle_tpu.to_tensor(by)
+                out = self.network(x)
+                if self._loss is not None:
+                    losses.append(float(self._loss(out, y)))
+                for m in self._metrics:
+                    outs = m.compute(out, y) if hasattr(m, "compute") else (out, y)
+                    if isinstance(outs, (tuple, list)):
+                        m.update(*outs)
+                    else:
+                        m.update(outs)
+        logs: Dict[str, float] = {}
+        if losses:
+            logs["eval_loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            logs[f"eval_{m.name()}"] = m.accumulate()
+        return logs
+
+    def predict(self, test_data: Any, batch_size: int = 1, **kw: Any) -> List[np.ndarray]:
+        self.network.eval()
+        outs = []
+        with paddle_tpu.no_grad():
+            if hasattr(test_data, "__iter__") and not isinstance(test_data, (tuple, list, np.ndarray)):
+                batches = test_data
+            else:
+                arr = np.asarray(test_data)
+                batches = (arr[i : i + batch_size] for i in range(0, len(arr), batch_size))
+            for bx in batches:
+                if isinstance(bx, (tuple, list)):
+                    bx = bx[0]
+                outs.append(self.network(paddle_tpu.to_tensor(np.asarray(bx))).numpy())
+        return outs
+
+    # -- io ----------------------------------------------------------------
+    def save(self, path: str, training: bool = True) -> None:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        paddle_tpu.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            paddle_tpu.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path: str, skip_mismatch: bool = False, reset_optimizer: bool = False) -> None:
+        self.network.set_state_dict(paddle_tpu.load(path + ".pdparams"))
+        if not reset_optimizer and self._optimizer is not None and os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(paddle_tpu.load(path + ".pdopt"))
+
+    def parameters(self) -> List[Any]:
+        return self.network.parameters()
+
+    def summary(self, input_size: Any = None, dtype: Any = None) -> Dict[str, int]:
+        total = sum(int(np.prod(p.shape)) for p in self.network.parameters())
+        trainable = sum(
+            int(np.prod(p.shape)) for p in self.network.parameters() if not p.stop_gradient
+        )
+        return {"total_params": total, "trainable_params": trainable}

@@ -58,7 +58,8 @@ class _HostEventRecorder:
         try:
             from paddle_tpu.core.native import load_native
 
-            self._native = load_native()
+            # build=False: never compile C++ during `import paddle_tpu`
+            self._native = load_native(build=False)
         except Exception:
             self._native = None
 

@@ -1,0 +1,134 @@
+"""OpTest harness — the reference's op-testing methodology
+(``test/legacy_test/op_test.py:418``): each op test supplies numpy inputs and
+expected outputs; the harness checks eager output, dygraph/jit parity
+(``check_output_with_place:2124`` old-IR/PIR parity analog), and analytic
+gradients against numeric central differences (``check_grad_with_place:3140``)
+with dtype-aware tolerances.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["OpTest"]
+
+_DTYPE_TOL = {
+    "float32": (1e-5, 1e-6),
+    "float64": (1e-7, 1e-8),
+    "bfloat16": (2e-2, 2e-2),
+    "float16": (1e-3, 1e-3),
+}
+
+
+class OpTest:
+    """Subclass and set ``op`` (callable), ``inputs`` (dict name→numpy),
+    ``attrs`` (kwargs), ``expected`` (numpy or callable(numpy inputs)->numpy).
+    """
+
+    op: Optional[Callable] = None
+    inputs: Dict[str, np.ndarray] = {}
+    attrs: Dict[str, Any] = {}
+    expected: Any = None
+
+    # -- helpers -----------------------------------------------------------
+    def _tensors(self) -> Dict[str, Tensor]:
+        return {k: paddle.to_tensor(v) for k, v in self.inputs.items()}
+
+    def _run_op(self, tensors: Dict[str, Tensor]) -> Tensor:
+        out = type(self).op(*tensors.values(), **self.attrs)
+        if isinstance(out, (tuple, list)):
+            out = out[0]
+        return out
+
+    def _expected_np(self) -> np.ndarray:
+        if callable(self.expected):
+            return np.asarray(self.expected(*self.inputs.values()))
+        return np.asarray(self.expected)
+
+    # -- checks (reference parity) ----------------------------------------
+    def check_output(self, rtol: Optional[float] = None, atol: Optional[float] = None) -> None:
+        """Eager output vs the numpy reference, plus eager↔jit parity (the
+        dygraph/static parity axis of the reference harness)."""
+        dtype = str(next(iter(self.inputs.values())).dtype) if self.inputs else "float32"
+        d_rtol, d_atol = _DTYPE_TOL.get(dtype, (1e-5, 1e-6))
+        rtol = rtol if rtol is not None else d_rtol
+        atol = atol if atol is not None else d_atol
+
+        tensors = self._tensors()
+        eager_out = self._run_op(tensors)
+        np.testing.assert_allclose(
+            eager_out.numpy(), self._expected_np(), rtol=rtol, atol=atol,
+            err_msg=f"{type(self).__name__}: eager output mismatch",
+        )
+
+        # jit parity: the same op traced+compiled must agree with eager
+        op = type(self).op
+        attrs = self.attrs
+
+        @paddle.jit.to_static
+        def jit_fn(*ts: Tensor) -> Tensor:
+            out = op(*ts, **attrs)
+            return out[0] if isinstance(out, (tuple, list)) else out
+
+        jit_out = jit_fn(*self._tensors().values())
+        np.testing.assert_allclose(
+            jit_out.numpy(), eager_out.numpy(), rtol=rtol, atol=atol,
+            err_msg=f"{type(self).__name__}: eager vs jit mismatch",
+        )
+
+    def check_grad(
+        self,
+        inputs_to_check: Sequence[str],
+        max_relative_error: float = 5e-3,
+        eps: float = 1e-3,
+        loss_weights: Optional[np.ndarray] = None,
+    ) -> None:
+        """Analytic grads (autograd tape) vs numeric central differences
+        (reference ``check_grad_with_place`` / ``get_numeric_gradient``)."""
+        # analytic
+        tensors = self._tensors()
+        for name in inputs_to_check:
+            tensors[name].stop_gradient = False
+        out = self._run_op(tensors)
+        if loss_weights is None:
+            # random cotangent: a plain sum-loss has zero gradient through
+            # ops with constant row sums (softmax) — the reference supplies
+            # user_defined_grad_outputs for the same reason
+            loss_weights = (
+                np.random.default_rng(1234).normal(size=tuple(out.shape)).astype(np.float32)
+            )
+        w = paddle.to_tensor(loss_weights).astype(out.dtype)
+        (out * w).sum().backward()
+        analytic = {n: tensors[n].grad.numpy().copy() for n in inputs_to_check}
+
+        # numeric central differences on the numpy function
+        wn = np.asarray(w.numpy(), np.float64)
+        for name in inputs_to_check:
+            base = self.inputs[name].astype(np.float64)
+            num = np.zeros_like(base)
+            flat = base.reshape(-1)
+            numf = num.reshape(-1)
+            for i in range(flat.size):
+                for sign in (+1, -1):
+                    pert = dict(self.inputs)
+                    fb = base.copy().reshape(-1)
+                    fb[i] += sign * eps
+                    pert[name] = fb.reshape(base.shape).astype(self.inputs[name].dtype)
+                    ts = {k: paddle.to_tensor(v) for k, v in pert.items()}
+                    val = float(
+                        (self._run_op(ts).numpy().astype(np.float64) * wn).sum()
+                    )
+                    numf[i] += sign * val
+                numf[i] /= 2 * eps
+            a = analytic[name].astype(np.float64)
+            denom = max(np.abs(num).max(), np.abs(a).max(), 1e-8)
+            max_err = np.abs(a - num).max() / denom
+            assert max_err <= max_relative_error, (
+                f"{type(self).__name__}: grad wrt {name}: max relative error "
+                f"{max_err:.2e} > {max_relative_error:.2e}"
+            )

@@ -1,0 +1,195 @@
+"""Aux subsystem tests: hapi Model fit/evaluate/predict, amp.debugging
+(tensor checker + operator stats), distributions."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+class TestHapiModel:
+    def _data(self, n=64):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(n, 8)).astype(np.float32)
+        w = rng.normal(size=(8, 1)).astype(np.float32)
+        y = x @ w + 0.01 * rng.normal(size=(n, 1)).astype(np.float32)
+        return x, y
+
+    def test_fit_reduces_loss(self):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+        model = paddle.Model(net)
+        model.prepare(
+            optimizer=paddle.optimizer.Adam(learning_rate=1e-2, parameters=net.parameters()),
+            loss=nn.MSELoss(),
+        )
+        x, y = self._data()
+        hist = model.fit((x, y), batch_size=16, epochs=15, verbose=0)
+        assert hist["loss"][-1] < hist["loss"][0] * 0.5
+
+    def test_evaluate_and_predict(self):
+        paddle.seed(1)
+        net = nn.Linear(8, 1)
+        model = paddle.Model(net)
+        model.prepare(
+            optimizer=paddle.optimizer.SGD(learning_rate=0.1, parameters=net.parameters()),
+            loss=nn.MSELoss(),
+        )
+        x, y = self._data(32)
+        logs = model.evaluate((x, y), batch_size=16)
+        assert "eval_loss" in logs and np.isfinite(logs["eval_loss"])
+        preds = model.predict(x, batch_size=16)
+        assert sum(p.shape[0] for p in preds) == 32
+
+    def test_save_load_roundtrip(self, tmp_path):
+        paddle.seed(2)
+        net = nn.Linear(8, 1)
+        model = paddle.Model(net)
+        model.prepare(
+            optimizer=paddle.optimizer.Adam(learning_rate=1e-2, parameters=net.parameters()),
+            loss=nn.MSELoss(),
+        )
+        x, y = self._data(32)
+        model.fit((x, y), batch_size=16, epochs=1, verbose=0)
+        path = str(tmp_path / "ckpt")
+        model.save(path)
+
+        paddle.seed(99)
+        net2 = nn.Linear(8, 1)
+        model2 = paddle.Model(net2)
+        model2.prepare(
+            optimizer=paddle.optimizer.Adam(learning_rate=1e-2, parameters=net2.parameters()),
+            loss=nn.MSELoss(),
+        )
+        model2.load(path)
+        np.testing.assert_allclose(net.weight.numpy(), net2.weight.numpy())
+
+    def test_evaluate_with_metrics(self):
+        paddle.seed(5)
+        net = nn.Sequential(nn.Linear(8, 2))
+        model = paddle.Model(net)
+        model.prepare(
+            optimizer=paddle.optimizer.SGD(learning_rate=0.1, parameters=net.parameters()),
+            loss=nn.CrossEntropyLoss(),
+            metrics=[paddle.metric.Accuracy(), paddle.metric.Precision()],
+        )
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(32, 8)).astype(np.float32)
+        y = rng.integers(0, 2, (32, 1)).astype(np.int64)
+        logs = model.evaluate((x, y), batch_size=16)
+        assert "eval_acc" in logs or any("acc" in k for k in logs)
+        assert any("precision" in k for k in logs)
+
+    def test_early_stopping(self):
+        from paddle_tpu.hapi.callbacks import EarlyStopping
+
+        paddle.seed(3)
+        net = nn.Linear(8, 1)
+        model = paddle.Model(net)
+        model.prepare(
+            optimizer=paddle.optimizer.SGD(learning_rate=0.0, parameters=net.parameters()),
+            loss=nn.MSELoss(),
+        )
+        x, y = self._data(32)
+        es = EarlyStopping(monitor="eval_loss", patience=1, mode="min")
+        hist = model.fit((x, y), eval_data=(x, y), batch_size=16, epochs=10,
+                         verbose=0, callbacks=[es])
+        # lr=0 → no improvement → stops well before 10 epochs
+        assert len(hist["loss"]) <= 4
+
+    def test_summary(self):
+        net = nn.Linear(8, 4)
+        info = paddle.Model(net).summary()
+        assert info["total_params"] == 8 * 4 + 4
+
+
+class TestAmpDebugging:
+    def test_tensor_checker_catches_nan(self):
+        from paddle_tpu.amp.debugging import (
+            TensorCheckerConfig,
+            disable_tensor_checker,
+            enable_tensor_checker,
+        )
+
+        enable_tensor_checker(TensorCheckerConfig(enable=True))
+        try:
+            bad = paddle.to_tensor(np.array([1.0, np.nan], np.float32))
+            with pytest.raises(Exception):
+                _ = bad + 1.0
+        finally:
+            disable_tensor_checker()
+
+    def test_check_numerics(self):
+        from paddle_tpu.amp.debugging import DebugMode, check_numerics
+
+        t = paddle.to_tensor(np.array([1.0, np.inf, np.nan], np.float32))
+        n_nan, n_inf = check_numerics(t, "op", "t", DebugMode.CHECK_NAN_INF)
+        assert (n_nan, n_inf) == (1, 1)
+        with pytest.raises(FloatingPointError):
+            check_numerics(t, "op", "t", DebugMode.CHECK_NAN_INF_AND_ABORT)
+
+    def test_operator_stats(self, capsys):
+        from paddle_tpu.amp.debugging import collect_operator_stats
+
+        with collect_operator_stats():
+            a = paddle.randn([4, 4])
+            _ = paddle.matmul(a, a)
+            _ = a + a
+        out = capsys.readouterr().out
+        assert "float32" in out
+
+
+class TestDistributions:
+    def test_normal(self):
+        from paddle_tpu.distribution import Normal
+
+        paddle.seed(0)
+        d = Normal(loc=1.0, scale=2.0)
+        s = d.sample([20000])
+        assert abs(float(s.numpy().mean()) - 1.0) < 0.1
+        assert abs(float(s.numpy().std()) - 2.0) < 0.1
+        lp = d.log_prob(1.0)
+        expect = -np.log(2.0) - 0.5 * np.log(2 * np.pi)
+        np.testing.assert_allclose(float(lp.numpy()), expect, rtol=1e-5)
+
+    def test_categorical(self):
+        from paddle_tpu.distribution import Categorical
+
+        paddle.seed(1)
+        d = Categorical(logits=np.log(np.array([0.7, 0.2, 0.1], np.float32)))
+        s = d.sample([10000]).numpy()
+        freq = np.bincount(s, minlength=3) / 10000
+        np.testing.assert_allclose(freq, [0.7, 0.2, 0.1], atol=0.03)
+        np.testing.assert_allclose(float(d.log_prob(0).numpy()), np.log(0.7), rtol=1e-4)
+
+    def test_kl_normal(self):
+        from paddle_tpu.distribution import Normal, kl_divergence
+
+        p = Normal(0.0, 1.0)
+        q = Normal(1.0, 2.0)
+        kl = float(kl_divergence(p, q).numpy())
+        expect = np.log(2.0) + (1 + 1) / (2 * 4) - 0.5
+        np.testing.assert_allclose(kl, expect, rtol=1e-5)
+
+    def test_bernoulli_uniform_exponential(self):
+        from paddle_tpu.distribution import Bernoulli, Exponential, Uniform
+
+        paddle.seed(2)
+        b = Bernoulli(0.3)
+        assert abs(float(b.sample([10000]).numpy().mean()) - 0.3) < 0.03
+        u = Uniform(0.0, 4.0)
+        assert abs(float(u.sample([10000]).numpy().mean()) - 2.0) < 0.1
+        e = Exponential(2.0)
+        assert abs(float(e.sample([10000]).numpy().mean()) - 0.5) < 0.05
+        assert float(u.entropy().numpy()) == pytest.approx(np.log(4.0))
+
+    def test_gamma_laplace_logprob(self):
+        from paddle_tpu.distribution import Gamma, Laplace
+
+        g = Gamma(2.0, 3.0)
+        # log p(x) = a log b + (a-1) log x - b x - lgamma(a), at x=1
+        expect = 2 * np.log(3.0) + 0.0 - 3.0 - 0.0
+        np.testing.assert_allclose(float(g.log_prob(1.0).numpy()), expect, rtol=1e-5)
+        l = Laplace(0.0, 1.0)
+        np.testing.assert_allclose(float(l.log_prob(0.0).numpy()), -np.log(2.0), rtol=1e-5)

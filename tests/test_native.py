@@ -34,17 +34,31 @@ class TestTCPStoreNative:
         assert client.get("from_master") == b"y2"
         assert master.add("ranks", 1) + client.add("ranks", 1) == 3  # 1 then 2
 
-    def test_multiprocess_rendezvous(self):
+    def test_multiprocess_rendezvous(self, tmp_path):
         """The reference pattern (test_collective_api_base.py:228): spawn real
-        subprocesses rendezvousing over loopback."""
-        port = 29619
+        subprocesses rendezvousing over loopback. Rank 0 binds an EPHEMERAL
+        port (no fixed-port collisions with stale runs) and publishes it via
+        a file rank 1 polls."""
+        port_file = str(tmp_path / "port")
         worker = textwrap.dedent(
             f"""
-            import sys
+            import os, sys, time
             sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
             from paddle_tpu.distributed.store import TCPStore
             rank = int(sys.argv[1])
-            store = TCPStore("127.0.0.1", {port}, is_master=(rank == 0), world_size=2)
+            port_file = {port_file!r}
+            if rank == 0:
+                store = TCPStore("127.0.0.1", 0, is_master=True, world_size=2, timeout=50)
+                with open(port_file + ".tmp", "w") as f:
+                    f.write(str(store.port))
+                os.rename(port_file + ".tmp", port_file)
+            else:
+                for _ in range(500):
+                    if os.path.exists(port_file):
+                        break
+                    time.sleep(0.1)
+                port = int(open(port_file).read())
+                store = TCPStore("127.0.0.1", port, is_master=False, world_size=2, timeout=50)
             store.set(f"rank{{rank}}", f"payload-{{rank}}".encode())
             # each rank waits for the OTHER rank's key (cross-process block)
             other = store.get(f"rank{{1 - rank}}")
@@ -63,7 +77,12 @@ class TestTCPStoreNative:
             )
             for r in (0, 1)
         ]
-        outs = [p.communicate(timeout=60)[0].decode() for p in procs]
+        try:
+            outs = [p.communicate(timeout=60)[0].decode() for p in procs]
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
         for r, (p, out) in enumerate(zip(procs, outs)):
             assert p.returncode == 0, f"rank {r} failed:\n{out}"
         assert "rank 0 ok" in outs[0] and "rank 1 ok" in outs[1]
@@ -86,6 +105,20 @@ class TestTCPStoreEdgeCases:
         with pytest.raises(TimeoutError):
             store.get("never-set")
         assert time.time() - t0 < 5
+
+    def test_hostname_resolution(self):
+        store = TCPStore("127.0.0.1", 0, is_master=True)
+        store.set("h", b"1")
+        client = TCPStore("localhost", store.port, is_master=False)
+        assert client.get("h") == b"1"
+
+    def test_add_stores_decimal_ascii(self):
+        # torch/paddle convention AND identical to the python fallback
+        store = TCPStore("127.0.0.1", 0, is_master=True)
+        store.add("n", 7)
+        assert store.get("n") == b"7"
+        store.add("n", 3)
+        assert int(store.get("n")) == 10
 
     def test_client_port_zero_rejected(self):
         with pytest.raises(ValueError):

@@ -1,0 +1,181 @@
+"""Op tests on the OpTest harness (reference test/legacy_test/test_*_op.py
+pattern): numpy references, analytic-vs-numeric grads, eager/jit parity."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from op_test import OpTest
+
+
+def _rand(*shape, seed=0, scale=1.0):
+    rng = np.random.default_rng(seed)
+    return (rng.normal(size=shape) * scale).astype(np.float32)
+
+
+class TestMatmulOp(OpTest):
+    op = staticmethod(paddle.matmul)
+    inputs = {"x": _rand(3, 4, seed=1), "y": _rand(4, 5, seed=2)}
+    expected = staticmethod(lambda x, y: x @ y)
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x", "y"])
+
+
+class TestMatmulTransposeOp(OpTest):
+    op = staticmethod(paddle.matmul)
+    inputs = {"x": _rand(3, 4, seed=3), "y": _rand(5, 4, seed=4)}
+    attrs = {"transpose_y": True}
+    expected = staticmethod(lambda x, y: x @ y.T)
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x", "y"])
+
+
+class TestSoftmaxOp(OpTest):
+    op = staticmethod(F.softmax)
+    inputs = {"x": _rand(4, 8, seed=5)}
+
+    @staticmethod
+    def expected(x):
+        e = np.exp(x - x.max(-1, keepdims=True))
+        return e / e.sum(-1, keepdims=True)
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x"])
+
+
+class TestTanhOp(OpTest):
+    op = staticmethod(paddle.tanh)
+    inputs = {"x": _rand(3, 7, seed=6)}
+    expected = staticmethod(np.tanh)
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x"])
+
+
+class TestSigmoidOp(OpTest):
+    op = staticmethod(F.sigmoid)
+    inputs = {"x": _rand(2, 9, seed=7)}
+    expected = staticmethod(lambda x: 1 / (1 + np.exp(-x)))
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x"])
+
+
+class TestGeluOp(OpTest):
+    op = staticmethod(F.gelu)
+    inputs = {"x": _rand(3, 5, seed=8)}
+
+    @staticmethod
+    def expected(x):
+        from scipy.special import erf  # type: ignore
+
+        return 0.5 * x * (1 + erf(x / np.sqrt(2)))
+
+    def test(self):
+        try:
+            import scipy  # noqa: F401
+        except ImportError:
+            pytest.skip("scipy not available")
+        self.check_output()
+        self.check_grad(["x"])
+
+
+class TestReduceSumOp(OpTest):
+    op = staticmethod(paddle.sum)
+    inputs = {"x": _rand(3, 4, 5, seed=9)}
+    attrs = {"axis": 1}
+    expected = staticmethod(lambda x: x.sum(1))
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x"])
+
+
+class TestMeanOp(OpTest):
+    op = staticmethod(paddle.mean)
+    inputs = {"x": _rand(6, 3, seed=10)}
+    expected = staticmethod(lambda x: x.mean())
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x"])
+
+
+class TestLayerNormOp(OpTest):
+    op = staticmethod(F.layer_norm)
+    inputs = {
+        "x": _rand(4, 16, seed=11),
+        "w": np.ones(16, np.float32) + _rand(16, seed=12, scale=0.1),
+        "b": _rand(16, seed=13, scale=0.1),
+    }
+    attrs = {"normalized_shape": 16}
+
+    @staticmethod
+    def op_wrapper(x, w, b, normalized_shape):
+        return F.layer_norm(x, normalized_shape, weight=w, bias=b)
+
+    op = staticmethod(op_wrapper.__func__)
+
+    @staticmethod
+    def expected(x, w, b):
+        mu = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        return (x - mu) / np.sqrt(var + 1e-5) * w + b
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x", "w", "b"], max_relative_error=1e-2)
+
+
+class TestLogSoftmaxOp(OpTest):
+    op = staticmethod(F.log_softmax)
+    inputs = {"x": _rand(3, 6, seed=14)}
+
+    @staticmethod
+    def expected(x):
+        m = x.max(-1, keepdims=True)
+        return x - m - np.log(np.exp(x - m).sum(-1, keepdims=True))
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x"])
+
+
+class TestExpOp(OpTest):
+    op = staticmethod(paddle.exp)
+    inputs = {"x": _rand(4, 4, seed=15, scale=0.5)}
+    expected = staticmethod(np.exp)
+
+    def test(self):
+        self.check_output()
+        self.check_grad(["x"])
+
+
+class TestBF16Output(OpTest):
+    """dtype-aware tolerance path (reference bf16 op tests)."""
+
+    op = staticmethod(paddle.matmul)
+    inputs = {
+        "x": _rand(4, 8, seed=16).astype("float32"),
+        "y": _rand(8, 4, seed=17).astype("float32"),
+    }
+
+    def test(self):
+        import jax.numpy as jnp
+
+        x = paddle.to_tensor(self.inputs["x"]).astype("bfloat16")
+        y = paddle.to_tensor(self.inputs["y"]).astype("bfloat16")
+        out = paddle.matmul(x, y)
+        assert str(out.dtype).endswith("bfloat16")
+        ref = self.inputs["x"] @ self.inputs["y"]
+        np.testing.assert_allclose(
+            out.astype("float32").numpy(), ref, rtol=3e-2, atol=3e-2
+        )
