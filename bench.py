@@ -13,6 +13,7 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 import numpy as np
@@ -20,17 +21,137 @@ import numpy as np
 BASELINE_TOKENS_PER_SEC_PER_CHIP = 2500.0
 
 
+def _fail_json(error: str) -> None:
+    """One parseable failure line on stdout — the driver records stdout
+    verbatim, so every exit path must leave a JSON record."""
+    print(
+        json.dumps(
+            {
+                "metric": "llama_train_tokens_per_sec_per_chip",
+                "value": 0.0,
+                "unit": "tokens/s/chip",
+                "vs_baseline": 0.0,
+                "error": error[:500],
+            }
+        ),
+        flush=True,
+    )
+
+
 def _count_params(model) -> int:
     return int(sum(int(np.prod(p.shape)) for p in model.parameters()))
 
 
-def main() -> None:
+def _preflight_pallas(platform: str, cfg, seq: int) -> None:
+    """Kill-switch: statically verify each gated Pallas kernel lowers for the
+    target platform at the EXACT shapes the bench will compile, BEFORE it is
+    baked into the jitted train step (a Mosaic lowering error inside jit is
+    uncatchable there and would cost the whole bench run — BENCH_r02 died
+    exactly this way). A failing kernel flips only its own FLAGS_use_pallas_*
+    off; the XLA fallback path covers it."""
+    import paddle_tpu as paddle
+
+    if platform != "tpu":
+        return
     import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.kernels.flash_attention import flash_attention_pallas
+    from paddle_tpu.kernels.fused import fused_rms_norm_pallas, fused_rope_pallas
+
+    hd = cfg.hidden_size // cfg.num_attention_heads
+
+    def check(name: str, flag: str, fn, *args) -> None:
+        try:
+            jax.export.export(jax.jit(fn), platforms=["tpu"])(*args)
+            print(f"bench: pallas preflight ok: {name}", file=sys.stderr)
+        except Exception as exc:  # noqa: BLE001
+            print(
+                f"bench: pallas preflight FAILED ({name}), disabling {flag}: {exc!r}"[:2000],
+                file=sys.stderr,
+            )
+            paddle.set_flags({flag: False})
+
+    q = jnp.zeros((1, seq, cfg.num_attention_heads, hd), jnp.bfloat16)
+    kv = jnp.zeros((1, seq, cfg.num_key_value_heads, hd), jnp.bfloat16)
+    check(
+        "flash_attention",
+        "FLAGS_use_pallas_attention",
+        # grad wrt q AND k/v: the backward runs as two pallas_calls (dq, dkv)
+        # and an unused dkv cotangent would let DCE prune the second kernel
+        # out before Mosaic lowering ever checked it
+        lambda q, k, v: jax.grad(
+            lambda q, k, v: flash_attention_pallas(q, k, v, causal=True)
+            .astype(jnp.float32)
+            .sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v),
+        q, kv, kv,
+    )
+    x = jnp.zeros((2, seq, cfg.hidden_size), jnp.bfloat16)
+    w = jnp.zeros((cfg.hidden_size,), jnp.bfloat16)
+    rope_x = jnp.zeros((1, seq, cfg.num_attention_heads, hd), jnp.bfloat16)
+    cs = jnp.zeros((1, seq, 1, hd), jnp.float32)
+    # rope has no custom VJP: its grad fails at TRACE time, which the eager
+    # warn_fallback try/except already catches — only Mosaic lowering of the
+    # forward is uncatchable, so that is what the preflight must cover.
+    check(
+        "fused_rms_norm+rope",
+        "FLAGS_use_pallas_fused",
+        lambda x, w, rx, c, s: (
+            jax.grad(lambda x: fused_rms_norm_pallas(x, w, 1e-6).astype(jnp.float32).sum())(x),
+            fused_rope_pallas(rx, c, s),
+        ),
+        x, w, rope_x, cs, cs,
+    )
+
+
+def _resolve_backend() -> str:
+    """Initialize the jax backend with two defenses: (a) the lab site-hook
+    overrides the ``JAX_PLATFORMS`` env var, so an explicit ``cpu`` request is
+    re-applied through ``jax.config`` (the call that actually sticks); (b) a
+    hung accelerator tunnel blocks backend init forever — a watchdog turns
+    that into a diagnostic JSON line instead of a silent lost round."""
+    import os
+    import threading
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    result: dict = {}
+
+    def probe() -> None:
+        try:
+            result["platform"] = jax.default_backend()
+            result["n"] = len(jax.devices())
+        except Exception as exc:  # noqa: BLE001
+            result["error"] = f"{type(exc).__name__}: {exc}"
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout=float(os.environ.get("BENCH_BACKEND_TIMEOUT", "180")))
+    if "platform" not in result:
+        _fail_json(
+            result.get(
+                "error",
+                "jax backend initialization timed out (accelerator tunnel down?)",
+            )
+        )
+        sys.stderr.flush()
+        os._exit(1)  # the hung probe thread would block a normal exit
+    print(f"bench: platform={result['platform']} devices={result['n']}", file=sys.stderr)
+    return result["platform"]
+
+
+def main() -> None:
+    # backend watchdog must run before `import paddle_tpu` — the framework
+    # import itself touches the backend, which hangs if the tunnel is down
+    platform = _resolve_backend()
 
     import paddle_tpu as paddle
     from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
-
-    platform = jax.default_backend()
     if platform == "tpu":
         # ~0.5B params: Llama proportions scaled to fit one v5e chip (16G)
         # with fp32 master weights + AdamW moments; per-layer recompute keeps
@@ -50,6 +171,7 @@ def main() -> None:
         cfg = LlamaConfig.tiny()
         batch, seq, steps, warmup = 2, 128, 3, 1
 
+    _preflight_pallas(platform, cfg, seq)
     paddle.seed(0)
     model = LlamaForCausalLM(cfg).to(dtype="bfloat16")
     n_params = _count_params(model)
@@ -98,4 +220,11 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as exc:  # noqa: BLE001
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        _fail_json(f"{type(exc).__name__}: {exc}")
+        sys.exit(1)

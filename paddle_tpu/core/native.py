@@ -1,91 +1,11 @@
-"""Loader for the native C++ runtime library (``cpp/`` → ctypes).
+"""Loader for the native C++ runtime library — re-export.
 
-Builds on demand with ``make -C cpp`` when the .so is missing and a toolchain
-exists; every consumer degrades gracefully to its pure-python fallback when
-the library is unavailable.
+The implementation lives in the stdlib-only top-level package
+``paddle_tpu_native`` so that rendezvous-side consumers (launch children,
+TCPStore subprocesses) can load it without importing ``paddle_tpu`` (and
+therefore without touching the jax runtime at all).
 """
 
-from __future__ import annotations
+from paddle_tpu_native.loader import load_native  # noqa: F401
 
-import ctypes
-import os
-import subprocess
-from typing import Optional
-
-_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-_LIB_PATH = os.path.join(_REPO_ROOT, "cpp", "build", "libpaddle_tpu_native.so")
-
-_lib: Optional[ctypes.CDLL] = None
-_tried = False
-
-
-def _build_locked(cpp_dir: str) -> bool:
-    """Run make under an exclusive file lock: concurrent ranks launched
-    together must not interleave compiles into the same build dir."""
-    import fcntl
-
-    os.makedirs(os.path.join(cpp_dir, "build"), exist_ok=True)
-    lock_path = os.path.join(cpp_dir, "build", ".build.lock")
-    try:
-        with open(lock_path, "w") as lock:
-            fcntl.flock(lock, fcntl.LOCK_EX)
-            if os.path.exists(_LIB_PATH):  # another rank built it meanwhile
-                return True
-            subprocess.run(
-                ["make", "-C", cpp_dir], check=True, capture_output=True, timeout=120
-            )
-            return True
-    except Exception:
-        return False
-
-
-def load_native(build: bool = True) -> Optional[ctypes.CDLL]:
-    """The native lib; with ``build=True`` compiles it on first use (under a
-    cross-process lock). ``build=False`` only loads an existing .so — used by
-    import-time consumers (profiler) so ``import paddle_tpu`` never blocks on
-    a compile."""
-    global _lib, _tried
-    if _lib is not None:
-        return _lib
-    if _tried and (not build or os.path.exists(_LIB_PATH)):
-        return _lib
-    if not os.path.exists(_LIB_PATH):
-        if not build:
-            return None
-        _tried = True
-        cpp_dir = os.path.join(_REPO_ROOT, "cpp")
-        if not os.path.isdir(cpp_dir) or not _build_locked(cpp_dir):
-            return None
-    _tried = True
-    try:
-        lib = ctypes.CDLL(_LIB_PATH)
-    except OSError:
-        return None
-    # tcp store
-    lib.tcpstore_master_start.restype = ctypes.c_void_p
-    lib.tcpstore_master_start.argtypes = [ctypes.c_int]
-    lib.tcpstore_master_port.restype = ctypes.c_int
-    lib.tcpstore_master_port.argtypes = [ctypes.c_void_p]
-    lib.tcpstore_master_stop.argtypes = [ctypes.c_void_p]
-    lib.tcpstore_connect.restype = ctypes.c_int
-    lib.tcpstore_connect.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
-    lib.tcpstore_set.restype = ctypes.c_int
-    lib.tcpstore_set.argtypes = [ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
-    lib.tcpstore_get.restype = ctypes.c_int
-    lib.tcpstore_get.argtypes = [
-        ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int
-    ]
-    lib.tcpstore_add.restype = ctypes.c_int64
-    lib.tcpstore_add.argtypes = [ctypes.c_int, ctypes.c_char_p, ctypes.c_int64]
-    lib.tcpstore_wait.restype = ctypes.c_int
-    lib.tcpstore_wait.argtypes = [ctypes.c_int, ctypes.c_char_p, ctypes.c_int]
-    lib.tcpstore_close.argtypes = [ctypes.c_int]
-    # host tracer
-    lib.het_enable.argtypes = [ctypes.c_int]
-    lib.het_enabled.restype = ctypes.c_int
-    lib.het_record.argtypes = [ctypes.c_char_p, ctypes.c_double, ctypes.c_double, ctypes.c_uint64]
-    lib.het_drain_json.restype = ctypes.c_int
-    lib.het_drain_json.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
-    lib.het_count.restype = ctypes.c_int
-    _lib = lib
-    return _lib
+__all__ = ["load_native"]

@@ -23,22 +23,31 @@ class Generator:
     def __init__(self, seed_: int = 0) -> None:
         self._lock = threading.Lock()
         self._seed = int(seed_)
-        self._key = jax.random.PRNGKey(self._seed)
+        # Key creation is deferred: PRNGKey() is a device computation, and a
+        # module-scope Generator would otherwise initialize the jax backend at
+        # `import paddle_tpu` time (hanging imports when the TPU tunnel is
+        # down, even for processes that never run a computation).
+        self._key: Optional[jax.Array] = None
+
+    def _ensure_key(self) -> jax.Array:
+        if self._key is None:
+            self._key = jax.random.PRNGKey(self._seed)
+        return self._key
 
     def manual_seed(self, seed_: int) -> "Generator":
         with self._lock:
             self._seed = int(seed_)
-            self._key = jax.random.PRNGKey(self._seed)
+            self._key = None
         return self
 
     def next_key(self) -> jax.Array:
         with self._lock:
-            self._key, sub = jax.random.split(self._key)
+            self._key, sub = jax.random.split(self._ensure_key())
             return sub
 
     def get_state(self) -> np.ndarray:
         with self._lock:
-            return np.asarray(jax.random.key_data(self._key))
+            return np.asarray(jax.random.key_data(self._ensure_key()))
 
     def set_state(self, state: Any) -> None:
         with self._lock:

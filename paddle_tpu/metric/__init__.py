@@ -66,6 +66,22 @@ class Accuracy(Metric):
         return res[0] if len(res) == 1 else res
 
 
+def _positive_scores(preds: Any, num_labels: int) -> np.ndarray:
+    """Positive-class score per sample (reference
+    ``python/paddle/metric/metrics.py`` Precision/Recall semantics).
+
+    Two-column rows ``[N, 2]`` with exactly N labels are binary-classifier
+    outputs: softmax column 1 is the positive probability (softmax keeps the
+    0.5 threshold equivalent to argmax, so raw logits work too). Anything
+    else is an elementwise positive probability."""
+    p = np.asarray(preds.numpy() if isinstance(preds, Tensor) else preds)
+    if p.ndim >= 2 and p.shape[-1] == 2 and p[..., 0].size == num_labels:
+        shifted = p - p.max(axis=-1, keepdims=True)
+        e = np.exp(shifted)
+        return (e[..., 1] / e.sum(axis=-1)).reshape(-1)
+    return p.reshape(-1)
+
+
 class Precision(Metric):
     def __init__(self, name: Optional[str] = None) -> None:
         super().__init__(name or "precision")
@@ -76,8 +92,8 @@ class Precision(Metric):
         self.fp = 0
 
     def update(self, preds: Any, labels: Any) -> None:
-        p = np.asarray(preds.numpy() if isinstance(preds, Tensor) else preds).reshape(-1)
         l = np.asarray(labels.numpy() if isinstance(labels, Tensor) else labels).reshape(-1)  # noqa: E741
+        p = _positive_scores(preds, l.size)
         pred_pos = (p > 0.5).astype(np.int64)
         self.tp += int(((pred_pos == 1) & (l == 1)).sum())
         self.fp += int(((pred_pos == 1) & (l == 0)).sum())
@@ -96,8 +112,8 @@ class Recall(Metric):
         self.fn = 0
 
     def update(self, preds: Any, labels: Any) -> None:
-        p = np.asarray(preds.numpy() if isinstance(preds, Tensor) else preds).reshape(-1)
         l = np.asarray(labels.numpy() if isinstance(labels, Tensor) else labels).reshape(-1)  # noqa: E741
+        p = _positive_scores(preds, l.size)
         pred_pos = (p > 0.5).astype(np.int64)
         self.tp += int(((pred_pos == 1) & (l == 1)).sum())
         self.fn += int(((pred_pos == 0) & (l == 1)).sum())

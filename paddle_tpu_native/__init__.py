@@ -1,0 +1,15 @@
+"""paddle_tpu_native: stdlib-only bindings to the native C++ runtime.
+
+This package deliberately has NO dependency on jax/numpy or on the
+``paddle_tpu`` package: rendezvous (TCPStore) must work in a process whose
+accelerator runtime is unhealthy or absent (reference keeps its store in
+``paddle/phi/core/distributed/store/`` for the same reason — it is linked
+below the device layer, ``tcp_store.h:121``).
+
+Contents:
+  - ``loader``  — ctypes loader for ``cpp/build/libpaddle_tpu_native.so``
+  - ``store``   — Store / TCPStore rendezvous key-value store
+"""
+
+from paddle_tpu_native.loader import load_native  # noqa: F401
+from paddle_tpu_native.store import Store, TCPStore  # noqa: F401

@@ -1,0 +1,164 @@
+"""TCPStore: the rendezvous key-value store (stdlib-only — no jax/numpy).
+
+Reference: ``paddle/phi/core/distributed/store/tcp_store.h:121`` (master +
+clients over sockets). The data path is the native C++ implementation
+(``cpp/tcp_store.cpp``) via ctypes; an in-process threading fallback keeps the
+single-process API available when no toolchain exists. Used by
+``init_parallel_env`` / launch for exchanging bootstrap blobs before any
+collective backend is up.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+from paddle_tpu_native.loader import load_native
+
+__all__ = ["TCPStore", "Store"]
+
+
+class Store:
+    """Abstract store (reference ``store.h:24``)."""
+
+    def set(self, key: str, value: bytes) -> None:
+        raise NotImplementedError
+
+    def get(self, key: str) -> bytes:
+        raise NotImplementedError
+
+    def add(self, key: str, amount: int) -> int:
+        raise NotImplementedError
+
+    def wait(self, key: str) -> None:
+        raise NotImplementedError
+
+
+class _PyMaster:
+    """Pure-python master fallback (same wire behavior, in-process only)."""
+
+    def __init__(self) -> None:
+        self._kv: Dict[str, bytes] = {}
+        self._counters: Dict[str, int] = {}
+        self._cond = threading.Condition()
+
+    def set(self, key: str, value: bytes) -> None:
+        with self._cond:
+            self._kv[key] = value
+            self._cond.notify_all()
+
+    def get(self, key: str, timeout: float) -> bytes:
+        with self._cond:
+            ok = self._cond.wait_for(lambda: key in self._kv, timeout)
+            if not ok:
+                raise TimeoutError(f"TCPStore.get({key!r}) timed out")
+            return self._kv[key]
+
+    def add(self, key: str, amount: int) -> int:
+        with self._cond:
+            v = self._counters.get(key, 0) + amount
+            self._counters[key] = v
+            self._kv[key] = str(v).encode()
+            self._cond.notify_all()
+            return v
+
+
+class TCPStore(Store):
+    """``TCPStore(host, port, is_master, world_size, timeout)`` parity."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        is_master: bool = False,
+        world_size: int = 1,
+        timeout: float = 300.0,
+    ) -> None:
+        self._lib = load_native()
+        self._timeout = timeout
+        self._master_handle = None
+        self._fd = -1
+        self._py: Optional[_PyMaster] = None
+        self.host = host
+        self.port = port
+
+        if self._lib is not None:
+            if is_master:
+                self._master_handle = self._lib.tcpstore_master_start(port)
+                if not self._master_handle:
+                    raise RuntimeError(f"TCPStore master failed to bind port {port}")
+                # port 0 = kernel-chosen ephemeral port; reflect the real one
+                self.port = port = self._lib.tcpstore_master_port(self._master_handle)
+            elif port == 0:
+                raise ValueError("TCPStore client needs the master's real port (got 0)")
+            self._fd = self._lib.tcpstore_connect(
+                host.encode(), port, int(timeout * 1000)
+            )
+            if self._fd < 0:
+                if self._master_handle:
+                    self._lib.tcpstore_master_stop(self._master_handle)
+                raise RuntimeError(f"TCPStore could not connect to {host}:{port}")
+        else:
+            # in-process fallback: only valid single-process (tests/dev) — a
+            # private map can never rendezvous across processes
+            if world_size > 1 or not is_master:
+                raise RuntimeError(
+                    "native TCPStore unavailable (cpp/ not built) — required "
+                    "for multi-process rendezvous; run `make -C cpp`"
+                )
+            self._py = _PyMaster()
+
+    # -- Store API ---------------------------------------------------------
+    def set(self, key: str, value: Any) -> None:
+        data = value if isinstance(value, bytes) else str(value).encode()
+        if self._py is not None:
+            self._py.set(key, data)
+            return
+        if self._lib.tcpstore_set(self._fd, key.encode(), data, len(data)) != 0:
+            raise RuntimeError(f"TCPStore.set({key!r}) failed")
+
+    def get(self, key: str) -> bytes:
+        if self._py is not None:
+            return self._py.get(key, self._timeout)
+        import ctypes
+
+        cap = 1 << 16
+        timeout_ms = int(self._timeout * 1000)
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.tcpstore_get(self._fd, key.encode(), buf, cap, timeout_ms)
+            if n == -2:
+                cap *= 4
+                continue
+            if n == -3:
+                raise TimeoutError(f"TCPStore.get({key!r}) timed out after {self._timeout}s")
+            if n < 0:
+                raise RuntimeError(f"TCPStore.get({key!r}) failed")
+            return buf.raw[:n]
+
+    def add(self, key: str, amount: int = 1) -> int:
+        if self._py is not None:
+            return self._py.add(key, amount)
+        v = self._lib.tcpstore_add(self._fd, key.encode(), amount)
+        if v < 0 and amount >= 0:
+            raise RuntimeError(f"TCPStore.add({key!r}) failed")
+        return int(v)
+
+    def wait(self, key: str) -> None:
+        if self._py is not None:
+            self._py.get(key, self._timeout)
+            return
+        rc = self._lib.tcpstore_wait(self._fd, key.encode(), int(self._timeout * 1000))
+        if rc == -3:
+            raise TimeoutError(f"TCPStore.wait({key!r}) timed out after {self._timeout}s")
+        if rc != 0:
+            raise RuntimeError(f"TCPStore.wait({key!r}) failed")
+
+    def __del__(self) -> None:
+        try:
+            if self._lib is not None and self._fd >= 0:
+                self._lib.tcpstore_close(self._fd)
+            if self._master_handle:
+                self._lib.tcpstore_master_stop(self._master_handle)
+        except Exception:
+            pass

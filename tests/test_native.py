@@ -44,7 +44,10 @@ class TestTCPStoreNative:
             f"""
             import os, sys, time
             sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
-            from paddle_tpu.distributed.store import TCPStore
+            # rendezvous must never require the ML runtime: the stdlib-only
+            # package is the canonical import for bootstrap-side processes
+            from paddle_tpu_native.store import TCPStore
+            assert "paddle_tpu" not in sys.modules, "store import pulled in the framework"
             rank = int(sys.argv[1])
             port_file = {port_file!r}
             if rank == 0:
@@ -141,13 +144,37 @@ class TestTCPStoreEdgeCases:
 
 class TestTCPStoreFallback:
     def test_python_fallback_api(self, monkeypatch):
-        import paddle_tpu.distributed.store as store_mod
+        import paddle_tpu_native.store as store_mod
 
         monkeypatch.setattr(store_mod, "load_native", lambda: None)
         s = store_mod.TCPStore("127.0.0.1", 0, is_master=True)
         s.set("k", b"v")
         assert s.get("k") == b"v"
         assert s.add("c", 2) == 2
+
+
+class TestStoreRuntimeDecoupling:
+    def test_store_importable_without_framework(self):
+        """Importing the rendezvous store must not import paddle_tpu (and with
+        it the jax runtime) — a child process must be able to rendezvous while
+        the accelerator plugin is unhealthy (round-1 regression: a 60s hang)."""
+        code = (
+            "import sys\n"
+            "import paddle_tpu_native.store as s\n"
+            "assert 'paddle_tpu' not in sys.modules, sorted(m for m in sys.modules if 'paddle' in m)\n"
+            "assert hasattr(s, 'TCPStore')\n"
+            "print('decoupled ok')\n"
+        )
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            timeout=60,
+            env={**os.environ, "PYTHONPATH": repo},
+            cwd=repo,
+        )
+        assert out.returncode == 0, out.stdout.decode() + out.stderr.decode()
+        assert b"decoupled ok" in out.stdout
 
 
 @pytest.mark.skipif(not native_available, reason="native lib not built")
