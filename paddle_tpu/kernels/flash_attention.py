@@ -125,7 +125,11 @@ def _fwd_kernel(
     acc, m, l = jax.lax.fori_loop(0, hi, body, (acc0, m0, l0))
     l = jnp.maximum(l, 1e-30)  # fully-masked rows: avoid 0/0
     o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0, 0] = (m + jnp.log(l))[:, 0]
+    # lse is carried as [B, H, Sq, 1]: a trailing unit lane dim keeps the
+    # block (1, 1, blk_q, 1) Mosaic-legal (sublane blk_q % 8 == 0, lane == 1
+    # equals the array dim) — a bare [B, H, Sq] layout would need an
+    # (·, ·, blk_q) block whose head dim of 1 violates the (8, 128) rule
+    lse_ref[0, 0] = m + jnp.log(l)
 
 
 def _run_fwd(q, k, v, idx, *, sq, sk, scale, causal, blk_q, blk_k, interpret):
@@ -171,11 +175,11 @@ def _run_fwd(q, k, v, idx, *, sq, sk, scale, causal, blk_q, blk_k, interpret):
         in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, blk_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, blk_q), lambda bi, hi, qi: (bi, hi, qi)),
+            pl.BlockSpec((1, 1, blk_q, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, sq_pad, d), q.dtype),
-            jax.ShapeDtypeStruct((b, h, sq_pad), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, sq_pad, 1), jnp.float32),
         ],
         interpret=interpret,
     )(*args)
@@ -194,8 +198,8 @@ def _bwd_dq_kernel(
     qi = pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32)  # [blk_q, D]
     g = g_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0][:, None]
-    delta = delta_ref[0, 0][:, None]
+    lse = lse_ref[0, 0]  # [blk_q, 1]
+    delta = delta_ref[0, 0]
     d = q.shape[-1]
     rows = qi * blk_q + jax.lax.broadcasted_iota(jnp.int32, (blk_q, 1), 0)
 
@@ -250,8 +254,8 @@ def _bwd_dkv_kernel(
         dk, dv = carry
         q = q_ref[0, 0, pl.dslice(qi * blk_q, blk_q), :].astype(jnp.float32)
         g = g_ref[0, 0, pl.dslice(qi * blk_q, blk_q), :].astype(jnp.float32)
-        lse = lse_ref[0, 0, pl.dslice(qi * blk_q, blk_q)][:, None]
-        delta = delta_ref[0, 0, pl.dslice(qi * blk_q, blk_q)][:, None]
+        lse = lse_ref[0, 0, pl.dslice(qi * blk_q, blk_q), :]  # [blk_q, 1]
+        delta = delta_ref[0, 0, pl.dslice(qi * blk_q, blk_q), :]
         rows = qi * blk_q + jax.lax.broadcasted_iota(jnp.int32, (blk_q, 1), 0)
         logits = scale * jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -285,7 +289,10 @@ def _run_bwd(q, k, v, idx, g, out, lse, *, sq, sk, scale, causal, blk_q, blk_k, 
     hk = k.shape[1]
     sk_pad = k.shape[2]
     group = h // hk
-    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)  # [B,H,Sq]
+    # [B, H, Sq, 1] — same trailing-unit-lane layout as lse (Mosaic tiling)
+    delta = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True
+    )
 
     common = dict(sq=sq, sk=sk, scale=scale, causal=causal, blk_q=blk_q, blk_k=blk_k)
 
@@ -313,9 +320,9 @@ def _run_bwd(q, k, v, idx, g, out, lse, *, sq, sk, scale, causal, blk_q, blk_k, 
             num_kv_blocks=sk_pad // blk_k,
         )
     dq_specs += [
-        pl.BlockSpec((1, 1, blk_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),   # g
-        pl.BlockSpec((1, 1, blk_q), lambda bi, hi, qi: (bi, hi, qi)),         # lse
-        pl.BlockSpec((1, 1, blk_q), lambda bi, hi, qi: (bi, hi, qi)),         # delta
+        pl.BlockSpec((1, 1, blk_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),      # g
+        pl.BlockSpec((1, 1, blk_q, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),      # lse
+        pl.BlockSpec((1, 1, blk_q, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),      # delta
     ]
     dq = pl.pallas_call(
         dq_kernel,
@@ -354,9 +361,9 @@ def _run_bwd(q, k, v, idx, g, out, lse, *, sq, sk, scale, causal, blk_q, blk_k, 
             group=group,
         )
     dkv_specs += [
-        pl.BlockSpec((1, 1, sq_pad, d), lambda bi, hi, ki: (bi, hi, 0, 0)),   # g
-        pl.BlockSpec((1, 1, sq_pad), lambda bi, hi, ki: (bi, hi, 0)),         # lse
-        pl.BlockSpec((1, 1, sq_pad), lambda bi, hi, ki: (bi, hi, 0)),         # delta
+        pl.BlockSpec((1, 1, sq_pad, d), lambda bi, hi, ki: (bi, hi, 0, 0)),      # g
+        pl.BlockSpec((1, 1, sq_pad, 1), lambda bi, hi, ki: (bi, hi, 0, 0)),      # lse
+        pl.BlockSpec((1, 1, sq_pad, 1), lambda bi, hi, ki: (bi, hi, 0, 0)),      # delta
     ]
     # per-q-head partial dk/dv, summed over the group afterwards
     dk_h, dv_h = pl.pallas_call(

@@ -30,18 +30,24 @@ def _rms_fwd_kernel(x_ref, w_ref, y_ref, rstd_ref, *, eps):
     rstd_ref[0] = rstd[:, 0]
 
 
-def _rms_bwd_kernel(x_ref, w_ref, rstd_ref, g_ref, dx_ref, dwp_ref, *, eps):
+def _rms_bwd_kernel(x_ref, w_ref, rstd_ref, g_ref, dx_ref, dw_ref, *, eps):
     x = x_ref[0].astype(jnp.float32)
     w = w_ref[...].astype(jnp.float32)
     g = g_ref[0].astype(jnp.float32)
     rstd = rstd_ref[0][:, None]
-    h = x.shape[-1]
     xhat = x * rstd
     gw = g * w[None, :]
     # dx = rstd * (gw - xhat * mean(gw * xhat))
     dot = jnp.mean(gw * xhat, axis=-1, keepdims=True)
     dx_ref[0] = (rstd * (gw - xhat * dot)).astype(dx_ref.dtype)
-    dwp_ref[0] = jnp.sum(g * xhat, axis=0)  # partial dw per row block
+    # dw accumulates into ONE [1, h] block across the sequential TPU grid
+    # (a per-block [nblk, h] partial would need an illegal (1, h) tile:
+    # sublane 1 is neither 8-divisible nor equal to nblk)
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        dw_ref[0] = jnp.zeros_like(dw_ref[0])
+
+    dw_ref[0] += jnp.sum(g * xhat, axis=0)
 
 
 @functools.lru_cache(maxsize=None)
@@ -78,8 +84,7 @@ def _make_rms(rows, h, eps, blk_rows, interpret):
 
     def core_bwd(res, g):
         x, w, rstd = res
-        nblk = rows // blk_rows
-        dx, dw_part = pl.pallas_call(
+        dx, dw = pl.pallas_call(
             functools.partial(_rms_bwd_kernel, eps=eps),
             grid=grid,
             in_specs=[
@@ -90,15 +95,15 @@ def _make_rms(rows, h, eps, blk_rows, interpret):
             ],
             out_specs=[
                 pl.BlockSpec((1, blk_rows, h), lambda i: (0, i, 0)),
-                pl.BlockSpec((1, h), lambda i: (i, 0)),
+                pl.BlockSpec((1, h), lambda i: (0, 0)),
             ],
             out_shape=[
                 jax.ShapeDtypeStruct((1, rows, h), x.dtype),
-                jax.ShapeDtypeStruct((nblk, h), jnp.float32),
+                jax.ShapeDtypeStruct((1, h), jnp.float32),
             ],
             interpret=interpret,
         )(x, w, rstd, g)
-        return dx, dw_part.sum(axis=0).astype(w.dtype)
+        return dx, dw[0].astype(w.dtype)
 
     core.defvjp(core_fwd, core_bwd)
     return core
