@@ -231,6 +231,29 @@ class PipelineLayer(Layer):
             out.extend(self._built[self.segment_parts[part] : self.segment_parts[part + 1]])
         return out
 
+    def build_spmd_executor(
+        self,
+        mesh: Any,
+        num_microbatches: int,
+        axis_name: str = "pp",
+        checkpoint_stages: bool = False,
+    ) -> Any:
+        """The TPU pipeline-parallel path: run this model's decoder region
+        through the scan+ppermute circular executor with stage weights sharded
+        over ``axis_name`` (see ``spmd_pipeline.SpmdPipelineExecutor``).
+        Virtual stages (``num_virtual_pipeline_stages``) become ring laps."""
+        from paddle_tpu.distributed.fleet.meta_parallel.spmd_pipeline import (
+            SpmdPipelineExecutor,
+        )
+
+        return SpmdPipelineExecutor(
+            self,
+            mesh,
+            num_microbatches,
+            axis_name=axis_name,
+            checkpoint_stages=checkpoint_stages,
+        )
+
     # --- execution -----------------------------------------------------
     def _run_one(self, i: int, layer: Any, x: Any) -> Any:
         if i in self._shared_forward:

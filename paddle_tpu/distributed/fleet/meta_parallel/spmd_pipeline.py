@@ -17,6 +17,7 @@ pipelined region (they belong to first/last stages and are small).
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable, List, Optional, Sequence
 
 import jax
@@ -27,7 +28,13 @@ shard_map = getattr(jax, "shard_map", None)
 if shard_map is None:  # pragma: no cover - jax<0.6 fallback
     from jax.experimental.shard_map import shard_map  # type: ignore
 
-__all__ = ["pipeline", "stack_stage_params", "num_pipeline_ticks"]
+__all__ = [
+    "pipeline",
+    "stack_stage_params",
+    "num_pipeline_ticks",
+    "plan_pipeline_region",
+    "SpmdPipelineExecutor",
+]
 
 
 def stack_stage_params(stage_params: Sequence[Any]) -> Any:
@@ -66,6 +73,8 @@ def pipeline(
     Returns: ``[M, microbatch...]`` outputs, replicated over ``axis_name``.
     """
     jmesh = mesh.jax_mesh() if hasattr(mesh, "jax_mesh") else mesh
+    if axis_name not in jmesh.shape:
+        raise ValueError(f"mesh has no '{axis_name}' axis (axes: {list(jmesh.shape)})")
     S = jmesh.shape[axis_name]
     M = int(microbatches.shape[0])
     for leaf in jax.tree.leaves(stacked_params):
@@ -83,11 +92,27 @@ def pipeline(
             f"num microbatches ({M}) should be a multiple of pipeline stages ({S}) "
             "for full utilization"
         )
-    fn = jax.checkpoint(stage_fn) if checkpoint_stages else stage_fn
-    T = num_pipeline_ticks(M, S)
     if mb_spec is None:
         mb_spec = P()
-    param_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
+    treedef = jax.tree.structure(stacked_params)
+    mapped = _build_pipeline_callable(
+        stage_fn, jmesh, axis_name, S, M, treedef, mb_spec, bool(checkpoint_stages)
+    )
+    return mapped(stacked_params, microbatches)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_pipeline_callable(
+    stage_fn, jmesh, axis_name, S, M, param_treedef, mb_spec, checkpoint_stages
+):
+    """One jitted shard_map per static pipeline configuration — rebuilding the
+    closure per call would defeat jax.jit's identity-keyed cache and recompile
+    the whole scan+ppermute program every eager step."""
+    fn = jax.checkpoint(stage_fn) if checkpoint_stages else stage_fn
+    T = num_pipeline_ticks(M, S)
+    param_specs = jax.tree_util.tree_unflatten(
+        param_treedef, [P(axis_name)] * param_treedef.num_leaves
+    )
     fwd_perm = [(i, i + 1) for i in range(S - 1)]
 
     def local_fn(params: Any, mb: Any) -> Any:
@@ -120,10 +145,187 @@ def pipeline(
         )
         return outputs
 
-    return shard_map(
+    # manual only over the pp axis: every other mesh axis (dp/mp/...) stays
+    # automatic, so GSPMD keeps propagating batch/tensor shardings through the
+    # stage compute — specs may only mention `axis_name`. Partial-manual
+    # shard_map only lowers inside a jit scope, so wrap the call (a no-op
+    # nesting when the caller is already tracing).
+    mapped = shard_map(
         local_fn,
         mesh=jmesh,
         in_specs=(param_specs, mb_spec),
         out_specs=mb_spec,
+        axis_names={axis_name},
         check_vma=False,
-    )(stacked_params, microbatches)
+    )
+    return jax.jit(mapped)
+
+
+# --------------------------------------------------------------------------
+# PipelineLayer wiring: run a model's homogeneous decoder region through the
+# circular executor (the reference runs 1F1B/interleave event loops instead:
+# ``meta_parallel/pipeline_parallel.py:547`` / ``:1138``)
+# --------------------------------------------------------------------------
+
+
+def _structure_key(layer: Any) -> Any:
+    """Structural fingerprint: two layers with the same key can be executed by
+    one template function with swapped parameters."""
+    from paddle_tpu.nn.layer.layers import Layer as _Layer
+
+    if not isinstance(layer, _Layer):
+        return None
+    return (
+        type(layer).__qualname__,
+        tuple(
+            (n, tuple(p.shape), str(p.dtype)) for n, p in layer.named_parameters()
+        ),
+    )
+
+
+def plan_pipeline_region(pipe: Any) -> tuple:
+    """Find the maximal contiguous run of structurally identical layers in a
+    ``PipelineLayer`` — the homogeneous decoder stack that the SPMD circular
+    pipeline executes. Returns ``(start, end)`` into ``pipe._built``;
+    ``[0, start)`` is the prologue (embeddings), ``[end, len)`` the epilogue
+    (final norm, lm head)."""
+    keys = [_structure_key(l) for l in pipe._built]  # noqa: E741
+    best = (0, 0)
+    i = 0
+    n = len(keys)
+    while i < n:
+        if keys[i] is None:
+            i += 1
+            continue
+        j = i
+        while j < n and keys[j] == keys[i]:
+            j += 1
+        if j - i > best[1] - best[0]:
+            best = (i, j)
+        i = j
+    if best[1] - best[0] < 2:
+        raise ValueError(
+            "PipelineLayer has no homogeneous region of >= 2 layers; the SPMD "
+            "circular pipeline needs a repeated decoder block structure"
+        )
+    return best
+
+
+class SpmdPipelineExecutor:
+    """Execute a ``PipelineLayer`` with its decoder region pipelined over the
+    ``pp`` mesh axis via the scan+ppermute circular schedule.
+
+    Prologue/epilogue layers (embedding, final norm, tied lm head) run in the
+    global program on every rank — they are small, and the tied-embedding
+    gradient accumulation falls out of autograd because both uses reference
+    the same Parameter. The region's blocks are assigned to stages in
+    contiguous chunks; with ``num_virtual_pipeline_stages = V > 1`` each stage
+    holds V chunks and the schedule makes V laps around the ring
+    (the interleave topology of reference ``PipelineParallelWithInterleave``,
+    expressed as stacked virtual stages rather than an event loop).
+
+    Differentiable end-to-end: the pipelined region is dispatched as one op
+    whose VJP is jax-derived, so ``loss.backward()`` reaches every block
+    parameter as well as the prologue/epilogue ones.
+    """
+
+    def __init__(
+        self,
+        pipe: Any,
+        mesh: Any,
+        num_microbatches: int,
+        axis_name: str = "pp",
+        checkpoint_stages: bool = False,
+    ) -> None:
+        self._pipe = pipe
+        self._mesh = mesh
+        self._axis = axis_name
+        self._M = int(num_microbatches)
+        self._ckpt = checkpoint_stages
+        jmesh = mesh.jax_mesh() if hasattr(mesh, "jax_mesh") else mesh
+        if axis_name not in jmesh.shape:
+            raise ValueError(
+                f"mesh has no '{axis_name}' axis (axes: {list(jmesh.shape)})"
+            )
+        self._S = int(jmesh.shape[axis_name])
+        self._V = int(getattr(pipe, "_num_virtual_pipeline_stages", 1) or 1)
+        start, end = plan_pipeline_region(pipe)
+        self._start, self._end = start, end
+        L = end - start
+        if L % (self._S * self._V) != 0:
+            raise ValueError(
+                f"decoder region has {L} blocks, not divisible by "
+                f"num_stages*virtual ({self._S}*{self._V})"
+            )
+        self._C = L // (self._S * self._V)  # blocks per (stage, lap) chunk
+        self._blocks = pipe._built[start:end]
+        self._template = self._blocks[0]
+        self._param_names = [n for n, _ in self._template.named_parameters()]
+        if not self._param_names:
+            raise ValueError("pipelined blocks have no parameters")
+
+    # -- template application (pure-jax view of one block) ------------------
+    def _apply_template(self, arrays: List[Any], x: Any) -> Any:
+        import paddle_tpu
+        from paddle_tpu.core.tensor import Tensor
+
+        named = list(self._template.named_parameters())
+        saved = [p._data for _, p in named]
+        try:
+            for (_n, p), a in zip(named, arrays):
+                p._data = a
+            with paddle_tpu.no_grad():
+                y = self._template(Tensor(x))
+            return y._data
+        finally:
+            for (_n, p), s in zip(named, saved):
+                p._data = s
+
+    def _chunk_fn(self, chunk_params: List[List[Any]], x: Any) -> Any:
+        for block_arrays in chunk_params:
+            x = self._apply_template(block_arrays, x)
+        return x
+
+    # -- full forward -------------------------------------------------------
+    def forward(self, x: Any) -> Any:
+        from paddle_tpu.core.dispatch import call_op
+
+        pipe, M, S, V, C = self._pipe, self._M, self._S, self._V, self._C
+        h = x
+        for i in range(self._start):
+            h = pipe._run_one(i, pipe._built[i], h)
+
+        batch = h.shape[0]
+        if batch % M != 0:
+            raise ValueError(f"batch {batch} not divisible by num_microbatches {M}")
+        per_block_tensors = [
+            [dict(b.named_parameters())[n] for n in self._param_names]
+            for b in self._blocks
+        ]
+        flat_params = [t for row in per_block_tensors for t in row]
+        P_ = len(self._param_names)
+
+        def impl(h_arr, *flat):
+            rows = [list(flat[i * P_ : (i + 1) * P_]) for i in range(len(self._blocks))]
+            mb = h_arr.reshape((M, batch // M) + h_arr.shape[1:])
+            for v in range(V):
+                stage_chunks = [
+                    rows[(v * S + s) * C : (v * S + s + 1) * C] for s in range(S)
+                ]
+                stacked = jax.tree.map(lambda *xs: jnp.stack(xs, 0), *stage_chunks)
+                mb = pipeline(
+                    self._chunk_fn,
+                    stacked,
+                    mb,
+                    self._mesh,
+                    axis_name=self._axis,
+                    checkpoint_stages=self._ckpt,
+                )
+            return mb.reshape((batch,) + mb.shape[2:])
+
+        h = call_op("spmd_pipeline", impl, h, *flat_params)
+        for i in range(self._end, len(pipe._built)):
+            h = pipe._run_one(i, pipe._built[i], h)
+        return h
+
+    __call__ = forward

@@ -269,3 +269,143 @@ class TestSpmdPipeline:
         mb = jax.random.normal(jax.random.PRNGKey(7), (2, 2, 8), jnp.float32)
         out = pipeline(self._stage_fn(), stacked, mb, mesh, axis_name="pp")
         assert out.shape == mb.shape
+
+
+class TestSpmdPipelineExecutorGPT:
+    """VERDICT r2 item #3: the circular executor wired into PipelineLayer/GPT —
+    full train step through scan+ppermute with loss/grad parity vs the
+    non-pipelined global view, on the 8-device CPU mesh."""
+
+    def _cfg(self, num_layers=4):
+        from paddle_tpu.models.gpt import GPTConfig
+
+        return GPTConfig(
+            vocab_size=64, hidden_size=16, num_layers=num_layers, num_heads=2,
+            max_position=32,
+        )
+
+    def _build(self, num_layers=4, num_stages=2, **kw):
+        from paddle_tpu.models.gpt import build_gpt_pipeline
+
+        paddle.seed(11)
+        return build_gpt_pipeline(self._cfg(num_layers), num_stages=num_stages, **kw)
+
+    def _data(self, batch=8, seq=16):
+        rng = np.random.default_rng(0)
+        ids = paddle.to_tensor(rng.integers(0, 64, (batch, seq)).astype(np.int32))
+        labels = paddle.to_tensor(rng.integers(0, 64, (batch, seq)).astype(np.int32))
+        return ids, labels
+
+    def test_plan_finds_decoder_region(self):
+        from paddle_tpu.distributed.fleet.meta_parallel.spmd_pipeline import (
+            plan_pipeline_region,
+        )
+
+        pipe = self._build()
+        start, end = plan_pipeline_region(pipe)
+        # [embed, block x4, ln_f, tied head] -> region is exactly the blocks
+        assert (start, end) == (1, 5)
+
+    def test_forward_matches_global_view(self):
+        import paddle_tpu.distributed as dist
+
+        mesh = dist.ProcessMesh(shape=[2, 2, 2], dim_names=["dp", "pp", "mp"])
+        pipe = self._build()
+        ex = pipe.build_spmd_executor(mesh, num_microbatches=4)
+        ids, _ = self._data()
+        out_pipe = ex(ids)
+        out_seq = pipe(ids)
+        np.testing.assert_allclose(
+            out_pipe.numpy(), out_seq.numpy(), rtol=2e-5, atol=2e-5
+        )
+
+    def test_train_step_grad_parity(self):
+        """fwd+bwd through the executor == fwd+bwd through the plain stack,
+        for every parameter including the tied embedding."""
+        import paddle_tpu.distributed as dist
+        import paddle_tpu.nn.functional as F
+
+        mesh = dist.ProcessMesh(shape=[4], dim_names=["pp"])
+        pipe = self._build(num_layers=4, num_stages=4)
+        ex = pipe.build_spmd_executor(mesh, num_microbatches=4)
+        ids, labels = self._data()
+
+        def ce(logits):
+            return F.cross_entropy(
+                logits.reshape([-1, logits.shape[-1]]).astype("float32"),
+                labels.reshape([-1]),
+                reduction="mean",
+            )
+
+        loss_pipe = ce(ex(ids))
+        loss_pipe.backward()
+        named = list(pipe.named_parameters())
+        grads_pipe = {n: p.grad.numpy().copy() for n, p in named if p.grad is not None}
+        pipe.clear_gradients()
+
+        loss_seq = ce(pipe(ids))
+        loss_seq.backward()
+        grads_seq = {n: p.grad.numpy().copy() for n, p in named if p.grad is not None}
+
+        np.testing.assert_allclose(float(loss_pipe), float(loss_seq), rtol=1e-5)
+        assert set(grads_pipe) == set(grads_seq) and grads_pipe
+        for n in grads_seq:
+            np.testing.assert_allclose(
+                grads_pipe[n], grads_seq[n], rtol=5e-4, atol=1e-5, err_msg=n
+            )
+
+    def test_interleave_virtual_stages(self):
+        """VPP: 8 blocks on 2 stages x 2 virtual chunks == plain stack."""
+        import paddle_tpu.distributed as dist
+
+        mesh = dist.ProcessMesh(shape=[2], dim_names=["pp"])
+        pipe = self._build(num_layers=8, num_stages=2, num_virtual_pipeline_stages=2)
+        ex = pipe.build_spmd_executor(mesh, num_microbatches=4)
+        ids, _ = self._data()
+        np.testing.assert_allclose(
+            ex(ids).numpy(), pipe(ids).numpy(), rtol=2e-5, atol=2e-5
+        )
+
+    def test_jitted_hybrid_train_step(self):
+        """Full jitted train step (fwd+bwd+AdamW) over dp x pp x mp with TP
+        placements — the shape the dryrun drives."""
+        import paddle_tpu.distributed as dist
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.models.gpt import gpt_shard_fn
+
+        mesh = dist.ProcessMesh(shape=[2, 2, 2], dim_names=["dp", "pp", "mp"])
+        dist.set_mesh(mesh)
+        pipe = self._build()
+        for name, sub in pipe.named_sublayers(include_self=True):
+            gpt_shard_fn(name, sub, mesh)
+        ex = pipe.build_spmd_executor(mesh, num_microbatches=2)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3, parameters=pipe.parameters())
+
+        @paddle.jit.to_static
+        def step(model_ex, opt, ids, labels):
+            logits = model_ex(ids)
+            loss = F.cross_entropy(
+                logits.reshape([-1, logits.shape[-1]]).astype("float32"),
+                labels.reshape([-1]),
+                reduction="mean",
+            )
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        ids, labels = self._data(batch=4, seq=8)
+        before = pipe._built[1].attn.qkv_proj.weight.numpy().copy()
+        l0 = float(step(ex, opt, ids, labels))
+        l1 = float(step(ex, opt, ids, labels))
+        assert np.isfinite(l0) and np.isfinite(l1)
+        after = pipe._built[1].attn.qkv_proj.weight.numpy()
+        assert not np.allclose(before, after)
+
+    def test_rejects_indivisible_region(self):
+        import paddle_tpu.distributed as dist
+
+        mesh = dist.ProcessMesh(shape=[4], dim_names=["pp"])
+        pipe = self._build(num_layers=6, num_stages=4)
+        with pytest.raises(ValueError, match="not divisible"):
+            pipe.build_spmd_executor(mesh, num_microbatches=4)
