@@ -101,7 +101,7 @@ def pipeline(
     return mapped(stacked_params, microbatches)
 
 
-@functools.lru_cache(maxsize=None)
+@functools.lru_cache(maxsize=32)  # bounded: each entry pins its stage_fn
 def _build_pipeline_callable(
     stage_fn, jmesh, axis_name, S, M, param_treedef, mb_spec, checkpoint_stages
 ):

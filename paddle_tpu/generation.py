@@ -1,0 +1,181 @@
+"""Autoregressive decoding: one compiled XLA program per (model, shape).
+
+The reference's decode path is the inference stack's cache attention
+(``paddle/phi/ops/yaml/ops.yaml:3074`` ``masked_multihead_attention_``,
+``paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu``) driven
+by a Python loop; the ``generate()`` surface mirrors the PaddleNLP
+GenerationMixin API. TPU-native shape: prefill + ``lax.scan`` of single-token
+steps over fixed-size KV-cache buffers, the whole thing inside ONE jit — no
+per-step retraces, no growing shapes, every decode step is the same program.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["GenerationMixin"]
+
+
+def _filter_logits(logits: jax.Array, temperature: float, top_k: int, top_p: float) -> jax.Array:
+    """Standard sampling filters (temperature, top-k, nucleus/top-p)."""
+    if temperature != 1.0:
+        logits = logits / max(float(temperature), 1e-6)
+    if top_k and top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_desc, axis=-1)
+        cum_excl = jnp.cumsum(probs, axis=-1) - probs
+        # smallest logit still inside the nucleus; everything below is cut
+        kept_min = jnp.min(
+            jnp.where(cum_excl > top_p, jnp.inf, sorted_desc), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits < kept_min, -jnp.inf, logits)
+    return logits
+
+
+class GenerationMixin:
+    """Adds ``generate()`` to a causal LM whose ``forward`` supports
+    ``(input_ids, past_key_values, use_cache, cache_position)`` with
+    static-cache decode semantics (see ``LlamaAttention``)."""
+
+    def generate(
+        self,
+        input_ids: Any,
+        max_new_tokens: int = 32,
+        do_sample: bool = False,
+        temperature: float = 1.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        eos_token_id: Optional[int] = None,
+        pad_token_id: Optional[int] = None,
+        seed: int = 0,
+    ) -> Any:
+        """Greedy or sampling decode. Returns ``[B, prompt + max_new_tokens]``
+        token ids (prompt included); after ``eos_token_id`` a sequence is
+        padded with ``pad_token_id`` (defaults to eos)."""
+        from paddle_tpu.core.tensor import Tensor
+
+        ids = input_ids._data if isinstance(input_ids, Tensor) else jnp.asarray(input_ids)
+        ids = ids.astype(jnp.int32)
+        b, prompt = ids.shape
+        max_pos = getattr(getattr(self, "config", None), "max_position_embeddings", None)
+        if max_pos is not None and prompt + max_new_tokens > max_pos:
+            # the decode path's dynamic rope-table slice would silently clamp
+            # past the table end and emit garbage — fail loudly instead
+            raise ValueError(
+                f"prompt ({prompt}) + max_new_tokens ({max_new_tokens}) exceeds "
+                f"max_position_embeddings ({max_pos})"
+            )
+        if pad_token_id is None:
+            pad_token_id = eos_token_id if eos_token_id is not None else 0
+
+        cfg = (
+            b, prompt, int(max_new_tokens), bool(do_sample), float(temperature),
+            int(top_k), float(top_p), eos_token_id, pad_token_id,
+        )
+        cache = getattr(self, "_generate_jit_cache", None)
+        if cache is None:
+            cache = {}
+            object.__setattr__(self, "_generate_jit_cache", cache)
+        if cfg not in cache:
+            cache[cfg] = jax.jit(
+                functools.partial(
+                    self._generate_impl,
+                    max_new_tokens=int(max_new_tokens),
+                    do_sample=bool(do_sample),
+                    temperature=float(temperature),
+                    top_k=int(top_k),
+                    top_p=float(top_p),
+                    eos_token_id=eos_token_id,
+                    pad_token_id=int(pad_token_id),
+                )
+            )
+        named = list(self.named_parameters())
+        arrays = [p._data for _, p in named]
+        out = cache[cfg](arrays, ids, jax.random.PRNGKey(seed))
+        return Tensor(out)
+
+    # traced: runs once per (shape, sampling config), then pure XLA
+    def _generate_impl(
+        self,
+        param_arrays: List[Any],
+        ids: jax.Array,
+        key: jax.Array,
+        *,
+        max_new_tokens: int,
+        do_sample: bool,
+        temperature: float,
+        top_k: int,
+        top_p: float,
+        eos_token_id: Optional[int],
+        pad_token_id: int,
+    ) -> jax.Array:
+        import paddle_tpu
+        from paddle_tpu.core.tensor import Tensor
+
+        b, prompt = ids.shape
+        s_total = prompt + max_new_tokens
+
+        def choose(logits: jax.Array, k: jax.Array) -> jax.Array:
+            logits = logits.astype(jnp.float32)
+            if do_sample:
+                return jax.random.categorical(
+                    k, _filter_logits(logits, temperature, top_k, top_p), axis=-1
+                ).astype(jnp.int32)
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+        named = list(self.named_parameters())
+        saved = [p._data for _, p in named]
+        try:
+            for (_n, p), a in zip(named, param_arrays):
+                p._data = a
+
+            with paddle_tpu.no_grad():
+                logits, caches = self(Tensor(ids), use_cache=True)
+            key, sub = jax.random.split(key)
+            tok0 = choose(logits._data[:, -1, :], sub)
+            done0 = (
+                tok0 == eos_token_id
+                if eos_token_id is not None
+                else jnp.zeros((b,), bool)
+            )
+            pad_spec = ((0, 0), (0, s_total - prompt), (0, 0), (0, 0))
+            cks = [jnp.pad(k_t._data, pad_spec) for k_t, _ in caches]
+            cvs = [jnp.pad(v_t._data, pad_spec) for _, v_t in caches]
+
+            def body(carry, _):
+                tok, cks, cvs, pos, done, key = carry
+                with paddle_tpu.no_grad():
+                    step_logits, new_caches = self(
+                        Tensor(tok[:, None]),
+                        past_key_values=[
+                            (Tensor(k), Tensor(v)) for k, v in zip(cks, cvs)
+                        ],
+                        use_cache=True,
+                        cache_position=Tensor(pos),
+                    )
+                key, sub = jax.random.split(key)
+                nxt = choose(step_logits._data[:, -1, :], sub)
+                nxt = jnp.where(done, jnp.int32(pad_token_id), nxt)
+                if eos_token_id is not None:
+                    done = done | (nxt == eos_token_id)
+                cks2 = [c[0]._data for c in new_caches]
+                cvs2 = [c[1]._data for c in new_caches]
+                return (nxt, cks2, cvs2, pos + 1, done, key), nxt
+
+            # tok0 came from the prefill logits; the scan emits each step's
+            # NEWLY chosen token, so only max_new_tokens - 1 decoder steps run
+            # (emitting the carry instead would pay one full forward whose
+            # result is discarded)
+            init = (tok0, cks, cvs, jnp.int32(prompt), done0, key)
+            _, toks = jax.lax.scan(body, init, None, length=max_new_tokens - 1)
+        finally:
+            for (_n, p), s in zip(named, saved):
+                p._data = s
+        return jnp.concatenate([ids, tok0[:, None], toks.T], axis=1)

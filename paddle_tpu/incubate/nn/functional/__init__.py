@@ -25,6 +25,7 @@ __all__ = [
     "fused_linear",
     "fused_bias_dropout_residual_layer_norm",
     "fused_dropout_add",
+    "masked_multihead_attention",
 ]
 
 
@@ -86,7 +87,13 @@ def _fused_rope_op(q, k, v, sin, cos, use_neox_rotary_style=True):
     def rope(x):
         if x is None:
             return None
-        if use_neox_rotary_style and x.shape[-1] % 128 == 0:
+        # per-batch tables (leading dim > 1, decode with ragged positions)
+        # cannot collapse to the kernel's [S, D] layout — XLA path only
+        if (
+            use_neox_rotary_style
+            and x.shape[-1] % 128 == 0
+            and (cos.ndim == 2 or cos.shape[0] == 1)
+        ):
             from paddle_tpu.kernels.select import pallas_enabled, warn_fallback
 
             if pallas_enabled("use_pallas_fused"):
@@ -171,6 +178,54 @@ def fused_linear(x, weight, bias=None, transpose_weight=False):
     if bias is not None:
         out = out + bias
     return out
+
+
+@defop("masked_multihead_attention", tensor_method=None)
+def masked_multihead_attention(q, k, v, cache_k, cache_v, seq_len, scale=None):
+    """Decode-phase attention with append-to-cache — the static-shape KV-cache
+    attention step (reference ``paddle/phi/ops/yaml/ops.yaml:3074``
+    ``masked_multihead_attention_``, CUDA kernel
+    ``paddle/phi/kernels/fusion/gpu/masked_multihead_attention_kernel.cu``).
+
+    One new token per sequence attends to every cached position up to its
+    current length; the new K/V are written into fixed-size buffers with
+    ``dynamic_update_slice`` so every decode step is the SAME compiled XLA
+    program (no shape growth, no recompiles — the TPU analog of the
+    reference's in-place `_` op).
+
+    Args:
+      q/k/v: ``[B, 1, H, D]`` / ``[B, 1, HK, D]`` this step's post-RoPE
+        projections (GQA: HK may divide H).
+      cache_k/cache_v: ``[B, S_max, HK, D]`` static cache buffers.
+      seq_len: int32 scalar or ``[B]`` — tokens already cached; the new token
+        is written at this index.
+      scale: attention scale, default ``1/sqrt(D)``.
+
+    Returns ``(out [B, 1, H, D], cache_k', cache_v')``.
+    """
+    b, _, h, d = q.shape
+    hk = cache_k.shape[2]
+    s_max = cache_k.shape[1]
+    group = h // hk
+    if scale is None:
+        scale = 1.0 / (d**0.5)
+    lens = jnp.broadcast_to(jnp.asarray(seq_len, jnp.int32).reshape(-1), (b,))
+
+    def append(buf, new, ln):
+        # buf [S_max, HK, D], new [1, HK, D]
+        return jax.lax.dynamic_update_slice(buf, new.astype(buf.dtype), (ln, 0, 0))
+
+    ck = jax.vmap(append)(cache_k, k, lens)
+    cv = jax.vmap(append)(cache_v, v, lens)
+
+    qg = q.reshape(b, 1, hk, group, d).astype(jnp.float32)
+    logits = jnp.einsum("bqkgd,bskd->bkgqs", qg, ck.astype(jnp.float32)) * scale
+    pos = jnp.arange(s_max, dtype=jnp.int32)
+    allowed = pos[None, :] <= lens[:, None]  # include the just-written token
+    logits = jnp.where(allowed[:, None, None, None, :], logits, -1e30)
+    p = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bkgqs,bskd->bqkgd", p, cv.astype(jnp.float32))
+    return out.reshape(b, 1, h, d).astype(q.dtype), ck, cv
 
 
 def fused_bias_dropout_residual_layer_norm(

@@ -26,6 +26,7 @@ import paddle_tpu
 import paddle_tpu.nn as nn
 import paddle_tpu.nn.functional as F
 from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.generation import GenerationMixin
 from paddle_tpu.incubate.nn.functional import fused_rotary_position_embedding
 from paddle_tpu.ops.creation import arange
 from paddle_tpu.ops.manipulation import concat, reshape
@@ -73,7 +74,28 @@ class LlamaRotaryEmbedding(nn.Layer):
         self.register_buffer("cos_cached", Tensor(np.cos(emb)), persistable=False)
         self.register_buffer("sin_cached", Tensor(np.sin(emb)), persistable=False)
 
-    def forward(self, seq_len: int, offset: int = 0) -> Tuple[Tensor, Tensor]:
+    def forward(self, seq_len: int, offset: Any = 0) -> Tuple[Tensor, Tensor]:
+        if isinstance(offset, Tensor):
+            # decode path: position is a traced scalar — or a [B] vector for
+            # batches whose sequences sit at different lengths — so the table
+            # lookup must be a dynamic_slice (vmapped for the vector case)
+            from paddle_tpu.core.dispatch import call_op
+            import jax
+
+            def sl(tab, off):
+                if off.ndim == 0 or off.size == 1:
+                    return jax.lax.dynamic_slice_in_dim(
+                        tab, off.reshape(()), seq_len, axis=0
+                    )
+                per = jax.vmap(
+                    lambda o: jax.lax.dynamic_slice_in_dim(tab, o, seq_len, axis=0)
+                )(off.reshape(-1))
+                return per[:, :, None, :]  # [B, s, 1, D] broadcasts over heads
+
+            return (
+                call_op("rope_table_slice", sl, self.cos_cached, offset),
+                call_op("rope_table_slice", sl, self.sin_cached, offset),
+            )
         return (
             self.cos_cached[offset : offset + seq_len],
             self.sin_cached[offset : offset + seq_len],
@@ -103,11 +125,26 @@ class LlamaAttention(nn.Layer):
         startend_row_indices: Optional[Tensor] = None,
         past_key_value: Optional[Tuple[Tensor, Tensor]] = None,
         use_cache: bool = False,
+        cache_position: Optional[Tensor] = None,
     ) -> Any:
         b, s, _ = hidden_states.shape
         q = reshape(self.q_proj(hidden_states), [b, s, self.num_heads, self.head_dim])
         k = reshape(self.k_proj(hidden_states), [b, s, self.num_kv_heads, self.head_dim])
         v = reshape(self.v_proj(hidden_states), [b, s, self.num_kv_heads, self.head_dim])
+        if cache_position is not None and past_key_value is not None:
+            # static-cache decode: past is a FIXED [B, S_max, HK, D] buffer
+            # pair; append this step's K/V at cache_position and attend with a
+            # length mask — one compiled program for every step (reference
+            # `masked_multihead_attention_` ops.yaml:3074)
+            from paddle_tpu.incubate.nn.functional import masked_multihead_attention
+
+            cos, sin = self.rotary_emb(s, cache_position)
+            q, k, _ = fused_rotary_position_embedding(q, k, None, sin=sin, cos=cos)
+            out, ck, cv = masked_multihead_attention(
+                q, k, v, past_key_value[0], past_key_value[1], cache_position
+            )
+            out = self.o_proj(reshape(out, [b, s, self.num_heads * self.head_dim]))
+            return (out, (ck, cv)) if use_cache else out
         offset = past_key_value[0].shape[1] if past_key_value is not None else 0
         cos, sin = self.rotary_emb(s, offset)
         q, k, _ = fused_rotary_position_embedding(q, k, None, sin=sin, cos=cos)
@@ -150,10 +187,13 @@ class LlamaDecoderLayer(nn.Layer):
         startend_row_indices: Optional[Tensor] = None,
         past_key_value: Any = None,
         use_cache: bool = False,
+        cache_position: Optional[Tensor] = None,
     ) -> Any:
         residual = hidden_states
         h = self.input_layernorm(hidden_states)
-        attn_out = self.self_attn(h, startend_row_indices, past_key_value, use_cache)
+        attn_out = self.self_attn(
+            h, startend_row_indices, past_key_value, use_cache, cache_position
+        )
         if use_cache:
             attn_out, cache = attn_out
         h = residual + attn_out
@@ -179,6 +219,7 @@ class LlamaModel(nn.Layer):
         startend_row_indices: Optional[Tensor] = None,
         past_key_values: Any = None,
         use_cache: bool = False,
+        cache_position: Optional[Tensor] = None,
     ) -> Any:
         h = self.embed_tokens(input_ids)
         new_caches = [] if use_cache else None
@@ -195,7 +236,7 @@ class LlamaModel(nn.Layer):
 
                 h = recompute(layer, h, startend_row_indices)
             else:
-                h = layer(h, startend_row_indices, past, use_cache)
+                h = layer(h, startend_row_indices, past, use_cache, cache_position)
             if use_cache:
                 h, cache = h
                 new_caches.append(cache)
@@ -205,7 +246,7 @@ class LlamaModel(nn.Layer):
         return h
 
 
-class LlamaForCausalLM(nn.Layer):
+class LlamaForCausalLM(nn.Layer, GenerationMixin):
     def __init__(self, config: LlamaConfig) -> None:
         super().__init__()
         self.config = config
@@ -222,8 +263,11 @@ class LlamaForCausalLM(nn.Layer):
         startend_row_indices: Optional[Tensor] = None,
         past_key_values: Any = None,
         use_cache: bool = False,
+        cache_position: Optional[Tensor] = None,
     ) -> Any:
-        out = self.llama(input_ids, startend_row_indices, past_key_values, use_cache)
+        out = self.llama(
+            input_ids, startend_row_indices, past_key_values, use_cache, cache_position
+        )
         caches = None
         if use_cache:
             out, caches = out
