@@ -57,11 +57,18 @@ class ReduceOp:
 @dataclass
 class Group:
     """Communication group ≈ a named mesh axis (reference Group over
-    ProcessGroup). ``axis_name`` binds collectives inside shard_map regions."""
+    ProcessGroup). ``axis_name`` binds collectives inside shard_map regions.
+
+    ``axis_index_groups`` (optional) restricts the collective to rank
+    SUBGROUPS of the axis — the XLA-native form of reference
+    ``new_group(ranks=[...])`` sub-communicators: a partition of the axis into
+    equally-sized index lists, forwarded to ``lax.psum``/``all_gather``/…
+    (``ranks`` then holds this group's own axis indices)."""
 
     id: int
     ranks: List[int]
     axis_name: Optional[str] = None
+    axis_index_groups: Optional[List[List[int]]] = None
 
     @property
     def nranks(self) -> int:
@@ -78,6 +85,30 @@ class Group:
     def process_group(self) -> "Group":
         return self
 
+    def _pos_in_group(self) -> np.ndarray:
+        """axis index -> position within its subgroup (identity layout when
+        the group spans the whole axis)."""
+        if self.axis_index_groups is None:
+            return np.arange(len(self.ranks))
+        size = sum(len(g) for g in self.axis_index_groups)
+        table = np.zeros(size, np.int32)
+        for grp in self.axis_index_groups:
+            for pos, idx in enumerate(grp):
+                table[idx] = pos
+        return table
+
+    def _member_at(self, pos: int) -> np.ndarray:
+        """axis index -> the axis index of its own subgroup's member ``pos``
+        (whole-axis group: group-local position IS the axis index)."""
+        if self.axis_index_groups is None:
+            return np.full(len(self.ranks), pos, np.int32)
+        size = sum(len(g) for g in self.axis_index_groups)
+        table = np.zeros(size, np.int32)
+        for grp in self.axis_index_groups:
+            for idx in grp:
+                table[idx] = grp[pos]
+        return table
+
 
 _groups: Dict[int, Group] = {}
 _next_group_id = [0]
@@ -90,12 +121,44 @@ def _default_group() -> Group:
     return _groups[0]
 
 
-def new_group(ranks: Optional[Sequence[int]] = None, backend: Optional[str] = None, timeout: Any = None, axis_name: Optional[str] = None) -> Group:
+def new_group(
+    ranks: Optional[Sequence[int]] = None,
+    backend: Optional[str] = None,
+    timeout: Any = None,
+    axis_name: Optional[str] = None,
+    axis_size: Optional[int] = None,
+) -> Group:
+    """Create a communication group (reference ``paddle.distributed.new_group``).
+
+    Two forms:
+      - ``new_group(global_ranks, axis_name=...)`` — a mesh-axis-wide group
+        (the fleet topology path; ``ranks`` are global device ids).
+      - ``new_group(axis_indices, axis_name=..., axis_size=N)`` — a true
+        SUB-group of an N-wide axis: collectives run only among those axis
+        indices (``lax`` ``axis_index_groups``). The remaining indices are
+        partitioned into sibling groups of the same size, so ``[0, 2]`` of a
+        4-wide axis yields the partition ``[[0, 2], [1, 3]]``.
+    """
     _next_group_id[0] += 1
     gid = _next_group_id[0]
     if ranks is None:
         ranks = list(range(len(jax.devices())))
-    g = Group(gid, list(ranks), axis_name=axis_name)
+    ranks = list(ranks)
+    aig = None
+    if axis_size is not None and len(ranks) < axis_size:
+        if any(r < 0 or r >= axis_size for r in ranks):
+            raise ValueError(f"subgroup ranks {ranks} out of range for axis size {axis_size}")
+        rest = [r for r in range(axis_size) if r not in ranks]
+        k = len(ranks)
+        if len(rest) % k != 0:
+            raise ValueError(
+                f"cannot partition the remaining {len(rest)} axis indices into "
+                f"sibling groups of size {k} (XLA axis_index_groups must be a "
+                f"partition into equal sizes)"
+            )
+        aig = [sorted(ranks)] + [rest[i : i + k] for i in range(0, len(rest), k)]
+        ranks = sorted(ranks)
+    g = Group(gid, ranks, axis_name=axis_name, axis_index_groups=aig)
     _groups[gid] = g
     return g
 
@@ -134,25 +197,27 @@ def _apply(t: Any, fn: Any) -> Any:
 
 
 def all_reduce(tensor: Any, op: str = ReduceOp.SUM, group: Optional[Group] = None, sync_op: bool = True) -> Any:
-    """AllReduce. Inside a shard_map region: ``lax.psum`` over the group axis.
-    On a global-view array (SPMD single-controller): values are already
-    globally consistent — identity (the reduction lives in the sharding
-    propagation), matching the DistTensor Partial→Replicate semantics."""
+    """AllReduce. Inside a shard_map region: ``lax.psum`` over the group axis
+    (restricted to the group's ``axis_index_groups`` for sub-groups). On a
+    global-view array (SPMD single-controller): values are already globally
+    consistent — identity (the reduction lives in the sharding propagation),
+    matching the DistTensor Partial→Replicate semantics."""
     axis = _axis(group)
     if axis is None:
         return tensor
+    aig = (group or _default_group()).axis_index_groups
 
     def fn(x: Any) -> Any:
         if op == ReduceOp.SUM:
-            return jax.lax.psum(x, axis)
+            return jax.lax.psum(x, axis, axis_index_groups=aig)
         if op == ReduceOp.MAX:
-            return jax.lax.pmax(x, axis)
+            return jax.lax.pmax(x, axis, axis_index_groups=aig)
         if op == ReduceOp.MIN:
-            return jax.lax.pmin(x, axis)
+            return jax.lax.pmin(x, axis, axis_index_groups=aig)
         if op == ReduceOp.AVG:
-            return jax.lax.pmean(x, axis)
+            return jax.lax.pmean(x, axis, axis_index_groups=aig)
         if op == ReduceOp.PROD:
-            return jnp.exp(jax.lax.psum(jnp.log(x), axis))
+            return jnp.exp(jax.lax.psum(jnp.log(x), axis, axis_index_groups=aig))
         raise ValueError(f"unknown reduce op {op}")
 
     result = _apply(tensor, fn)
@@ -163,24 +228,32 @@ def all_reduce(tensor: Any, op: str = ReduceOp.SUM, group: Optional[Group] = Non
 
 
 def all_gather(tensor_list: Optional[List[Any]], tensor: Any, group: Optional[Group] = None, sync_op: bool = True, axis: int = 0) -> Any:
+    """AllGather. With ``tensor_list`` given: appends each member's tensor
+    (reference list form). Without: returns the shards CONCATENATED along
+    ``axis`` (reference functional form)."""
     axis_name = _axis(group)
     if axis_name is None:
         if tensor_list is not None:
             tensor_list.append(tensor)
             return tensor_list
         return tensor
+    aig = (group or _default_group()).axis_index_groups
 
-    def fn(x: Any) -> Any:
-        return jax.lax.all_gather(x, axis_name, tiled=False)
-
-    gathered = _apply(tensor, fn)
     if tensor_list is not None:
-        n = (group or _default_group()).nranks
+        gathered = _apply(
+            tensor,
+            lambda x: jax.lax.all_gather(x, axis_name, axis_index_groups=aig, tiled=False),
+        )
         from paddle_tpu.ops.manipulation import unbind
 
         tensor_list.extend(unbind(gathered, axis=0))
         return tensor_list
-    return gathered
+    return _apply(
+        tensor,
+        lambda x: jax.lax.all_gather(
+            x, axis_name, axis_index_groups=aig, axis=axis, tiled=True
+        ),
+    )
 
 
 def all_gather_object(object_list: List[Any], obj: Any, group: Optional[Group] = None) -> None:
@@ -188,16 +261,50 @@ def all_gather_object(object_list: List[Any], obj: Any, group: Optional[Group] =
 
 
 def reduce(tensor: Any, dst: int = 0, op: str = ReduceOp.SUM, group: Optional[Group] = None, sync_op: bool = True) -> Any:
-    return all_reduce(tensor, op=op, group=group)
+    """Reduce-to-one: only the ``dst`` member keeps the reduced value; every
+    other member's tensor is unchanged (reference ``communication/reduce.py``
+    semantics — NOT an all_reduce)."""
+    axis_name = _axis(group)
+    if axis_name is None:
+        return tensor
+    g = group or _default_group()
+    dst_local = g.get_group_rank(dst)
+    if dst_local < 0:
+        raise ValueError(f"dst rank {dst} is not a member of group {g.ranks}")
+    dst_table = jnp.asarray(g._member_at(dst_local))
+    aig = g.axis_index_groups
+
+    def fn(x: Any) -> Any:
+        if op == ReduceOp.SUM:
+            red = jax.lax.psum(x, axis_name, axis_index_groups=aig)
+        elif op == ReduceOp.MAX:
+            red = jax.lax.pmax(x, axis_name, axis_index_groups=aig)
+        elif op == ReduceOp.MIN:
+            red = jax.lax.pmin(x, axis_name, axis_index_groups=aig)
+        elif op == ReduceOp.AVG:
+            red = jax.lax.pmean(x, axis_name, axis_index_groups=aig)
+        elif op == ReduceOp.PROD:
+            red = jnp.exp(jax.lax.psum(jnp.log(x), axis_name, axis_index_groups=aig))
+        else:
+            raise ValueError(f"unknown reduce op {op}")
+        idx = jax.lax.axis_index(axis_name)
+        return jnp.where(idx == dst_table[idx], red, x)
+
+    result = _apply(tensor, fn)
+    if isinstance(tensor, Tensor) and isinstance(result, Tensor):
+        tensor._replace_(result)
+        return tensor
+    return result
 
 
 def reduce_scatter(tensor: Any, tensor_list: Any = None, op: str = ReduceOp.SUM, group: Optional[Group] = None, sync_op: bool = True) -> Any:
     axis_name = _axis(group)
     if axis_name is None:
         return tensor_list if tensor_list is not None else tensor
+    aig = (group or _default_group()).axis_index_groups
 
     def fn(x: Any) -> Any:
-        return jax.lax.psum_scatter(x, axis_name, tiled=True)
+        return jax.lax.psum_scatter(x, axis_name, axis_index_groups=aig, tiled=True)
 
     src = tensor_list if tensor_list is not None else tensor
     return _apply(src, fn)
@@ -212,10 +319,12 @@ def broadcast(tensor: Any, src: int = 0, group: Optional[Group] = None, sync_op:
     if local_src < 0:
         raise ValueError(f"src rank {src} is not a member of group {g.ranks}")
 
+    aig = g.axis_index_groups
+
     def fn(x: Any) -> Any:
         # select the src member's value on every member (gathered axis is
         # indexed by group-local position, not global rank)
-        return jax.lax.all_gather(x, axis_name)[local_src]
+        return jax.lax.all_gather(x, axis_name, axis_index_groups=aig)[local_src]
 
     result = _apply(tensor, fn)
     if isinstance(tensor, Tensor) and isinstance(result, Tensor):
@@ -233,9 +342,13 @@ def scatter(tensor: Any, tensor_list: Any = None, src: int = 0, group: Optional[
     if local_src < 0:
         raise ValueError(f"src rank {src} is not a member of group {g.ranks}")
 
+    aig = g.axis_index_groups
+    pos_table = jnp.asarray(g._pos_in_group())
+
     def fn(x: Any) -> Any:
         idx = jax.lax.axis_index(axis_name)
-        return jax.lax.all_gather(x, axis_name)[local_src][idx]
+        gathered = jax.lax.all_gather(x, axis_name, axis_index_groups=aig)
+        return gathered[local_src][pos_table[idx]]
 
     return _apply(tensor_list if tensor_list is not None else tensor, fn)
 
@@ -250,9 +363,12 @@ def alltoall(out_tensor_list: Any, in_tensor_list: Any, group: Optional[Group] =
     from paddle_tpu.ops.manipulation import stack, unbind
 
     stacked = stack(in_tensor_list, axis=0) if isinstance(in_tensor_list, list) else in_tensor_list
+    aig = (group or _default_group()).axis_index_groups
 
     def fn(x: Any) -> Any:
-        return jax.lax.all_to_all(x, axis_name, split_axis=0, concat_axis=0, tiled=False)
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=0, concat_axis=0, axis_index_groups=aig, tiled=False
+        )
 
     result = _apply(stacked, fn)
     if isinstance(out_tensor_list, list):
@@ -272,9 +388,12 @@ def alltoall_single(
     axis_name = _axis(group)
     if axis_name is None:
         return in_tensor
+    aig = (group or _default_group()).axis_index_groups
 
     def fn(x: Any) -> Any:
-        return jax.lax.all_to_all(x, axis_name, split_axis=0, concat_axis=0, tiled=True)
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=0, concat_axis=0, axis_index_groups=aig, tiled=True
+        )
 
     return _apply(in_tensor, fn)
 
@@ -282,13 +401,22 @@ def alltoall_single(
 def ppermute(tensor: Any, perm: Sequence[Any], group: Optional[Group] = None) -> Any:
     """Point-to-point permutation over the group axis: ``perm`` is a list of
     (src_group_rank, dst_group_rank) pairs (each destination at most once) —
-    the XLA collective-permute that pipeline p2p compiles to."""
+    the XLA collective-permute that pipeline p2p compiles to. For a sub-group,
+    the same group-local permutation is applied within EVERY sibling subgroup
+    (SPMD programs are identical across ranks)."""
     axis_name = _axis(group)
     if axis_name is None:
         return tensor
+    g = group or _default_group()
+    if g.axis_index_groups is not None:
+        pairs = [
+            (grp[a], grp[b]) for grp in g.axis_index_groups for a, b in perm
+        ]
+    else:
+        pairs = [tuple(p) for p in perm]
 
     def fn(x: Any) -> Any:
-        return jax.lax.ppermute(x, axis_name, [tuple(p) for p in perm])
+        return jax.lax.ppermute(x, axis_name, pairs)
 
     return _apply(tensor, fn)
 
@@ -329,7 +457,15 @@ def recv(tensor: Any, src: int = 0, group: Optional[Group] = None, sync_op: bool
 
 class P2POp:
     """One element of a batched p2p exchange (reference
-    ``paddle.distributed.P2POp`` used by ``batch_isend_irecv``)."""
+    ``paddle.distributed.P2POp`` used by ``batch_isend_irecv``).
+
+    SPMD programs are rank-agnostic, so both endpoints must be named:
+      - ``P2POp(isend, t, peer, src=j)``: member ``j`` sends its ``t`` to
+        ``peer``.
+      - ``P2POp(irecv, buf, peer, src=k)``: member ``k`` receives from
+        ``peer`` — i.e. the pair (peer → k); ``buf`` is the (shared-name)
+        buffer whose per-member values carry the payload in the SPMD view.
+    """
 
     def __init__(self, op: Any, tensor: Any, peer: int, group: Optional[Group] = None, src: Optional[int] = None) -> None:
         self.op = op  # dist.isend / dist.irecv
@@ -340,24 +476,57 @@ class P2POp:
 
 
 def batch_isend_irecv(p2p_op_list: Sequence[P2POp]) -> List[Any]:
-    """Fuse a list of sends/recvs into one collective-permute. Send ops
-    contribute (self→peer) pairs; each pair's source is the op's ``src``
-    (defaulting to the matching recv's peer)."""
+    """Batched p2p (reference ``pp_utils/p2p_communication.py:570``
+    ``_p2p_helper`` batched isend/irecv): ALL ops touching the same buffer
+    fold into ONE collective-permute (e.g. a bidirectional ring shift is a
+    single ppermute with forward and backward pairs), and distinct buffers
+    each get their own — XLA schedules them concurrently, the async-stream
+    behavior the reference hand-codes. Returns one result per op, aligned
+    with ``p2p_op_list``."""
     if not p2p_op_list:
         return []
     group = p2p_op_list[0].group
     g = group or _default_group()
-    perm = []
-    tensor = None
+
+    def pair_of(op: P2POp):
+        if op.src is None:
+            raise ValueError(
+                "SPMD p2p needs both endpoints: P2POp(isend, t, peer, src=j) "
+                "or P2POp(irecv, buf, peer, src=k)"
+            )
+        if op.op in (send, isend):
+            a, b = op.src, op.peer  # src sends to peer
+        elif op.op in (recv, irecv):
+            a, b = op.peer, op.src  # src receives from peer
+        else:
+            raise ValueError(f"P2POp.op must be isend/irecv, got {op.op!r}")
+        la, lb = g.get_group_rank(a), g.get_group_rank(b)
+        if la < 0 or lb < 0:
+            raise ValueError(f"p2p endpoints ({a}, {b}) not in group {g.ranks}")
+        return (la, lb)
+
+    # fold ops per distinct buffer; dedupe pairs (a send and its matching
+    # recv describe the same edge)
+    buffers: List[Any] = []
+    buf_ids: List[int] = []
+    pairs_per_buf: List[List[Any]] = []
+    op_slots: List[Any] = []  # (buffer_index) per op
     for op in p2p_op_list:
-        if op.op is send or op.op is isend:
-            src_rank = op.src if op.src is not None else 0
-            perm.append((g.get_group_rank(src_rank), g.get_group_rank(op.peer)))
-            tensor = op.tensor
-    if tensor is None:
-        tensor = p2p_op_list[0].tensor
-    result = ppermute(tensor, perm, group)
-    return [result]
+        tid = id(op.tensor)
+        if tid not in buf_ids:
+            buf_ids.append(tid)
+            buffers.append(op.tensor)
+            pairs_per_buf.append([])
+        bi = buf_ids.index(tid)
+        pr = pair_of(op)
+        if pr not in pairs_per_buf[bi]:
+            pairs_per_buf[bi].append(pr)
+        op_slots.append(bi)
+
+    results = [
+        ppermute(buf, pairs, group) for buf, pairs in zip(buffers, pairs_per_buf)
+    ]
+    return [results[bi] for bi in op_slots]
 
 
 isend = send

@@ -64,6 +64,10 @@ class GenerationMixin:
         ids = input_ids._data if isinstance(input_ids, Tensor) else jnp.asarray(input_ids)
         ids = ids.astype(jnp.int32)
         b, prompt = ids.shape
+        if max_new_tokens < 0:
+            raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
+        if max_new_tokens == 0:
+            return Tensor(ids)
         max_pos = getattr(getattr(self, "config", None), "max_position_embeddings", None)
         if max_pos is not None and prompt + max_new_tokens > max_pos:
             # the decode path's dynamic rope-table slice would silently clamp
@@ -83,6 +87,9 @@ class GenerationMixin:
         if cache is None:
             cache = {}
             object.__setattr__(self, "_generate_jit_cache", cache)
+        if cfg not in cache and len(cache) >= 16:
+            # bounded: each entry pins a compiled executable (FIFO eviction)
+            cache.pop(next(iter(cache)))
         if cfg not in cache:
             cache[cfg] = jax.jit(
                 functools.partial(

@@ -11,6 +11,7 @@ from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from paddle_tpu.ops.registry import defop
 from paddle_tpu.nn.functional.activation import swiglu  # noqa: F401
@@ -210,6 +211,16 @@ def masked_multihead_attention(q, k, v, cache_k, cache_v, seq_len, scale=None):
     if scale is None:
         scale = 1.0 / (d**0.5)
     lens = jnp.broadcast_to(jnp.asarray(seq_len, jnp.int32).reshape(-1), (b,))
+    try:
+        # concrete lengths (eager decode loops): fail loudly on overflow —
+        # inside jit the write index would silently clamp onto the last slot
+        concrete = np.asarray(lens)
+        if (concrete >= s_max).any():
+            raise ValueError(
+                f"KV cache overflow: seq_len {concrete.max()} >= buffer size {s_max}"
+            )
+    except (jax.errors.TracerArrayConversionError, jax.errors.ConcretizationTypeError):
+        pass
 
     def append(buf, new, ln):
         # buf [S_max, HK, D], new [1, HK, D]
