@@ -129,3 +129,59 @@ class device:  # noqa: N801 - mirrors paddle.device module-as-namespace usage
         import jax as _jax
 
         (_jax.device_put(0) + 0).block_until_ready()
+
+    # memory observability (reference stats.h:126 + paddle.device.cuda.*)
+    @staticmethod
+    def memory_stats(device_: object = None):
+        from paddle_tpu.core.memory import memory_stats as _ms
+
+        return _ms(device_)
+
+    @staticmethod
+    def memory_allocated(device_: object = None) -> int:
+        from paddle_tpu.core.memory import memory_allocated as _ma
+
+        return _ma(device_)
+
+    @staticmethod
+    def max_memory_allocated(device_: object = None) -> int:
+        from paddle_tpu.core.memory import max_memory_allocated as _mma
+
+        return _mma(device_)
+
+    @staticmethod
+    def memory_reserved(device_: object = None) -> int:
+        from paddle_tpu.core.memory import memory_reserved as _mr
+
+        return _mr(device_)
+
+    @staticmethod
+    def max_memory_reserved(device_: object = None) -> int:
+        from paddle_tpu.core.memory import max_memory_reserved as _mmr
+
+        return _mmr(device_)
+
+    @staticmethod
+    def reset_max_memory_allocated(device_: object = None) -> None:
+        from paddle_tpu.core.memory import reset_max_memory_allocated as _r
+
+        _r(device_)
+
+    class cuda:  # noqa: N801 - paddle.device.cuda.* script compatibility
+        """Accelerator-memory API under the reference's ``cuda`` name; maps
+        onto the PJRT device (TPU here) so existing scripts keep working.
+        Methods are aliased from ``device`` below — one implementation."""
+
+
+# paddle.device.cuda.* == paddle.device.* (single set of bindings)
+for _name in (
+    "memory_stats",
+    "memory_allocated",
+    "max_memory_allocated",
+    "memory_reserved",
+    "max_memory_reserved",
+    "reset_max_memory_allocated",
+    "synchronize",
+):
+    setattr(device.cuda, _name, getattr(device, _name))
+del _name

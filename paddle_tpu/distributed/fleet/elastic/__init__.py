@@ -1,0 +1,4 @@
+from paddle_tpu.distributed.fleet.elastic.manager import (  # noqa: F401
+    ElasticManager,
+    ElasticStatus,
+)

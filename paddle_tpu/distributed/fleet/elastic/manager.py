@@ -1,0 +1,109 @@
+"""Elastic training: membership tracking + scale-change detection.
+
+Reference: ``python/paddle/distributed/fleet/elastic/manager.py`` — an
+etcd-backed registry of alive hosts (lease TTL ``:251``), ``PADDLE_ELASTIC_*``
+env config (``:128-175``), membership watch, and relaunch hooks.
+
+TPU translation: the registry is the native TCPStore (the same rendezvous
+store bootstrap uses — no etcd dependency): each worker renews a heartbeat
+key ``elastic/beat/{rank}``; the manager scans heartbeats and reports
+dead/alive membership. Relaunch is the launcher's job (see
+``launch/main.py`` ``--max_restarts``): on failure it re-execs the worker
+with ``PADDLE_RESTART_COUNT`` bumped, and the training script resumes from
+its latest checkpoint (``paddle_tpu.distributed.checkpoint``).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from enum import Enum
+from typing import Any, Dict, List, Optional
+
+__all__ = ["ElasticManager", "ElasticStatus"]
+
+
+class ElasticStatus(Enum):
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"  # membership stable
+    RESTART = "restart"  # membership changed -> relaunch needed
+    EXIT = "exit"
+
+
+class ElasticManager:
+    """Worker membership over a TCPStore (reference manager.py:128-251).
+
+    Env parity (reference ``PADDLE_ELASTIC_*``):
+      - ``PADDLE_ELASTIC_TIMEOUT``   heartbeat TTL seconds (default 30)
+      - ``PADDLE_ELASTIC_NP``        expected world size
+    """
+
+    def __init__(
+        self,
+        store: Any,
+        rank: int,
+        world_size: Optional[int] = None,
+        ttl: Optional[float] = None,
+    ) -> None:
+        self._store = store
+        self.rank = int(rank)
+        self.world_size = int(
+            world_size
+            if world_size is not None
+            else os.environ.get("PADDLE_ELASTIC_NP", os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        )
+        self.ttl = float(
+            ttl if ttl is not None else os.environ.get("PADDLE_ELASTIC_TIMEOUT", "30")
+        )
+        self._stop = threading.Event()
+        self._beat_thread: Optional[threading.Thread] = None
+
+    # -- worker side --------------------------------------------------------
+    def register(self) -> None:
+        """Announce membership and start renewing the heartbeat lease."""
+        self._beat()
+        self._beat_thread = threading.Thread(target=self._beat_loop, daemon=True)
+        self._beat_thread.start()
+
+    def _beat(self) -> None:
+        self._store.set(f"elastic/beat/{self.rank}", str(time.time()).encode())
+
+    def _beat_loop(self) -> None:
+        # renew at 1/3 TTL like a lease keepalive
+        while not self._stop.wait(self.ttl / 3.0):
+            try:
+                self._beat()
+            except Exception:
+                return  # store gone: the manager will see the lease expire
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._beat_thread is not None:
+            self._beat_thread.join(timeout=2)
+
+    # -- manager side -------------------------------------------------------
+    def alive_workers(self) -> List[int]:
+        now = time.time()
+        alive = []
+        for r in range(self.world_size):
+            try:
+                raw = self._store.get(f"elastic/beat/{r}")
+                if now - float(raw.decode()) <= self.ttl:
+                    alive.append(r)
+            except Exception:
+                continue
+        return alive
+
+    def watch(self) -> ElasticStatus:
+        """One membership scan (reference watch loop): HOLD when everyone is
+        alive, RESTART when membership shrank (dead heartbeat)."""
+        alive = self.alive_workers()
+        if len(alive) == self.world_size:
+            return ElasticStatus.HOLD
+        return ElasticStatus.RESTART
+
+    def dead_workers(self) -> List[int]:
+        alive = set(self.alive_workers())
+        return [r for r in range(self.world_size) if r not in alive]

@@ -48,6 +48,12 @@ def _parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                    help="visible device ids (comma separated)")
     p.add_argument("--log_dir", default=None)
     p.add_argument("--run_mode", default="collective", choices=["collective"])
+    p.add_argument(
+        "--max_restarts", type=int, default=int(os.environ.get("PADDLE_ELASTIC_MAX_RESTARTS", "0")),
+        help="elastic fault tolerance: relaunch a failed worker up to N times "
+        "(reference elastic manager relaunch, manager.py:251); the child sees "
+        "PADDLE_RESTART_COUNT and should resume from its latest checkpoint",
+    )
     p.add_argument("training_script", help="script to run")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -76,25 +82,44 @@ def launch(argv: Optional[List[str]] = None) -> int:
     if args.log_dir:
         os.makedirs(args.log_dir, exist_ok=True)
 
-    procs: List[subprocess.Popen] = []
-    logs = []
-    for local_rank in range(args.nproc_per_node):
+    def spawn(local_rank: int, restart_count: int = 0) -> subprocess.Popen:
         cmd = [sys.executable, "-u", args.training_script] + args.training_script_args
         stdout = None
         if args.log_dir:
             log_path = os.path.join(args.log_dir, f"workerlog.{local_rank}")
-            stdout = open(log_path, "w")
-            logs.append(stdout)
-        procs.append(
-            subprocess.Popen(
-                cmd,
-                env=_child_env(args, local_rank),
-                stdout=stdout,
-                stderr=subprocess.STDOUT if stdout else None,
-            )
+            stdout = open(log_path, "a" if restart_count else "w")
+        env = _child_env(args, local_rank)
+        env["PADDLE_RESTART_COUNT"] = str(restart_count)
+        proc = subprocess.Popen(
+            cmd, env=env, stdout=stdout, stderr=subprocess.STDOUT if stdout else None
         )
+        proc._local_rank = local_rank  # type: ignore[attr-defined]
+        proc._log = stdout  # type: ignore[attr-defined]
+        return proc
 
-    # watcher: tear everything down on first failure (reference watcher.py)
+    def reap(p: subprocess.Popen) -> None:
+        if getattr(p, "_log", None) is not None:
+            p._log.close()  # type: ignore[attr-defined]
+
+    def terminate_all(procs: List[subprocess.Popen]) -> None:
+        for other in procs:
+            other.send_signal(signal.SIGTERM)
+        for other in procs:
+            try:
+                other.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                other.kill()
+            reap(other)
+
+    restart_count = 0
+    procs: List[subprocess.Popen] = [spawn(r) for r in range(args.nproc_per_node)]
+
+    # watcher (reference watcher.py): poll children; on failure either
+    # relaunch (elastic fault tolerance, --max_restarts) or tear the job
+    # down. A relaunch restarts the WHOLE local group — surviving ranks are
+    # blocked inside collectives waiting on the dead one and a lone fresh
+    # process could never rejoin the advanced coordination state (the
+    # reference elastic manager also relaunches all local trainers).
     rc = 0
     try:
         while procs:
@@ -103,21 +128,27 @@ def launch(argv: Optional[List[str]] = None) -> int:
                 if ret is None:
                     continue
                 procs.remove(p)
-                if ret != 0:
-                    rc = ret
-                    for other in procs:
-                        other.send_signal(signal.SIGTERM)
-                    for other in procs:
-                        try:
-                            other.wait(timeout=10)
-                        except subprocess.TimeoutExpired:
-                            other.kill()
-                    procs = []
+                reap(p)
+                if ret == 0:
+                    continue
+                if restart_count < args.max_restarts:
+                    restart_count += 1
+                    sys.stderr.write(
+                        f"[launch] worker {p._local_rank} exited rc={ret}; "  # type: ignore[attr-defined]
+                        f"restarting the local group "
+                        f"(restart {restart_count}/{args.max_restarts})\n"
+                    )
+                    terminate_all(procs)
+                    procs = [spawn(r, restart_count) for r in range(args.nproc_per_node)]
                     break
+                rc = ret
+                terminate_all(procs)
+                procs = []
+                break
             time.sleep(0.2)
     finally:
-        for f in logs:
-            f.close()
+        for p in procs:
+            reap(p)
     return rc
 
 

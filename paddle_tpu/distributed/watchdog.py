@@ -1,0 +1,134 @@
+"""Communication/step watchdog: hang detection for compiled collective steps.
+
+Reference: ``paddle/phi/core/distributed/comm_task_manager.h:37``
+(``CommTaskManager`` + ``NCCLCommTask``): a background thread that watches
+enqueued collectives, detects async errors and hangs, dumps diagnostics on
+timeout and aborts the process so the job scheduler can relaunch.
+
+TPU translation: XLA compiles collectives into the step program, so the unit
+being watched is the *dispatched step* (or any section wrapping device work).
+A hang shows up as ``block_until_ready`` never returning — e.g. a peer host
+died mid all-reduce over DCN. The watchdog arms a timer around each watched
+section; on expiry it writes a diagnostic dump (section name, elapsed,
+recent section history, all Python thread stacks) and either calls the
+user's handler or aborts (``os._exit``) like the reference's error dump +
+abort path.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import traceback
+from collections import deque
+from typing import Any, Callable, Deque, Dict, List, Optional
+
+__all__ = ["CommWatchdog", "WatchdogTimeout"]
+
+
+class WatchdogTimeout(RuntimeError):
+    pass
+
+
+class CommWatchdog:
+    """Watch device-work sections for hangs.
+
+    Usage::
+
+        wd = CommWatchdog(timeout=1800, abort=True)
+        for batch in loader:
+            with wd.section("train_step"):
+                loss = train_step(model, opt, batch)   # blocks on device
+    """
+
+    def __init__(
+        self,
+        timeout: float = 1800.0,
+        on_timeout: Optional[Callable[[Dict[str, Any]], None]] = None,
+        abort: bool = False,
+        history: int = 64,
+    ) -> None:
+        self.timeout = float(timeout)
+        self.on_timeout = on_timeout
+        self.abort = abort
+        self.completed: Deque[Dict[str, Any]] = deque(maxlen=history)
+        self._lock = threading.Lock()
+        self._seq = 0
+
+    # -- dump ---------------------------------------------------------------
+    def _dump(self, name: str, started: float) -> Dict[str, Any]:
+        stacks: Dict[str, List[str]] = {}
+        for tid, frame in sys._current_frames().items():
+            stacks[str(tid)] = traceback.format_stack(frame)
+        return {
+            "section": name,
+            "elapsed_s": time.monotonic() - started,
+            "timeout_s": self.timeout,
+            "pid": os.getpid(),
+            "recent_sections": list(self.completed),
+            "thread_stacks": stacks,
+        }
+
+    def _fire(self, name: str, started: float, done: threading.Event) -> None:
+        if done.wait(self.timeout):
+            return
+        dump = self._dump(name, started)
+        try:
+            if self.on_timeout is not None:
+                self.on_timeout(dump)
+            else:
+                sys.stderr.write(
+                    f"[CommWatchdog] section '{name}' exceeded {self.timeout}s — "
+                    f"probable collective hang. Recent sections: "
+                    f"{[s['section'] for s in dump['recent_sections']]}\n"
+                )
+                for tid, st in dump["thread_stacks"].items():
+                    sys.stderr.write(f"--- thread {tid} ---\n{''.join(st)}\n")
+                sys.stderr.flush()
+        finally:
+            if self.abort:
+                # the hung collective cannot be cancelled from Python — abort
+                # so the launcher/elastic layer can relaunch (reference
+                # CommTaskManager timeout dump + abort)
+                os._exit(124)
+
+    # -- public -------------------------------------------------------------
+    def section(self, name: str = "step") -> "_Section":
+        return _Section(self, name)
+
+    def watch(self, fn: Callable, *args: Any, name: Optional[str] = None, **kwargs: Any) -> Any:
+        with self.section(name or getattr(fn, "__name__", "step")):
+            return fn(*args, **kwargs)
+
+
+class _Section:
+    def __init__(self, wd: CommWatchdog, name: str) -> None:
+        self._wd = wd
+        self._name = name
+        self._done = threading.Event()
+        self._started = 0.0
+
+    def __enter__(self) -> "_Section":
+        self._started = time.monotonic()
+        self._thread = threading.Thread(
+            target=self._wd._fire,
+            args=(self._name, self._started, self._done),
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def __exit__(self, exc_type: Any, exc: Any, tb: Any) -> None:
+        self._done.set()
+        with self._wd._lock:
+            self._wd._seq += 1
+            self._wd.completed.append(
+                {
+                    "section": self._name,
+                    "seq": self._wd._seq,
+                    "duration_s": time.monotonic() - self._started,
+                    "ok": exc_type is None,
+                }
+            )

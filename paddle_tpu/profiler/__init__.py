@@ -203,16 +203,42 @@ class Profiler:
         self._state = ProfilerState.CLOSED
         self._events: List[Dict[str, Any]] = []
         self._timer_only = timer_only
+        self._profile_memory = profile_memory
         self._jax_dir: Optional[str] = None
 
     def start(self) -> None:
         self._state = self._schedule(self._step)
         if self._state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
             _recorder.enabled = True
+        # HBM accounting across the profiled region (reference
+        # DeviceMemoryStat peak tracking, stats.h:126). Opt-in via
+        # profile_memory: the reset restarts the PROCESS-WIDE interval
+        # tracker, which must not silently clobber a user's own measurement.
+        if self._profile_memory:
+            try:
+                from paddle_tpu.core.memory import (
+                    memory_allocated,
+                    reset_max_memory_allocated,
+                )
+
+                reset_max_memory_allocated()
+                self.memory_at_start = memory_allocated()
+            except Exception:
+                self.memory_at_start = 0
 
     def stop(self) -> None:
         _recorder.enabled = False
         self._events.extend(_recorder.drain())
+        try:
+            from paddle_tpu.core.memory import max_memory_allocated, memory_allocated
+
+            # peak since the profiler's reset (profile_memory=True) or the
+            # process-wide peak (still useful, never destructive)
+            self.peak_memory_allocated = max_memory_allocated()
+            self.memory_at_stop = memory_allocated()
+        except Exception:
+            self.peak_memory_allocated = 0
+            self.memory_at_stop = 0
         if self._on_trace_ready is not None:
             self._on_trace_ready(self)
         self._state = ProfilerState.CLOSED

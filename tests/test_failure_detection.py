@@ -1,0 +1,183 @@
+"""Failure detection + elastic: comm watchdog hang dumps, TCPStore-lease
+membership, and launcher relaunch-on-failure with checkpoint resume.
+
+Reference parity: ``paddle/phi/core/distributed/comm_task_manager.h:37``
+(watchdog), ``fleet/elastic/manager.py:128-251`` (membership + relaunch).
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from paddle_tpu.distributed.watchdog import CommWatchdog
+from paddle_tpu_native.loader import load_native
+from paddle_tpu_native.store import TCPStore
+
+native_available = load_native() is not None
+
+
+class TestCommWatchdog:
+    def test_fast_section_no_fire(self):
+        fired = []
+        wd = CommWatchdog(timeout=5.0, on_timeout=fired.append)
+        with wd.section("quick"):
+            pass
+        time.sleep(0.1)
+        assert not fired
+        assert wd.completed[-1]["section"] == "quick" and wd.completed[-1]["ok"]
+
+    def test_hang_detected_with_dump(self):
+        fired = []
+        wd = CommWatchdog(timeout=0.3, on_timeout=fired.append)
+        with wd.section("hung_allreduce"):
+            time.sleep(0.8)  # simulated stuck collective
+        assert len(fired) == 1
+        dump = fired[0]
+        assert dump["section"] == "hung_allreduce"
+        assert dump["elapsed_s"] >= 0.3
+        assert dump["thread_stacks"]  # stacks captured for the hang dump
+        # the stuck frame (this sleep) is visible in some thread's stack
+        assert any("time.sleep" in "".join(st) or "test_hang_detected" in "".join(st)
+                   for st in dump["thread_stacks"].values())
+
+    def test_watch_wraps_callable(self):
+        wd = CommWatchdog(timeout=5.0)
+        assert wd.watch(lambda a, b: a + b, 2, 3) == 5
+        assert wd.completed[-1]["section"] == "<lambda>"
+
+    def test_history_records_failures(self):
+        wd = CommWatchdog(timeout=5.0)
+        with pytest.raises(RuntimeError):
+            with wd.section("boom"):
+                raise RuntimeError("x")
+        assert wd.completed[-1]["ok"] is False
+
+
+@pytest.mark.skipif(not native_available, reason="native lib not built")
+class TestElasticMembership:
+    def test_dead_worker_detected_after_kill(self, tmp_path):
+        """The VERDICT scenario: kill one local process, observe detection.
+        Worker 1 is a real subprocess heartbeating through the store; killing
+        it lets its lease expire while worker 0 stays alive."""
+        from paddle_tpu.distributed.fleet.elastic import ElasticManager, ElasticStatus
+
+        master = TCPStore("127.0.0.1", 0, is_master=True, world_size=2, timeout=3)
+        worker_code = textwrap.dedent(
+            f"""
+            import sys, time
+            sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
+            from paddle_tpu_native.store import TCPStore
+            from paddle_tpu.distributed.fleet.elastic import ElasticManager
+            store = TCPStore("127.0.0.1", {master.port}, is_master=False, timeout=3)
+            em = ElasticManager(store, rank=1, world_size=2, ttl=1.0)
+            em.register()
+            print("registered", flush=True)
+            time.sleep(60)
+            """
+        )
+        proc = subprocess.Popen(
+            [sys.executable, "-c", worker_code],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        try:
+            line = proc.stdout.readline().decode()
+            assert "registered" in line, line
+
+            mgr = ElasticManager(master, rank=0, world_size=2, ttl=1.0)
+            mgr.register()
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                if mgr.watch() == ElasticStatus.HOLD:
+                    break
+                time.sleep(0.2)
+            assert mgr.watch() == ElasticStatus.HOLD
+            assert mgr.alive_workers() == [0, 1]
+
+            proc.kill()
+            proc.wait(timeout=10)
+            deadline = time.time() + 10
+            status = ElasticStatus.HOLD
+            while time.time() < deadline:
+                status = mgr.watch()
+                if status == ElasticStatus.RESTART:
+                    break
+                time.sleep(0.2)
+            assert status == ElasticStatus.RESTART
+            assert mgr.dead_workers() == [1]
+            mgr.stop()
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+
+
+class TestLaunchRelaunch:
+    def test_failed_worker_relaunched_and_resumes(self, tmp_path):
+        """Launcher-level fault tolerance: the worker crashes on its first
+        life, is relaunched with PADDLE_RESTART_COUNT=1, restores its
+        'checkpoint' and succeeds."""
+        from paddle_tpu.distributed.launch.main import launch
+
+        ckpt = tmp_path / "ckpt.txt"
+        script = tmp_path / "train.py"
+        script.write_text(
+            textwrap.dedent(
+                f"""
+                import os, sys
+                ckpt = {str(ckpt)!r}
+                restart = int(os.environ.get("PADDLE_RESTART_COUNT", "0"))
+                if restart == 0:
+                    with open(ckpt, "w") as f:
+                        f.write("step=3")
+                    sys.exit(1)  # simulated crash mid-training
+                assert os.path.exists(ckpt), "checkpoint lost across relaunch"
+                state = open(ckpt).read()
+                assert state == "step=3"
+                print(f"resumed from {{state}} on restart {{restart}}")
+                """
+            )
+        )
+        rc = launch(["--max_restarts", "1", "--nproc_per_node", "1", str(script)])
+        assert rc == 0
+
+    def test_no_restarts_fails_job(self, tmp_path):
+        from paddle_tpu.distributed.launch.main import launch
+
+        script = tmp_path / "always_fail.py"
+        script.write_text("import sys; sys.exit(7)\n")
+        rc = launch(["--max_restarts", "0", "--nproc_per_node", "1", str(script)])
+        assert rc == 7
+
+    def test_group_restart_relaunches_all_local_workers(self, tmp_path):
+        """When one rank dies, the WHOLE local group restarts — surviving
+        ranks are stuck in collectives and a lone fresh process could never
+        rejoin (reference elastic manager restarts all local trainers)."""
+        from paddle_tpu.distributed.launch.main import launch
+
+        marker = tmp_path / "lives.txt"
+        script = tmp_path / "train.py"
+        script.write_text(
+            textwrap.dedent(
+                f"""
+                import os, sys, time
+                rank = int(os.environ["PADDLE_TRAINER_ID"])
+                restart = int(os.environ.get("PADDLE_RESTART_COUNT", "0"))
+                with open({str(marker)!r}, "a") as f:
+                    f.write(f"rank{{rank}}-life{{restart}}\\n")
+                if restart == 0:
+                    if rank == 0:
+                        sys.exit(3)      # rank 0 crashes
+                    time.sleep(60)       # rank 1 'hangs in a collective'
+                """
+            )
+        )
+        rc = launch(["--max_restarts", "1", "--nproc_per_node", "2", str(script)])
+        assert rc == 0
+        lives = set(marker.read_text().split())
+        # both ranks ran life 0 AND both were relaunched for life 1
+        assert {"rank0-life0", "rank1-life0", "rank0-life1", "rank1-life1"} <= lives
