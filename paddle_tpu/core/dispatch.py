@@ -82,10 +82,15 @@ def call_op(name: str, fn: Callable, *args: Any, **kwargs: Any) -> Any:
     diff_pos = [i for i in tensor_pos if _differentiable(leaves[i])]
     diff_tensors = [leaves[i] for i in diff_pos]
 
+    # Close over raw arrays only (no Tensor objects): the node retains this
+    # closure for create_graph re-differentiation, and holding Tensors here
+    # would pin non-differentiable inputs' upstream tape alive.
+    plain = list(leaves)
+    for i in tensor_pos:
+        plain[i] = data_at[i]
+
     def closed(*diff_arrays: Any) -> Any:
-        rebuilt = list(leaves)
-        for i in tensor_pos:
-            rebuilt[i] = data_at[i]
+        rebuilt = list(plain)
         for pos, arr in zip(diff_pos, diff_arrays):
             rebuilt[pos] = arr
         a, k = jax.tree_util.tree_unflatten(treedef, rebuilt)
@@ -94,9 +99,11 @@ def call_op(name: str, fn: Callable, *args: Any, **kwargs: Any) -> Any:
     primals = [data_at[i] for i in diff_pos]
     raw_out, vjp_fn = jax.vjp(closed, *primals)
 
-    flat_out, _ = jax.tree_util.tree_flatten(raw_out)
+    flat_out, out_treedef = jax.tree_util.tree_flatten(raw_out)
     out_avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in flat_out]
-    node = _ag.GradNode(name, vjp_fn, diff_tensors, out_avals)
+    node = _ag.GradNode(
+        name, vjp_fn, diff_tensors, out_avals, fwd_fn=closed, out_treedef=out_treedef
+    )
     return _wrap_outputs(name, raw_out, node=node)
 
 

@@ -142,20 +142,34 @@ class Tensor:
     def _apply_backward_hooks(self, g: Any) -> Any:
         if not self._backward_hooks:
             return g
-        gt = Tensor(g)
+        keep_tensor = isinstance(g, Tensor)
+        gt = g if keep_tensor else Tensor(g)
         for hook in self._backward_hooks:
             out = hook(gt)
             if out is not None:
                 gt = out if isinstance(out, Tensor) else Tensor(out)
-        return gt._data
+        return gt if keep_tensor else gt._data
 
     def _accumulate_grad(self, g: Any) -> None:
         # Grads accumulate in the parameter's dtype (AMP-cast cotangents are
         # upcast here, mirroring the cast-op grad in the reference's O1 path).
+        if isinstance(g, Tensor) and g.grad_node is not None:
+            # create_graph sweep: preserve the grad's own tape so it can be
+            # differentiated again (cast/add dispatched, not detached).
+            if jnp.dtype(g.dtype) != jnp.dtype(self._data.dtype):
+                g = g.astype(self._data.dtype)
+            self._grad = g if self._grad is None else self._grad + g
+            return
+        if isinstance(g, Tensor):
+            g = g._data
         if hasattr(g, "dtype") and jnp.dtype(g.dtype) != jnp.dtype(self._data.dtype):
             g = g.astype(self._data.dtype)
         if self._grad is None:
             self._grad = Tensor(g, stop_gradient=True, name=self.name + "@GRAD")
+        elif self._grad.grad_node is not None:
+            # Existing grad carries a tape (create_graph): add via dispatch so
+            # the taped component stays differentiable.
+            self._grad = self._grad + Tensor(g, stop_gradient=True)
         else:
             self._grad = Tensor(self._grad._data + g, stop_gradient=True, name=self.name + "@GRAD")
 

@@ -147,3 +147,90 @@ def test_getitem_grad():
     x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]], stop_gradient=False)
     x[0].sum().backward()
     np.testing.assert_allclose(x.grad.numpy(), [[1, 1], [0, 0]])
+
+
+# -- higher-order (create_graph) ------------------------------------------
+# Reference: egr::Grad with create_graph=True, paddle/fluid/eager/backward.cc:450.
+
+
+def test_create_graph_double_grad():
+    x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = x**3
+    (gx,) = paddle.grad([y.sum()], [x], create_graph=True)
+    np.testing.assert_allclose(gx.numpy(), [12.0, 27.0])
+    assert not gx.stop_gradient
+    (ggx,) = paddle.grad([gx.sum()], [x])
+    np.testing.assert_allclose(ggx.numpy(), [12.0, 18.0])
+
+
+def test_create_graph_third_order():
+    x = paddle.to_tensor([1.5], stop_gradient=False)
+    y = x**4
+    (g1,) = paddle.grad([y.sum()], [x], create_graph=True)
+    (g2,) = paddle.grad([g1.sum()], [x], create_graph=True)
+    (g3,) = paddle.grad([g2.sum()], [x])
+    np.testing.assert_allclose(g3.numpy(), [36.0], rtol=1e-6)
+
+
+def test_create_graph_mixed_partial():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = paddle.to_tensor(5.0, stop_gradient=False)
+    z = x * y * y
+    (gx,) = paddle.grad([z], [x], create_graph=True)
+    np.testing.assert_allclose(gx.numpy(), 25.0)
+    (gxy,) = paddle.grad([gx], [y])
+    np.testing.assert_allclose(gxy.numpy(), 10.0)
+
+
+def test_create_graph_backward_on_grad():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = (x * x).sum()
+    (gx,) = paddle.grad([y], [x], create_graph=True)
+    gx.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_create_graph_matmul_second_order():
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((3, 4)).astype(np.float32)
+    c = rng.standard_normal((3, 4)).astype(np.float32)
+    A = paddle.to_tensor(a, stop_gradient=False)
+    B = paddle.to_tensor(rng.standard_normal((4, 2)).astype(np.float32), stop_gradient=False)
+    out = paddle.matmul(A, B).sum()
+    (gA,) = paddle.grad([out], [A], create_graph=True)  # = ones(3,2) @ B.T
+    (gB,) = paddle.grad([(gA * paddle.to_tensor(c)).sum()], [B], allow_unused=True)
+    # d/dB sum(ones@B.T * C) = C.T @ ones(3,2)
+    np.testing.assert_allclose(gB.numpy(), c.T @ np.ones((3, 2), np.float32), rtol=1e-5)
+
+
+def test_create_graph_exp_hessian_vector():
+    x = paddle.to_tensor([0.3, -0.7], stop_gradient=False)
+    y = paddle.exp(x).sum()
+    (gx,) = paddle.grad([y], [x], create_graph=True)
+    v = paddle.to_tensor([1.0, 2.0])
+    (hvp,) = paddle.grad([(gx * v).sum()], [x])
+    np.testing.assert_allclose(hvp.numpy(), np.exp([0.3, -0.7]) * [1.0, 2.0], rtol=1e-6)
+
+
+def test_grad_only_inputs_no_side_effects():
+    a = paddle.to_tensor(2.0, stop_gradient=False)
+    b = paddle.to_tensor(5.0, stop_gradient=False)
+    z = a * b
+    (ga,) = paddle.grad([z], [a])
+    np.testing.assert_allclose(ga.numpy(), 5.0)
+    assert b.grad is None  # egr::Grad only_inputs semantics
+
+
+def test_mixed_accumulation_keeps_taped_grad():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = (x * x).sum()
+    # taped grad via run_backward(create_graph), then a plain backward on top
+    from paddle_tpu.core.autograd import run_backward
+
+    run_backward([y], retain_graph=True, create_graph=True)
+    assert x.grad.grad_node is not None
+    y2 = (x * 3.0).sum()
+    y2.backward()
+    # 2x + 3 accumulated; the taped component must survive
+    np.testing.assert_allclose(x.grad.numpy(), [9.0])
+    assert x.grad.grad_node is not None

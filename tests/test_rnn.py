@@ -1,0 +1,220 @@
+"""RNN/LSTM/GRU layer tests (reference test model:
+``test/dygraph_to_static`` + ``test/rnn/test_rnn_nets.py`` — numeric parity
+against torch CPU as the oracle, matching weight layouts)."""
+
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+
+
+RNG = np.random.default_rng(7)
+B, T, I, H = 3, 7, 5, 4
+
+
+def _copy_cell_to_torch(cell, tmod, layer, suffix=""):
+    with torch.no_grad():
+        getattr(tmod, f"weight_ih_l{layer}{suffix}").copy_(torch.tensor(cell.weight_ih.numpy()))
+        getattr(tmod, f"weight_hh_l{layer}{suffix}").copy_(torch.tensor(cell.weight_hh.numpy()))
+        getattr(tmod, f"bias_ih_l{layer}{suffix}").copy_(torch.tensor(cell.bias_ih.numpy()))
+        getattr(tmod, f"bias_hh_l{layer}{suffix}").copy_(torch.tensor(cell.bias_hh.numpy()))
+
+
+def _layer_cell(rnn_layer, direction=0):
+    if hasattr(rnn_layer, "cell"):
+        return rnn_layer.cell
+    return rnn_layer.cell_fw if direction == 0 else rnn_layer.cell_bw
+
+
+def test_lstm_matches_torch():
+    x = RNG.standard_normal((B, T, I)).astype(np.float32)
+    m = paddle.nn.LSTM(I, H)
+    tm = torch.nn.LSTM(I, H, batch_first=True)
+    _copy_cell_to_torch(m[0].cell, tm, 0)
+    out, (h, c) = m(paddle.to_tensor(x))
+    tout, (th, tc) = tm(torch.tensor(x))
+    np.testing.assert_allclose(out.numpy(), tout.detach().numpy(), atol=1e-5)
+    np.testing.assert_allclose(h.numpy(), th.detach().numpy(), atol=1e-5)
+    np.testing.assert_allclose(c.numpy(), tc.detach().numpy(), atol=1e-5)
+
+
+def test_gru_matches_torch():
+    x = RNG.standard_normal((B, T, I)).astype(np.float32)
+    m = paddle.nn.GRU(I, H)
+    tm = torch.nn.GRU(I, H, batch_first=True)
+    _copy_cell_to_torch(m[0].cell, tm, 0)
+    out, h = m(paddle.to_tensor(x))
+    tout, th = tm(torch.tensor(x))
+    np.testing.assert_allclose(out.numpy(), tout.detach().numpy(), atol=1e-5)
+    np.testing.assert_allclose(h.numpy(), th.detach().numpy(), atol=1e-5)
+
+
+def test_simple_rnn_matches_torch():
+    x = RNG.standard_normal((B, T, I)).astype(np.float32)
+    m = paddle.nn.SimpleRNN(I, H, activation="relu")
+    tm = torch.nn.RNN(I, H, nonlinearity="relu", batch_first=True)
+    _copy_cell_to_torch(m[0].cell, tm, 0)
+    out, h = m(paddle.to_tensor(x))
+    tout, th = tm(torch.tensor(x))
+    np.testing.assert_allclose(out.numpy(), tout.detach().numpy(), atol=1e-5)
+
+
+def test_bidirectional_two_layer_lstm_matches_torch():
+    x = RNG.standard_normal((B, T, I)).astype(np.float32)
+    m = paddle.nn.LSTM(I, H, num_layers=2, direction="bidirect")
+    tm = torch.nn.LSTM(I, H, num_layers=2, bidirectional=True, batch_first=True)
+    for layer in range(2):
+        for d, suf in ((0, ""), (1, "_reverse")):
+            _copy_cell_to_torch(_layer_cell(m[layer], d), tm, layer, suf)
+    out, (h, c) = m(paddle.to_tensor(x))
+    tout, (th, tc) = tm(torch.tensor(x))
+    np.testing.assert_allclose(out.numpy(), tout.detach().numpy(), atol=1e-5)
+    np.testing.assert_allclose(h.numpy(), th.detach().numpy(), atol=1e-5)
+    np.testing.assert_allclose(c.numpy(), tc.detach().numpy(), atol=1e-5)
+
+
+def test_time_major():
+    x = RNG.standard_normal((T, B, I)).astype(np.float32)
+    m = paddle.nn.GRU(I, H, time_major=True)
+    out, h = m(paddle.to_tensor(x))
+    assert list(out.shape) == [T, B, H]
+    # same weights run batch-first must agree
+    m2 = paddle.nn.GRU(I, H)
+    m2.set_state_dict(m.state_dict())
+    out2, _ = m2(paddle.to_tensor(np.swapaxes(x, 0, 1)))
+    np.testing.assert_allclose(out.numpy(), np.swapaxes(out2.numpy(), 0, 1), atol=1e-6)
+
+
+def test_sequence_length_masking():
+    x = RNG.standard_normal((B, T, I)).astype(np.float32)
+    seq_len = np.array([T, 4, 2], dtype=np.int32)
+    m = paddle.nn.LSTM(I, H)
+    tm = torch.nn.LSTM(I, H, batch_first=True)
+    _copy_cell_to_torch(m[0].cell, tm, 0)
+    out, (h, c) = m(paddle.to_tensor(x), sequence_length=paddle.to_tensor(seq_len))
+    packed = torch.nn.utils.rnn.pack_padded_sequence(
+        torch.tensor(x), torch.tensor(seq_len, dtype=torch.int64), batch_first=True
+    )
+    tout_p, (th, tc) = tm(packed)
+    tout, _ = torch.nn.utils.rnn.pad_packed_sequence(tout_p, batch_first=True, total_length=T)
+    np.testing.assert_allclose(out.numpy(), tout.detach().numpy(), atol=1e-5)
+    np.testing.assert_allclose(h.numpy(), th.detach().numpy(), atol=1e-5)
+    np.testing.assert_allclose(c.numpy(), tc.detach().numpy(), atol=1e-5)
+
+
+def test_sequence_length_reverse_direction():
+    x = RNG.standard_normal((B, T, I)).astype(np.float32)
+    seq_len = np.array([T, 5, 3], dtype=np.int32)
+    cell = paddle.nn.GRUCell(I, H)
+    rnn_bw = paddle.nn.RNN(cell, is_reverse=True)
+    out, h = rnn_bw(paddle.to_tensor(x), sequence_length=paddle.to_tensor(seq_len))
+    # reverse scan with mask: final state equals processing x[:len] backwards
+    for b in range(B):
+        hb = np.zeros((1, H), np.float32)
+        for t in reversed(range(seq_len[b])):
+            _, hb_t = cell(paddle.to_tensor(x[b : b + 1, t]), paddle.to_tensor(hb))
+            hb = hb_t.numpy()
+        np.testing.assert_allclose(h.numpy()[b], hb[0], atol=1e-5)
+        # outputs past the valid region are zeroed
+        assert np.all(out.numpy()[b, seq_len[b] :] == 0)
+
+
+def test_lstm_proj_size():
+    P = 3
+    x = RNG.standard_normal((B, T, I)).astype(np.float32)
+    m = paddle.nn.LSTM(I, H, proj_size=P)
+    tm = torch.nn.LSTM(I, H, proj_size=P, batch_first=True)
+    cell = m[0].cell
+    _copy_cell_to_torch(cell, tm, 0)
+    with torch.no_grad():
+        tm.weight_hr_l0.copy_(torch.tensor(cell.weight_ho.numpy().T))
+    out, (h, c) = m(paddle.to_tensor(x))
+    tout, (th, tc) = tm(torch.tensor(x))
+    assert list(out.shape) == [B, T, P]
+    np.testing.assert_allclose(out.numpy(), tout.detach().numpy(), atol=1e-5)
+    np.testing.assert_allclose(c.numpy(), tc.detach().numpy(), atol=1e-5)
+
+
+def test_cells_single_step():
+    x = RNG.standard_normal((B, I)).astype(np.float32)
+    lstm_cell = paddle.nn.LSTMCell(I, H)
+    out, (h, c) = lstm_cell(paddle.to_tensor(x))
+    assert list(out.shape) == [B, H] and list(c.shape) == [B, H]
+    gru_cell = paddle.nn.GRUCell(I, H)
+    out, h = gru_cell(paddle.to_tensor(x))
+    assert list(out.shape) == [B, H]
+    rnn_cell = paddle.nn.SimpleRNNCell(I, H)
+    out, h = rnn_cell(paddle.to_tensor(x))
+    assert list(out.shape) == [B, H]
+
+
+def test_rnn_grads_flow_through_scan():
+    x = paddle.to_tensor(RNG.standard_normal((B, T, I)).astype(np.float32))
+    m = paddle.nn.LSTM(I, H, num_layers=2)
+    out, _ = m(x)
+    out.sum().backward()
+    for p in m.parameters():
+        assert p.grad is not None, p.name
+        assert np.isfinite(p.grad.numpy()).all()
+
+
+def test_rnn_grad_matches_torch():
+    x = RNG.standard_normal((B, T, I)).astype(np.float32)
+    m = paddle.nn.GRU(I, H)
+    tm = torch.nn.GRU(I, H, batch_first=True)
+    _copy_cell_to_torch(m[0].cell, tm, 0)
+    out, _ = m(paddle.to_tensor(x))
+    out.sum().backward()
+    tx = torch.tensor(x)
+    tout, _ = tm(tx)
+    tout.sum().backward()
+    np.testing.assert_allclose(
+        m[0].cell.weight_ih.grad.numpy(), tm.weight_ih_l0.grad.numpy(), atol=1e-4
+    )
+    np.testing.assert_allclose(
+        m[0].cell.weight_hh.grad.numpy(), tm.weight_hh_l0.grad.numpy(), atol=1e-4
+    )
+
+
+def test_rnn_under_jit():
+    m = paddle.nn.LSTM(I, H)
+    x = paddle.to_tensor(RNG.standard_normal((B, T, I)).astype(np.float32))
+    eager, _ = m(x)
+
+    stepped = paddle.jit.to_static(lambda inp: m(inp)[0])
+    jitted = stepped(x)
+    np.testing.assert_allclose(eager.numpy(), jitted.numpy(), atol=1e-6)
+
+
+def test_rnn_dropout_between_layers():
+    m = paddle.nn.GRU(I, H, num_layers=2, dropout=0.5)
+    x = paddle.to_tensor(RNG.standard_normal((B, T, I)).astype(np.float32))
+    m.eval()
+    o1, _ = m(x)
+    o2, _ = m(x)
+    np.testing.assert_allclose(o1.numpy(), o2.numpy())  # eval: dropout off
+    m.train()
+    o3, _ = m(x)
+    assert o3.shape == o1.shape
+
+
+def test_custom_cell_generic_fallback():
+    class WrappedGRU(paddle.nn.RNNCellBase):
+        def __init__(self, input_size, hidden_size):
+            super().__init__()
+            self.inner = paddle.nn.GRUCell(input_size, hidden_size)
+
+        @property
+        def state_shape(self):
+            return (self.inner.hidden_size,)
+
+        def forward(self, inputs, states=None):
+            return self.inner(inputs, states)
+
+    x = RNG.standard_normal((B, T, I)).astype(np.float32)
+    cell = WrappedGRU(I, H)
+    out, h = paddle.nn.RNN(cell)(paddle.to_tensor(x))
+    ref_out, ref_h = paddle.nn.RNN(cell.inner)(paddle.to_tensor(x))
+    np.testing.assert_allclose(out.numpy(), ref_out.numpy(), atol=1e-6)
+    np.testing.assert_allclose(h.numpy(), ref_h.numpy(), atol=1e-6)
