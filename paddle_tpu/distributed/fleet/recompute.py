@@ -132,14 +132,37 @@ def recompute(function: Any, *args: Any, **kwargs: Any) -> Any:
                 grad_outputs.append(Tensor(jnp.zeros(aval.shape, aval.dtype)))
             else:
                 grad_outputs.append(Tensor(c))
-        # Inner sweep: param grads accumulate in-place; input grads captured.
-        grads = _ag.grad(
-            [o for o in re_out_tensors],
-            recomputed_inputs,
-            grad_outputs=grad_outputs,
-            allow_unused=True,
+        # Inner sweep semantics must match the OUTER sweep's:
+        # - under a plain ``backward()`` (no capture set): accumulate mode —
+        #   parameter grads write into ``param.grad`` in place, additive, so
+        #   composition with grads arriving from outside the segment is
+        #   correct (matches the reference PyLayer backward, which calls
+        #   paddle.autograd.backward on the inner graph);
+        # - under an only-inputs ``autograd.grad()``: inherit the outer
+        #   capture set (plus our own segment inputs) so params are NOT
+        #   side-effected — unless the caller asked for them.
+        # Input grads are read off the fresh leaf tensors afterwards.
+        roots: List[Tensor] = []
+        root_cots: List[Any] = []
+        for o, g in zip(re_out_tensors, grad_outputs):
+            if o.grad_node is None and o.stop_gradient:
+                continue  # output did not depend on anything differentiable
+            roots.append(o)
+            root_cots.append(g)
+        for t in recomputed_inputs:
+            t._grad = None
+        outer_capture = _ag.current_grad_capture()
+        inner_capture = (
+            None
+            if outer_capture is None
+            else set(outer_capture) | {id(t) for t in recomputed_inputs}
         )
-        out = tuple(g.data if g is not None else None for g in grads)
+        if roots:
+            _ag.run_backward(roots, root_cots, grad_capture=inner_capture)
+        out = tuple(
+            t.grad.data if t.grad is not None else None
+            for t in recomputed_inputs
+        )
         return out
 
     node = _ag.GradNode("recompute", vjp_fn, tensor_inputs, out_avals)

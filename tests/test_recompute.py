@@ -170,3 +170,30 @@ def test_recompute_replays_amp_state():
     lin.clear_gradients()
     loss_rc.backward()  # outside auto_cast: state must be replayed
     np.testing.assert_allclose(lin.weight.grad.numpy(), ref, rtol=1e-6)
+
+
+def test_recompute_under_only_inputs_grad_no_param_side_effects():
+    """autograd.grad() through a recompute segment must honor only-inputs
+    semantics: input grads returned, param .grad left untouched (r4 review
+    finding — the inner sweep used to side-effect params)."""
+    paddle.seed(0)
+    lin = nn.Linear(8, 8)
+    x = _t(np.random.rand(4, 8).astype(np.float32))
+    x.stop_gradient = False
+
+    loss = recompute(lin, x).sum()
+    (gx,) = paddle.autograd.grad([loss], [x])
+    assert gx is not None
+    assert lin.weight.grad is None, "grad() leaked param grads through recompute"
+    assert lin.bias.grad is None
+
+    # and asking grad() FOR the segment's params still works
+    loss2 = recompute(lin, x).sum()
+    gw, gb = paddle.autograd.grad([loss2], [lin.weight, lin.bias])
+    assert gw is not None and gb is not None
+    # parity vs non-recompute grad()
+    loss3 = lin(x).sum()
+    gw3, gb3 = paddle.autograd.grad([loss3], [lin.weight, lin.bias])
+    np.testing.assert_allclose(gw.numpy(), gw3.numpy(), rtol=1e-5)
+    np.testing.assert_allclose(gb.numpy(), gb3.numpy(), rtol=1e-5)
+    assert lin.weight.grad is None
