@@ -118,7 +118,7 @@ def fused_rms_norm_pallas(
     rows = 1
     for s in lead:
         rows *= s
-    blk = 128
+    blk = _autotune_rms_rows(rows, h, x.dtype, float(epsilon), bool(interpret))
     pad = (-rows) % blk
     x2 = x.reshape(1, rows, h)
     if pad:
@@ -126,6 +126,24 @@ def fused_rms_norm_pallas(
     core = _make_rms(rows + pad, h, float(epsilon), blk, bool(interpret))
     y = core(x2, weight)
     return y[0, :rows].reshape(*lead, h)
+
+
+def _autotune_rms_rows(rows: int, h: int, dtype, eps: float, interpret: bool) -> int:
+    """Benchmark-pick the row-block for rms_norm at this shape (reference
+    ``auto_tune_base.h:48``); 128 when tuning is off."""
+    from paddle_tpu.kernels.autotune import autotune
+
+    key = (rows, h, str(dtype))
+
+    def build(blk):
+        pad = (-rows) % blk
+        xz = jnp.zeros((1, rows + pad, h), dtype)
+        wz = jnp.zeros((h,), dtype)
+        core = _make_rms(rows + pad, h, eps, blk, interpret)
+        return lambda: core(xz, wz)
+
+    picked = autotune("fused_rms_norm", key, (128, 256, 512, 1024), build, default=128)
+    return int(picked)
 
 
 def _rope_kernel(x_ref, cos_ref, sin_ref, y_ref):
@@ -139,24 +157,80 @@ def _rope_kernel(x_ref, cos_ref, sin_ref, y_ref):
     y_ref[0, 0] = (x * cos + rot * sin).astype(y_ref.dtype)
 
 
+def _rope_bwd_kernel(g_ref, cos_ref, sin_ref, dx_ref):
+    # y = x⊙cos + rot(x)⊙sin with rot([x1,x2]) = [-x2, x1]. The adjoint of
+    # rot is unrot([v1,v2]) = [v2, -v1], so dx = g⊙cos + unrot(g⊙sin):
+    #   dx1 = g1·cos1 + g2·sin2 ; dx2 = g2·cos2 − g1·sin1
+    # (exact even when the two sin halves differ — no table-symmetry
+    # assumption). Reference: fused_rope_grad_kernel.cu (fused_ops.yaml:408).
+    g = g_ref[0, 0].astype(jnp.float32)  # [S, D]
+    cos = cos_ref[0].astype(jnp.float32)
+    sin = sin_ref[0].astype(jnp.float32)
+    d = g.shape[-1]
+    gs = g * sin
+    v1 = gs[:, : d // 2]
+    v2 = gs[:, d // 2 :]
+    unrot = jnp.concatenate([v2, -v1], axis=-1)
+    dx_ref[0, 0] = (g * cos + unrot).astype(dx_ref.dtype)
+
+
+@functools.lru_cache(maxsize=None)
+def _make_rope(bh, s, d, interpret):
+    grid = (bh,)
+    in_specs = [
+        pl.BlockSpec((1, 1, s, d), lambda i: (i, 0, 0, 0)),
+        pl.BlockSpec((1, s, d), lambda i: (0, 0, 0)),
+        pl.BlockSpec((1, s, d), lambda i: (0, 0, 0)),
+    ]
+    out_spec = pl.BlockSpec((1, 1, s, d), lambda i: (i, 0, 0, 0))
+
+    def run(kernel, xh, cos2, sin2):
+        return pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=out_spec,
+            out_shape=jax.ShapeDtypeStruct((bh, 1, s, d), xh.dtype),
+            interpret=interpret,
+        )(xh, cos2, sin2)
+
+    @jax.custom_vjp
+    def core(xh, cos2, sin2):
+        return run(_rope_kernel, xh, cos2, sin2)
+
+    def core_fwd(xh, cos2, sin2):
+        return run(_rope_kernel, xh, cos2, sin2), (xh, cos2, sin2)
+
+    def core_bwd(res, g):
+        xh, cos2, sin2 = res
+        dx = run(_rope_bwd_kernel, g, cos2, sin2)
+        # Table cotangents: trig tables are constants in every real model, so
+        # XLA dead-code-eliminates these sums; computed exactly for parity.
+        gf = g.astype(jnp.float32)
+        xf = xh.astype(jnp.float32)
+        dcos = jnp.sum(gf * xf, axis=0)  # [1, S, D]
+        x1 = xf[..., : d // 2]
+        x2 = xf[..., d // 2 :]
+        rot = jnp.concatenate([-x2, x1], axis=-1)
+        dsin = jnp.sum(gf * rot, axis=0)
+        return dx, dcos.astype(cos2.dtype), dsin.astype(sin2.dtype)
+
+    core.defvjp(core_fwd, core_bwd)
+    return core
+
+
 def fused_rope_pallas(
     x: jax.Array, cos: jax.Array, sin: jax.Array, interpret: bool = False
 ) -> jax.Array:
-    """Rotate-half rotary embedding. ``x`` [B, S, H, D]; cos/sin [S, D]."""
+    """Rotate-half rotary embedding. ``x`` [B, S, H, D]; cos/sin [S, D].
+
+    Differentiable: custom VJP with a Pallas backward kernel (the bwd is a
+    rope with the rotation adjoint applied to g⊙sin).
+    """
     b, s, h, d = x.shape
     xh = jnp.moveaxis(x, 2, 1).reshape(b * h, 1, s, d)  # grid over B*H
     cos2 = cos.reshape(1, s, d)
     sin2 = sin.reshape(1, s, d)
-    y = pl.pallas_call(
-        _rope_kernel,
-        grid=(b * h,),
-        in_specs=[
-            pl.BlockSpec((1, 1, s, d), lambda i: (i, 0, 0, 0)),
-            pl.BlockSpec((1, s, d), lambda i: (0, 0, 0)),
-            pl.BlockSpec((1, s, d), lambda i: (0, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, 1, s, d), lambda i: (i, 0, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, 1, s, d), x.dtype),
-        interpret=interpret,
-    )(xh, cos2, sin2)
+    core = _make_rope(b * h, s, d, bool(interpret))
+    y = core(xh, cos2, sin2)
     return jnp.moveaxis(y.reshape(b, h, s, d), 1, 2)

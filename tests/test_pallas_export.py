@@ -124,10 +124,9 @@ class TestFusedKernelExport:
         w = jnp.asarray(rng.normal(size=shape[-1:]), jnp.bfloat16)
         _export_grad(lambda x, w: fused_rms_norm_pallas(x, w, 1e-6), x, w)
 
-    def test_rope_forward(self):
-        # rope has no custom VJP (grad falls back at trace time, catchably);
-        # only the forward must lower
+    def test_rope_grad(self):
+        # custom VJP: fwd AND the Pallas bwd kernel must lower for TPU
         rng = np.random.default_rng(0)
         x = jnp.asarray(rng.normal(size=(2, 256, 4, 64)), jnp.bfloat16)
         cs = jnp.asarray(rng.normal(size=(256, 64)), jnp.float32)
-        jax.export.export(jax.jit(lambda x: fused_rope_pallas(x, cs, cs)), platforms=["tpu"])(x)
+        _export_grad(lambda x: fused_rope_pallas(x, cs, cs), x)
