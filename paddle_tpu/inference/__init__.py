@@ -1,0 +1,349 @@
+"""Inference / deployment API: Config + Predictor.
+
+Reference: the AnalysisPredictor stack
+(``paddle/fluid/inference/api/analysis_predictor.h:105``,
+``paddle_inference_api.h``, ``analysis_config.cc``) — load a saved program +
+params, run an optimization pass pipeline, optionally convert to mixed
+precision (``paddle/fluid/inference/analysis/passes/convert_to_mixed_precision.cc``),
+then serve ``Run()`` with zero-copy input/output handles.
+
+TPU-native redesign: the "program" is a serialized ``jax.export`` artifact
+(StableHLO) produced by ``paddle_tpu.jit.save``; the pass pipeline and memory
+optimization are XLA's job at compile time, so ``Config``'s IR-optim switches
+gate *donation* and *precision casting* — the two knobs that exist on this
+side of the compiler. Handles mirror the reference's zero-copy tensors: inputs
+are staged host-side and device-put once per ``run()``; outputs stay on device
+until ``copy_to_cpu()``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "Config",
+    "Predictor",
+    "PredictorTensor",
+    "create_predictor",
+    "convert_to_mixed_precision",
+    "PrecisionType",
+]
+
+
+class PrecisionType:
+    """Reference ``paddle_infer.PrecisionType`` parity."""
+
+    Float32 = "float32"
+    Half = "float16"
+    Bfloat16 = "bfloat16"
+    Int8 = "int8"  # accepted, mapped to bf16 (no TPU int8 serving path yet)
+
+
+class Config:
+    """Inference config (reference ``AnalysisConfig``).
+
+    ``Config(prog_file, params_file)`` or ``Config(model_dir)`` where the dir
+    contains ``inference.pdmodel`` / ``inference.pdiparams`` (also accepts the
+    bare bundle prefix produced by ``paddle_tpu.jit.save``).
+    """
+
+    def __init__(self, prog_file: Optional[str] = None, params_file: Optional[str] = None) -> None:
+        self._prefix: Optional[str] = None
+        if prog_file is not None and params_file is None:
+            # model_dir form, or a bundle prefix
+            if os.path.isdir(prog_file):
+                for stem in ("inference", "model", "__model__"):
+                    cand = os.path.join(prog_file, stem)
+                    if os.path.exists(cand + ".pdmodel"):
+                        self._prefix = cand
+                        break
+                if self._prefix is None:
+                    raise FileNotFoundError(f"no *.pdmodel bundle under {prog_file}")
+            else:
+                self._prefix = prog_file[:-8] if prog_file.endswith(".pdmodel") else prog_file
+        elif prog_file is not None:
+            self._prefix = prog_file[:-8] if prog_file.endswith(".pdmodel") else prog_file
+        self._layer: Any = None
+        self._input_spec: Optional[Sequence[Any]] = None
+        self.device: str = "tpu"
+        self.precision: str = PrecisionType.Float32
+        self.memory_optim: bool = True  # donate input buffers
+        self.ir_optim: bool = True  # kept for API parity; XLA always optimizes
+
+    # -- construction from a live layer (the reference's memory-program path) --
+    @classmethod
+    def from_layer(cls, layer: Any, input_spec: Sequence[Any]) -> "Config":
+        cfg = cls()
+        cfg._layer = layer
+        cfg._input_spec = input_spec
+        return cfg
+
+    # -- reference AnalysisConfig surface ------------------------------------
+    def set_model(self, prog_file: str, params_file: Optional[str] = None) -> None:
+        self._prefix = prog_file[:-8] if prog_file.endswith(".pdmodel") else prog_file
+
+    def enable_use_gpu(self, memory_pool_init_size_mb: int = 100, device_id: int = 0) -> None:
+        self.device = "tpu"  # accelerator serving; TPU is the accelerator here
+
+    def disable_gpu(self) -> None:
+        self.device = "cpu"
+
+    def enable_mixed_precision(self, precision: str = PrecisionType.Bfloat16) -> None:
+        self.precision = precision
+
+    def enable_memory_optim(self, x: bool = True) -> None:
+        self.memory_optim = bool(x)
+
+    def switch_ir_optim(self, x: bool = True) -> None:
+        self.ir_optim = bool(x)
+
+    def set_cpu_math_library_num_threads(self, n: int) -> None:  # parity no-op
+        pass
+
+    def summary(self) -> str:
+        return (
+            f"Config(prefix={self._prefix}, device={self.device}, "
+            f"precision={self.precision}, memory_optim={self.memory_optim})"
+        )
+
+
+class PredictorTensor:
+    """Zero-copy style input/output handle (reference ``ZeroCopyTensor``)."""
+
+    def __init__(self, name: str, shape: Sequence[int], dtype: str) -> None:
+        self.name = name
+        self._shape = list(shape)
+        self._dtype = dtype
+        self._host: Optional[np.ndarray] = None
+        self._device: Optional[jax.Array] = None
+
+    def shape(self) -> List[int]:
+        if self._device is not None:
+            return list(self._device.shape)
+        return self._shape
+
+    def copy_from_cpu(self, arr: np.ndarray) -> None:
+        self._host = np.asarray(arr)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        if self._device is None:
+            raise RuntimeError(f"output '{self.name}' not produced yet; call run() first")
+        return np.asarray(self._device)
+
+    # reference aliases
+    def reshape(self, shape: Sequence[int]) -> None:
+        self._shape = list(shape)
+
+    def type(self) -> str:
+        return self._dtype
+
+
+class Predictor:
+    """Compiled serving predictor (reference ``AnalysisPredictor``).
+
+    Construction compiles once; ``run()`` executes with resident weights.
+    Weights are device-resident across calls; with ``memory_optim`` the input
+    buffers are donated to XLA so activations can reuse them.
+    """
+
+    def __init__(self, config: Config) -> None:
+        self._config = config
+        # honor Config.device: "cpu" pins execution to the host backend even
+        # when an accelerator is visible (committed arrays steer jit)
+        self._device = None
+        if config.device == "cpu" and jax.default_backend() != "cpu":
+            try:
+                self._device = jax.devices("cpu")[0]
+            except RuntimeError:
+                self._device = None
+        if config._layer is not None:
+            self._init_from_layer(config)
+        elif config._prefix is not None:
+            self._init_from_bundle(config)
+        else:
+            raise ValueError("Config has neither a model path nor a layer")
+        if self._device is not None:
+            self._params = jax.device_put(self._params, self._device)
+
+    # -- init paths ----------------------------------------------------------
+    def _init_from_bundle(self, config: Config) -> None:
+        from paddle_tpu.jit.save_load import load
+
+        bundle = load(config._prefix)
+        if bundle._exported is None:
+            raise RuntimeError(
+                f"{config._prefix}.pdmodel has no serialized program; re-save with "
+                "jit.save(layer, path, input_spec=...)"
+            )
+        params = {k: t._data for k, t in bundle.state_dict().items()}
+        # NOTE: precision conversion cannot be applied to an already-exported
+        # program (dtypes are baked into the StableHLO signature) — that is a
+        # save-time pass here (convert_to_mixed_precision), exactly like the
+        # reference's offline convert_to_mixed_precision.cc tool.
+        exported = bundle._exported
+        call = exported.call
+        n_in = len(bundle.input_spec)
+        donate = config.memory_optim and config.device != "cpu" and jax.default_backend() != "cpu"
+        self._fn = jax.jit(
+            lambda params_, *xs: call(params_, *xs),
+            donate_argnums=tuple(range(1, 1 + n_in)) if donate else (),
+        )
+        self._params = params
+        self._inputs = [
+            PredictorTensor(s["name"], s["shape"], s["dtype"]) for s in bundle.input_spec
+        ]
+        self._outputs = [
+            PredictorTensor(s["name"], s["shape"], s["dtype"]) for s in bundle.output_spec
+        ]
+
+    def _init_from_layer(self, config: Config) -> None:
+        from paddle_tpu.core import autograd as _ag
+        from paddle_tpu.jit.save_load import _pure_forward, specs_from_input_spec
+
+        layer = config._layer
+        layer.eval()
+        params = {k: v._data for k, v in layer.state_dict().items()}
+        tgt = None
+        if config.precision in (PrecisionType.Bfloat16, PrecisionType.Half, PrecisionType.Int8):
+            tgt = jnp.float16 if config.precision == PrecisionType.Half else jnp.bfloat16
+            params = {
+                k: v.astype(tgt) if jnp.issubdtype(v.dtype, jnp.floating) else v
+                for k, v in params.items()
+            }
+        pure = _pure_forward(layer)
+        # inputs follow the param cast dtype (f16 params get f16 inputs —
+        # mixing f16 x bf16 would silently promote every matmul to fp32)
+        specs = specs_from_input_spec(config._input_spec, float_dtype=tgt)
+        self._inputs = [
+            PredictorTensor(getattr(s, "name", None) or f"x{i}", spec.shape, str(spec.dtype))
+            for i, (s, spec) in enumerate(zip(config._input_spec, specs))
+        ]
+        n_in = len(specs)
+
+        def fn(params_, *xs):
+            with _ag.set_grad_enabled(False):
+                return pure(params_, *xs)
+
+        donate = config.memory_optim and config.device != "cpu" and jax.default_backend() != "cpu"
+        self._fn = jax.jit(
+            fn,
+            donate_argnums=tuple(range(1, 1 + n_in)) if donate else (),
+        )
+        out_avals = jax.eval_shape(fn, params, *specs)
+        flat, _ = jax.tree_util.tree_flatten(out_avals)
+        self._outputs = [
+            PredictorTensor(f"fetch{i}", a.shape, str(a.dtype)) for i, a in enumerate(flat)
+        ]
+        self._params = params
+
+    # -- reference predictor surface -----------------------------------------
+    def get_input_names(self) -> List[str]:
+        return [h.name for h in self._inputs]
+
+    def get_output_names(self) -> List[str]:
+        return [h.name for h in self._outputs]
+
+    def get_input_handle(self, name: str) -> PredictorTensor:
+        for h in self._inputs:
+            if h.name == name:
+                return h
+        raise KeyError(f"no input named {name!r}; have {self.get_input_names()}")
+
+    def get_output_handle(self, name: str) -> PredictorTensor:
+        for h in self._outputs:
+            if h.name == name:
+                return h
+        raise KeyError(f"no output named {name!r}; have {self.get_output_names()}")
+
+    def run(self, inputs: Optional[Sequence[np.ndarray]] = None) -> Optional[List[np.ndarray]]:
+        """Execute one inference. Two forms:
+
+        - handle style (reference ZeroCopyRun): stage inputs with
+          ``copy_from_cpu`` on the handles, call ``run()``, read outputs with
+          ``copy_to_cpu``;
+        - direct style: ``outs = predictor.run([arr, ...])`` returns numpy.
+        """
+        if inputs is not None:
+            for h, a in zip(self._inputs, inputs):
+                h.copy_from_cpu(a)
+        arrays = []
+        for h in self._inputs:
+            if h._host is None:
+                raise RuntimeError(f"input '{h.name}' was never fed (copy_from_cpu)")
+            arr = jnp.asarray(h._host)
+            want = jnp.dtype(h._dtype)
+            if arr.dtype != want and jnp.issubdtype(want, jnp.floating):
+                arr = arr.astype(want)
+            if self._device is not None:
+                arr = jax.device_put(arr, self._device)
+            arrays.append(arr)
+        out = self._fn(self._params, *arrays)
+        flat, _ = jax.tree_util.tree_flatten(out)
+        for h, a in zip(self._outputs, flat):
+            h._device = a
+        if inputs is not None:
+            return [np.asarray(a) for a in flat]
+        return None
+
+    # reference alias
+    def zero_copy_run(self) -> None:
+        self.run()
+
+    ZeroCopyRun = zero_copy_run
+
+
+def create_predictor(config: Config) -> Predictor:
+    """Reference ``paddle_infer.create_predictor`` parity."""
+    return Predictor(config)
+
+
+def convert_to_mixed_precision(
+    layer_or_path: Any,
+    save_path: str,
+    input_spec: Optional[Sequence[Any]] = None,
+    mixed_precision: str = PrecisionType.Bfloat16,
+    backend: str = "tpu",
+    black_list: Optional[Sequence[str]] = None,
+) -> None:
+    """Offline mixed-precision conversion (reference
+    ``convert_to_mixed_precision.cc``): cast a model's float params to the
+    target dtype and re-export the bundle with a low-precision program.
+
+    Accepts a live Layer (+ input_spec). dtype conversion happens *before*
+    export because StableHLO bakes dtypes into the program signature.
+    """
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.jit import save as jit_save
+    from paddle_tpu.nn.layer.layers import Layer
+
+    if not isinstance(layer_or_path, Layer):
+        raise TypeError(
+            "convert_to_mixed_precision needs a live Layer on this backend "
+            "(exported programs have baked dtypes)"
+        )
+    from paddle_tpu.jit.save_load import specs_from_input_spec
+
+    layer = layer_or_path
+    tgt = jnp.bfloat16 if mixed_precision != PrecisionType.Half else jnp.float16
+    black = set(black_list or ())
+    # cast for the export only — the caller's live (training) weights are
+    # restored afterwards, like the reference's offline converter working on
+    # a separate saved model
+    saved = []
+    for name, p in layer.named_parameters():
+        if name in black:
+            continue
+        if jnp.issubdtype(jnp.dtype(p.dtype), jnp.floating):
+            saved.append((p, p._data))
+            p._data = p._data.astype(tgt)
+    try:
+        specs = specs_from_input_spec(input_spec or [], float_dtype=tgt)
+        jit_save(layer, save_path, input_spec=specs)
+    finally:
+        for p, d in saved:
+            p._data = d

@@ -1,0 +1,118 @@
+"""Inference stack: jit.save serialized-program round trip + Predictor API.
+
+Reference: AnalysisPredictor (``paddle/fluid/inference/api/analysis_predictor.h:105``)
+and the offline mixed-precision convert
+(``paddle/fluid/inference/analysis/passes/convert_to_mixed_precision.cc``).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import inference, nn
+from paddle_tpu.static import InputSpec
+
+
+class SmallNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+
+@pytest.fixture()
+def bundle(tmp_path):
+    paddle.seed(0)
+    net = SmallNet()
+    net.eval()
+    path = str(tmp_path / "m" / "inference")
+    paddle.jit.save(net, path, input_spec=[InputSpec([2, 8], "float32", name="x")])
+    x = np.random.default_rng(0).normal(size=(2, 8)).astype(np.float32)
+    ref = net(paddle.to_tensor(x)).numpy()
+    return path, x, ref
+
+
+def test_save_load_roundtrip_executes(bundle):
+    path, x, ref = bundle
+    loaded = paddle.jit.load(path)
+    out = loaded(paddle.to_tensor(x))
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-6)
+    # signature travels with the bundle
+    assert loaded.input_spec[0]["name"] == "x"
+    assert loaded.input_spec[0]["shape"] == [2, 8]
+    assert loaded.output_spec[0]["shape"] == [2, 4]
+    assert "stablehlo" in (loaded.program_text or "") or "func" in (loaded.program_text or "")
+
+
+def test_predictor_handle_style(bundle):
+    path, x, ref = bundle
+    config = inference.Config(path + ".pdmodel")
+    config.enable_memory_optim(False)
+    predictor = inference.create_predictor(config)
+    assert predictor.get_input_names() == ["x"]
+    h = predictor.get_input_handle("x")
+    h.copy_from_cpu(x)
+    predictor.run()
+    out_h = predictor.get_output_handle(predictor.get_output_names()[0])
+    np.testing.assert_allclose(out_h.copy_to_cpu(), ref, rtol=1e-5, atol=1e-6)
+    assert out_h.shape() == [2, 4]
+
+
+def test_predictor_direct_run_and_model_dir(bundle, tmp_path):
+    path, x, ref = bundle
+    # model_dir form: directory containing inference.pdmodel
+    import os
+
+    config = inference.Config(os.path.dirname(path))
+    predictor = inference.create_predictor(config)
+    outs = predictor.run([x])
+    np.testing.assert_allclose(outs[0], ref, rtol=1e-5, atol=1e-6)
+    # second run reuses the compiled program (weights resident)
+    outs2 = predictor.run([x])
+    np.testing.assert_allclose(outs2[0], ref, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_from_layer_bf16():
+    paddle.seed(1)
+    net = SmallNet()
+    net.eval()
+    config = inference.Config.from_layer(net, [InputSpec([2, 8], "float32", name="x")])
+    config.enable_mixed_precision(inference.PrecisionType.Bfloat16)
+    config.enable_memory_optim(False)
+    predictor = inference.create_predictor(config)
+    x = np.random.default_rng(1).normal(size=(2, 8)).astype(np.float32)
+    outs = predictor.run([x])
+    ref = net(paddle.to_tensor(x)).numpy()
+    # bf16 weights: loose tolerance, but must be the same function
+    np.testing.assert_allclose(outs[0].astype(np.float32), ref, rtol=0.1, atol=0.1)
+    assert "bfloat16" in predictor._inputs[0]._dtype
+
+
+def test_convert_to_mixed_precision_offline(tmp_path):
+    paddle.seed(2)
+    net = SmallNet()
+    net.eval()
+    x = np.random.default_rng(2).normal(size=(2, 8)).astype(np.float32)
+    ref = net(paddle.to_tensor(x)).numpy()
+    path = str(tmp_path / "bf16" / "inference")
+    inference.convert_to_mixed_precision(
+        net, path, input_spec=[InputSpec([2, 8], "float32", name="x")]
+    )
+    config = inference.Config(path)
+    config.enable_memory_optim(False)
+    predictor = inference.create_predictor(config)
+    outs = predictor.run([x.astype("float32")])
+    np.testing.assert_allclose(np.asarray(outs[0], np.float32), ref, rtol=0.1, atol=0.1)
+    # params on disk really are bf16
+    loaded = paddle.jit.load(path)
+    assert any("bfloat16" in str(t.dtype) for t in loaded.state_dict().values())
+
+
+def test_static_load_inference_model(bundle):
+    path, x, ref = bundle
+    loaded = paddle.static.load_inference_model(path)
+    out = loaded(paddle.to_tensor(x))
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-6)
