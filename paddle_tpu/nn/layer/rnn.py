@@ -353,8 +353,9 @@ def _gru_step(x: Any, h: Any, ps: Sequence[Any], has_bi: bool, has_bh: bool) -> 
     return z * h + (1.0 - z) * c
 
 
-def _cell_scan_fn(cell: "RNNCellBase") -> Tuple[Any, int]:
-    """Return (pure step over (x, states, params), n_params) for ``cell``."""
+def _cell_scan_fn(cell: "RNNCellBase") -> Tuple[Any, List[Any]]:
+    """Return (pure step over (x, states, params), param tensors to pass as
+    op inputs) for ``cell``."""
     if isinstance(cell, SimpleRNNCell):
         relu, bi, bh = cell._act_relu, cell.bias_ih is not None, cell.bias_hh is not None
 
@@ -385,9 +386,13 @@ def _cell_scan_fn(cell: "RNNCellBase") -> Tuple[Any, int]:
             return cell_step(x, st, ps)
 
     else:
-        # Generic cell: run its eager forward under tracing (dispatch is
-        # transparent to tracers) and unwrap the Tensor results back to raw
-        # arrays so the scan carry/outputs stay valid JAX types.
+        # Generic cell (the reference's documented extension pattern: override
+        # forward()). Run its eager forward under tracing, functional-call
+        # style: the cell's parameters are real op inputs, substituted into
+        # the layer for the duration of the step, so they receive gradients —
+        # as closed-over constants they would be silently non-differentiable.
+        gen_params = list(cell.parameters())
+
         def step(x: Any, st: Any, ps: Sequence[Any]) -> Tuple[Any, Any]:
             from paddle_tpu.core.tensor import Tensor
 
@@ -398,14 +403,21 @@ def _cell_scan_fn(cell: "RNNCellBase") -> Tuple[Any, int]:
                 return v.data if isinstance(v, Tensor) else v
 
             is_t = lambda v: isinstance(v, Tensor)  # noqa: E731
-            out, new_st = cell(wrap(x), jax.tree_util.tree_map(wrap, st))
+            saved = [(p, p._data) for p in gen_params]
+            try:
+                for p, arr in zip(gen_params, ps):
+                    p._data = arr
+                out, new_st = cell(wrap(x), jax.tree_util.tree_map(wrap, st))
+            finally:
+                for p, d in saved:
+                    p._data = d
             return (
                 jax.tree_util.tree_map(unwrap, out, is_leaf=is_t),
                 jax.tree_util.tree_map(unwrap, new_st, is_leaf=is_t),
             )
 
-        return step, 0
-    return step, len(cell._params())
+        return step, gen_params
+    return step, cell._params()
 
 
 class RNN(Layer):
@@ -430,8 +442,7 @@ class RNN(Layer):
             initial_states = self.cell.get_initial_states(
                 inputs, self.cell.state_shape, batch_dim_idx=batch_idx
             )
-        step, n_params = _cell_scan_fn(self.cell)
-        params = self.cell._params() if n_params else []
+        step, params = _cell_scan_fn(self.cell)
         time_major = self.time_major
         reverse = self.is_reverse
         has_len = sequence_length is not None
@@ -453,7 +464,6 @@ class RNN(Layer):
                 x_t, t = xt
                 out, new_states = step(x_t, carry, ps)
                 mask = (t < seq_len)  # [B] bool
-                m = mask[:, None].astype(out.dtype)
                 sel = jax.tree_util.tree_map(
                     lambda new, old: jnp.where(
                         mask.reshape((-1,) + (1,) * (new.ndim - 1)), new, old
@@ -461,7 +471,16 @@ class RNN(Layer):
                     new_states,
                     carry,
                 )
-                return sel, out * m
+                # Zero outputs at padded steps (torch pack_padded semantics —
+                # intentional deviation from the reference, which keeps raw
+                # step outputs past seq_len). Tree-mapped: custom cells may
+                # emit nested outputs.
+                out_masked = jax.tree_util.tree_map(
+                    lambda o: o
+                    * mask.reshape((-1,) + (1,) * (o.ndim - 1)).astype(o.dtype),
+                    out,
+                )
+                return sel, out_masked
 
             xs_in = (xs, t_index) if seq_len is not None else xs
             final, outs = jax.lax.scan(body, init, xs_in, reverse=reverse)

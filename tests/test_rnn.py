@@ -218,3 +218,40 @@ def test_custom_cell_generic_fallback():
     ref_out, ref_h = paddle.nn.RNN(cell.inner)(paddle.to_tensor(x))
     np.testing.assert_allclose(out.numpy(), ref_out.numpy(), atol=1e-6)
     np.testing.assert_allclose(h.numpy(), ref_h.numpy(), atol=1e-6)
+
+
+def test_custom_cell_generic_fallback_param_grads():
+    """ADVICE r3 (high): the generic fallback must pass the cell's params
+    through the op so they receive gradients — and backward() must work even
+    when the sequence input has stop_gradient=True (the default)."""
+
+    class WrappedGRU(paddle.nn.RNNCellBase):
+        def __init__(self, input_size, hidden_size):
+            super().__init__()
+            self.inner = paddle.nn.GRUCell(input_size, hidden_size)
+
+        @property
+        def state_shape(self):
+            return (self.inner.hidden_size,)
+
+        def forward(self, inputs, states=None):
+            return self.inner(inputs, states)
+
+    x_np = RNG.standard_normal((B, T, I)).astype(np.float32)
+
+    cell = WrappedGRU(I, H)
+    x = paddle.to_tensor(x_np)  # stop_gradient=True: params alone drive the tape
+    out, _ = paddle.nn.RNN(cell)(x)
+    out.sum().backward()
+    grads = {}
+    for name, p in cell.named_parameters():
+        assert p.grad is not None, f"generic-fallback cell param {name} got no grad"
+        grads[name] = p.grad.numpy().copy()
+
+    # parity vs the builtin GRU scan path on the same weights
+    cell.inner.clear_gradients()
+    out_ref, _ = paddle.nn.RNN(cell.inner)(paddle.to_tensor(x_np))
+    out_ref.sum().backward()
+    for name, p in cell.named_parameters():
+        ref = p.grad.numpy()
+        np.testing.assert_allclose(grads[name], ref, rtol=1e-4, atol=1e-5)
