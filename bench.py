@@ -265,7 +265,7 @@ def main() -> None:
     # v5e peak 197 bf16 TFLOP/s; 6*N*T FLOPs/token (fwd+bwd, weight FLOPs)
     mfu = 6.0 * n_params * tokens_per_sec / 197e12 if platform == "tpu" else 0.0
 
-    secondary = _bench_ernie(paddle, platform)
+    secondary = [_bench_ernie(paddle, platform), _bench_sd_unet(paddle, platform)]
     print(
         json.dumps(
             {
@@ -329,6 +329,60 @@ def _bench_ernie(paddle, platform: str) -> dict:
         }
     except Exception as exc:  # noqa: BLE001 - secondary must never kill primary
         return {"metric": "ernie3_base_finetune_step_time_ms", "error": f"{exc!r}"[:300]}
+
+
+def _bench_sd_unet(paddle, platform: str) -> dict:
+    """Tertiary metric (BASELINE.md config #5): Stable-Diffusion v1.5 UNet
+    inference latency through the Predictor (bf16 serving, resident weights)."""
+    from paddle_tpu import inference
+    from paddle_tpu.models.sd_unet import UNet2DConditionModel, UNetConfig
+    from paddle_tpu.static import InputSpec
+
+    try:
+        if platform == "tpu":
+            cfg = UNetConfig.sd15()
+            batch, hw, ctx_len, steps, warmup = 2, 64, 77, 10, 2
+        else:
+            cfg = UNetConfig.tiny()
+            batch, hw, ctx_len, steps, warmup = 1, 16, 8, 2, 1
+
+        paddle.seed(0)
+        model = UNet2DConditionModel(cfg)
+        model.eval()
+        config = inference.Config.from_layer(
+            model,
+            [
+                InputSpec([batch, cfg.in_channels, hw, hw], "float32", name="sample"),
+                InputSpec([batch], "int32", name="timestep"),
+                InputSpec([batch, ctx_len, cfg.cross_attention_dim], "float32", name="context"),
+            ],
+        )
+        if platform == "tpu":
+            config.enable_mixed_precision(inference.PrecisionType.Bfloat16)
+        config.enable_memory_optim(False)  # keep inputs reusable across timed runs
+        predictor = inference.create_predictor(config)
+        rng = np.random.default_rng(2)
+        feeds = [
+            rng.normal(size=(batch, cfg.in_channels, hw, hw)).astype(np.float32),
+            np.full((batch,), 10, np.int32),
+            rng.normal(size=(batch, ctx_len, cfg.cross_attention_dim)).astype(np.float32),
+        ]
+        for _ in range(warmup):
+            predictor.run(feeds)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            outs = predictor.run(feeds)
+        dt = time.perf_counter() - t0
+        assert np.isfinite(np.asarray(outs[0], np.float32)).all()
+        return {
+            "metric": "sd15_unet_inference_images_per_sec",
+            "value": round(batch * steps / dt, 2),
+            "unit": "images/s",
+            "batch": batch,
+            "latent": hw,
+        }
+    except Exception as exc:  # noqa: BLE001
+        return {"metric": "sd15_unet_inference_images_per_sec", "error": f"{exc!r}"[:300]}
 
 
 if __name__ == "__main__":

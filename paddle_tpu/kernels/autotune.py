@@ -1,0 +1,152 @@
+"""Benchmark-driven kernel-config autotuning.
+
+Reference: ``paddle/phi/kernels/autotune/auto_tune_base.h:48`` (time each
+candidate kernel config at first use) + ``cache.h:97`` (per-shape config
+cache). TPU-native shape: the tunable axis is the Pallas block geometry
+(blk_q/blk_k for flash attention, row-block for rms_norm) — the MXU/VMEM
+trade-off XLA cannot make for a hand-written kernel.
+
+Protocol: at the first call for a given (kernel, shape-key), each candidate
+config is compiled and timed on the live backend (median of ``repeats`` runs
+after a warmup); the winner is cached in-process and optionally persisted to
+a JSON file (``FLAGS_kernel_autotune_cache`` path) so later processes skip
+the sweep. Disabled by default (``FLAGS_use_kernel_autotune``) — tuning costs
+a few hundred ms per shape and is meant for long training runs / benches.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+
+from paddle_tpu.flags import GLOBAL_FLAGS, define_flag
+
+define_flag("use_kernel_autotune", bool, False, "Time Pallas block-size candidates at first use per shape.")
+define_flag("kernel_autotune_cache", str, "", "Optional JSON file persisting autotune picks across processes.")
+
+_logger = logging.getLogger("paddle_tpu.kernels.autotune")
+
+__all__ = ["autotune", "AutotuneCache", "cache"]
+
+
+class AutotuneCache:
+    """Per-process (kernel, key) → config cache with optional JSON persistence."""
+
+    def __init__(self) -> None:
+        self._picks: Dict[str, Any] = {}
+        self._loaded_path: Optional[str] = None
+
+    @staticmethod
+    def _k(kernel: str, key: Tuple) -> str:
+        return f"{kernel}|{'|'.join(map(str, key))}"
+
+    def _maybe_load(self) -> None:
+        path = GLOBAL_FLAGS.get("kernel_autotune_cache")
+        if path and path != self._loaded_path and os.path.exists(path):
+            try:
+                with open(path) as f:
+                    stored = json.load(f)
+                # stored configs are JSON lists; callers use tuples
+                self._picks.update({k: tuple(v) if isinstance(v, list) else v for k, v in stored.items()})
+            except Exception as exc:  # noqa: BLE001 - cache corruption is not fatal
+                _logger.warning("autotune cache %s unreadable: %s", path, exc)
+            self._loaded_path = path
+
+    def get(self, kernel: str, key: Tuple) -> Optional[Any]:
+        self._maybe_load()
+        return self._picks.get(self._k(kernel, key))
+
+    def put(self, kernel: str, key: Tuple, config: Any) -> None:
+        self._picks[self._k(kernel, key)] = config
+        path = GLOBAL_FLAGS.get("kernel_autotune_cache")
+        if path:
+            try:
+                serial = {
+                    k: list(v) if isinstance(v, tuple) else v for k, v in self._picks.items()
+                }
+                with open(path, "w") as f:
+                    json.dump(serial, f, indent=1)
+            except Exception as exc:  # noqa: BLE001
+                _logger.warning("autotune cache %s not writable: %s", path, exc)
+
+    def clear(self) -> None:
+        self._picks.clear()
+        self._loaded_path = None
+
+
+cache = AutotuneCache()
+
+
+def _time_once(fn: Callable[[], Any]) -> float:
+    t0 = time.perf_counter()
+    out = fn()
+    jax.block_until_ready(out)
+    return time.perf_counter() - t0
+
+
+def autotune(
+    kernel: str,
+    key: Tuple,
+    candidates: Sequence[Any],
+    build: Callable[[Any], Optional[Callable[[], Any]]],
+    default: Any,
+    repeats: int = 3,
+) -> Any:
+    """Pick the fastest config for ``kernel`` at shape ``key``.
+
+    ``build(config)`` returns a zero-arg runner executing the kernel with that
+    config on representative inputs, or None if the config is inapplicable.
+    Falls back to ``default`` when tuning is disabled, off-TPU, or every
+    candidate fails. The chosen config is cached under (kernel, key).
+    """
+    if not GLOBAL_FLAGS.get("use_kernel_autotune"):
+        return default
+    try:
+        if jax.default_backend() != "tpu":
+            return default
+    except Exception:  # noqa: BLE001
+        return default
+    hit = cache.get(kernel, key)
+    if hit is not None:
+        return hit
+    best, best_t = None, float("inf")
+    results: List[Tuple[Any, float]] = []
+    for cfg in candidates:
+        runner = build(cfg)
+        if runner is None:
+            continue
+        try:
+            _time_once(runner)  # compile + settle
+            t = min(_time_once(runner) for _ in range(max(1, repeats)))
+        except Exception as exc:  # noqa: BLE001 - candidate may not lower
+            _logger.debug("autotune %s cfg=%s failed: %r", kernel, cfg, exc)
+            continue
+        results.append((cfg, t))
+        if t < best_t:
+            best, best_t = cfg, t
+    if best is None:
+        best = default
+    cache.put(kernel, key, best)
+    _logger.info(
+        "autotune %s key=%s picked %s (%.3fms) over %s",
+        kernel,
+        key,
+        best,
+        best_t * 1e3 if best_t < float("inf") else -1.0,
+        [(c, round(t * 1e3, 3)) for c, t in results],
+    )
+    if os.environ.get("PADDLE_TPU_AUTOTUNE_VERBOSE"):
+        import sys
+
+        print(
+            f"autotune: {kernel} {key} -> {best} "
+            f"({[(c, round(t * 1e3, 3)) for c, t in results]})",
+            file=sys.stderr,
+            flush=True,
+        )
+    return best

@@ -439,6 +439,40 @@ def _make_flash_core(sq, sk, scale, causal, blk_q, blk_k, interpret):
     return core
 
 
+def _autotune_blocks(q_shape, kv_heads, dtype, sq, sk, d, scale, causal, mask_c, interpret):
+    """Benchmark-pick (blk_q, blk_k) for this attention shape (reference
+    ``auto_tune_base.h:48``); returns the defaults when tuning is off."""
+    from paddle_tpu.kernels.autotune import autotune
+
+    b, h = q_shape[0], q_shape[2]
+    key = (b, h, kv_heads, sq, sk, d, str(dtype), causal, mask_c)
+    candidates = [
+        (bq, bk)
+        for bq in (128, 256, 512)
+        for bk in (128, 256, 512)
+        if bq <= max(sq, 128) and bk <= max(sk, 128) and bq * bk <= 512 * 256
+    ]
+
+    def build(cfg):
+        bq, bk = cfg
+        qz = jnp.zeros((b, h, sq, d), dtype)
+        kz = jnp.zeros((b, kv_heads, sk, d), dtype)
+        bounds = (
+            jnp.zeros((b, 1, sk, mask_c), jnp.int32) if mask_c else None
+        )
+        core = _make_flash_core(
+            sq, sk, float(scale), bool(causal),
+            min(bq, max(_cdiv(sq, 8) * 8, 8)), min(bk, max(_cdiv(sk, 8) * 8, 8)),
+            bool(interpret),
+        )
+        return lambda: core(qz, kz, kz, bounds)
+
+    return autotune(
+        "flash_attention", key, candidates, build,
+        default=(DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K),
+    )
+
+
 def flash_attention_pallas(
     q: jax.Array,
     k: jax.Array,
@@ -446,16 +480,26 @@ def flash_attention_pallas(
     startend_row_indices: Optional[jax.Array] = None,
     causal: bool = False,
     scale: Optional[float] = None,
-    block_q: int = DEFAULT_BLOCK_Q,
-    block_k: int = DEFAULT_BLOCK_K,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     interpret: bool = False,
 ) -> jax.Array:
     """Flash attention over paddle layout ``[B, S, H, D]`` (optionally with a
-    FlashMask bounds tensor ``[B, Hm, Sk, C]``). Differentiable."""
+    FlashMask bounds tensor ``[B, Hm, Sk, C]``). Differentiable.
+
+    ``block_q``/``block_k`` default to the autotuner's pick for this shape
+    when ``FLAGS_use_kernel_autotune`` is on, else (128, 128)."""
     sq, sk = q.shape[1], k.shape[1]
     d = q.shape[-1]
     if scale is None:
         scale = 1.0 / (d**0.5)
+    if block_q is None or block_k is None:
+        mask_c = 0 if startend_row_indices is None else int(startend_row_indices.shape[-1])
+        tuned_q, tuned_k = _autotune_blocks(
+            q.shape, k.shape[2], q.dtype, sq, sk, d, scale, causal, mask_c, interpret
+        )
+        block_q = block_q if block_q is not None else tuned_q
+        block_k = block_k if block_k is not None else tuned_k
     blk_q = min(block_q, max(_cdiv(sq, 8) * 8, 8))
     blk_k = min(block_k, max(_cdiv(sk, 8) * 8, 8))
     qh = jnp.moveaxis(q, 2, 1)  # [B, H, S, D]
