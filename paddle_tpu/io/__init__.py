@@ -20,3 +20,4 @@ from paddle_tpu.io.sampler import (  # noqa: F401
     WeightedRandomSampler,
 )
 from paddle_tpu.io.dataloader import DataLoader, default_collate_fn  # noqa: F401
+from paddle_tpu.io.worker import WorkerInfo, get_worker_info  # noqa: F401

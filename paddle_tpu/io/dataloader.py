@@ -8,8 +8,6 @@ covers the same ground without fork-safety issues inside the PJRT client.
 
 from __future__ import annotations
 
-import queue
-import threading
 from typing import Any, Callable, Iterator, List, Optional, Sequence
 
 import numpy as np
@@ -60,8 +58,14 @@ class DataLoader:
     ) -> None:
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
+        self._user_collate_fn = collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = max(prefetch_factor, 1)
+        self.use_shared_memory = use_shared_memory
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
+        self.persistent_workers = persistent_workers
+        self._pool = None
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -93,11 +97,43 @@ class DataLoader:
             for indices in self.batch_sampler:
                 yield self.collate_fn([self.dataset[i] for i in indices])
 
-    def __iter__(self) -> Iterator[Any]:
-        if self.num_workers == 0:
-            yield from self._iter_batches()
-            return
-        # Thread-based prefetch pipeline.
+    def _wrap_np_tree(self, tree: Any) -> Any:
+        """Parent-side: numpy tree from the workers → Tensor tree (the one
+        host→device copy, overlapped with compute by PJRT)."""
+        if isinstance(tree, np.ndarray):
+            return Tensor(tree)
+        if isinstance(tree, (list, tuple)):
+            return type(tree)(self._wrap_np_tree(t) for t in tree)
+        if isinstance(tree, dict):
+            return {k: self._wrap_np_tree(v) for k, v in tree.items()}
+        return tree
+
+    def _get_pool(self):
+        from paddle_tpu.io.worker import WorkerPool
+
+        # iterable workers consume their stream; a pool can't be reused across
+        # epochs in that mode
+        if self._pool is not None and not self._iterable_mode and self._pool.alive():
+            return self._pool
+        self._pool = WorkerPool(
+            self.dataset,
+            self._iterable_mode,
+            self.num_workers,
+            self._user_collate_fn,
+            self.worker_init_fn,
+            self.use_shared_memory,
+            float(self.timeout),
+            drop_last=getattr(self, "drop_last", False),
+        )
+        return self._pool
+
+    def _iter_threaded(self) -> Iterator[Any]:
+        """Parent-side prefetch thread: used when a custom collate_fn is set —
+        user collate functions may build framework Tensors, which must never
+        run in a forked child (PJRT after fork is undefined behavior)."""
+        import queue
+        import threading
+
         q: "queue.Queue[Any]" = queue.Queue(maxsize=self.num_workers * self.prefetch_factor)
         sentinel = object()
         error_box: List[BaseException] = []
@@ -120,3 +156,41 @@ class DataLoader:
             yield item
         if error_box:
             raise error_box[0]
+
+    def __iter__(self) -> Iterator[Any]:
+        if self.num_workers == 0:
+            yield from self._iter_batches()
+            return
+        if self._user_collate_fn is not None:
+            yield from self._iter_threaded()
+            return
+        # Multiprocess workers (reference worker.py): fork pool + shared-memory
+        # handoff; results re-ordered to match num_workers=0 iteration order.
+        import itertools
+
+        pool = self._get_pool()
+        if self._iterable_mode:
+            tasks: Iterator[Any] = ((i, self.batch_size) for i in itertools.count())
+        else:
+            tasks = ((i, idx) for i, idx in enumerate(self.batch_sampler))
+        prefetch = self.num_workers * self.prefetch_factor
+        completed = False
+        try:
+            for np_batch in pool.run_epoch(tasks, prefetch):
+                yield self._wrap_np_tree(np_batch)
+            completed = True
+        finally:
+            # a pool can only be reused when its epoch drained fully: breaking
+            # mid-epoch leaves in-flight results that would corrupt the next
+            # epoch's ordering, so tear it down
+            if self._iterable_mode or not self.persistent_workers or not completed:
+                pool.shutdown()
+                self._pool = None
+
+    def __del__(self) -> None:
+        pool = getattr(self, "_pool", None)
+        if pool is not None:
+            try:
+                pool.shutdown()
+            except Exception:  # noqa: BLE001
+                pass
