@@ -4,6 +4,7 @@ SPMD-first: a mesh + placements API backed by GSPMD, shard_map parallel
 regions for explicit collectives, and fleet-style hybrid-parallel wrappers.
 """
 
+from paddle_tpu.distributed import auto_parallel  # noqa: F401
 from paddle_tpu.distributed import checkpoint  # noqa: F401
 from paddle_tpu.distributed import fleet  # noqa: F401
 from paddle_tpu.distributed import sharding  # noqa: F401

@@ -1,0 +1,10 @@
+"""Auto-parallel: declarative Engine + Strategy over GSPMD.
+
+Reference: ``python/paddle/distributed/auto_parallel/`` — the static Engine
+(``static/engine.py:96``) and Strategy (``strategy.py:191``). The dygraph
+semi-auto API (shard_tensor/reshard/shard_layer) lives in
+``paddle_tpu.distributed.api``.
+"""
+
+from paddle_tpu.distributed.auto_parallel.engine import Engine  # noqa: F401
+from paddle_tpu.distributed.auto_parallel.strategy import Strategy  # noqa: F401

@@ -1,0 +1,92 @@
+"""Auto-parallel Strategy (reference
+``python/paddle/distributed/auto_parallel/strategy.py:191``): a nested config
+tree selecting parallelization/optimization behaviors for the Engine.
+
+The reference's fields configure graph passes; here each field maps onto the
+TPU-native mechanism that replaces the pass (GSPMD sharding, autocast
+contexts, recompute wrapping, ZeRO optimizer sharding, gradient accumulation
+inside the jitted step).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+
+class BaseConfig:
+    """Attribute-bag with defaults + dict override (reference BaseConfig)."""
+
+    _defaults: Dict[str, Any] = {}
+
+    def __init__(self, config: Optional[Dict[str, Any]] = None) -> None:
+        for k, v in self._defaults.items():
+            setattr(self, k, v)
+        for k, v in (config or {}).items():
+            setattr(self, k, v)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {k: getattr(self, k) for k in self._defaults}
+
+    def __repr__(self) -> str:
+        inner = ", ".join(f"{k}={getattr(self, k)!r}" for k in self._defaults)
+        return f"{type(self).__name__}({inner})"
+
+
+class AmpConfig(BaseConfig):
+    _defaults = {
+        "enable": False,
+        "dtype": "bfloat16",
+        "level": "o1",
+        "init_loss_scaling": 32768.0,
+        "use_master_weights": True,
+    }
+
+
+class ShardingConfig(BaseConfig):
+    _defaults = {"enable": False, "stage": 1, "degree": 8}
+
+
+class RecomputeConfig(BaseConfig):
+    _defaults = {"enable": False, "refined_ops": None}
+
+
+class PipelineConfig(BaseConfig):
+    _defaults = {
+        "enable": False,
+        "schedule_mode": "1F1B",
+        "accumulate_steps": 1,
+        "micro_batch_size": None,
+    }
+
+
+class GradientMergeConfig(BaseConfig):
+    _defaults = {"enable": False, "k_steps": 1, "avg": True}
+
+
+class FusedPassesConfig(BaseConfig):
+    # XLA fuses; kept for API parity (scripts read/write these fields)
+    _defaults = {"enable": False, "fused_passes_list": None}
+
+
+class Strategy(BaseConfig):
+    """Top-level strategy (reference ``strategy.py:191``): ``strategy.amp``,
+    ``strategy.sharding``, ``strategy.recompute``, ``strategy.pipeline``,
+    ``strategy.gradient_merge``, ``strategy.fused_passes``."""
+
+    _defaults = {"auto_mode": "semi", "seed": None}
+
+    def __init__(self, config: Optional[Dict[str, Any]] = None) -> None:
+        config = dict(config or {})
+        self.amp = AmpConfig(config.pop("amp", None))
+        self.sharding = ShardingConfig(config.pop("sharding", None))
+        self.recompute = RecomputeConfig(config.pop("recompute", None))
+        self.pipeline = PipelineConfig(config.pop("pipeline", None))
+        self.gradient_merge = GradientMergeConfig(config.pop("gradient_merge", None))
+        self.fused_passes = FusedPassesConfig(config.pop("fused_passes", None))
+        super().__init__(config)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = super().to_dict()
+        for name in ("amp", "sharding", "recompute", "pipeline", "gradient_merge", "fused_passes"):
+            d[name] = getattr(self, name).to_dict()
+        return d
