@@ -30,8 +30,10 @@ if shard_map is None:  # pragma: no cover - jax<0.6 fallback
 
 __all__ = [
     "pipeline",
+    "pipeline_interleaved",
     "stack_stage_params",
     "num_pipeline_ticks",
+    "num_interleaved_ticks",
     "plan_pipeline_region",
     "SpmdPipelineExecutor",
 ]
@@ -45,6 +47,15 @@ def stack_stage_params(stage_params: Sequence[Any]) -> Any:
 
 def num_pipeline_ticks(num_microbatches: int, num_stages: int) -> int:
     return num_microbatches + num_stages - 1
+
+
+def num_interleaved_ticks(num_microbatches: int, num_stages: int, num_virtual: int) -> int:
+    """Ticks for the interleaved ring schedule: ``V*M + S - 1`` — the V laps
+    overlap, so the fill/drain bubble is paid once (S-1 ticks) instead of per
+    lap (``V*(M+S-1)`` for sequential laps). Reference analog: the interleave
+    scheduler of ``PipelineParallelWithInterleave`` /
+    ``pipeline_scheduler_pass/pipeline_zero_bubble.py``'s bubble math."""
+    return num_virtual * num_microbatches + num_stages - 1
 
 
 def pipeline(
@@ -150,6 +161,130 @@ def _build_pipeline_callable(
     # stage compute — specs may only mention `axis_name`. Partial-manual
     # shard_map only lowers inside a jit scope, so wrap the call (a no-op
     # nesting when the caller is already tracing).
+    mapped = shard_map(
+        local_fn,
+        mesh=jmesh,
+        in_specs=(param_specs, mb_spec),
+        out_specs=mb_spec,
+        axis_names={axis_name},
+        check_vma=False,
+    )
+    return jax.jit(mapped)
+
+
+def pipeline_interleaved(
+    stage_fn: Callable[[Any, Any], Any],
+    stacked_params_sv: Any,
+    microbatches: Any,
+    mesh: Any,
+    num_virtual: int,
+    axis_name: str = "pp",
+    mb_spec: Optional[P] = None,
+    checkpoint_stages: bool = False,
+) -> Any:
+    """Interleaved circular pipeline: device s holds V parameter chunks
+    (virtual stages ``v*S + s``); ONE scan drives all V laps concurrently
+    over a wrapped ring, so microbatch m on lap v occupies device s exactly
+    at tick ``v*M + m + s`` — no device contention for ``M >= S``, and the
+    warmup/drain bubble is paid once.
+
+    ``stacked_params_sv``: pytree with leading axes ``[S, V, ...]`` on every
+    leaf (stage-major, then lap). Requires ``M >= S`` (else a lap-v microbatch
+    would need its lap-(v-1) result before the ring delivers it).
+    """
+    jmesh = mesh.jax_mesh() if hasattr(mesh, "jax_mesh") else mesh
+    if axis_name not in jmesh.shape:
+        raise ValueError(f"mesh has no '{axis_name}' axis (axes: {list(jmesh.shape)})")
+    S = jmesh.shape[axis_name]
+    V = int(num_virtual)
+    M = int(microbatches.shape[0])
+    if V < 2:
+        raise ValueError("pipeline_interleaved needs num_virtual >= 2; use pipeline()")
+    if M % S != 0 or M < S:
+        raise ValueError(
+            f"interleaved schedule needs num_microbatches ({M}) to be a multiple "
+            f"of num_stages ({S}) and >= it"
+        )
+    for leaf in jax.tree.leaves(stacked_params_sv):
+        if leaf.shape[0] != S or leaf.shape[1] != V:
+            raise ValueError(
+                f"stacked_params_sv leaves need leading [S={S}, V={V}] axes, "
+                f"got {leaf.shape[:2]}"
+            )
+    if mb_spec is None:
+        mb_spec = P()
+    treedef = jax.tree.structure(stacked_params_sv)
+    mapped = _build_interleaved_callable(
+        stage_fn, jmesh, axis_name, S, V, M, treedef, mb_spec, bool(checkpoint_stages)
+    )
+    return mapped(stacked_params_sv, microbatches)
+
+
+@functools.lru_cache(maxsize=32)
+def _build_interleaved_callable(
+    stage_fn, jmesh, axis_name, S, V, M, param_treedef, mb_spec, checkpoint_stages
+):
+    fn = jax.checkpoint(stage_fn) if checkpoint_stages else stage_fn
+    T = num_interleaved_ticks(M, S, V)
+    param_specs = jax.tree_util.tree_unflatten(
+        param_treedef, [P(axis_name)] * param_treedef.num_leaves
+    )
+    ring_perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def local_fn(params: Any, mb: Any) -> Any:
+        params = jax.tree.map(lambda a: a[0], params)  # [V, ...] on this device
+        idx = jax.lax.axis_index(axis_name)
+        state = jnp.zeros_like(mb[0])
+        wrap_buf = jnp.zeros_like(mb)  # device 0: lap v inputs keyed by m
+        outputs = jnp.zeros_like(mb)
+
+        def tick(carry: Any, t: Any) -> Any:
+            state, wrap_buf, outputs = carry
+            # 1) bank the ring-wrapped activation (device S-1 produced it at
+            #    t-1 with phase t-S): it is microbatch (t-S)%M entering lap
+            #    (t-S)//M + 1 at device 0, consumed at tick ((t-S)//M+1)*M+(t-S)%M
+            prod_phase = t - S
+            wrap_ok = jnp.logical_and(
+                jnp.logical_and(idx == 0, prod_phase >= 0),
+                (prod_phase // M) < (V - 1),
+            )
+            slot = jnp.clip(jnp.where(prod_phase >= 0, prod_phase % M, 0), 0, M - 1)
+            cur_slot = jax.lax.dynamic_index_in_dim(wrap_buf, slot, 0, keepdims=False)
+            wrap_buf = jax.lax.dynamic_update_index_in_dim(
+                wrap_buf, jnp.where(wrap_ok, state, cur_slot), slot, 0
+            )
+            # 2) my (lap, microbatch) this tick
+            phase = jnp.clip(t - idx, 0, V * M - 1)
+            v = phase // M
+            m = phase % M
+            params_v = jax.tree.map(
+                lambda a: jax.lax.dynamic_index_in_dim(a, v, 0, keepdims=False), params
+            )
+            fresh = jax.lax.dynamic_index_in_dim(mb, m, 0, keepdims=False)
+            banked = jax.lax.dynamic_index_in_dim(wrap_buf, m, 0, keepdims=False)
+            x = jnp.where(idx == 0, jnp.where(v == 0, fresh, banked), state)
+            y = fn(params_v, x)
+            # 3) final-lap outputs leave at device S-1
+            out_ok = jnp.logical_and(
+                jnp.logical_and(idx == S - 1, v == V - 1), t - idx >= 0
+            )
+            cur_out = jax.lax.dynamic_index_in_dim(outputs, m, 0, keepdims=False)
+            outputs = jax.lax.dynamic_update_index_in_dim(
+                outputs, jnp.where(out_ok, y, cur_out), m, 0
+            )
+            # 4) ring step (wraps S-1 -> 0 for the next lap)
+            state = jax.lax.ppermute(y, axis_name, ring_perm)
+            return (state, wrap_buf, outputs), None
+
+        (state, wrap_buf, outputs), _ = jax.lax.scan(
+            tick, (state, wrap_buf, outputs), jnp.arange(T)
+        )
+        idx = jax.lax.axis_index(axis_name)
+        outputs = jax.lax.psum(
+            jnp.where(idx == S - 1, outputs, jnp.zeros_like(outputs)), axis_name
+        )
+        return outputs
+
     mapped = shard_map(
         local_fn,
         mesh=jmesh,
@@ -308,19 +443,41 @@ class SpmdPipelineExecutor:
         def impl(h_arr, *flat):
             rows = [list(flat[i * P_ : (i + 1) * P_]) for i in range(len(self._blocks))]
             mb = h_arr.reshape((M, batch // M) + h_arr.shape[1:])
-            for v in range(V):
-                stage_chunks = [
-                    rows[(v * S + s) * C : (v * S + s + 1) * C] for s in range(S)
+            if V > 1 and S > 1 and M >= S:
+                # interleaved ring: all V laps overlap in ONE scan —
+                # V*M + S - 1 ticks instead of V*(M + S - 1)
+                per_sv = [
+                    [rows[(v * S + s) * C : (v * S + s + 1) * C] for v in range(V)]
+                    for s in range(S)
                 ]
-                stacked = jax.tree.map(lambda *xs: jnp.stack(xs, 0), *stage_chunks)
-                mb = pipeline(
+                lap_stacked = [
+                    jax.tree.map(lambda *xs: jnp.stack(xs, 0), *per_sv[s])
+                    for s in range(S)
+                ]
+                stacked = jax.tree.map(lambda *xs: jnp.stack(xs, 0), *lap_stacked)
+                mb = pipeline_interleaved(
                     self._chunk_fn,
                     stacked,
                     mb,
                     self._mesh,
+                    V,
                     axis_name=self._axis,
                     checkpoint_stages=self._ckpt,
                 )
+            else:
+                for v in range(V):
+                    stage_chunks = [
+                        rows[(v * S + s) * C : (v * S + s + 1) * C] for s in range(S)
+                    ]
+                    stacked = jax.tree.map(lambda *xs: jnp.stack(xs, 0), *stage_chunks)
+                    mb = pipeline(
+                        self._chunk_fn,
+                        stacked,
+                        mb,
+                        self._mesh,
+                        axis_name=self._axis,
+                        checkpoint_stages=self._ckpt,
+                    )
             return mb.reshape((batch,) + mb.shape[2:])
 
         h = call_op("spmd_pipeline", impl, h, *flat_params)
