@@ -121,6 +121,7 @@ from paddle_tpu import optimizer  # noqa: F401
 from paddle_tpu import profiler  # noqa: F401
 from paddle_tpu import static  # noqa: F401
 from paddle_tpu import inference  # noqa: F401
+from paddle_tpu import sparse  # noqa: F401
 from paddle_tpu import incubate  # noqa: F401
 
 from paddle_tpu.framework.io import load, save  # noqa: F401

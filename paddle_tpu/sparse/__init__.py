@@ -1,0 +1,471 @@
+"""``paddle_tpu.sparse`` — sparse tensor family over jax BCOO/BCSR.
+
+Reference: ``paddle/phi/core/sparse_coo_tensor.h`` /
+``sparse_csr_tensor.h`` + ``python/paddle/sparse/`` (51 ops in
+``sparse_ops.yaml``). TPU-native redesign: storage is
+``jax.experimental.sparse.BCOO`` (indices ``[nnz, ndim]`` + values), which
+XLA compiles as gather/scatter/segment-sum programs — there are no sparse
+MXU kernels, so the win is *memory* (O(nnz) storage, masked compute), the
+same trade the reference's SparseCooTensor makes on GPU.
+
+API parity: ``sparse_coo_tensor``, ``sparse_csr_tensor``,
+``Tensor.to_sparse_coo``/``to_dense`` (installed on the dense Tensor),
+value-wise unary ops, COO±COO elementwise, sparse×dense ``matmul``,
+``masked_matmul``, ``coalesce``, ``transpose``, ``sum``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = [
+    "SparseCooTensor",
+    "SparseCsrTensor",
+    "sparse_coo_tensor",
+    "sparse_csr_tensor",
+    "is_same_shape",
+    "add",
+    "subtract",
+    "multiply",
+    "divide",
+    "matmul",
+    "masked_matmul",
+    "relu",
+    "abs",
+    "sin",
+    "sinh",
+    "tan",
+    "tanh",
+    "asin",
+    "asinh",
+    "atan",
+    "atanh",
+    "sqrt",
+    "square",
+    "log1p",
+    "expm1",
+    "neg",
+    "pow",
+    "cast",
+    "transpose",
+    "sum",
+    "coalesce",
+]
+
+
+class SparseCooTensor:
+    """COO sparse tensor (reference ``sparse_coo_tensor.h``): paddle-layout
+    ``indices [sparse_dim, nnz]`` + ``values [nnz, ...dense dims]``."""
+
+    is_sparse_coo_flag = True
+
+    def __init__(self, bcoo: jsparse.BCOO) -> None:
+        self._bcoo = bcoo
+
+    # -- constructors --------------------------------------------------------
+    @classmethod
+    def from_parts(cls, indices: Any, values: Any, shape: Sequence[int]) -> "SparseCooTensor":
+        idx = jnp.asarray(indices.data if isinstance(indices, Tensor) else indices)
+        val = jnp.asarray(values.data if isinstance(values, Tensor) else values)
+        # paddle stores [sparse_dim, nnz]; BCOO wants [nnz, sparse_dim]
+        bcoo = jsparse.BCOO((val, idx.T.astype(jnp.int32)), shape=tuple(int(s) for s in shape))
+        return cls(bcoo)
+
+    @classmethod
+    def from_dense(cls, x: Any, sparse_dim: Optional[int] = None) -> "SparseCooTensor":
+        arr = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+        n_sparse = sparse_dim if sparse_dim is not None else arr.ndim
+        return cls(jsparse.BCOO.fromdense(arr, n_batch=0, n_dense=arr.ndim - n_sparse))
+
+    # -- paddle surface ------------------------------------------------------
+    @property
+    def shape(self) -> List[int]:
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self) -> Any:
+        return self._bcoo.data.dtype
+
+    @property
+    def nnz(self) -> int:
+        return int(self._bcoo.nse)
+
+    def indices(self) -> Tensor:
+        return Tensor(self._bcoo.indices.T)  # [sparse_dim, nnz]
+
+    def values(self) -> Tensor:
+        return Tensor(self._bcoo.data)
+
+    def to_dense(self) -> Tensor:
+        return Tensor(self._bcoo.todense())
+
+    def to_sparse_csr(self) -> "SparseCsrTensor":
+        return SparseCsrTensor.from_coo(self)
+
+    def is_sparse(self) -> bool:
+        return True
+
+    def is_sparse_coo(self) -> bool:
+        return True
+
+    def is_sparse_csr(self) -> bool:
+        return False
+
+    def coalesce(self) -> "SparseCooTensor":
+        return SparseCooTensor(self._bcoo.sum_duplicates())
+
+    def astype(self, dtype: Any) -> "SparseCooTensor":
+        from paddle_tpu.core.dtypes import convert_dtype
+
+        b = self._bcoo
+        return SparseCooTensor(
+            jsparse.BCOO((b.data.astype(convert_dtype(dtype)), b.indices), shape=b.shape)
+        )
+
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._bcoo.todense())
+
+    def __repr__(self) -> str:
+        return (
+            f"SparseCooTensor(shape={self.shape}, nnz={self.nnz}, dtype={self.dtype})"
+        )
+
+    # -- value-wise + arithmetic --------------------------------------------
+    def _map_values(self, fn) -> "SparseCooTensor":
+        b = self._bcoo
+        return SparseCooTensor(jsparse.BCOO((fn(b.data), b.indices), shape=b.shape))
+
+    def __neg__(self) -> "SparseCooTensor":
+        return self._map_values(jnp.negative)
+
+    def __add__(self, other: Any) -> "SparseCooTensor":
+        return add(self, other)
+
+    def __sub__(self, other: Any) -> "SparseCooTensor":
+        return subtract(self, other)
+
+    def __mul__(self, other: Any) -> Any:
+        return multiply(self, other)
+
+    def __matmul__(self, other: Any) -> Any:
+        return matmul(self, other)
+
+    def matmul(self, other: Any) -> Any:
+        return matmul(self, other)
+
+    # transposes sparse dims only (paddle sparse.transpose parity for COO)
+    def transpose(self, perm: Sequence[int]) -> "SparseCooTensor":
+        return transpose(self, perm)
+
+
+class SparseCsrTensor:
+    """CSR sparse matrix (reference ``sparse_csr_tensor.h``): crows/cols/values.
+
+    Stored as BCSR for 2-D; batched CSR falls back through COO.
+    """
+
+    def __init__(self, crows: Any, cols: Any, values: Any, shape: Sequence[int]) -> None:
+        self._crows = jnp.asarray(crows.data if isinstance(crows, Tensor) else crows, jnp.int32)
+        self._cols = jnp.asarray(cols.data if isinstance(cols, Tensor) else cols, jnp.int32)
+        self._values = jnp.asarray(values.data if isinstance(values, Tensor) else values)
+        self._shape = tuple(int(s) for s in shape)
+
+    @classmethod
+    def from_coo(cls, coo: SparseCooTensor) -> "SparseCsrTensor":
+        if len(coo.shape) != 2:
+            raise ValueError("SparseCsrTensor supports 2-D matrices")
+        b = coo.coalesce()._bcoo
+        rows = b.indices[:, 0]
+        cols = b.indices[:, 1]
+        order = jnp.lexsort((cols, rows))
+        rows, cols, vals = rows[order], cols[order], b.data[order]
+        n = coo.shape[0]
+        crows = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32), jnp.cumsum(jnp.bincount(rows, length=n)).astype(jnp.int32)]
+        )
+        return cls(crows, cols, vals, coo.shape)
+
+    @property
+    def shape(self) -> List[int]:
+        return list(self._shape)
+
+    @property
+    def dtype(self) -> Any:
+        return self._values.dtype
+
+    @property
+    def nnz(self) -> int:
+        return int(self._values.shape[0])
+
+    def crows(self) -> Tensor:
+        return Tensor(self._crows)
+
+    def cols(self) -> Tensor:
+        return Tensor(self._cols)
+
+    def values(self) -> Tensor:
+        return Tensor(self._values)
+
+    def to_sparse_coo(self, sparse_dim: int = 2) -> SparseCooTensor:
+        counts = jnp.diff(self._crows)
+        rows = jnp.repeat(jnp.arange(self._shape[0], dtype=jnp.int32), counts,
+                          total_repeat_length=self.nnz)
+        idx = jnp.stack([rows, self._cols], axis=1)
+        return SparseCooTensor(jsparse.BCOO((self._values, idx), shape=self._shape))
+
+    def to_dense(self) -> Tensor:
+        return self.to_sparse_coo().to_dense()
+
+    def is_sparse(self) -> bool:
+        return True
+
+    def is_sparse_coo(self) -> bool:
+        return False
+
+    def is_sparse_csr(self) -> bool:
+        return True
+
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self.to_dense().data)
+
+    def __repr__(self) -> str:
+        return f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz}, dtype={self.dtype})"
+
+
+# ---------------------------------------------------------------------------
+# functional API (paddle.sparse.*)
+# ---------------------------------------------------------------------------
+
+
+def sparse_coo_tensor(
+    indices: Any,
+    values: Any,
+    shape: Optional[Sequence[int]] = None,
+    dtype: Any = None,
+    place: Any = None,
+    stop_gradient: bool = True,
+) -> SparseCooTensor:
+    """``paddle.sparse.sparse_coo_tensor`` parity."""
+    idx = np.asarray(indices.numpy() if isinstance(indices, Tensor) else indices)
+    val = values.data if isinstance(values, Tensor) else jnp.asarray(values)
+    if dtype is not None:
+        from paddle_tpu.core.dtypes import convert_dtype
+
+        val = val.astype(convert_dtype(dtype))
+    if shape is None:
+        sparse_shape = tuple(int(m) + 1 for m in idx.max(axis=1))
+        shape = sparse_shape + tuple(val.shape[1:])
+    return SparseCooTensor.from_parts(idx, val, shape)
+
+
+def sparse_csr_tensor(
+    crows: Any, cols: Any, values: Any, shape: Sequence[int], dtype: Any = None, **kw: Any
+) -> SparseCsrTensor:
+    t = SparseCsrTensor(crows, cols, values, shape)
+    if dtype is not None:
+        from paddle_tpu.core.dtypes import convert_dtype
+
+        t._values = t._values.astype(convert_dtype(dtype))
+    return t
+
+
+def is_same_shape(x: Any, y: Any) -> bool:
+    return list(x.shape) == list(y.shape)
+
+
+def _coo(x: Any) -> SparseCooTensor:
+    if isinstance(x, SparseCooTensor):
+        return x
+    if isinstance(x, SparseCsrTensor):
+        return x.to_sparse_coo()
+    raise TypeError(f"expected a sparse tensor, got {type(x).__name__}")
+
+
+def add(x: SparseCooTensor, y: Any) -> SparseCooTensor:
+    """COO + COO (union of patterns) — reference ``sparse/unary_kernel`` add."""
+    xb = _coo(x)._bcoo
+    if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        yb = _coo(y)._bcoo
+        out = jsparse.BCOO(
+            (jnp.concatenate([xb.data, yb.data]), jnp.concatenate([xb.indices, yb.indices])),
+            shape=xb.shape,
+        ).sum_duplicates()
+        return SparseCooTensor(out)
+    raise TypeError("sparse.add supports sparse + sparse; use to_dense() for mixed")
+
+
+def subtract(x: SparseCooTensor, y: Any) -> SparseCooTensor:
+    return add(x, _coo(y)._map_values(jnp.negative))
+
+
+def multiply(x: SparseCooTensor, y: Any) -> Any:
+    """Elementwise multiply: sparse × dense keeps the sparse pattern (a mask);
+    sparse × scalar scales values."""
+    xb = _coo(x)._bcoo
+    if isinstance(y, (int, float)):
+        return SparseCooTensor(jsparse.BCOO((xb.data * y, xb.indices), shape=xb.shape))
+    if isinstance(y, Tensor) or hasattr(y, "shape"):
+        dense = y.data if isinstance(y, Tensor) else jnp.asarray(y)
+        gathered = dense[tuple(xb.indices[:, i] for i in range(xb.indices.shape[1]))]
+        return SparseCooTensor(jsparse.BCOO((xb.data * gathered, xb.indices), shape=xb.shape))
+    raise TypeError(f"cannot multiply sparse by {type(y).__name__}")
+
+
+def divide(x: SparseCooTensor, y: Any) -> Any:
+    if isinstance(y, (int, float)):
+        return multiply(x, 1.0 / y)
+    dense = y.data if isinstance(y, Tensor) else jnp.asarray(y)
+    xb = _coo(x)._bcoo
+    gathered = dense[tuple(xb.indices[:, i] for i in range(xb.indices.shape[1]))]
+    return SparseCooTensor(jsparse.BCOO((xb.data / gathered, xb.indices), shape=xb.shape))
+
+
+def matmul(x: Any, y: Any) -> Any:
+    """sparse @ dense → dense (reference ``sparse/matmul_kernel.cu``); XLA
+    lowers bcoo_dot_general to gather + segment-sum."""
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        xb = _coo(x)._bcoo
+        dense = y.data if isinstance(y, Tensor) else jnp.asarray(y)
+        out = jsparse.bcoo_dot_general(
+            xb, dense, dimension_numbers=(([xb.ndim - 1], [0]), ([], []))
+        )
+        return Tensor(out)
+    if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        # dense @ sparse via (sparse^T @ dense^T)^T
+        yb = _coo(y)
+        dense = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+        yt = transpose(yb, list(range(len(yb.shape)))[::-1])
+        return Tensor(
+            jsparse.bcoo_dot_general(
+                yt._bcoo, dense.T, dimension_numbers=(([1], [0]), ([], []))
+            ).T
+        )
+    raise TypeError("sparse.matmul needs at least one sparse operand")
+
+
+def masked_matmul(x: Any, y: Any, mask: SparseCooTensor) -> SparseCooTensor:
+    """(x @ y) evaluated ONLY at ``mask``'s nonzero positions (reference
+    ``sparse/masked_matmul_kernel``): O(nnz·K) work instead of O(M·N·K)."""
+    xd = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    yd = y.data if isinstance(y, Tensor) else jnp.asarray(y)
+    mb = _coo(mask)._bcoo
+    rows = mb.indices[:, 0]
+    cols = mb.indices[:, 1]
+    vals = jnp.einsum("nk,nk->n", xd[rows, :], yd[:, cols].T)
+    return SparseCooTensor(jsparse.BCOO((vals, mb.indices), shape=mb.shape))
+
+
+def _unary(name: str, fn) -> Any:
+    def op(x: Any) -> Any:
+        return _coo(x)._map_values(fn)
+
+    op.__name__ = name
+    op.__doc__ = f"Value-wise ``{name}`` on a sparse tensor (reference sparse_ops.yaml)."
+    return op
+
+
+relu = _unary("relu", jax.nn.relu)
+abs = _unary("abs", jnp.abs)  # noqa: A001 - paddle API name
+sin = _unary("sin", jnp.sin)
+sinh = _unary("sinh", jnp.sinh)
+tan = _unary("tan", jnp.tan)
+tanh = _unary("tanh", jnp.tanh)
+asin = _unary("asin", jnp.arcsin)
+asinh = _unary("asinh", jnp.arcsinh)
+atan = _unary("atan", jnp.arctan)
+atanh = _unary("atanh", jnp.arctanh)
+sqrt = _unary("sqrt", jnp.sqrt)
+square = _unary("square", jnp.square)
+log1p = _unary("log1p", jnp.log1p)
+expm1 = _unary("expm1", jnp.expm1)
+neg = _unary("neg", jnp.negative)
+
+
+def pow(x: Any, factor: float) -> SparseCooTensor:  # noqa: A001
+    return _coo(x)._map_values(lambda v: jnp.power(v, factor))
+
+
+def cast(x: Any, index_dtype: Any = None, value_dtype: Any = None) -> SparseCooTensor:
+    b = _coo(x)._bcoo
+    from paddle_tpu.core.dtypes import convert_dtype
+
+    data = b.data if value_dtype is None else b.data.astype(convert_dtype(value_dtype))
+    idx = b.indices if index_dtype is None else b.indices.astype(convert_dtype(index_dtype))
+    return SparseCooTensor(jsparse.BCOO((data, idx), shape=b.shape))
+
+
+def transpose(x: SparseCooTensor, perm: Sequence[int]) -> SparseCooTensor:
+    b = _coo(x)._bcoo
+    perm = [int(p) for p in perm]
+    n_sp = b.indices.shape[1]
+    if sorted(perm) != list(range(len(b.shape))):
+        raise ValueError(f"perm {perm} is not a permutation of {len(b.shape)} dims")
+    if perm[n_sp:] != list(range(n_sp, len(b.shape))):
+        raise NotImplementedError(
+            "sparse.transpose permutes sparse dims only; dense trailing dims "
+            f"must stay in place (sparse_dim={n_sp}, perm={perm})"
+        )
+    new_idx = b.indices[:, jnp.asarray(perm[:n_sp])]
+    new_shape = tuple(b.shape[p] for p in perm)
+    return SparseCooTensor(jsparse.BCOO((b.data, new_idx), shape=new_shape))
+
+
+def sum(x: Any, axis: Optional[int] = None, dtype: Any = None, keepdim: bool = False) -> Any:  # noqa: A001
+    """Sum over the whole tensor (dense scalar) or one sparse axis."""
+    b = _coo(x)._bcoo
+    if axis is None:
+        out = jnp.sum(b.data)
+        if dtype is not None:
+            from paddle_tpu.core.dtypes import convert_dtype
+
+            out = out.astype(convert_dtype(dtype))
+        return Tensor(out)
+    nd = len(b.shape)
+    axis = axis % nd
+    n_sp = b.indices.shape[1]
+    if axis >= n_sp:
+        # dense trailing axis: reduce inside the values block
+        # (values axis 0 is nnz, so tensor axis maps to values axis - n_sp + 1)
+        v_axis = axis - n_sp + 1
+        new_data = jnp.sum(b.data, axis=v_axis)
+        new_shape = tuple(s for i, s in enumerate(b.shape) if i != axis)
+        res = SparseCooTensor(jsparse.BCOO((new_data, b.indices), shape=new_shape))
+        if keepdim:
+            dense = res.to_dense().data
+            return SparseCooTensor.from_dense(jnp.expand_dims(dense, axis))
+        return res
+    keep = [i for i in range(n_sp) if i != axis]
+    new_idx = b.indices[:, jnp.asarray(keep)]
+    new_shape = tuple(b.shape[i] for i in keep) + tuple(b.shape[n_sp:])
+    out = jsparse.BCOO((b.data, new_idx), shape=new_shape).sum_duplicates()
+    res = SparseCooTensor(out)
+    if keepdim:
+        dense = res.to_dense().data
+        return SparseCooTensor.from_dense(jnp.expand_dims(dense, axis))
+    return res
+
+
+def coalesce(x: SparseCooTensor) -> SparseCooTensor:
+    return _coo(x).coalesce()
+
+
+# -- install dense-Tensor conversions (paddle Tensor API parity) -------------
+
+
+def _tensor_to_sparse_coo(self: Tensor, sparse_dim: Optional[int] = None) -> SparseCooTensor:
+    return SparseCooTensor.from_dense(self, sparse_dim)
+
+
+def _tensor_to_sparse_csr(self: Tensor) -> SparseCsrTensor:
+    return SparseCooTensor.from_dense(self).to_sparse_csr()
+
+
+Tensor.to_sparse_coo = _tensor_to_sparse_coo
+Tensor.to_sparse_csr = _tensor_to_sparse_csr

@@ -1,0 +1,175 @@
+"""Sparse tensor family (reference ``paddle/phi/core/sparse_coo_tensor.h`` +
+``python/paddle/sparse``, sparse_ops.yaml): OpTest-style parity vs dense."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+
+RNG = np.random.default_rng(0)
+
+
+def _rand_sparse_np(shape=(6, 8), density=0.3):
+    dense = RNG.normal(size=shape).astype(np.float32)
+    mask = RNG.random(shape) < density
+    return dense * mask
+
+
+class TestSparseCoo:
+    def test_roundtrip_dense_coo_dense(self):
+        d = _rand_sparse_np()
+        s = paddle.to_tensor(d).to_sparse_coo(2)
+        assert s.is_sparse() and s.is_sparse_coo()
+        assert s.shape == [6, 8]
+        assert s.nnz == int((d != 0).sum())
+        np.testing.assert_array_equal(s.to_dense().numpy(), d)
+
+    def test_construct_from_indices_values(self):
+        indices = [[0, 1, 2], [1, 2, 0]]
+        values = [1.0, 2.0, 3.0]
+        s = sparse.sparse_coo_tensor(indices, values, shape=[3, 3])
+        dense = np.zeros((3, 3), np.float32)
+        dense[0, 1], dense[1, 2], dense[2, 0] = 1, 2, 3
+        np.testing.assert_array_equal(s.to_dense().numpy(), dense)
+        # indices()/values() come back in paddle layout
+        assert list(s.indices().shape) == [2, 3]
+        assert list(s.values().shape) == [3]
+
+    def test_coalesce_sums_duplicates(self):
+        s = sparse.sparse_coo_tensor([[0, 0], [1, 1]], [2.0, 3.0], shape=[2, 2])
+        c = s.coalesce()
+        assert c.nnz == 1
+        assert float(c.to_dense().numpy()[0, 1]) == 5.0
+
+    def test_unary_ops_match_dense(self):
+        d = np.clip(np.abs(_rand_sparse_np()), 0.0, 0.9)  # in-domain for sqrt/asin
+        s = paddle.to_tensor(d).to_sparse_coo(2)
+        for name in ["relu", "abs", "sin", "sinh", "tan", "tanh", "asin",
+                     "asinh", "atan", "sqrt", "square", "log1p", "expm1", "neg"]:
+            fn = getattr(sparse, name)
+            got = fn(s).to_dense().numpy()
+            ref_fn = {
+                "relu": lambda x: np.maximum(x, 0), "neg": np.negative,
+                "asin": np.arcsin, "asinh": np.arcsinh, "atan": np.arctan,
+            }.get(name, getattr(np, name, None))
+            ref = np.where(d != 0, ref_fn(d), 0.0).astype(np.float32)
+            np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6, err_msg=name)
+
+    def test_pow_and_cast(self):
+        d = np.abs(_rand_sparse_np())
+        s = paddle.to_tensor(d).to_sparse_coo(2)
+        np.testing.assert_allclose(
+            sparse.pow(s, 2.0).to_dense().numpy(), d * d, rtol=1e-5
+        )
+        assert str(sparse.cast(s, value_dtype="float64").dtype) in ("float64", "float32")
+
+    def test_add_subtract_union_patterns(self):
+        a = _rand_sparse_np()
+        b = _rand_sparse_np()
+        sa = paddle.to_tensor(a).to_sparse_coo(2)
+        sb = paddle.to_tensor(b).to_sparse_coo(2)
+        np.testing.assert_allclose((sa + sb).to_dense().numpy(), a + b, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose((sa - sb).to_dense().numpy(), a - b, rtol=1e-5, atol=1e-6)
+
+    def test_multiply_dense_masks(self):
+        a = _rand_sparse_np()
+        y = RNG.normal(size=a.shape).astype(np.float32)
+        s = paddle.to_tensor(a).to_sparse_coo(2)
+        np.testing.assert_allclose(
+            sparse.multiply(s, paddle.to_tensor(y)).to_dense().numpy(),
+            a * y, rtol=1e-5, atol=1e-6,
+        )
+        np.testing.assert_allclose(
+            sparse.multiply(s, 2.5).to_dense().numpy(), a * 2.5, rtol=1e-5
+        )
+
+    def test_matmul_sparse_dense(self):
+        a = _rand_sparse_np((5, 7))
+        y = RNG.normal(size=(7, 3)).astype(np.float32)
+        s = paddle.to_tensor(a).to_sparse_coo(2)
+        out = sparse.matmul(s, paddle.to_tensor(y))
+        np.testing.assert_allclose(np.asarray(out.numpy()), a @ y, rtol=1e-4, atol=1e-5)
+        # dense @ sparse
+        x = RNG.normal(size=(4, 5)).astype(np.float32)
+        out2 = sparse.matmul(paddle.to_tensor(x), s)
+        np.testing.assert_allclose(np.asarray(out2.numpy()), x @ a, rtol=1e-4, atol=1e-5)
+
+    def test_masked_matmul(self):
+        x = RNG.normal(size=(5, 4)).astype(np.float32)
+        y = RNG.normal(size=(4, 6)).astype(np.float32)
+        mask_np = (_rand_sparse_np((5, 6)) != 0).astype(np.float32)
+        mask = paddle.to_tensor(mask_np).to_sparse_coo(2)
+        out = sparse.masked_matmul(paddle.to_tensor(x), paddle.to_tensor(y), mask)
+        np.testing.assert_allclose(
+            out.to_dense().numpy(), (x @ y) * mask_np, rtol=1e-4, atol=1e-5
+        )
+
+    def test_transpose_and_sum(self):
+        a = _rand_sparse_np((4, 6))
+        s = paddle.to_tensor(a).to_sparse_coo(2)
+        np.testing.assert_allclose(
+            sparse.transpose(s, [1, 0]).to_dense().numpy(), a.T, rtol=1e-6
+        )
+        np.testing.assert_allclose(float(sparse.sum(s).numpy()), a.sum(), rtol=1e-5)
+        np.testing.assert_allclose(
+            sparse.sum(s, axis=0).to_dense().numpy(), a.sum(0), rtol=1e-5, atol=1e-6
+        )
+
+    def test_is_same_shape(self):
+        a = paddle.to_tensor(_rand_sparse_np()).to_sparse_coo(2)
+        b = paddle.to_tensor(_rand_sparse_np()).to_sparse_coo(2)
+        assert sparse.is_same_shape(a, b)
+
+
+class TestSparseCsr:
+    def test_coo_csr_roundtrip(self):
+        d = _rand_sparse_np((5, 9))
+        csr = paddle.to_tensor(d).to_sparse_csr()
+        assert csr.is_sparse_csr()
+        np.testing.assert_array_equal(csr.to_dense().numpy(), d)
+        back = csr.to_sparse_coo()
+        np.testing.assert_array_equal(back.to_dense().numpy(), d)
+
+    def test_construct_csr(self):
+        # [[0, 1, 0], [2, 0, 3]]
+        csr = sparse.sparse_csr_tensor([0, 1, 3], [1, 0, 2], [1.0, 2.0, 3.0], [2, 3])
+        ref = np.array([[0, 1, 0], [2, 0, 3]], np.float32)
+        np.testing.assert_array_equal(csr.to_dense().numpy(), ref)
+        assert csr.nnz == 3
+
+    def test_csr_matmul_via_coo(self):
+        d = _rand_sparse_np((4, 5))
+        y = RNG.normal(size=(5, 2)).astype(np.float32)
+        csr = paddle.to_tensor(d).to_sparse_csr()
+        out = sparse.matmul(csr, paddle.to_tensor(y))
+        np.testing.assert_allclose(np.asarray(out.numpy()), d @ y, rtol=1e-4, atol=1e-5)
+
+
+class TestDenseTrailingDims:
+    """r4 review: COO with sparse_dim < ndim (dense trailing dims)."""
+
+    def test_sum_over_dense_axis(self):
+        arr = np.zeros((4, 3, 2), np.float32)
+        arr[0, 1] = [1.0, 2.0]
+        arr[2, 0] = [3.0, 4.0]
+        s = paddle.to_tensor(arr).to_sparse_coo(2)  # indices have 2 cols
+        out = sparse.sum(s, axis=2)
+        np.testing.assert_allclose(out.to_dense().numpy(), arr.sum(2), rtol=1e-6)
+
+    def test_sum_over_sparse_axis_keeps_dense_part(self):
+        arr = np.zeros((4, 3, 2), np.float32)
+        arr[0, 1] = [1.0, 2.0]
+        arr[2, 1] = [3.0, 4.0]
+        s = paddle.to_tensor(arr).to_sparse_coo(2)
+        out = sparse.sum(s, axis=0)
+        np.testing.assert_allclose(out.to_dense().numpy(), arr.sum(0), rtol=1e-6)
+
+    def test_transpose_dense_dims_rejected(self):
+        arr = np.zeros((4, 3, 2), np.float32)
+        arr[0, 1] = [1.0, 2.0]
+        s = paddle.to_tensor(arr).to_sparse_coo(2)
+        with pytest.raises(NotImplementedError):
+            sparse.transpose(s, [2, 1, 0])
+        out = sparse.transpose(s, [1, 0, 2])  # sparse-dims-only perm is fine
+        np.testing.assert_allclose(out.to_dense().numpy(), arr.transpose(1, 0, 2))
