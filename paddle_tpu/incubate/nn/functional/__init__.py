@@ -27,7 +27,18 @@ __all__ = [
     "fused_bias_dropout_residual_layer_norm",
     "fused_dropout_add",
     "masked_multihead_attention",
+    "block_multihead_attention",
+    "block_cache_prefill",
+    "block_cache_append",
+    "BlockKVCache",
 ]
+
+from paddle_tpu.incubate.nn.functional.block_attention import (  # noqa: E402,F401
+    BlockKVCache,
+    block_cache_append,
+    block_cache_prefill,
+    block_multihead_attention,
+)
 
 
 def fused_rms_norm(

@@ -1,0 +1,187 @@
+"""Paged (blocked) KV-cache attention for serving.
+
+Reference: ``block_multihead_attention_`` (``fused_ops.yaml:45``, CUDA kernel
+``paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu``) — the
+vLLM-style paged cache: KV lives in fixed-size physical blocks; a per-sequence
+``block_table`` maps logical block index → physical block id, so sequences
+grow without reserving max_seq_len per slot and freed blocks are reused.
+
+TPU-native shape: the cache is a dense ``[num_blocks, block_size, H, D]``
+array; appends are batched scatters (``.at[phys, off].set``) and attention
+gathers each sequence's blocks with a static ``max_blocks_per_seq`` bound —
+all static shapes, so the whole decode step jits once. The block allocator is
+host-side Python (it runs between steps, not inside the program), mirroring
+the reference where block tables are produced by the serving scheduler.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "BlockKVCache",
+    "block_multihead_attention",
+    "block_cache_prefill",
+    "block_cache_append",
+]
+
+
+class BlockKVCache:
+    """Host-side paged-cache manager: physical block pool + per-sequence block
+    tables (reference: the serving scheduler that feeds ``block_tables``)."""
+
+    def __init__(
+        self,
+        num_blocks: int,
+        block_size: int,
+        num_heads: int,
+        head_dim: int,
+        max_blocks_per_seq: int,
+        dtype: Any = jnp.bfloat16,
+    ) -> None:
+        self.block_size = int(block_size)
+        self.num_blocks = int(num_blocks)
+        self.max_blocks_per_seq = int(max_blocks_per_seq)
+        self.key_cache = jnp.zeros((num_blocks, block_size, num_heads, head_dim), dtype)
+        self.value_cache = jnp.zeros((num_blocks, block_size, num_heads, head_dim), dtype)
+        self._free: List[int] = list(range(num_blocks - 1, -1, -1))
+        self._tables: dict = {}  # seq id -> list of physical block ids
+        self._lens: dict = {}  # seq id -> tokens stored
+
+    # -- allocator ----------------------------------------------------------
+    def allocate(self, seq_id: int, num_tokens: int) -> None:
+        """Ensure ``seq_id`` has blocks for ``num_tokens`` more tokens."""
+        table = self._tables.setdefault(seq_id, [])
+        cur = self._lens.get(seq_id, 0)
+        need_blocks = -(-(cur + num_tokens) // self.block_size)
+        while len(table) < need_blocks:
+            if not self._free:
+                raise MemoryError("paged KV cache out of physical blocks")
+            if len(table) >= self.max_blocks_per_seq:
+                raise MemoryError(
+                    f"sequence {seq_id} exceeds max_blocks_per_seq={self.max_blocks_per_seq}"
+                )
+            table.append(self._free.pop())
+        self._lens[seq_id] = cur + num_tokens
+
+    def free(self, seq_id: int) -> None:
+        """Return a finished sequence's blocks to the pool."""
+        for b in self._tables.pop(seq_id, []):
+            self._free.append(b)
+        self._lens.pop(seq_id, None)
+
+    def seq_len(self, seq_id: int) -> int:
+        return self._lens.get(seq_id, 0)
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def block_table(self, seq_ids: Sequence[int]) -> jnp.ndarray:
+        """Dense ``[B, max_blocks_per_seq]`` table (unused slots point at
+        block 0; masking makes them unreachable)."""
+        out = np.zeros((len(seq_ids), self.max_blocks_per_seq), np.int32)
+        for i, sid in enumerate(seq_ids):
+            t = self._tables.get(sid, [])
+            out[i, : len(t)] = t
+        return jnp.asarray(out)
+
+    def seq_lens(self, seq_ids: Sequence[int]) -> jnp.ndarray:
+        return jnp.asarray([self._lens.get(s, 0) for s in seq_ids], jnp.int32)
+
+
+def block_cache_append(
+    key_cache: jax.Array,  # [NB, BS, H, D]
+    value_cache: jax.Array,
+    k: jax.Array,  # [B, H, D] one new token per sequence
+    v: jax.Array,
+    block_tables: jax.Array,  # [B, MBS]
+    positions: jax.Array,  # [B] token index being written (0-based)
+) -> Tuple[jax.Array, jax.Array]:
+    """Scatter one new KV token per sequence into its physical block slot."""
+    bs = key_cache.shape[1]
+    blk_idx = positions // bs
+    off = positions % bs
+    phys = jnp.take_along_axis(block_tables, blk_idx[:, None], axis=1)[:, 0]
+    key_cache = key_cache.at[phys, off].set(k.astype(key_cache.dtype))
+    value_cache = value_cache.at[phys, off].set(v.astype(value_cache.dtype))
+    return key_cache, value_cache
+
+
+def block_cache_prefill(
+    key_cache: jax.Array,
+    value_cache: jax.Array,
+    k: jax.Array,  # [B, S, H, D] prompt KV
+    v: jax.Array,
+    block_tables: jax.Array,  # [B, MBS]
+    seq_lens: jax.Array,  # [B] prompt lengths (<= S)
+) -> Tuple[jax.Array, jax.Array]:
+    """Write whole prompts into the paged cache (encoder phase of the
+    reference kernel). Positions past ``seq_lens`` scatter into a scratch
+    slot (block 0 / slot recomputed) are avoided via clamping + final mask."""
+    b, s, h, d = k.shape
+    nb, bs = key_cache.shape[0], key_cache.shape[1]
+    t = jnp.arange(s)[None, :]  # [1, S]
+    valid = t < seq_lens[:, None]  # [B, S]
+    blk_idx = jnp.minimum(t // bs, block_tables.shape[1] - 1)
+    off = t % bs
+    phys = jnp.take_along_axis(block_tables, blk_idx, axis=1)  # [B, S]
+    # invalid positions are routed OUT OF BOUNDS and dropped by the scatter —
+    # clamping them onto a real block would collide with a valid write at the
+    # same slot, and duplicate-index scatter order is undefined
+    phys = jnp.where(valid, phys, nb)
+    flat_phys = phys.reshape(-1)
+    flat_off = jnp.broadcast_to(off, phys.shape).reshape(-1)
+    flat_k = k.reshape(b * s, h, d).astype(key_cache.dtype)
+    flat_v = v.reshape(b * s, h, d).astype(value_cache.dtype)
+    key_cache = key_cache.at[flat_phys, flat_off].set(flat_k, mode="drop")
+    value_cache = value_cache.at[flat_phys, flat_off].set(flat_v, mode="drop")
+    return key_cache, value_cache
+
+
+def block_multihead_attention(
+    q: jax.Array,  # [B, 1, HQ, D] decode query (one token per sequence)
+    k: jax.Array,  # [B, 1, HKV, D] new key
+    v: jax.Array,  # [B, 1, HKV, D] new value
+    key_cache: jax.Array,  # [NB, BS, HKV, D]
+    value_cache: jax.Array,
+    block_tables: jax.Array,  # [B, MBS] int32
+    seq_lens: jax.Array,  # [B] tokens already cached (EXCLUDING this one)
+    scale: Optional[float] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One paged-cache decode step: append the new KV, attend over the
+    sequence's blocks. Returns ``(out [B, 1, HQ, D], key_cache, value_cache)``
+    — pass donated caches under jit for true in-place update (the reference
+    op is declared ``inplace``)."""
+    b, one, hq, d = q.shape
+    hkv = k.shape[2]
+    if scale is None:
+        scale = 1.0 / (d**0.5)
+    key_cache, value_cache = block_cache_append(
+        key_cache, value_cache, k[:, 0], v[:, 0], block_tables, seq_lens
+    )
+    # gather each sequence's blocks: [B, MBS, BS, HKV, D] -> [B, L, HKV, D]
+    gk = key_cache[block_tables]
+    gv = value_cache[block_tables]
+    mbs, bs = block_tables.shape[1], key_cache.shape[1]
+    L = mbs * bs
+    gk = gk.reshape(b, L, hkv, d)
+    gv = gv.reshape(b, L, hkv, d)
+    if hkv != hq:
+        if hq % hkv != 0:
+            raise ValueError(f"q heads {hq} not a multiple of kv heads {hkv}")
+        rep = hq // hkv
+        gk = jnp.repeat(gk, rep, axis=2)
+        gv = jnp.repeat(gv, rep, axis=2)
+    qf = q[:, 0].astype(jnp.float32) * scale  # [B, HQ, D]
+    scores = jnp.einsum("bhd,blhd->bhl", qf, gk.astype(jnp.float32))
+    pos = jnp.arange(L)[None, None, :]
+    mask = pos <= seq_lens[:, None, None]  # attends the freshly-appended token
+    scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhl,blhd->bhd", probs, gv.astype(jnp.float32))
+    return out[:, None].astype(q.dtype), key_cache, value_cache
