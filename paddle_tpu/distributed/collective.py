@@ -307,7 +307,16 @@ def reduce_scatter(tensor: Any, tensor_list: Any = None, op: str = ReduceOp.SUM,
         return jax.lax.psum_scatter(x, axis_name, axis_index_groups=aig, tiled=True)
 
     src = tensor_list if tensor_list is not None else tensor
-    return _apply(src, fn)
+    result = _apply(src, fn)
+    # reference in-place semantics (communication/reduce_scatter.py): when an
+    # output buffer is provided alongside the input list, write into it —
+    # ported scripts read the buffer. Single-argument form: the tensor is the
+    # INPUT; mutating it would clobber the caller's buffer with a
+    # differently-shaped shard, so return the result instead.
+    if tensor_list is not None and isinstance(tensor, Tensor) and isinstance(result, Tensor):
+        tensor._replace_(result)
+        return tensor
+    return result
 
 
 def broadcast(tensor: Any, src: int = 0, group: Optional[Group] = None, sync_op: bool = True) -> Any:
@@ -350,7 +359,15 @@ def scatter(tensor: Any, tensor_list: Any = None, src: int = 0, group: Optional[
         gathered = jax.lax.all_gather(x, axis_name, axis_index_groups=aig)
         return gathered[local_src][pos_table[idx]]
 
-    return _apply(tensor_list if tensor_list is not None else tensor, fn)
+    result = _apply(tensor_list if tensor_list is not None else tensor, fn)
+    # reference in-place semantics (communication/scatter.py): only when the
+    # tensor is a dedicated OUTPUT buffer (input came via tensor_list) — in
+    # the single-argument form the tensor is the input and must not be
+    # clobbered with the differently-shaped shard.
+    if tensor_list is not None and isinstance(tensor, Tensor) and isinstance(result, Tensor):
+        tensor._replace_(result)
+        return tensor
+    return result
 
 
 def alltoall(out_tensor_list: Any, in_tensor_list: Any, group: Optional[Group] = None, sync_op: bool = True) -> Any:

@@ -7,12 +7,20 @@ dispatches on the pair of types (the reference's registered-kl pattern).
 
 from paddle_tpu.distribution.distributions import (  # noqa: F401
     Bernoulli,
+    Beta,
     Categorical,
+    Cauchy,
+    Dirichlet,
     Distribution,
     Exponential,
     Gamma,
+    Geometric,
+    Gumbel,
     Laplace,
+    LogNormal,
+    Multinomial,
     Normal,
+    Poisson,
     Uniform,
     kl_divergence,
 )

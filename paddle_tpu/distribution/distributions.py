@@ -26,6 +26,14 @@ __all__ = [
     "Exponential",
     "Gamma",
     "Laplace",
+    "Beta",
+    "Dirichlet",
+    "Multinomial",
+    "Gumbel",
+    "LogNormal",
+    "Poisson",
+    "Geometric",
+    "Cauchy",
     "kl_divergence",
 ]
 
@@ -188,8 +196,11 @@ class Bernoulli(Distribution):
         return Tensor(u.astype(jnp.float32))
 
     def log_prob(self, value: Any) -> Tensor:
+        import jax.scipy.special as jss
+
         v = _arr(value)
-        return Tensor(v * jnp.log(self.probs_) + (1 - v) * jnp.log(1 - self.probs_))
+        # xlogy: deterministic outcomes (p in {0,1}) stay finite
+        return Tensor(jss.xlogy(v, self.probs_) + jss.xlog1py(1 - v, -self.probs_))
 
     def entropy(self) -> Tensor:
         p = self.probs_
@@ -298,6 +309,318 @@ def kl_divergence(p: Distribution, q: Distribution) -> Tensor:
     if isinstance(p, Exponential) and isinstance(q, Exponential):
         r = p.rate / q.rate
         return Tensor(jnp.log(r) + 1.0 / r - 1.0)
+    if isinstance(p, Gamma) and isinstance(q, Gamma):
+        import jax.scipy.special as jss
+
+        a1, b1, a2, b2 = p.concentration, p.rate, q.concentration, q.rate
+        return Tensor(
+            (a1 - a2) * jss.digamma(a1)
+            - jax.lax.lgamma(a1)
+            + jax.lax.lgamma(a2)
+            + a2 * (jnp.log(b1) - jnp.log(b2))
+            + a1 * (b2 - b1) / b1
+        )
+    if isinstance(p, Beta) and isinstance(q, Beta):
+        import jax.scipy.special as jss
+
+        a1, b1, a2, b2 = p.alpha, p.beta, q.alpha, q.beta
+
+        def lbeta(a, b):
+            return jax.lax.lgamma(a) + jax.lax.lgamma(b) - jax.lax.lgamma(a + b)
+
+        return Tensor(
+            lbeta(a2, b2)
+            - lbeta(a1, b1)
+            + (a1 - a2) * jss.digamma(a1)
+            + (b1 - b2) * jss.digamma(b1)
+            + (a2 - a1 + b2 - b1) * jss.digamma(a1 + b1)
+        )
     raise NotImplementedError(
         f"kl_divergence not registered for ({type(p).__name__}, {type(q).__name__})"
     )
+
+
+class Beta(Distribution):
+    """Reference ``distribution/beta.py``."""
+
+    def __init__(self, alpha: Any, beta: Any, name: Optional[str] = None) -> None:
+        self.alpha = _arr(alpha)
+        self.beta = _arr(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape, self.beta.shape))
+
+    @property
+    def mean(self) -> Tensor:
+        return Tensor(self.alpha / (self.alpha + self.beta))
+
+    @property
+    def variance(self) -> Tensor:
+        s = self.alpha + self.beta
+        return Tensor(self.alpha * self.beta / (s * s * (s + 1)))
+
+    def sample(self, shape: Sequence[int] = ()) -> Tensor:
+        out = jax.random.beta(
+            _rng.next_key(), self.alpha, self.beta, _shape(shape, self.batch_shape)
+        )
+        return Tensor(out)
+
+    def log_prob(self, value: Any) -> Tensor:
+        v = _arr(value)
+        a, b = self.alpha, self.beta
+        lbeta = jax.lax.lgamma(a) + jax.lax.lgamma(b) - jax.lax.lgamma(a + b)
+        return Tensor((a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v) - lbeta)
+
+    def entropy(self) -> Tensor:
+        import jax.scipy.special as jss
+
+        a, b = self.alpha, self.beta
+        lbeta = jax.lax.lgamma(a) + jax.lax.lgamma(b) - jax.lax.lgamma(a + b)
+        return Tensor(
+            lbeta
+            - (a - 1) * jss.digamma(a)
+            - (b - 1) * jss.digamma(b)
+            + (a + b - 2) * jss.digamma(a + b)
+        )
+
+
+class Dirichlet(Distribution):
+    """Reference ``distribution/dirichlet.py``."""
+
+    def __init__(self, concentration: Any, name: Optional[str] = None) -> None:
+        self.concentration = _arr(concentration)
+        super().__init__(self.concentration.shape[:-1], self.concentration.shape[-1:])
+
+    @property
+    def mean(self) -> Tensor:
+        return Tensor(self.concentration / self.concentration.sum(-1, keepdims=True))
+
+    @property
+    def variance(self) -> Tensor:
+        a = self.concentration
+        a0 = a.sum(-1, keepdims=True)
+        return Tensor(a * (a0 - a) / (a0 * a0 * (a0 + 1)))
+
+    def sample(self, shape: Sequence[int] = ()) -> Tensor:
+        out = jax.random.dirichlet(
+            _rng.next_key(), self.concentration, tuple(shape) + self.batch_shape
+        )
+        return Tensor(out)
+
+    def log_prob(self, value: Any) -> Tensor:
+        v = _arr(value)
+        a = self.concentration
+        lnorm = jax.lax.lgamma(a).sum(-1) - jax.lax.lgamma(a.sum(-1))
+        return Tensor(((a - 1) * jnp.log(v)).sum(-1) - lnorm)
+
+    def entropy(self) -> Tensor:
+        import jax.scipy.special as jss
+
+        a = self.concentration
+        a0 = a.sum(-1)
+        k = a.shape[-1]
+        lnorm = jax.lax.lgamma(a).sum(-1) - jax.lax.lgamma(a0)
+        return Tensor(
+            lnorm
+            + (a0 - k) * jss.digamma(a0)
+            - ((a - 1) * jss.digamma(a)).sum(-1)
+        )
+
+
+class Multinomial(Distribution):
+    """Reference ``distribution/multinomial.py``: n trials over K categories."""
+
+    def __init__(self, total_count: int, probs: Any, name: Optional[str] = None) -> None:
+        self.total_count = int(total_count)
+        self.probs_ = _arr(probs)
+        self.probs_ = self.probs_ / self.probs_.sum(-1, keepdims=True)
+        super().__init__(self.probs_.shape[:-1], self.probs_.shape[-1:])
+
+    @property
+    def mean(self) -> Tensor:
+        return Tensor(self.total_count * self.probs_)
+
+    @property
+    def variance(self) -> Tensor:
+        return Tensor(self.total_count * self.probs_ * (1 - self.probs_))
+
+    def sample(self, shape: Sequence[int] = ()) -> Tensor:
+        logits = jnp.log(self.probs_)
+        draws = jax.random.categorical(
+            _rng.next_key(),
+            logits,
+            shape=tuple(shape) + (self.total_count,) + self.batch_shape,
+            axis=-1,
+        )
+        k = self.probs_.shape[-1]
+        counts = jax.nn.one_hot(draws, k).sum(axis=len(tuple(shape)))
+        return Tensor(counts)
+
+    def log_prob(self, value: Any) -> Tensor:
+        v = _arr(value)
+        import jax.scipy.special as jss
+
+        logf = (
+            jax.lax.lgamma(jnp.asarray(self.total_count + 1.0))
+            - jax.lax.lgamma(v + 1.0).sum(-1)
+        )
+        # xlogy: a zero count against a zero probability contributes 0, not NaN
+        return Tensor(logf + jss.xlogy(v, self.probs_).sum(-1))
+
+
+class Gumbel(Distribution):
+    """Reference ``distribution/gumbel.py``."""
+
+    _EULER = 0.5772156649015329
+
+    def __init__(self, loc: Any, scale: Any, name: Optional[str] = None) -> None:
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self) -> Tensor:
+        return Tensor(jnp.broadcast_to(self.loc + self._EULER * self.scale, self.batch_shape))
+
+    @property
+    def variance(self) -> Tensor:
+        return Tensor(
+            jnp.broadcast_to((jnp.pi**2 / 6) * self.scale**2, self.batch_shape)
+        )
+
+    def sample(self, shape: Sequence[int] = ()) -> Tensor:
+        g = jax.random.gumbel(_rng.next_key(), _shape(shape, self.batch_shape))
+        return Tensor(self.loc + self.scale * g)
+
+    rsample = sample
+
+    def log_prob(self, value: Any) -> Tensor:
+        z = (_arr(value) - self.loc) / self.scale
+        return Tensor(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+    def entropy(self) -> Tensor:
+        return Tensor(
+            jnp.broadcast_to(jnp.log(self.scale) + 1 + self._EULER, self.batch_shape)
+        )
+
+
+class LogNormal(Distribution):
+    """Reference ``distribution/lognormal.py``."""
+
+    def __init__(self, loc: Any, scale: Any, name: Optional[str] = None) -> None:
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self) -> Tensor:
+        return Tensor(jnp.exp(self.loc + self.scale**2 / 2))
+
+    @property
+    def variance(self) -> Tensor:
+        s2 = self.scale**2
+        return Tensor((jnp.exp(s2) - 1) * jnp.exp(2 * self.loc + s2))
+
+    def sample(self, shape: Sequence[int] = ()) -> Tensor:
+        n = jax.random.normal(_rng.next_key(), _shape(shape, self.batch_shape))
+        return Tensor(jnp.exp(self.loc + self.scale * n))
+
+    rsample = sample
+
+    def log_prob(self, value: Any) -> Tensor:
+        v = _arr(value)
+        z = (jnp.log(v) - self.loc) / self.scale
+        return Tensor(
+            -0.5 * z**2 - jnp.log(self.scale) - jnp.log(v) - 0.5 * jnp.log(2 * jnp.pi)
+        )
+
+    def entropy(self) -> Tensor:
+        return Tensor(
+            jnp.broadcast_to(
+                self.loc + 0.5 + jnp.log(self.scale) + 0.5 * jnp.log(2 * jnp.pi),
+                self.batch_shape,
+            )
+        )
+
+
+class Poisson(Distribution):
+    """Reference ``distribution/poisson.py``."""
+
+    def __init__(self, rate: Any, name: Optional[str] = None) -> None:
+        self.rate = _arr(rate)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self) -> Tensor:
+        return Tensor(self.rate)
+
+    @property
+    def variance(self) -> Tensor:
+        return Tensor(self.rate)
+
+    def sample(self, shape: Sequence[int] = ()) -> Tensor:
+        out = jax.random.poisson(
+            _rng.next_key(), self.rate, _shape(shape, self.batch_shape)
+        )
+        return Tensor(out.astype(jnp.float32))
+
+    def log_prob(self, value: Any) -> Tensor:
+        v = _arr(value)
+        return Tensor(v * jnp.log(self.rate) - self.rate - jax.lax.lgamma(v + 1.0))
+
+
+class Geometric(Distribution):
+    """Reference ``distribution/geometric.py``: failures before first success."""
+
+    def __init__(self, probs: Any, name: Optional[str] = None) -> None:
+        self.probs_ = _arr(probs)
+        super().__init__(self.probs_.shape)
+
+    @property
+    def mean(self) -> Tensor:
+        return Tensor((1 - self.probs_) / self.probs_)
+
+    @property
+    def variance(self) -> Tensor:
+        return Tensor((1 - self.probs_) / self.probs_**2)
+
+    def sample(self, shape: Sequence[int] = ()) -> Tensor:
+        u = jax.random.uniform(
+            _rng.next_key(), _shape(shape, self.batch_shape), minval=1e-7, maxval=1.0
+        )
+        return Tensor(jnp.floor(jnp.log(u) / jnp.log1p(-self.probs_)))
+
+    def log_prob(self, value: Any) -> Tensor:
+        import jax.scipy.special as jss
+
+        v = _arr(value)
+        # xlog1py: v=0 at probs=1 contributes 0, not NaN
+        return Tensor(jss.xlog1py(v, -self.probs_) + jnp.log(self.probs_))
+
+    def entropy(self) -> Tensor:
+        import jax.scipy.special as jss
+
+        p = self.probs_
+        return Tensor(-(jss.xlog1py(1 - p, -p) + jss.xlogy(p, p)) / p)
+
+
+class Cauchy(Distribution):
+    """Reference ``distribution/cauchy.py`` (mean/variance undefined)."""
+
+    def __init__(self, loc: Any, scale: Any, name: Optional[str] = None) -> None:
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def sample(self, shape: Sequence[int] = ()) -> Tensor:
+        c = jax.random.cauchy(_rng.next_key(), _shape(shape, self.batch_shape))
+        return Tensor(self.loc + self.scale * c)
+
+    rsample = sample
+
+    def log_prob(self, value: Any) -> Tensor:
+        z = (_arr(value) - self.loc) / self.scale
+        return Tensor(-jnp.log(jnp.pi * self.scale * (1 + z**2)))
+
+    def entropy(self) -> Tensor:
+        return Tensor(
+            jnp.broadcast_to(jnp.log(4 * jnp.pi * self.scale), self.batch_shape)
+        )

@@ -198,3 +198,44 @@ class TestAlltoallSubgroup:
         # member 0 (subgroup [0, 2]): keeps its row 0, receives member 2's row 0
         np.testing.assert_allclose(out[0], x2[0])
         np.testing.assert_allclose(out[1], x2[4])  # member 2's first row
+
+
+class TestInPlaceSemantics:
+    """reduce_scatter/scatter write into the provided output tensor, matching
+    the reference's in-place collectives (communication/reduce_scatter.py) —
+    ported scripts read the buffer, not the return value."""
+
+    def test_reduce_scatter_writes_output_tensor(self):
+        g = _axis_group()
+        X64 = np.arange(N * N, dtype=np.float32)  # local [N] per rank
+
+        def fn(x):
+            from paddle_tpu.core.tensor import Tensor
+
+            t_in = Tensor(x)
+            out = Tensor(jnp.zeros((x.shape[0] // N,), x.dtype))
+            ret = C.reduce_scatter(out, t_in, group=g)
+            assert ret is out  # same object returned
+            return out.data
+
+        out = _run(fn, X64)
+        # tiled psum_scatter: rank r gets sum_s X64[N*s + r]
+        expect = np.array([X64[r::N].sum() for r in range(N)], np.float32)
+        np.testing.assert_allclose(out, expect)
+
+    def test_scatter_writes_output_tensor(self):
+        g = _axis_group()
+        X64 = np.arange(N * N, dtype=np.float32)
+
+        def fn(x):
+            from paddle_tpu.core.tensor import Tensor
+
+            t_in = Tensor(x)
+            out = Tensor(jnp.zeros((), x.dtype))
+            ret = C.scatter(out, t_in, src=0, group=g)
+            assert ret is out
+            return out.data.reshape(1)
+
+        out = _run(fn, X64)
+        # each rank receives its piece of rank 0's local buffer X64[:N]
+        np.testing.assert_allclose(out, X64[:N])

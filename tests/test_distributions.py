@@ -1,0 +1,136 @@
+"""Distribution families (reference ``python/paddle/distribution``): log_prob
+parity against torch.distributions oracles, sample-moment sanity, KL pairs."""
+
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+
+def _t(x):
+    return torch.as_tensor(np.asarray(x, np.float64))
+
+
+ORACLES = [
+    # (ours, torch ctor, params, test values)
+    (lambda: D.Beta(2.0, 3.0), lambda: torch.distributions.Beta(_t(2.0), _t(3.0)),
+     [0.1, 0.5, 0.9]),
+    (lambda: D.Gumbel(1.0, 2.0), lambda: torch.distributions.Gumbel(_t(1.0), _t(2.0)),
+     [-1.0, 0.5, 4.0]),
+    (lambda: D.LogNormal(0.5, 0.7), lambda: torch.distributions.LogNormal(_t(0.5), _t(0.7)),
+     [0.2, 1.0, 3.0]),
+    (lambda: D.Poisson(3.5), lambda: torch.distributions.Poisson(_t(3.5)),
+     [0.0, 2.0, 7.0]),
+    (lambda: D.Geometric(0.3), lambda: torch.distributions.Geometric(_t(0.3)),
+     [0.0, 1.0, 5.0]),
+    (lambda: D.Cauchy(0.0, 1.5), lambda: torch.distributions.Cauchy(_t(0.0), _t(1.5)),
+     [-2.0, 0.0, 3.0]),
+]
+
+
+@pytest.mark.parametrize("ours,theirs,values", ORACLES,
+                         ids=["beta", "gumbel", "lognormal", "poisson", "geometric", "cauchy"])
+def test_log_prob_matches_torch(ours, theirs, values):
+    d = ours()
+    ref = theirs()
+    for v in values:
+        got = float(d.log_prob(v).numpy())
+        want = float(ref.log_prob(_t(v)))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_dirichlet_log_prob_and_mean():
+    alpha = np.array([2.0, 3.0, 5.0], np.float32)
+    d = D.Dirichlet(alpha)
+    ref = torch.distributions.Dirichlet(_t(alpha))
+    v = np.array([0.2, 0.3, 0.5], np.float32)
+    np.testing.assert_allclose(
+        float(d.log_prob(v).numpy()), float(ref.log_prob(_t(v))), rtol=1e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(d.mean.numpy()), alpha / alpha.sum(), rtol=1e-5
+    )
+    s = d.sample([100])
+    np.testing.assert_allclose(np.asarray(s.numpy()).sum(-1), 1.0, rtol=1e-4)
+
+
+def test_multinomial_log_prob_and_counts():
+    probs = np.array([0.2, 0.3, 0.5], np.float32)
+    d = D.Multinomial(10, probs)
+    ref = torch.distributions.Multinomial(10, probs=_t(probs))
+    v = np.array([2.0, 3.0, 5.0], np.float32)
+    np.testing.assert_allclose(
+        float(d.log_prob(v).numpy()), float(ref.log_prob(_t(v))), rtol=1e-4
+    )
+    paddle.seed(0)
+    s = np.asarray(d.sample([40]).numpy())
+    assert s.shape == (40, 3)
+    np.testing.assert_array_equal(s.sum(-1), np.full(40, 10.0))
+
+
+def test_entropy_matches_torch():
+    pairs = [
+        (D.Beta(2.0, 3.0), torch.distributions.Beta(_t(2.0), _t(3.0))),
+        (D.Gumbel(1.0, 2.0), torch.distributions.Gumbel(_t(1.0), _t(2.0))),
+        (D.Dirichlet(np.array([2.0, 3.0, 5.0], np.float32)),
+         torch.distributions.Dirichlet(_t([2.0, 3.0, 5.0]))),
+        (D.Cauchy(0.0, 1.5), torch.distributions.Cauchy(_t(0.0), _t(1.5))),
+    ]
+    for ours, ref in pairs:
+        np.testing.assert_allclose(
+            float(ours.entropy().numpy()), float(ref.entropy()), rtol=1e-4,
+            err_msg=type(ours).__name__,
+        )
+
+
+def test_sample_moments():
+    paddle.seed(1)
+    checks = [
+        (D.Beta(2.0, 3.0), 2 / 5),
+        (D.LogNormal(0.0, 0.5), np.exp(0.125)),
+        (D.Poisson(4.0), 4.0),
+        (D.Geometric(0.4), 1.5),
+        (D.Gumbel(0.0, 1.0), 0.5772),
+    ]
+    for d, want_mean in checks:
+        s = np.asarray(d.sample([20000]).numpy())
+        np.testing.assert_allclose(s.mean(), want_mean, rtol=0.1,
+                                   err_msg=type(d).__name__)
+
+
+def test_kl_gamma_and_beta_match_torch():
+    p = D.Gamma(2.0, 1.5)
+    q = D.Gamma(3.0, 0.5)
+    tp = torch.distributions.Gamma(_t(2.0), _t(1.5))
+    tq = torch.distributions.Gamma(_t(3.0), _t(0.5))
+    np.testing.assert_allclose(
+        float(D.kl_divergence(p, q).numpy()),
+        float(torch.distributions.kl_divergence(tp, tq)), rtol=1e-4,
+    )
+    pb = D.Beta(2.0, 3.0)
+    qb = D.Beta(4.0, 1.0)
+    tpb = torch.distributions.Beta(_t(2.0), _t(3.0))
+    tqb = torch.distributions.Beta(_t(4.0), _t(1.0))
+    np.testing.assert_allclose(
+        float(D.kl_divergence(pb, qb).numpy()),
+        float(torch.distributions.kl_divergence(tpb, tqb)), rtol=1e-4,
+    )
+
+
+def test_unregistered_kl_raises():
+    with pytest.raises(NotImplementedError):
+        D.kl_divergence(D.Beta(1.0, 1.0), D.Gamma(1.0, 1.0))
+
+
+def test_multinomial_zero_prob_category_finite():
+    """r4 review: a zero count against a zero-probability category must
+    contribute 0 to log_prob, not NaN."""
+    d = D.Multinomial(5, np.array([0.5, 0.5, 0.0], np.float32))
+    lp = float(d.log_prob(np.array([3.0, 2.0, 0.0], np.float32)).numpy())
+    ref = torch.distributions.Multinomial(
+        5, probs=_t([0.5, 0.5, 0.0])
+    ).log_prob(_t([3.0, 2.0, 0.0]))
+    assert np.isfinite(lp)
+    np.testing.assert_allclose(lp, float(ref), rtol=1e-4)
