@@ -58,6 +58,9 @@ class Tensor:
         self._grad_output_index: int = 0
         self.retain_grads_flag: bool = False
         self._backward_hooks: List[Callable] = []
+        # bumped by in-place mutation; create_graph backward checks it
+        # (reference: tensor version counters, eager/tensor_wrapper.h)
+        self._version: int = 0
         self.name = name or _auto_name()
         self.persistable = False
 
@@ -147,6 +150,19 @@ class Tensor:
         for hook in self._backward_hooks:
             out = hook(gt)
             if out is not None:
+                if not isinstance(out, Tensor) and keep_tensor:
+                    # under a create_graph sweep a raw-array hook result has
+                    # no tape: rewrapping it would silently detach the
+                    # higher-order gradient through this hook — warn once
+                    # (hooks must return Tensors to stay differentiable)
+                    import warnings
+
+                    warnings.warn(
+                        "a backward hook returned a raw array during a "
+                        "create_graph sweep; the higher-order tape is detached "
+                        "through it. Return a Tensor to keep it differentiable.",
+                        stacklevel=2,
+                    )
                 gt = out if isinstance(out, Tensor) else Tensor(out)
         return gt if keep_tensor else gt._data
 
@@ -248,17 +264,37 @@ class Tensor:
                 f"set_value shape mismatch: tensor {tuple(self._data.shape)} vs value {tuple(new.shape)}"
             )
         self._data = new.astype(self._data.dtype)
+        self._version += 1
 
     def copy_(self, other: Any) -> "Tensor":
         self.set_value(other)
         return self
 
     def _replace_(self, new: "Tensor") -> None:
-        """Adopt another tensor's buffer + tape position (in-place op support)."""
+        """Adopt another tensor's buffer + tape position (in-place op support).
+
+        When the adopting op recorded ``self`` as its input, that recording
+        must keep pointing at the PRE-mutation tape position — otherwise the
+        node's input would resolve to the node itself (a cycle) and the
+        history feeding the in-place op would be orphaned. An alias tensor
+        carries the old buffer + old grad node into the recording (the
+        reference's TensorWrapper keeps the pre-bump version the same way).
+        """
+        node = new._grad_node
+        if node is not None and not getattr(node, "released", True):
+            alias: Optional[Tensor] = None
+            for i, t in enumerate(node.input_tensors):
+                if t is self:
+                    if alias is None:
+                        alias = Tensor(self._data, stop_gradient=self.stop_gradient)
+                        alias._grad_node = self._grad_node
+                        alias._grad_output_index = self._grad_output_index
+                    node.input_tensors[i] = alias
         self._data = new._data
         self._grad_node = new._grad_node
         self._grad_output_index = new._grad_output_index
         self.stop_gradient = new.stop_gradient
+        self._version += 1
 
     # -- indexing -------------------------------------------------------------
     def __getitem__(self, index: Any) -> "Tensor":

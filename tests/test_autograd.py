@@ -234,3 +234,49 @@ def test_mixed_accumulation_keeps_taped_grad():
     # 2x + 3 accumulated; the taped component must survive
     np.testing.assert_allclose(x.grad.numpy(), [9.0])
     assert x.grad.grad_node is not None
+
+
+def test_create_graph_immune_to_inplace_mutation():
+    """ADVICE r3: create_graph re-derives the vjp from buffers snapshotted at
+    dispatch (reference TensorWrapper semantics), so an in-place mutation
+    between forward and double-backward yields gradients w.r.t. the ORIGINAL
+    values — not silently wrong ones from the mutated buffer."""
+    x = paddle.to_tensor(np.array([2.0, 3.0], np.float32))
+    x.stop_gradient = False
+    y = (x * x).sum()
+    x.set_value(np.array([9.0, 9.0], np.float32))  # mutate AFTER forward
+    (gx,) = paddle.autograd.grad([y], [x], create_graph=True)
+    np.testing.assert_allclose(gx.numpy(), [4.0, 6.0], rtol=1e-6)  # 2*orig
+
+
+def test_create_graph_through_inplace_op():
+    """The in-place op's own rebind must not break create_graph either: the
+    node snapshots its input before _replace_ bumps the buffer."""
+    x = paddle.to_tensor(np.array([2.0, 3.0], np.float32))
+    x.stop_gradient = False
+    y = x * x  # d/dx = 2x
+    y.scale_(3.0)  # in-place on a non-leaf; total: 3*x^2
+    (gx,) = paddle.autograd.grad([y.sum()], [x], create_graph=True)
+    np.testing.assert_allclose(gx.numpy(), 6 * np.array([2.0, 3.0]), rtol=1e-5)
+    (ggx,) = paddle.autograd.grad([gx.sum()], [x])
+    np.testing.assert_allclose(ggx.numpy(), [6.0, 6.0], rtol=1e-5)
+
+
+def test_create_graph_without_mutation_still_works():
+    x = paddle.to_tensor(np.array([2.0, 3.0], np.float32))
+    x.stop_gradient = False
+    y = (x * x * x).sum()
+    (gx,) = paddle.autograd.grad([y], [x], create_graph=True)
+    (ggx,) = paddle.autograd.grad([gx.sum()], [x])
+    np.testing.assert_allclose(ggx.numpy(), 6 * np.array([2.0, 3.0]), rtol=1e-5)
+
+
+def test_first_order_backward_through_inplace_on_nonleaf():
+    """Regression (r4 review chain): in-place on a non-leaf used to rewire the
+    recording into a self-cycle, orphaning the producer's tape."""
+    x = paddle.to_tensor(np.array([1.0, 4.0], np.float32))
+    x.stop_gradient = False
+    y = x * x
+    y.scale_(2.0)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), 4 * np.array([1.0, 4.0]), rtol=1e-6)
