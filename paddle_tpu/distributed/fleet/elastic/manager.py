@@ -6,7 +6,7 @@ env config (``:128-175``), membership watch, and relaunch hooks.
 
 TPU translation: the registry is the native TCPStore (the same rendezvous
 store bootstrap uses — no etcd dependency): each worker renews a heartbeat
-key ``elastic/beat/{rank}``; the manager scans heartbeats and reports
+key ``elastic/{generation}/beat/{rank}``; the manager scans heartbeats and reports
 dead/alive membership. Relaunch is the launcher's job (see
 ``launch/main.py`` ``--max_restarts``): on failure it re-execs the worker
 with ``PADDLE_RESTART_COUNT`` bumped, and the training script resumes from
@@ -49,26 +49,55 @@ class ElasticManager:
     ) -> None:
         self._store = store
         self.rank = int(rank)
-        self.world_size = int(
+        # PADDLE_ELASTIC_NP accepts "N" or a "min:max" range (reference
+        # manager.py:128-175) — the range is the scale-in/out envelope
+        np_env = str(
             world_size
             if world_size is not None
             else os.environ.get("PADDLE_ELASTIC_NP", os.environ.get("PADDLE_TRAINERS_NUM", "1"))
         )
+        if ":" in np_env:
+            lo, hi = np_env.split(":", 1)
+            self.min_np, self.max_np = int(lo), int(hi)
+            self.world_size = self.max_np
+        else:
+            self.world_size = int(np_env)
+            self.min_np = self.max_np = self.world_size
         self.ttl = float(
             ttl if ttl is not None else os.environ.get("PADDLE_ELASTIC_TIMEOUT", "30")
         )
         self._stop = threading.Event()
         self._beat_thread: Optional[threading.Thread] = None
+        # membership generation: every rebuild bumps it, which NAMESPACES the
+        # beat/fault keys — stale leases and faults from a previous topology
+        # can never poison the new one
+        self._gen = self._read_gen()
+
+    def _read_gen(self) -> int:
+        try:
+            return int(self._store.get("elastic/generation").decode())
+        except Exception:
+            return 0
+
+    def _beat_key(self, rank: int) -> str:
+        return f"elastic/{self._gen}/beat/{rank}"
+
+    def _fault_key(self, rank: int) -> str:
+        return f"elastic/{self._gen}/fault/{rank}"
 
     # -- worker side --------------------------------------------------------
     def register(self) -> None:
-        """Announce membership and start renewing the heartbeat lease."""
+        """Announce membership and start renewing the heartbeat lease. A
+        relaunched worker re-registers under the current generation with a
+        clean fault state."""
+        self._gen = self._read_gen()
+        self._store.set(self._fault_key(self.rank), b"")  # clear any old fault
         self._beat()
         self._beat_thread = threading.Thread(target=self._beat_loop, daemon=True)
         self._beat_thread.start()
 
     def _beat(self) -> None:
-        self._store.set(f"elastic/beat/{self.rank}", str(time.time()).encode())
+        self._store.set(self._beat_key(self.rank), str(time.time()).encode())
 
     def _beat_loop(self) -> None:
         # renew at 1/3 TTL like a lease keepalive
@@ -83,27 +112,105 @@ class ElasticManager:
         if self._beat_thread is not None:
             self._beat_thread.join(timeout=2)
 
+    # -- fault reporting (per-trainer watchdog integration) ------------------
+    def report_fault(self, reason: str = "watchdog") -> None:
+        """Mark THIS worker unhealthy (e.g. from a CommWatchdog on_timeout
+        hook): the manager treats faulted workers as dead even while their
+        heartbeat thread keeps renewing (a hung collective doesn't stop the
+        beat thread — the reference integrates CommTaskManager the same way).
+        The mark lives in the current generation only; re-register clears it."""
+        self._store.set(
+            self._fault_key(self.rank), f"{time.time()}|{reason}".encode()
+        )
+
+    def watchdog_hook(self) -> Any:
+        """``on_timeout`` callable for :class:`CommWatchdog`."""
+
+        def hook(dump: Dict[str, Any]) -> None:
+            try:
+                self.report_fault(f"hang in {dump.get('section')}")
+            except Exception:  # noqa: BLE001 - store may be gone too
+                pass
+
+        return hook
+
+    def _faulted(self, r: int) -> bool:
+        try:
+            return bool(self._store.get(self._fault_key(r)))
+        except Exception:
+            return False
+
     # -- manager side -------------------------------------------------------
     def alive_workers(self) -> List[int]:
         now = time.time()
         alive = []
-        for r in range(self.world_size):
+        for r in range(self.max_np):
             try:
-                raw = self._store.get(f"elastic/beat/{r}")
-                if now - float(raw.decode()) <= self.ttl:
-                    alive.append(r)
+                raw = self._store.get(self._beat_key(r))
+                if now - float(raw.decode()) > self.ttl:
+                    continue
             except Exception:
                 continue
+            # fault lookup only for fresh-beat ranks (halves store traffic in
+            # the all-healthy case; dead ranks need no fault check)
+            if self._faulted(r):
+                continue
+            alive.append(r)
         return alive
 
     def watch(self) -> ElasticStatus:
-        """One membership scan (reference watch loop): HOLD when everyone is
-        alive, RESTART when membership shrank (dead heartbeat)."""
+        """One membership scan (reference watch loop):
+
+        - every expected worker alive → HOLD
+        - alive count within [min_np, world) or grew past world → RESTART
+          (scale-in/out: the job relaunches on the new membership)
+        - alive count below min_np → ERROR (cannot make progress)
+        """
         alive = self.alive_workers()
         if len(alive) == self.world_size:
             return ElasticStatus.HOLD
+        if self.min_np < self.max_np and len(alive) < self.min_np:
+            # elastic range: below the viable envelope the job cannot make
+            # progress at any permitted scale
+            return ElasticStatus.ERROR
+        # fixed np (or still within range): relaunch — dead workers respawn at
+        # the same scale, or the group rebuilds on the surviving membership
         return ElasticStatus.RESTART
 
     def dead_workers(self) -> List[int]:
         alive = set(self.alive_workers())
-        return [r for r in range(self.world_size) if r not in alive]
+        return [r for r in range(self.max_np) if r not in alive]
+
+    # -- membership-change rebuild ------------------------------------------
+    def rebuild_endpoints(self) -> Dict[str, Any]:
+        """Compute the post-change topology (reference: the manager rewrites
+        ``PADDLE_TRAINER_ENDPOINTS`` before relaunch): survivors get dense new
+        ranks in old-rank order; the new world size and a bumped generation
+        are published to the store so every relaunched worker agrees."""
+        alive = self.alive_workers()
+        mapping = {old: new for new, old in enumerate(sorted(alive))}
+        gen = self._read_gen() + 1
+        self._store.set("elastic/generation", str(gen).encode())
+        self._store.set(
+            "elastic/world",
+            ",".join(str(r) for r in sorted(alive)).encode(),
+        )
+        # the bump invalidates every beat/fault key of the old topology
+        self._gen = gen
+        self.world_size = len(alive)
+        return {
+            "generation": gen,
+            "world_size": len(alive),
+            "rank_map": mapping,
+            "my_rank": mapping.get(self.rank),  # None when this worker died
+        }
+
+    @staticmethod
+    def load_topology(store: Any) -> Optional[Dict[str, Any]]:
+        """Worker side after relaunch: read the published membership."""
+        try:
+            gen = int(store.get("elastic/generation").decode())
+            world = [int(r) for r in store.get("elastic/world").decode().split(",") if r]
+        except Exception:
+            return None
+        return {"generation": gen, "world_size": len(world), "members": world}

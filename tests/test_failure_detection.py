@@ -181,3 +181,94 @@ class TestLaunchRelaunch:
         lives = set(marker.read_text().split())
         # both ranks ran life 0 AND both were relaunched for life 1
         assert {"rank0-life0", "rank1-life0", "rank0-life1", "rank1-life1"} <= lives
+
+
+class TestElasticScaling:
+    """r4: scale-in/out envelope, endpoint rebuild, watchdog fault wiring
+    (reference elastic manager.py:128-251 + CommTaskManager integration)."""
+
+    def _mgr(self, world="4", rank=0, ttl=5.0):
+        from paddle_tpu.distributed.fleet.elastic import ElasticManager
+
+        store = TCPStore("127.0.0.1", 0, is_master=True, world_size=1, timeout=3)
+        return ElasticManager(store, rank=rank, world_size=world, ttl=ttl), store
+
+    def test_np_range_parsing(self):
+        mgr, _ = self._mgr(world="2:4")
+        assert mgr.min_np == 2 and mgr.max_np == 4 and mgr.world_size == 4
+
+    def test_scale_decisions(self):
+        from paddle_tpu.distributed.fleet.elastic import ElasticStatus
+
+        mgr, store = self._mgr(world="2:4", ttl=30.0)
+        now = str(time.time()).encode()
+        # 4 alive -> HOLD
+        for r in range(4):
+            store.set(f"elastic/0/beat/{r}", now)
+        assert mgr.watch() == ElasticStatus.HOLD
+        # 3 alive (within [2,4)) -> RESTART (scale-in)
+        store.set("elastic/0/beat/3", b"0.0")
+        assert mgr.watch() == ElasticStatus.RESTART
+        # 1 alive (< min_np) -> ERROR
+        for r in (1, 2):
+            store.set(f"elastic/0/beat/{r}", b"0.0")
+        assert mgr.watch() == ElasticStatus.ERROR
+
+    def test_rebuild_endpoints_dense_ranks_and_generation(self):
+        mgr, store = self._mgr(world="2:4", ttl=30.0)
+        now = str(time.time()).encode()
+        for r in (0, 1, 3):  # rank 2 died
+            store.set(f"elastic/0/beat/{r}", now)
+        topo = mgr.rebuild_endpoints()
+        assert topo["world_size"] == 3
+        assert topo["rank_map"] == {0: 0, 1: 1, 3: 2}
+        assert topo["my_rank"] == 0
+        assert topo["generation"] == 1
+        # workers read the published membership after relaunch
+        from paddle_tpu.distributed.fleet.elastic import ElasticManager
+
+        loaded = ElasticManager.load_topology(store)
+        assert loaded == {"generation": 1, "world_size": 3, "members": [0, 1, 3]}
+        # a second rebuild bumps the generation
+        assert mgr.rebuild_endpoints()["generation"] == 2
+
+    def test_watchdog_fault_marks_worker_dead(self):
+        from paddle_tpu.distributed.fleet.elastic import ElasticManager, ElasticStatus
+
+        mgr, store = self._mgr(world="1:2", ttl=30.0)
+        now = str(time.time()).encode()
+        store.set("elastic/0/beat/0", now)
+        store.set("elastic/0/beat/1", now)
+        assert mgr.watch() == ElasticStatus.HOLD
+        # rank 1's watchdog fires: heartbeat still fresh, but faulted
+        peer = ElasticManager(store, rank=1, world_size="1:2", ttl=30.0)
+        peer.watchdog_hook()({"section": "train_step"})
+        assert mgr.alive_workers() == [0]
+        assert mgr.watch() == ElasticStatus.RESTART
+
+    def test_generation_bump_invalidates_stale_state(self):
+        """r4 review: rebuild must not let old-topology beats/faults poison
+        the new one, and a re-registered worker sheds its fault mark."""
+        from paddle_tpu.distributed.fleet.elastic import ElasticManager
+
+        mgr, store = self._mgr(world="2:4", ttl=30.0)
+        now = str(time.time()).encode()
+        for r in (0, 1, 3):
+            store.set(f"elastic/0/beat/{r}", now)
+        topo = mgr.rebuild_endpoints()
+        assert topo["generation"] == 1
+        # old gen-0 beats are invisible now: nobody alive until re-register
+        assert mgr.alive_workers() == []
+        # survivors re-register under the new generation
+        w0 = ElasticManager(store, rank=0, world_size="2:4", ttl=30.0)
+        w0.register()
+        assert mgr.alive_workers() == [0]
+        w0.stop()
+        # a previously-faulted worker that re-registers is healthy again
+        w1 = ElasticManager(store, rank=1, world_size="2:4", ttl=30.0)
+        w1.register()
+        w1.report_fault("hang")
+        assert mgr.alive_workers() == [0]
+        w1.register()  # relaunch: clean fault state
+        assert sorted(mgr.alive_workers()) == [0, 1]
+        w1.stop()
