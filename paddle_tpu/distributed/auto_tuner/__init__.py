@@ -1,0 +1,212 @@
+"""Distributed-configuration auto-tuner.
+
+Reference: ``python/paddle/distributed/auto_tuner/tuner.py:21`` (AutoTuner:
+grid search over (dp, mp, pp, sharding, micro-batch, recompute), pruned by
+divisibility + memory estimates, launching one trial per config and ranking
+by the measured metric).
+
+TPU-native reshape: a "trial" is not a relaunched process — SPMD means one
+process can rebuild the mesh and jit the train step per candidate, so
+``Tuner.run`` drives ``trial_fn(cfg) -> metric`` directly (raise ``MemoryError``
+/ any exception to mark the config failed, exactly how the reference marks
+OOM trials). The memory prune uses an analytic HBM model: params/grads/
+optimizer-state bytes divided by the sharding/mp/pp factors plus an
+activation term scaled by micro-batch and recompute.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+__all__ = ["AutoTuner", "Tuner", "default_candidates", "prune_by_memory", "divisor"]
+
+
+def divisor(num: int, reverse: bool = False) -> List[int]:
+    """All divisors of ``num`` (reference ``utils.py:32``)."""
+    out = [d for d in range(1, num + 1) if num % d == 0]
+    return out[::-1] if reverse else out
+
+
+def default_candidates(tuner_cfg: Dict[str, Any]) -> Dict[str, List[Any]]:
+    """Candidate value lists per axis (reference ``utils.py:162``)."""
+    n = int(tuner_cfg["num_gpus"])
+    model = tuner_cfg.get("model_cfg", {})
+    layers = int(model.get("num_layers", 1) or 1)
+    heads = int(model.get("num_attention_heads", 1) or 1)
+    hidden = int(model.get("hidden_size", 1) or 1)
+    vocab = int(model.get("vocab_size", 1) or 1)
+    global_bs = int(tuner_cfg.get("global_batch_size", 1) or 1)
+
+    def _axis(key: str, default: List[Any]) -> List[Any]:
+        v = tuner_cfg.get(key, "auto")
+        if v == "auto" or v is None:
+            return default
+        return list(v) if isinstance(v, (list, tuple)) else [v]
+
+    mp_default = [
+        d for d in divisor(n)
+        if heads % d == 0 and hidden % d == 0 and vocab % d == 0
+    ]
+    pp_default = [d for d in divisor(n) if layers % d == 0]
+    return {
+        "mp_degree": _axis("mp_degree", mp_default),
+        "pp_degree": _axis("pp_degree", pp_default),
+        "sharding_degree": _axis("sharding_degree", divisor(n)),
+        "sharding_stage": _axis("sharding_stage", [1, 2, 3]),
+        "micro_batch_size": _axis("micro_batch_size", divisor(global_bs)),
+        "use_recompute": _axis("use_recompute", [True, False]),
+    }
+
+
+def _model_bytes(model: Dict[str, Any]) -> float:
+    layers = int(model.get("num_layers", 0) or 0)
+    hidden = int(model.get("hidden_size", 0) or 0)
+    vocab = int(model.get("vocab_size", 0) or 0)
+    inter = int(model.get("intermediate_size", 4 * hidden) or 4 * hidden)
+    if not layers or not hidden:
+        return 0.0
+    per_layer = 4 * hidden * hidden + 3 * hidden * inter  # attn + glu mlp
+    return float(layers * per_layer + 2 * vocab * hidden)
+
+
+def prune_by_memory(cfg: Dict[str, Any], tuner_cfg: Dict[str, Any]) -> bool:
+    """True when the config is estimated to exceed per-chip HBM (reference
+    ``prune.py`` prune_by_memory_estimation). Analytic model:
+
+    - weights bf16 + fp32 master + AdamW moments: 2 + 4 + 8 = 14 B/param,
+      divided by mp*pp, with master+moments further divided by sharding
+      (stage >= 1 shards optimizer state; stage >= 2 also grads: 4 B).
+    - activations: micro_bs * seq * hidden * layers/pp * ~16 B (bf16,
+      attn+mlp residual stream), /sqrt(1) or a flat /5 with recompute.
+    """
+    hbm = float(tuner_cfg.get("hbm_bytes", 16e9))
+    model = tuner_cfg.get("model_cfg", {})
+    n_param = _model_bytes(model)
+    if not n_param:
+        return False
+    mp = int(cfg.get("mp_degree", 1))
+    pp = int(cfg.get("pp_degree", 1))
+    shard = max(1, int(cfg.get("sharding_degree", 1)))
+    stage = int(cfg.get("sharding_stage", 1))
+    mbs = int(cfg.get("micro_batch_size", 1))
+    seq = int(model.get("seq_length", 2048) or 2048)
+    hidden = int(model.get("hidden_size", 1) or 1)
+    layers = int(model.get("num_layers", 1) or 1)
+
+    shard_params = n_param / (mp * pp)
+    weights = 2.0 * shard_params / (shard if stage >= 3 else 1)
+    grads = 4.0 * shard_params / (shard if stage >= 2 else 1)
+    opt_state = 12.0 * shard_params / shard  # master + two moments, fp32
+    act_per_layer = 16.0 * mbs * seq * hidden
+    act = act_per_layer * (layers / pp)
+    if cfg.get("use_recompute", False):
+        act = act_per_layer + act / layers  # boundary activations only
+    return (weights + grads + opt_state + act) > hbm
+
+
+class AutoTuner:
+    """Grid search over pruned parallel configs (reference ``tuner.py:21``)."""
+
+    def __init__(self, tuner_cfg: Dict[str, Any]) -> None:
+        self.tuner_cfg = dict(tuner_cfg)
+        self.num_gpus = int(tuner_cfg["num_gpus"])
+        self.task_limit = int(tuner_cfg.get("task_limit", 100))
+        self.metric_mode = tuner_cfg.get("mode", "max")  # max: throughput
+        self.cur_task_id = 0
+        self.history_cfgs: List[Dict[str, Any]] = []
+        self._queue = self._build_queue()
+
+    # -- candidate enumeration ----------------------------------------------
+    def _build_queue(self) -> List[Dict[str, Any]]:
+        cand = default_candidates(self.tuner_cfg)
+        out: List[Dict[str, Any]] = []
+        seen = set()
+        for mp, pp, sd, st, mbs, rc in itertools.product(
+            cand["mp_degree"],
+            cand["pp_degree"],
+            cand["sharding_degree"],
+            cand["sharding_stage"],
+            cand["micro_batch_size"],
+            cand["use_recompute"],
+        ):
+            if mp * pp > self.num_gpus or self.num_gpus % (mp * pp) != 0:
+                continue
+            dp = self.num_gpus // (mp * pp)
+            if sd > dp or dp % sd != 0:
+                continue  # sharding group lives inside dp
+            if sd == 1 and st != 1:
+                continue  # stages only differ with a real sharding group
+            gbs = int(self.tuner_cfg.get("global_batch_size", 1) or 1)
+            if gbs % dp != 0 or (gbs // dp) % mbs != 0:
+                continue
+            cfg = {
+                "dp_degree": dp,
+                "mp_degree": mp,
+                "pp_degree": pp,
+                "sharding_degree": sd,
+                "sharding_stage": st,
+                "micro_batch_size": mbs,
+                "use_recompute": rc,
+                "acc_steps": (gbs // dp) // mbs,
+            }
+            key = tuple(sorted((k, v) for k, v in cfg.items()))
+            if key in seen:
+                continue
+            seen.add(key)
+            if prune_by_memory(cfg, self.tuner_cfg):
+                continue
+            out.append(cfg)
+        # memory-friendly first: higher parallelism degrees before plain dp
+        # (the reference's memory_sort), so early trials are least likely to OOM
+        out.sort(
+            key=lambda c: (
+                -(c["mp_degree"] * c["pp_degree"] * c["sharding_degree"]),
+                c["micro_batch_size"],
+            )
+        )
+        return out
+
+    # -- reference surface ---------------------------------------------------
+    def search_once(self) -> Optional[Dict[str, Any]]:
+        """Next config to trial, or None when exhausted/limited."""
+        if self.cur_task_id >= self.task_limit or not self._queue:
+            return None
+        self.cur_task_id += 1
+        return self._queue.pop(0)
+
+    def add_cfg(self, cfg: Dict[str, Any]) -> None:
+        self.history_cfgs.append(cfg)
+
+    def get_best_cfg(self) -> Optional[Dict[str, Any]]:
+        ok = [c for c in self.history_cfgs if c.get("metric") is not None]
+        if not ok:
+            return None
+        return (max if self.metric_mode == "max" else min)(
+            ok, key=lambda c: c["metric"]
+        )
+
+    # -- TPU-native driver ---------------------------------------------------
+    def run(
+        self, trial_fn: Callable[[Dict[str, Any]], float], max_trials: Optional[int] = None
+    ) -> Optional[Dict[str, Any]]:
+        """Trial every candidate in-process: ``trial_fn(cfg)`` returns the
+        metric (tokens/s or step time); exceptions mark the config failed
+        (the reference's OOM/error trials). Returns the best config."""
+        trials = 0
+        while max_trials is None or trials < max_trials:
+            cfg = self.search_once()
+            if cfg is None:
+                break
+            trials += 1
+            try:
+                cfg["metric"] = float(trial_fn(dict(cfg)))
+                cfg["status"] = "ok"
+            except Exception as exc:  # noqa: BLE001 - failed trial, keep searching
+                cfg["metric"] = None
+                cfg["status"] = f"failed: {exc}"[:200]
+            self.add_cfg(cfg)
+        return self.get_best_cfg()
+
+
+Tuner = AutoTuner  # reference alias
