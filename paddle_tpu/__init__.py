@@ -86,6 +86,7 @@ from paddle_tpu.ops.linalg import (  # noqa: F401
     dot,
     einsum,
     histogram,
+    bincount,
     matmul,
     mm,
     mv,
@@ -110,6 +111,7 @@ from paddle_tpu import amp  # noqa: F401
 from paddle_tpu import autograd  # noqa: F401
 from paddle_tpu import distributed  # noqa: F401
 from paddle_tpu import distribution  # noqa: F401
+from paddle_tpu import fft  # noqa: F401
 from paddle_tpu import hapi  # noqa: F401
 from paddle_tpu import io  # noqa: F401
 from paddle_tpu import jit  # noqa: F401
@@ -123,6 +125,7 @@ from paddle_tpu import static  # noqa: F401
 from paddle_tpu import inference  # noqa: F401
 from paddle_tpu import sparse  # noqa: F401
 from paddle_tpu import incubate  # noqa: F401
+from paddle_tpu import quantization  # noqa: F401
 
 from paddle_tpu.framework.io import load, save  # noqa: F401
 from paddle_tpu.framework.random import get_cuda_rng_state  # noqa: F401

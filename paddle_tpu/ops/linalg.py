@@ -24,6 +24,7 @@ __all__ = [
     "cross",
     "einsum",
     "histogram",
+    "bincount",
     "cholesky",
     "qr",
     "svd",
@@ -336,3 +337,25 @@ def trace(x, offset=0, axis1=0, axis2=1):
 @defop("diagonal")
 def diagonal(x, offset=0, axis1=0, axis2=1):
     return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    """Count occurrences of each value (reference ``ops.yaml`` bincount).
+    Output length is value-dependent (max(x)+1), so it is eager-only like
+    ``unique``; integer counts record no tape. Negative values raise, like
+    the reference."""
+    from paddle_tpu.core.tensor import Tensor
+
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    n = int(arr.size)
+    if n and int(jnp.min(arr)) < 0:
+        raise ValueError("bincount: input must be non-negative")
+    length = int(minlength) if n == 0 else max(int(jnp.max(arr)) + 1, int(minlength))
+    w = None
+    if weights is not None:
+        w = weights._data if isinstance(weights, Tensor) else jnp.asarray(weights)
+        w = w.reshape(-1)
+    return Tensor(jnp.bincount(arr.reshape(-1), weights=w, length=length))
+
+
+register_tensor_method("bincount", bincount)

@@ -1,0 +1,93 @@
+"""paddle.fft module (reference ``python/paddle/fft.py``): numpy parity,
+norm conventions, gradients through transforms, bincount."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import fft
+
+RNG = np.random.default_rng(0)
+X = RNG.normal(size=(4, 8)).astype(np.float32)
+XC = (RNG.normal(size=(4, 8)) + 1j * RNG.normal(size=(4, 8))).astype(np.complex64)
+
+
+@pytest.mark.parametrize("name", ["fft", "ifft", "rfft", "ihfft"])
+@pytest.mark.parametrize("norm", ["backward", "ortho", "forward"])
+def test_1d_real_input_matches_numpy(name, norm):
+    ours = getattr(fft, name)(paddle.to_tensor(X), norm=norm).numpy()
+    ref = getattr(np.fft, name)(X, norm=None if norm == "backward" else norm)
+    np.testing.assert_allclose(np.asarray(ours), ref.astype(ours.dtype), rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("name", ["fft", "ifft", "irfft", "hfft"])
+def test_1d_complex_input_matches_numpy(name):
+    ours = getattr(fft, name)(paddle.to_tensor(XC)).numpy()
+    ref = getattr(np.fft, name)(XC)
+    np.testing.assert_allclose(np.asarray(ours), ref.astype(ours.dtype), rtol=1e-3, atol=1e-4)
+
+
+@pytest.mark.parametrize("name", ["fft2", "ifft2", "rfft2"])
+def test_2d_matches_numpy(name):
+    ours = getattr(fft, name)(paddle.to_tensor(X)).numpy()
+    ref = getattr(np.fft, name)(X)
+    np.testing.assert_allclose(np.asarray(ours), ref.astype(ours.dtype), rtol=1e-4, atol=1e-4)
+
+
+def test_fftn_axes_and_s():
+    ours = fft.fftn(paddle.to_tensor(X), s=(4, 4), axes=(0, 1)).numpy()
+    ref = np.fft.fftn(X, s=(4, 4), axes=(0, 1))
+    np.testing.assert_allclose(np.asarray(ours), ref.astype(ours.dtype), rtol=1e-4, atol=1e-4)
+
+
+def test_rfft_irfft_roundtrip():
+    r = fft.irfft(fft.rfft(paddle.to_tensor(X)), n=8).numpy()
+    np.testing.assert_allclose(np.asarray(r), X, rtol=1e-4, atol=1e-5)
+
+
+def test_freq_and_shift():
+    np.testing.assert_allclose(
+        np.asarray(fft.fftfreq(8, d=0.5).numpy()), np.fft.fftfreq(8, d=0.5), rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(fft.rfftfreq(8).numpy()), np.fft.rfftfreq(8), rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(fft.fftshift(paddle.to_tensor(X)).numpy()), np.fft.fftshift(X)
+    )
+    np.testing.assert_allclose(
+        np.asarray(fft.ifftshift(paddle.to_tensor(X)).numpy()), np.fft.ifftshift(X)
+    )
+
+
+def test_rfft_gradient_parseval():
+    """Transforms ride the eager tape: d/dx ||rfft(x)||^2 = 2*n*x (Parseval)."""
+    x = paddle.to_tensor(X.copy())
+    x.stop_gradient = False
+    y = fft.rfft(x)
+    # |Y|^2 with the hermitian double-count correction: full fft energy = n*|x|^2
+    yf = fft.fft(x)
+    energy = (yf.abs() ** 2).sum()
+    energy.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 2 * 8 * X, rtol=1e-3, atol=1e-3)
+
+
+def test_invalid_norm_rejected():
+    with pytest.raises(ValueError, match="norm"):
+        fft.fft(paddle.to_tensor(X), norm="bogus")
+
+
+def test_bincount():
+    v = np.array([1, 1, 3, 0, 3, 3], np.int32)
+    t = paddle.to_tensor(v)
+    np.testing.assert_array_equal(np.asarray(paddle.bincount(t).numpy()), np.bincount(v))
+    np.testing.assert_array_equal(
+        np.asarray(paddle.bincount(t, minlength=8).numpy()), np.bincount(v, minlength=8)
+    )
+    w = np.array([0.5, 0.5, 1.0, 2.0, 1.0, 1.0], np.float32)
+    np.testing.assert_allclose(
+        np.asarray(paddle.bincount(t, weights=paddle.to_tensor(w)).numpy()),
+        np.bincount(v, weights=w),
+        rtol=1e-6,
+    )
+    np.testing.assert_array_equal(np.asarray(t.bincount().numpy()), np.bincount(v))
