@@ -1,0 +1,364 @@
+"""``paddle_tpu.quantization`` — QAT / PTQ framework.
+
+Reference: ``python/paddle/quantization/`` (QuantConfig + factory-built
+observers/quanters, ``qat.py`` QAT wrapping layers with fake-quant,
+``ptq.py`` PTQ inserting observers then converting).
+
+TPU-native shape: int8 storage is a *memory/bandwidth* optimization on TPU
+(the MXU computes bf16/int8 via XLA's native dot); fake-quant runs as a
+quantize-dequantize pair with a straight-through-estimator gradient
+(``jax.custom_vjp`` identity), so QAT trains through the rounding. Conversion
+produces layers holding int8 weights + per-channel scales, dequantized on the
+fly — XLA fuses the dequant into the matmul.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import call_op
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn.layer.layers import Layer
+
+__all__ = [
+    "QuantConfig",
+    "QAT",
+    "PTQ",
+    "AbsmaxObserver",
+    "FakeQuanterWithAbsMax",
+    "QuantedLinear",
+    "quantize_linear",
+    "dequantize_linear",
+]
+
+
+# ---------------------------------------------------------------------------
+# quant/dequant primitives
+# ---------------------------------------------------------------------------
+
+
+def _scales_absmax(w: jnp.ndarray, axis: Optional[int], bits: int) -> jnp.ndarray:
+    qmax = float(2 ** (bits - 1) - 1)
+    if axis is None:
+        m = jnp.max(jnp.abs(w))
+    else:
+        red = tuple(i for i in range(w.ndim) if i != axis)
+        m = jnp.max(jnp.abs(w), axis=red, keepdims=False)
+    return jnp.maximum(m, 1e-8) / qmax
+
+
+def quantize_linear(x: Any, scale: Any, bits: int = 8, axis: Optional[int] = None) -> Tensor:
+    """Real quantization: float → int8 (reference ``quantize_linear`` op)."""
+    qmax = 2 ** (bits - 1) - 1
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    s = scale._data if isinstance(scale, Tensor) else jnp.asarray(scale)
+    if axis is not None and s.ndim == 1:
+        shape = [1] * arr.ndim
+        shape[axis] = s.shape[0]
+        s = s.reshape(shape)
+    q = jnp.clip(jnp.round(arr / s), -qmax - 1, qmax).astype(jnp.int8)
+    return Tensor(q)
+
+
+def dequantize_linear(q: Any, scale: Any, axis: Optional[int] = None) -> Tensor:
+    arr = q._data if isinstance(q, Tensor) else jnp.asarray(q)
+    s = scale._data if isinstance(scale, Tensor) else jnp.asarray(scale)
+    if axis is not None and s.ndim == 1:
+        shape = [1] * arr.ndim
+        shape[axis] = s.shape[0]
+        s = s.reshape(shape)
+    return Tensor(arr.astype(s.dtype) * s)
+
+
+import functools
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _fake_quant(x: jnp.ndarray, scale: jnp.ndarray, qmax: float = 127.0) -> jnp.ndarray:
+    return jnp.clip(jnp.round(x / scale), -qmax - 1, qmax) * scale
+
+
+def _fq_fwd(x, scale, qmax):
+    return _fake_quant(x, scale, qmax), (x, scale)
+
+
+def _fq_bwd(qmax, res, g):
+    # straight-through estimator: pass the gradient through inside the
+    # representable range, zero outside (reference fake_quantize grad)
+    x, scale = res
+    inside = (jnp.abs(x) <= scale * qmax).astype(g.dtype)
+    return g * inside, jnp.zeros_like(scale)
+
+
+_fake_quant.defvjp(_fq_fwd, _fq_bwd)
+
+
+# ---------------------------------------------------------------------------
+# observers / quanters (reference base_observer.py / base_quanter.py)
+# ---------------------------------------------------------------------------
+
+
+class AbsmaxObserver(Layer):
+    """PTQ observer: tracks the running abs-max of what flows through
+    (reference ``observers/abs_max.py``)."""
+
+    def __init__(self, quant_bits: int = 8, axis: Optional[int] = None) -> None:
+        super().__init__()
+        self.quant_bits = quant_bits
+        self.axis = axis
+        self._absmax: Optional[jnp.ndarray] = None
+
+    def forward(self, x: Tensor) -> Tensor:
+        arr = x._data
+        if self.axis is None:
+            m = jnp.max(jnp.abs(arr))
+        else:
+            red = tuple(i for i in range(arr.ndim) if i != self.axis)
+            m = jnp.max(jnp.abs(arr), axis=red)
+        self._absmax = m if self._absmax is None else jnp.maximum(self._absmax, m)
+        return x
+
+    def scales(self) -> Tensor:
+        if self._absmax is None:
+            raise RuntimeError("observer saw no data; run calibration first")
+        qmax = float(2 ** (self.quant_bits - 1) - 1)
+        return Tensor(jnp.maximum(self._absmax, 1e-8) / qmax)
+
+
+class FakeQuanterWithAbsMax(Layer):
+    """QAT quanter: quantize-dequantize with an STE gradient (reference
+    ``quanters/abs_max.py`` FakeQuanterWithAbsMaxObserver)."""
+
+    def __init__(self, quant_bits: int = 8, axis: Optional[int] = None) -> None:
+        super().__init__()
+        self.quant_bits = quant_bits
+        self.axis = axis
+
+    def forward(self, x: Tensor) -> Tensor:
+        axis = self.axis
+        bits = self.quant_bits
+
+        def fn(a: jnp.ndarray) -> jnp.ndarray:
+            s = jax.lax.stop_gradient(_scales_absmax(a, axis, bits))
+            if axis is not None:
+                shape = [1] * a.ndim
+                shape[axis] = s.shape[0]
+                s = s.reshape(shape)
+            return _fake_quant(a, s, float(2 ** (bits - 1) - 1))
+
+        return call_op("fake_quant", fn, x)
+
+
+# ---------------------------------------------------------------------------
+# config + wrapped layers
+# ---------------------------------------------------------------------------
+
+
+class QuantConfig:
+    """Which layers get quantized, and how (reference ``config.py``).
+
+    ``activation``/``weight`` are quanter/observer prototypes — their
+    ``quant_bits``/``axis`` drive the layers QAT/PTQ builds."""
+
+    def __init__(self, activation: Any = None, weight: Any = None) -> None:
+        self.activation = activation
+        self.weight = weight
+        self._layer_types: List[type] = []
+        self._layers: List[Layer] = []
+
+    def _weight_bits(self) -> int:
+        return int(getattr(self.weight, "quant_bits", 8) or 8)
+
+    def _act_bits(self) -> int:
+        return int(getattr(self.activation, "quant_bits", 8) or 8)
+
+    def add_type_config(self, layer_type: Any, activation: Any = None, weight: Any = None) -> None:
+        types = layer_type if isinstance(layer_type, (list, tuple)) else [layer_type]
+        self._layer_types.extend(types)
+        if activation is not None:
+            self.activation = activation
+        if weight is not None:
+            self.weight = weight
+
+    def add_layer_config(self, layer: Any, activation: Any = None, weight: Any = None) -> None:
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        self._layers.extend(layers)
+
+    def _should_quant(self, layer: Layer) -> bool:
+        from paddle_tpu.nn import Linear
+
+        # explicit selections are exclusive (reference config semantics);
+        # the no-config default quantizes every Linear
+        if self._layers:
+            return any(layer is l for l in self._layers)  # noqa: E741
+        if self._layer_types:
+            return isinstance(layer, tuple(self._layer_types))
+        return isinstance(layer, Linear)
+
+
+class QuantedLinear(Layer):
+    """Inference form: int8 weight + per-output-channel scales, dequantized on
+    the fly (XLA fuses the dequant multiply into the matmul). With an
+    ``act_scale`` (from PTQ calibration) the input is statically
+    quantize-dequantized through the observed range."""
+
+    def __init__(self, linear: Any, bits: int = 8, act_scale: Any = None) -> None:
+        super().__init__()
+        w = linear.weight._data  # [in, out]
+        qmax = float(2 ** (bits - 1) - 1)
+        scales = _scales_absmax(w, axis=1, bits=bits)
+        self.qweight = Tensor(
+            jnp.clip(jnp.round(w / scales[None, :]), -qmax - 1, qmax).astype(jnp.int8)
+        )
+        self.scales = Tensor(scales)
+        self.act_scale = (
+            None if act_scale is None
+            else (act_scale if isinstance(act_scale, Tensor) else Tensor(jnp.asarray(act_scale)))
+        )
+        self.bias = linear.bias
+        self.bits = bits
+
+    def forward(self, x: Tensor) -> Tensor:
+        qw = self.qweight
+        sc = self.scales
+        qmax = float(2 ** (self.bits - 1) - 1)
+        has_act = self.act_scale is not None
+
+        def fn(a, q, s, *rest):
+            it = iter(rest)
+            if has_act:
+                a_s = next(it)
+                a = jnp.clip(jnp.round(a / a_s), -qmax - 1, qmax) * a_s
+            w = q.astype(s.dtype) * s[None, :]
+            out = a @ w.astype(a.dtype)
+            b = next(it, None)
+            if b is not None:
+                out = out + b
+            return out
+
+        extras = []
+        if has_act:
+            extras.append(self.act_scale)
+        if self.bias is not None:
+            extras.append(self.bias)
+        return call_op("quanted_linear", fn, x, qw, sc, *extras)
+
+
+class _ObservedLinear(Layer):
+    """PTQ calibration form: observer on the input activation."""
+
+    def __init__(self, linear: Any, observer: AbsmaxObserver) -> None:
+        super().__init__()
+        self.inner = linear
+        self.act_observer = observer
+
+    def forward(self, x: Tensor) -> Tensor:
+        return self.inner(self.act_observer(x))
+
+
+class _QATLinear(Layer):
+    """QAT form: fake-quant on weight (per-channel) and activation."""
+
+    def __init__(
+        self,
+        linear: Any,
+        weight_quanter: Optional[FakeQuanterWithAbsMax] = None,
+        act_quanter: Optional[FakeQuanterWithAbsMax] = None,
+    ) -> None:
+        super().__init__()
+        self.inner = linear
+        self.weight_quanter = weight_quanter or FakeQuanterWithAbsMax(8, axis=1)
+        self.act_quanter = act_quanter or FakeQuanterWithAbsMax(8, axis=None)
+
+    def forward(self, x: Tensor) -> Tensor:
+        x = self.act_quanter(x)
+        w = self.weight_quanter(self.inner.weight)
+        out = x @ w
+        if self.inner.bias is not None:
+            out = out + self.inner.bias
+        return out
+
+
+def _replace_sublayers(model: Layer, predicate: Callable, build: Callable) -> int:
+    n = 0
+    for parent in model.sublayers(include_self=True):
+        for name, child in list(parent.named_children()):
+            if predicate(child):
+                setattr(parent, name, build(child))
+                n += 1
+    return n
+
+
+class Quantization:
+    def __init__(self, config: QuantConfig) -> None:
+        self._config = config
+
+
+class QAT(Quantization):
+    """Quantization-aware training (reference ``qat.py``)."""
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        if not inplace:
+            model = copy.deepcopy(model)
+        cfg = self._config
+        _replace_sublayers(
+            model,
+            cfg._should_quant,
+            lambda lin: _QATLinear(
+                lin,
+                weight_quanter=FakeQuanterWithAbsMax(cfg._weight_bits(), axis=1),
+                act_quanter=FakeQuanterWithAbsMax(cfg._act_bits(), axis=None),
+            ),
+        )
+        return model
+
+    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+        """Fold trained fake-quant layers into int8 inference layers."""
+        if not inplace:
+            model = copy.deepcopy(model)
+        _replace_sublayers(
+            model,
+            lambda l: isinstance(l, _QATLinear),  # noqa: E741
+            lambda q: QuantedLinear(q.inner, bits=q.weight_quanter.quant_bits),
+        )
+        return model
+
+
+class PTQ(Quantization):
+    """Post-training quantization (reference ``ptq.py``): insert observers,
+    run calibration batches, convert."""
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        if not inplace:
+            model = copy.deepcopy(model)
+        cfg = self._config
+        _replace_sublayers(
+            model,
+            cfg._should_quant,
+            lambda lin: _ObservedLinear(lin, AbsmaxObserver(cfg._act_bits())),
+        )
+        return model
+
+    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+        """Calibration results feed the converted layers: the observer's
+        activation scale becomes the static input quantization range."""
+        if not inplace:
+            model = copy.deepcopy(model)
+        cfg = self._config
+
+        def build(obs: "_ObservedLinear") -> QuantedLinear:
+            act_scale = (
+                obs.act_observer.scales() if obs.act_observer._absmax is not None else None
+            )
+            return QuantedLinear(obs.inner, bits=cfg._weight_bits(), act_scale=act_scale)
+
+        _replace_sublayers(
+            model,
+            lambda l: isinstance(l, _ObservedLinear),  # noqa: E741
+            build,
+        )
+        return model
