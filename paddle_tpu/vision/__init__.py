@@ -1,4 +1,4 @@
 """``paddle_tpu.vision`` (reference ``python/paddle/vision``): model zoo +
 transforms + synthetic datasets for benchmarks."""
 
-from paddle_tpu.vision import models, transforms  # noqa: F401
+from paddle_tpu.vision import datasets, models, transforms  # noqa: F401

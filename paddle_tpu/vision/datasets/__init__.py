@@ -1,0 +1,233 @@
+"""Vision datasets (reference ``python/paddle/vision/datasets``):
+DatasetFolder/ImageFolder directory pipelines + MNIST/Cifar file parsers.
+
+Zero-egress environment: no downloads — datasets read from local files
+(``download=False`` semantics); MNIST reads the idx byte format, Cifar the
+pickled batch format, exactly like the reference parsers, so locally-provided
+copies of the standard files work unchanged.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from paddle_tpu.io.dataset import Dataset
+
+__all__ = ["DatasetFolder", "ImageFolder", "MNIST", "FashionMNIST", "Cifar10", "Cifar100"]
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".npy")
+
+
+def _load_image(path: str) -> np.ndarray:
+    if path.endswith(".npy"):
+        return np.load(path)
+    try:
+        from PIL import Image  # optional dependency
+
+        return np.asarray(Image.open(path).convert("RGB"))
+    except ImportError as exc:  # pragma: no cover - depends on image libs
+        raise RuntimeError(
+            f"loading {path} needs PIL; store arrays as .npy for a "
+            "dependency-free pipeline"
+        ) from exc
+
+
+class DatasetFolder(Dataset):
+    """``root/class_x/xxx.ext`` directory layout → (sample, class_index)
+    (reference ``folder.py`` DatasetFolder)."""
+
+    def __init__(
+        self,
+        root: str,
+        loader: Optional[Callable] = None,
+        extensions: Optional[Sequence[str]] = None,
+        transform: Optional[Callable] = None,
+        is_valid_file: Optional[Callable] = None,
+    ) -> None:
+        self.root = root
+        self.loader = loader or _load_image
+        self.transform = transform
+        exts = tuple(e.lower() for e in (extensions or IMG_EXTENSIONS))
+        classes = sorted(
+            d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d))
+        )
+        if not classes:
+            raise RuntimeError(f"no class directories under {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples: List[Tuple[str, int]] = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for dirpath, _dirs, files in sorted(os.walk(cdir)):
+                for f in sorted(files):
+                    path = os.path.join(dirpath, f)
+                    ok = (
+                        is_valid_file(path)
+                        if is_valid_file is not None
+                        else path.lower().endswith(exts)
+                    )
+                    if ok:
+                        self.samples.append((path, self.class_to_idx[c]))
+        if not self.samples:
+            raise RuntimeError(f"no valid samples under {root} (extensions {exts})")
+
+    def __len__(self) -> int:
+        return len(self.samples)
+
+    def __getitem__(self, idx: int) -> Tuple[Any, int]:
+        path, target = self.samples[idx]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, target
+
+
+class ImageFolder(Dataset):
+    """Flat (unlabeled) image directory (reference ``folder.py`` ImageFolder)."""
+
+    def __init__(
+        self,
+        root: str,
+        loader: Optional[Callable] = None,
+        extensions: Optional[Sequence[str]] = None,
+        transform: Optional[Callable] = None,
+        is_valid_file: Optional[Callable] = None,
+    ) -> None:
+        self.root = root
+        self.loader = loader or _load_image
+        self.transform = transform
+        exts = tuple(e.lower() for e in (extensions or IMG_EXTENSIONS))
+        self.samples: List[str] = []
+        for dirpath, _dirs, files in sorted(os.walk(root)):
+            for f in sorted(files):
+                path = os.path.join(dirpath, f)
+                ok = (
+                    is_valid_file(path)
+                    if is_valid_file is not None
+                    else path.lower().endswith(exts)
+                )
+                if ok:
+                    self.samples.append(path)
+        if not self.samples:
+            raise RuntimeError(f"no valid samples under {root}")
+
+    def __len__(self) -> int:
+        return len(self.samples)
+
+    def __getitem__(self, idx: int) -> List[Any]:
+        sample = self.loader(self.samples[idx])
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return [sample]
+
+
+def _read_idx(path: str) -> np.ndarray:
+    """Parse the MNIST idx byte format (gz or raw)."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        dims = [struct.unpack(">I", f.read(4))[0] for _ in range(ndim)]
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    return data.reshape(dims)
+
+
+class MNIST(Dataset):
+    """MNIST from local idx files (reference ``mnist.py``; no download)."""
+
+    NAME = "mnist"
+
+    def __init__(
+        self,
+        image_path: Optional[str] = None,
+        label_path: Optional[str] = None,
+        mode: str = "train",
+        transform: Optional[Callable] = None,
+        download: bool = False,
+        backend: str = "cv2",
+    ) -> None:
+        if download:
+            raise RuntimeError(
+                f"{self.NAME}: no network egress — pass image_path/label_path "
+                "to locally provided idx files"
+            )
+        if image_path is None or label_path is None:
+            raise ValueError("image_path and label_path are required (no download)")
+        self.images = _read_idx(image_path)
+        self.labels = _read_idx(label_path).astype(np.int64)
+        if len(self.images) != len(self.labels):
+            raise ValueError(
+                f"images ({len(self.images)}) and labels ({len(self.labels)}) disagree"
+            )
+        self.transform = transform
+        self.mode = mode
+
+    def __len__(self) -> int:
+        return len(self.images)
+
+    def __getitem__(self, idx: int) -> Tuple[Any, np.ndarray]:
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+
+class FashionMNIST(MNIST):
+    NAME = "fashion-mnist"
+
+
+class Cifar10(Dataset):
+    """CIFAR-10 from a local python-version archive dir or batch files
+    (reference ``cifar.py``; no download)."""
+
+    _train_files = [f"data_batch_{i}" for i in range(1, 6)]
+    _test_files = ["test_batch"]
+    _label_key = b"labels"
+
+    def __init__(
+        self,
+        data_file: Optional[str] = None,
+        mode: str = "train",
+        transform: Optional[Callable] = None,
+        download: bool = False,
+        backend: str = "cv2",
+    ) -> None:
+        if download:
+            raise RuntimeError("no network egress — pass data_file to a local copy")
+        if data_file is None:
+            raise ValueError("data_file is required (no download)")
+        names = self._train_files if mode == "train" else self._test_files
+        images, labels = [], []
+        for n in names:
+            path = os.path.join(data_file, n) if os.path.isdir(data_file) else data_file
+            with open(path, "rb") as f:
+                batch = pickle.load(f, encoding="bytes")
+            images.append(np.asarray(batch[b"data"], np.uint8))
+            labels.extend(batch[self._label_key])
+            if not os.path.isdir(data_file):
+                break
+        self.images = np.concatenate(images).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(labels, np.int64)
+        self.transform = transform
+        self.mode = mode
+
+    def __len__(self) -> int:
+        return len(self.images)
+
+    def __getitem__(self, idx: int) -> Tuple[Any, np.ndarray]:
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+
+class Cifar100(Cifar10):
+    _train_files = ["train"]
+    _test_files = ["test"]
+    _label_key = b"fine_labels"

@@ -1,0 +1,114 @@
+"""Vision dataset pipeline (reference ``python/paddle/vision/datasets``):
+DatasetFolder/ImageFolder directory walking, MNIST idx parsing, Cifar batch
+parsing, end-to-end with the multiprocess DataLoader."""
+
+import gzip
+import os
+import pickle
+import struct
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import DataLoader
+from paddle_tpu.vision import transforms
+from paddle_tpu.vision.datasets import Cifar10, DatasetFolder, ImageFolder, MNIST
+
+RNG = np.random.default_rng(0)
+
+
+def _folder_tree(tmp_path, classes=("cat", "dog"), per_class=3):
+    for c in classes:
+        d = tmp_path / c
+        d.mkdir(parents=True)
+        for i in range(per_class):
+            np.save(d / f"{i}.npy", RNG.integers(0, 255, (8, 8, 3)).astype(np.uint8))
+    return str(tmp_path)
+
+
+class TestFolders:
+    def test_dataset_folder_classes_and_samples(self, tmp_path):
+        root = _folder_tree(tmp_path)
+        ds = DatasetFolder(root)
+        assert ds.classes == ["cat", "dog"]
+        assert len(ds) == 6
+        img, label = ds[0]
+        assert img.shape == (8, 8, 3) and label == 0
+        img, label = ds[5]
+        assert label == 1
+
+    def test_dataset_folder_with_transform_and_loader(self, tmp_path):
+        root = _folder_tree(tmp_path)
+        tf = transforms.Compose([transforms.ToTensor()])
+        ds = DatasetFolder(root, transform=tf)
+        img, _ = ds[0]
+        assert list(img.shape) == [3, 8, 8]  # CHW
+        assert float(img.numpy().max()) <= 1.0
+
+    def test_image_folder_flat(self, tmp_path):
+        root = _folder_tree(tmp_path)
+        ds = ImageFolder(root)
+        assert len(ds) == 6
+        (img,) = ds[0]
+        assert img.shape == (8, 8, 3)
+
+    def test_empty_raises(self, tmp_path):
+        (tmp_path / "empty_cls").mkdir()
+        with pytest.raises(RuntimeError):
+            DatasetFolder(str(tmp_path))
+
+    def test_end_to_end_multiprocess_loader(self, tmp_path):
+        root = _folder_tree(tmp_path, per_class=8)
+        ds = DatasetFolder(root)  # raw numpy samples: worker-safe
+        loader = DataLoader(ds, batch_size=4, num_workers=2)
+        batches = list(loader)
+        assert len(batches) == 4
+        xb, yb = batches[0]
+        assert list(xb.shape) == [4, 8, 8, 3]
+        assert list(yb.shape) == [4]
+
+
+class TestMNIST:
+    def _write_idx(self, path, arr, magic_dims):
+        with gzip.open(path, "wb") as f:
+            f.write(struct.pack(">I", magic_dims))
+            for d in arr.shape:
+                f.write(struct.pack(">I", d))
+            f.write(arr.tobytes())
+
+    def test_idx_roundtrip(self, tmp_path):
+        imgs = RNG.integers(0, 255, (10, 28, 28)).astype(np.uint8)
+        labels = RNG.integers(0, 10, (10,)).astype(np.uint8)
+        ip = str(tmp_path / "img.gz")
+        lp = str(tmp_path / "lbl.gz")
+        self._write_idx(ip, imgs, 0x00000803)
+        self._write_idx(lp, labels, 0x00000801)
+        ds = MNIST(image_path=ip, label_path=lp)
+        assert len(ds) == 10
+        img, lab = ds[3]
+        np.testing.assert_array_equal(img, imgs[3])
+        assert int(lab) == int(labels[3])
+
+    def test_download_refused(self):
+        with pytest.raises(RuntimeError, match="egress"):
+            MNIST(download=True)
+
+
+class TestCifar:
+    def test_batch_parsing(self, tmp_path):
+        data = RNG.integers(0, 255, (20, 3 * 32 * 32)).astype(np.uint8)
+        labels = RNG.integers(0, 10, (20,)).tolist()
+        d = tmp_path / "cifar-10-batches-py"
+        d.mkdir()
+        for i in range(1, 6):
+            with open(d / f"data_batch_{i}", "wb") as f:
+                pickle.dump({b"data": data, b"labels": labels}, f)
+        with open(d / "test_batch", "wb") as f:
+            pickle.dump({b"data": data[:5], b"labels": labels[:5]}, f)
+        train = Cifar10(data_file=str(d), mode="train")
+        assert len(train) == 100  # 5 batches x 20
+        img, lab = train[0]
+        assert img.shape == (3, 32, 32)
+        test = Cifar10(data_file=str(d), mode="test")
+        assert len(test) == 5
