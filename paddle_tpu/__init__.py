@@ -81,6 +81,7 @@ from paddle_tpu.ops.logic import *  # noqa: F401,F403
 from paddle_tpu.ops.search import *  # noqa: F401,F403
 from paddle_tpu.ops.linalg import (  # noqa: F401
     bmm,
+    trace,
     cross,
     dist,
     dot,

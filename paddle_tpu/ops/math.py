@@ -308,3 +308,57 @@ def increment(x, value=1.0):
 
 
 _export("increment")
+
+
+# ---- r4 coverage additions (reference ops.yaml parity) ----------------------
+_unary("positive", jnp.positive)
+_unary("negative", jnp.negative)
+_unary("signbit", jnp.signbit)
+_binary("isin", lambda x, t: jnp.isin(x, t))
+
+
+@defop("vander", tensor_method=None)
+def vander(x, n=None, increasing=False):
+    return jnp.vander(x, N=n, increasing=increasing)
+
+
+_export("vander")
+
+
+@defop("tensordot", tensor_method=None)
+def tensordot(x, y, axes=2):
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(a) if isinstance(a, (list, tuple)) else a for a in axes)
+    return jnp.tensordot(x, y, axes=axes)
+
+
+_export("tensordot")
+
+
+@defop("renorm", tensor_method="renorm")
+def renorm(x, p, axis, max_norm):
+    # per-slice p-norm along every dim EXCEPT axis, clamped to max_norm
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    norms = jnp.sum(jnp.abs(x) ** p, axis=red, keepdims=True) ** (1.0 / p)
+    factor = jnp.where(norms > max_norm, max_norm / jnp.maximum(norms, 1e-12), 1.0)
+    return x * factor
+
+
+_export("renorm")
+
+
+@defop("take", tensor_method="take")
+def take(x, index, mode="raise"):
+    idx = index.reshape(-1)
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    if mode == "wrap":
+        idx = idx % n
+    elif mode == "clip":
+        idx = jnp.clip(idx, 0, n - 1)
+    else:  # 'raise' cannot raise inside a traced program; paddle clamps too
+        idx = jnp.clip(jnp.where(idx < 0, idx + n, idx), 0, n - 1)
+    return flat[idx].reshape(index.shape)
+
+
+_export("take")

@@ -219,3 +219,58 @@ class TestInferMeta:
 
         out = infer_meta("matmul", paddle.ones([7, 3]), paddle.ones([3, 9]))
         assert tuple(out.shape) == (7, 9)
+
+
+class TestR4CoverageOps:
+    """r4 additions: take/renorm/tensordot/vander/trace/signbit/isin/..."""
+
+    def test_trace_diagonal(self):
+        x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+        np.testing.assert_allclose(paddle.trace(x).numpy(), 4.0)
+
+    def test_take_modes(self):
+        x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+        idx = paddle.to_tensor(np.array([[0, 5], [7, -1]], np.int32))
+        np.testing.assert_allclose(
+            paddle.take(x, idx, mode="wrap").numpy(), [[0, 5], [1, 5]]
+        )
+        np.testing.assert_allclose(
+            paddle.take(x, idx, mode="clip").numpy(), [[0, 5], [5, 0]]
+        )
+        # default: negatives wrap once, then clamp (paddle semantics)
+        np.testing.assert_allclose(paddle.take(x, idx).numpy(), [[0, 5], [5, 5]])
+
+    def test_tensordot(self):
+        a = np.arange(6, dtype=np.float32).reshape(2, 3)
+        x = paddle.to_tensor(a)
+        np.testing.assert_allclose(
+            paddle.tensordot(x, x, axes=[[1], [1]]).numpy(), a @ a.T, rtol=1e-6
+        )
+
+    def test_renorm_caps_slices(self):
+        a = np.array([[3.0, 4.0], [0.3, 0.4]], np.float32)
+        out = paddle.renorm(paddle.to_tensor(a), 2.0, 0, 1.0).numpy()
+        np.testing.assert_allclose(np.linalg.norm(np.asarray(out)[0]), 1.0, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(out)[1], a[1], rtol=1e-6)  # under the cap: untouched
+
+    def test_vander_signbit_isin_negative(self):
+        v = paddle.vander(paddle.to_tensor(np.array([1.0, 2.0], np.float32)), n=3)
+        np.testing.assert_allclose(v.numpy(), np.vander([1.0, 2.0], 3), rtol=1e-6)
+        s = paddle.signbit(paddle.to_tensor(np.array([-1.0, 2.0], np.float32)))
+        np.testing.assert_array_equal(s.numpy(), [True, False])
+        np.testing.assert_array_equal(
+            paddle.isin(
+                paddle.to_tensor(np.array([1.0, 3.0], np.float32)),
+                paddle.to_tensor(np.array([3.0], np.float32)),
+            ).numpy(),
+            [False, True],
+        )
+        np.testing.assert_allclose(
+            paddle.negative(paddle.to_tensor(np.array([1.0], np.float32))).numpy(), [-1.0]
+        )
+
+    def test_take_and_renorm_gradients(self):
+        x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+        x.stop_gradient = False
+        paddle.take(x, paddle.to_tensor(np.array([0, 5], np.int32))).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [[1, 0, 0], [0, 0, 1]])
