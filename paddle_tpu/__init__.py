@@ -129,6 +129,11 @@ from paddle_tpu import incubate  # noqa: F401
 from paddle_tpu import quantization  # noqa: F401
 
 from paddle_tpu.framework.io import load, save  # noqa: F401
+from paddle_tpu.framework.tensor_types import (  # noqa: F401
+    SelectedRows,
+    TensorArray,
+    create_array,
+)
 from paddle_tpu.framework.random import get_cuda_rng_state  # noqa: F401
 
 # paddle-API aliases

@@ -1,3 +1,11 @@
 """Framework glue: save/load IO, ParamAttr, random compat."""
 
 from paddle_tpu.framework.param_attr import ParamAttr  # noqa: F401
+from paddle_tpu.framework.tensor_types import (  # noqa: F401
+    SelectedRows,
+    TensorArray,
+    array_length,
+    array_read,
+    array_write,
+    create_array,
+)

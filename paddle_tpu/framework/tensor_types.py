@@ -1,0 +1,144 @@
+"""Auxiliary tensor types: TensorArray + SelectedRows.
+
+Reference: ``paddle/phi/core/tensor_array.h`` (dynamic list of tensors backing
+``paddle.tensor.array_*`` / static-RNN state) and
+``paddle/phi/core/selected_rows.h:27`` (row-sparse gradient container used by
+sparse embedding updates).
+
+TPU-native framing: XLA programs are static, so a *dynamic* array only lives
+at the Python level — inside jit, ``lax.scan`` replaces array_write loops
+(see ``nn/layer/rnn.py``). TensorArray therefore serves eager code and API
+portability. SelectedRows keeps (rows, values) unmaterialized so an embedding
+gradient of a few rows doesn't densify the whole table until the optimizer
+applies it — the same memory trade the reference makes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator, List, Optional, Sequence
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = [
+    "TensorArray",
+    "SelectedRows",
+    "create_array",
+    "array_write",
+    "array_read",
+    "array_length",
+]
+
+
+class TensorArray:
+    """Dynamic tensor list (reference ``tensor_array.h``)."""
+
+    def __init__(self, tensors: Optional[Sequence[Tensor]] = None) -> None:
+        self._items: List[Tensor] = list(tensors or [])
+
+    def append(self, t: Any) -> None:
+        self._items.append(t if isinstance(t, Tensor) else Tensor(t))
+
+    def write(self, index: int, t: Any) -> None:
+        t = t if isinstance(t, Tensor) else Tensor(t)
+        if index == len(self._items):
+            self._items.append(t)
+        elif 0 <= index < len(self._items):
+            self._items[index] = t
+        else:
+            raise IndexError(
+                f"array_write index {index} out of range [0, {len(self._items)}]"
+            )
+
+    def read(self, index: int) -> Tensor:
+        return self._items[index]
+
+    def stack(self, axis: int = 0) -> Tensor:
+        from paddle_tpu.ops.manipulation import stack
+
+        return stack(self._items, axis=axis)
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def __getitem__(self, i: int) -> Tensor:
+        return self._items[i]
+
+    def __iter__(self) -> Iterator[Tensor]:
+        return iter(self._items)
+
+    def __repr__(self) -> str:
+        return f"TensorArray(len={len(self._items)})"
+
+
+def create_array(dtype: Any = "float32", initialized_list: Any = None) -> TensorArray:
+    """``paddle.tensor.create_array`` parity."""
+    return TensorArray(initialized_list)
+
+
+def array_write(x: Any, i: Any, array: Optional[TensorArray] = None) -> TensorArray:
+    if array is None:
+        array = TensorArray()
+    array.write(int(i), x)
+    return array
+
+
+def array_read(array: TensorArray, i: Any) -> Tensor:
+    return array.read(int(i))
+
+
+def array_length(array: TensorArray) -> int:
+    return len(array)
+
+
+class SelectedRows:
+    """Row-sparse value container (reference ``selected_rows.h:27``):
+    ``rows[i]`` is the logical row of dense slice ``value[i]``. Keeps sparse
+    embedding gradients O(touched rows) until applied."""
+
+    def __init__(self, rows: Any, value: Any, height: int) -> None:
+        self._rows = jnp.asarray(
+            rows._data if isinstance(rows, Tensor) else rows, jnp.int32
+        )
+        v = value._data if isinstance(value, Tensor) else jnp.asarray(value)
+        if v.shape[0] != self._rows.shape[0]:
+            raise ValueError(
+                f"value rows ({v.shape[0]}) != rows index length ({self._rows.shape[0]})"
+            )
+        self._value = v
+        self._height = int(height)
+
+    @property
+    def rows(self) -> Tensor:
+        return Tensor(self._rows)
+
+    @property
+    def value(self) -> Tensor:
+        return Tensor(self._value)
+
+    @property
+    def height(self) -> int:
+        return self._height
+
+    @property
+    def shape(self) -> List[int]:
+        return [self._height] + list(self._value.shape[1:])
+
+    def to_dense(self) -> Tensor:
+        """Scatter-add into the dense logical shape (duplicate rows sum,
+        matching gradient-accumulation semantics)."""
+        dense = jnp.zeros((self._height,) + self._value.shape[1:], self._value.dtype)
+        return Tensor(dense.at[self._rows].add(self._value))
+
+    def merge_rows(self) -> "SelectedRows":
+        """Coalesce duplicate rows (reference ``MergeAdd``)."""
+        uniq, inv = jnp.unique(self._rows, return_inverse=True, size=self._rows.shape[0],
+                               fill_value=self._height)
+        merged = jnp.zeros((uniq.shape[0],) + self._value.shape[1:], self._value.dtype)
+        merged = merged.at[inv].add(self._value)
+        keep = uniq < self._height
+        return SelectedRows(uniq[keep], merged[keep], self._height)
+
+    def __repr__(self) -> str:
+        return f"SelectedRows(nrows={self._rows.shape[0]}, height={self._height})"

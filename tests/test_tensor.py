@@ -89,3 +89,38 @@ def test_creation_ops():
     assert paddle.eye(3).numpy().trace() == 3
     t = paddle.tril(paddle.ones([3, 3]))
     assert t.numpy()[0, 2] == 0
+
+
+class TestAuxTensorTypes:
+    """TensorArray (reference tensor_array.h) + SelectedRows
+    (selected_rows.h:27)."""
+
+    def test_tensor_array_write_read_stack(self):
+        from paddle_tpu.framework import array_length, array_read, array_write, create_array
+
+        arr = create_array()
+        for i in range(3):
+            array_write(paddle.to_tensor(np.full((2,), float(i), np.float32)), i, arr)
+        assert array_length(arr) == 3
+        np.testing.assert_allclose(array_read(arr, 1).numpy(), [1.0, 1.0])
+        array_write(paddle.to_tensor(np.full((2,), 9.0, np.float32)), 1, arr)  # overwrite
+        np.testing.assert_allclose(arr.stack().numpy(), [[0, 0], [9, 9], [2, 2]])
+        with pytest.raises(IndexError):
+            arr.write(7, paddle.to_tensor(np.zeros((2,), np.float32)))
+
+    def test_selected_rows_to_dense_and_merge(self):
+        from paddle_tpu import SelectedRows
+
+        sr = SelectedRows(
+            rows=np.array([1, 3, 1], np.int32),
+            value=np.array([[1.0, 1.0], [2.0, 2.0], [5.0, 5.0]], np.float32),
+            height=5,
+        )
+        assert sr.shape == [5, 2]
+        dense = sr.to_dense().numpy()
+        np.testing.assert_allclose(
+            dense, [[0, 0], [6, 6], [0, 0], [2, 2], [0, 0]]
+        )
+        merged = sr.merge_rows()
+        assert int(merged.rows.numpy().shape[0]) == 2
+        np.testing.assert_allclose(merged.to_dense().numpy(), dense)
