@@ -31,6 +31,7 @@ __all__ = [
     "block_cache_prefill",
     "block_cache_append",
     "BlockKVCache",
+    "fused_moe",
 ]
 
 from paddle_tpu.incubate.nn.functional.block_attention import (  # noqa: E402,F401
@@ -39,6 +40,7 @@ from paddle_tpu.incubate.nn.functional.block_attention import (  # noqa: E402,F4
     block_cache_prefill,
     block_multihead_attention,
 )
+from paddle_tpu.incubate.nn.functional.fused_moe import fused_moe  # noqa: E402,F401
 
 
 def fused_rms_norm(

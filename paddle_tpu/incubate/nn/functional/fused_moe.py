@@ -1,0 +1,99 @@
+"""Fused dropless MoE over ``lax.ragged_dot``.
+
+Reference: the fused MoE kernel family
+(``paddle/phi/kernels/fusion/gpu/fused_moe_kernel.cu``, exposed as
+``paddle.incubate.nn.functional.fused_moe``): gate → top-k → grouped expert
+GEMMs → weighted combine, with no [E, C, M] capacity buffer.
+
+TPU-native mechanics: tokens are sorted by expert id and the two expert FFN
+GEMMs run as ``jax.lax.ragged_dot`` — the Mosaic grouped-matmul primitive
+that keeps the MXU busy across experts of unequal load. Dropless: every
+token reaches its experts (group sizes are data-dependent, shapes stay
+static at T*K). The gather/sort/scatter bookkeeping is XLA-fused around the
+two ragged GEMMs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import call_op
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["fused_moe"]
+
+
+def _fused_moe_impl(
+    x: jnp.ndarray,  # [T, M]
+    gate_w: jnp.ndarray,  # [M, E]
+    ffn1_w: jnp.ndarray,  # [E, M, H] (or [E, M, 2H] for swiglu)
+    ffn2_w: jnp.ndarray,  # [E, H, M]
+    top_k: int,
+    norm_topk_prob: bool,
+    activation: str,
+) -> jnp.ndarray:
+    t, m = x.shape
+    e = gate_w.shape[1]
+    logits = (x.astype(jnp.float32) @ gate_w.astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)
+    topv, topi = jax.lax.top_k(probs, top_k)  # [T, K]
+    if norm_topk_prob:
+        topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
+
+    flat_expert = topi.reshape(-1)  # [T*K]
+    flat_token = jnp.repeat(jnp.arange(t, dtype=jnp.int32), top_k)
+    flat_weight = topv.reshape(-1)
+
+    order = jnp.argsort(flat_expert)  # stable grouping by expert
+    tok_sorted = flat_token[order]
+    w_sorted = flat_weight[order]
+    gathered = x[tok_sorted]  # [T*K, M]
+    group_sizes = jnp.bincount(flat_expert, length=e).astype(jnp.int32)
+
+    h = jax.lax.ragged_dot(gathered, ffn1_w.astype(x.dtype), group_sizes)
+    if activation == "swiglu":
+        half = h.shape[-1] // 2
+        h = jax.nn.silu(h[:, :half]) * h[:, half:]
+    elif activation == "gelu":
+        h = jax.nn.gelu(h)
+    elif activation == "relu":
+        h = jax.nn.relu(h)
+    else:
+        raise ValueError(f"unsupported activation {activation!r}")
+    out = jax.lax.ragged_dot(h, ffn2_w.astype(x.dtype), group_sizes)  # [T*K, M]
+
+    out = out * w_sorted[:, None].astype(out.dtype)
+    y = jnp.zeros((t, m), out.dtype).at[tok_sorted].add(out)
+    return y
+
+
+def fused_moe(
+    x: Any,
+    gate_weight: Any,
+    ffn1_weight: Any,
+    ffn2_weight: Any,
+    moe_topk: int = 2,
+    norm_topk_prob: bool = True,
+    activation: str = "swiglu",
+) -> Tensor:
+    """Dropless fused MoE (reference ``fused_moe``): tokens ``[T, M]`` or
+    ``[B, S, M]``; ``ffn1_weight [E, M, H or 2H]``, ``ffn2_weight [E, H, M]``.
+    Differentiable through the eager tape."""
+    xt = x if isinstance(x, Tensor) else Tensor(x)
+    lead = None
+    if len(xt.shape) == 3:
+        lead = tuple(xt.shape[:2])
+        xt = xt.reshape([lead[0] * lead[1], xt.shape[-1]])
+
+    def fn(xa, gw, w1, w2):
+        return _fused_moe_impl(
+            xa, gw, w1, w2, int(moe_topk), bool(norm_topk_prob), activation
+        )
+
+    out = call_op("fused_moe", fn, xt, gate_weight, ffn1_weight, ffn2_weight)
+    if lead is not None:
+        out = out.reshape([lead[0], lead[1], out.shape[-1]])
+    return out

@@ -130,3 +130,23 @@ class TestFusedKernelExport:
         x = jnp.asarray(rng.normal(size=(2, 256, 4, 64)), jnp.bfloat16)
         cs = jnp.asarray(rng.normal(size=(256, 64)), jnp.float32)
         _export_grad(lambda x: fused_rope_pallas(x, cs, cs), x)
+
+
+class TestFusedMoeExport:
+    def test_fused_moe_lowers_for_tpu(self):
+        # ragged_dot is a Mosaic grouped matmul: statically verify fwd+bwd
+        # TPU lowering like the Pallas kernels
+        from paddle_tpu.incubate.nn.functional.fused_moe import _fused_moe_impl
+
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(16, 32)), jnp.float32)
+        gw = jnp.asarray(rng.normal(size=(32, 4)), jnp.float32)
+        w1 = jnp.asarray(rng.normal(size=(4, 32, 64)), jnp.float32)
+        w2 = jnp.asarray(rng.normal(size=(4, 32, 32)), jnp.float32)
+
+        def loss(x, gw, w1, w2):
+            return _fused_moe_impl(x, gw, w1, w2, 2, True, "swiglu").sum()
+
+        jax.export.export(
+            jax.jit(jax.grad(loss, argnums=(0, 1, 2, 3))), platforms=["tpu"]
+        )(x, gw, w1, w2)
