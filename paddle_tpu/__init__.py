@@ -131,6 +131,7 @@ from paddle_tpu import quantization  # noqa: F401
 from paddle_tpu.framework.io import load, save  # noqa: F401
 from paddle_tpu.framework.tensor_types import (  # noqa: F401
     SelectedRows,
+    StringTensor,
     TensorArray,
     create_array,
 )

@@ -3,6 +3,7 @@
 from paddle_tpu.framework.param_attr import ParamAttr  # noqa: F401
 from paddle_tpu.framework.tensor_types import (  # noqa: F401
     SelectedRows,
+    StringTensor,
     TensorArray,
     array_length,
     array_read,

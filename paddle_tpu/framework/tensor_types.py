@@ -24,6 +24,7 @@ from paddle_tpu.core.tensor import Tensor
 __all__ = [
     "TensorArray",
     "SelectedRows",
+    "StringTensor",
     "create_array",
     "array_write",
     "array_read",
@@ -142,3 +143,35 @@ class SelectedRows:
 
     def __repr__(self) -> str:
         return f"SelectedRows(nrows={self._rows.shape[0]}, height={self._height})"
+
+
+class StringTensor:
+    """String tensor (reference ``paddle/phi/core/string_tensor.h``): host-side
+    ndarray of UTF-8 strings feeding tokenizer-style preprocessing. TPU
+    programs never consume strings — this container exists at the input
+    pipeline boundary (faster_tokenizer analog), so storage is numpy object
+    dtype, not a device buffer."""
+
+    def __init__(self, data: Any, name: str = "") -> None:
+        import numpy as np
+
+        arr = np.asarray(data, dtype=object)
+        self._data = arr
+        self.name = name
+
+    @property
+    def shape(self) -> List[int]:
+        return list(self._data.shape)
+
+    def numpy(self):
+        return self._data
+
+    def __len__(self) -> int:
+        return int(self._data.shape[0]) if self._data.ndim else 1
+
+    def __getitem__(self, idx: Any) -> Any:
+        out = self._data[idx]
+        return StringTensor(out) if getattr(out, "ndim", 0) else out
+
+    def __repr__(self) -> str:
+        return f"StringTensor(shape={self.shape})"

@@ -124,3 +124,13 @@ class TestAuxTensorTypes:
         merged = sr.merge_rows()
         assert int(merged.rows.numpy().shape[0]) == 2
         np.testing.assert_allclose(merged.to_dense().numpy(), dense)
+
+    def test_string_tensor(self):
+        from paddle_tpu import StringTensor
+
+        st = StringTensor([["ab", "cd"], ["e", "f"]])
+        assert st.shape == [2, 2]
+        assert st[0, 1] == "cd"
+        row = st[0]
+        assert row.shape == [2] and len(row) == 2
+        assert st.numpy().dtype == object
