@@ -1,0 +1,233 @@
+// Shared-memory ring arena for DataLoader worker→parent batch handoff.
+//
+// Reference: the multiprocess DataLoader's shared-memory tensor transport
+// (python/paddle/io/dataloader/worker.py + the C++ shared-memory allocator
+// under paddle/fluid/memory/allocation/mmap_allocator.cc): batches cross the
+// process boundary through mapped memory, not pickled pipe bytes.
+//
+// Design: one POSIX shm segment = header + N fixed-size slots. Slot states
+// advance EMPTY -> WRITING -> READY -> READING -> EMPTY via C11 atomics in
+// the mapped header (process-shared, lock-free); waiting sides back off with
+// short sleeps (batch-granularity handoff; microsecond latency is
+// irrelevant next to a training step). Producers claim any EMPTY slot;
+// consumers drain READY slots in commit order via a monotone ticket so batch
+// ordering survives multi-producer races.
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <ctime>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x50525247;  // "PRRG"
+
+enum SlotState : uint32_t {
+  kEmpty = 0,
+  kWriting = 1,
+  kReady = 2,
+  kReading = 3,
+};
+
+struct SlotHeader {
+  std::atomic<uint32_t> state;
+  std::atomic<uint64_t> ticket;  // commit order
+  uint64_t size;                 // payload bytes
+  int64_t tag;                   // caller-defined (e.g. batch index)
+};
+
+struct RingHeader {
+  uint32_t magic;
+  uint32_t nslots;
+  uint64_t slot_bytes;
+  std::atomic<uint64_t> next_ticket;   // producer commit counter
+  std::atomic<uint64_t> read_ticket;   // next ticket the consumer wants
+  SlotHeader slots[];                  // nslots entries, then payload area
+};
+
+struct Ring {
+  RingHeader* hdr;
+  uint8_t* payload;
+  size_t map_bytes;
+  char name[256];
+  bool owner;
+};
+
+size_t total_bytes(uint32_t nslots, uint64_t slot_bytes) {
+  return sizeof(RingHeader) + nslots * sizeof(SlotHeader) +
+         static_cast<size_t>(nslots) * slot_bytes;
+}
+
+void sleep_us(long us) {
+  struct timespec ts {0, us * 1000L};
+  nanosleep(&ts, nullptr);
+}
+
+double now_s() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec + ts.tv_nsec * 1e-9;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create (owner=1) or attach (owner=0). Returns nullptr on failure.
+void* shm_ring_open(const char* name, uint32_t nslots, uint64_t slot_bytes,
+                    int create) {
+  size_t bytes = 0;
+  int fd = -1;
+  if (create) {
+    shm_unlink(name);  // stale segment from a dead run
+    fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+    if (fd < 0) return nullptr;
+    bytes = total_bytes(nslots, slot_bytes);
+    if (ftruncate(fd, static_cast<off_t>(bytes)) != 0) {
+      close(fd);
+      shm_unlink(name);
+      return nullptr;
+    }
+  } else {
+    fd = shm_open(name, O_RDWR, 0600);
+    if (fd < 0) return nullptr;
+    struct stat st;
+    if (fstat(fd, &st) != 0) {
+      close(fd);
+      return nullptr;
+    }
+    bytes = static_cast<size_t>(st.st_size);
+  }
+  void* mem = mmap(nullptr, bytes, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+
+  Ring* r = new Ring();
+  r->hdr = static_cast<RingHeader*>(mem);
+  r->map_bytes = bytes;
+  r->owner = create != 0;
+  snprintf(r->name, sizeof(r->name), "%s", name);
+  if (create) {
+    r->hdr->magic = kMagic;
+    r->hdr->nslots = nslots;
+    r->hdr->slot_bytes = slot_bytes;
+    r->hdr->next_ticket.store(0);
+    r->hdr->read_ticket.store(0);
+    for (uint32_t i = 0; i < nslots; ++i) {
+      r->hdr->slots[i].state.store(kEmpty);
+      r->hdr->slots[i].ticket.store(0);
+      r->hdr->slots[i].size = 0;
+      r->hdr->slots[i].tag = 0;
+    }
+  } else if (r->hdr->magic != kMagic) {
+    munmap(mem, bytes);
+    delete r;
+    return nullptr;
+  }
+  r->payload = reinterpret_cast<uint8_t*>(mem) + sizeof(RingHeader) +
+               r->hdr->nslots * sizeof(SlotHeader);
+  return r;
+}
+
+uint64_t shm_ring_slot_bytes(void* ring) {
+  return static_cast<Ring*>(ring)->hdr->slot_bytes;
+}
+
+uint32_t shm_ring_nslots(void* ring) {
+  return static_cast<Ring*>(ring)->hdr->nslots;
+}
+
+// Claim an EMPTY slot for writing; returns slot index or -1 on timeout.
+int shm_ring_acquire_write(void* ring, double timeout_s) {
+  Ring* r = static_cast<Ring*>(ring);
+  const double deadline = now_s() + timeout_s;
+  long backoff = 1;
+  for (;;) {
+    const uint32_t n = r->hdr->nslots;
+    for (uint32_t i = 0; i < n; ++i) {
+      uint32_t expect = kEmpty;
+      if (r->hdr->slots[i].state.compare_exchange_strong(expect, kWriting)) {
+        return static_cast<int>(i);
+      }
+    }
+    if (timeout_s >= 0 && now_s() > deadline) return -1;
+    sleep_us(backoff);
+    if (backoff < 200) backoff *= 2;
+  }
+}
+
+// Payload pointer for a claimed slot.
+void* shm_ring_slot_ptr(void* ring, int slot) {
+  Ring* r = static_cast<Ring*>(ring);
+  return r->payload + static_cast<size_t>(slot) * r->hdr->slot_bytes;
+}
+
+// Publish a written slot (assigns the next commit ticket).
+int shm_ring_commit_write(void* ring, int slot, uint64_t size, int64_t tag) {
+  Ring* r = static_cast<Ring*>(ring);
+  if (size > r->hdr->slot_bytes) return -1;
+  SlotHeader& s = r->hdr->slots[slot];
+  if (s.state.load() != kWriting) return -2;
+  s.size = size;
+  s.tag = tag;
+  s.ticket.store(r->hdr->next_ticket.fetch_add(1));
+  s.state.store(kReady);
+  return 0;
+}
+
+// Abort a claimed write (slot returns to the pool).
+void shm_ring_abort_write(void* ring, int slot) {
+  static_cast<Ring*>(ring)->hdr->slots[slot].state.store(kEmpty);
+}
+
+// Take the next READY slot in commit order. Returns slot index or -1 on
+// timeout; fills size/tag.
+int shm_ring_acquire_read(void* ring, double timeout_s, uint64_t* size,
+                          int64_t* tag) {
+  Ring* r = static_cast<Ring*>(ring);
+  const double deadline = now_s() + timeout_s;
+  long backoff = 1;
+  const uint64_t want = r->hdr->read_ticket.load();
+  for (;;) {
+    const uint32_t n = r->hdr->nslots;
+    for (uint32_t i = 0; i < n; ++i) {
+      SlotHeader& s = r->hdr->slots[i];
+      if (s.state.load() == kReady && s.ticket.load() == want) {
+        uint32_t expect = kReady;
+        if (s.state.compare_exchange_strong(expect, kReading)) {
+          r->hdr->read_ticket.fetch_add(1);
+          *size = s.size;
+          *tag = s.tag;
+          return static_cast<int>(i);
+        }
+      }
+    }
+    if (timeout_s >= 0 && now_s() > deadline) return -1;
+    sleep_us(backoff);
+    if (backoff < 200) backoff *= 2;
+  }
+}
+
+// Return a read slot to the pool.
+void shm_ring_release_read(void* ring, int slot) {
+  static_cast<Ring*>(ring)->hdr->slots[slot].state.store(kEmpty);
+}
+
+void shm_ring_close(void* ring) {
+  Ring* r = static_cast<Ring*>(ring);
+  const bool owner = r->owner;
+  char name[256];
+  snprintf(name, sizeof(name), "%s", r->name);
+  munmap(r->hdr, r->map_bytes);
+  if (owner) shm_unlink(name);
+  delete r;
+}
+
+}  // extern "C"

@@ -136,6 +136,7 @@ def _worker_loop(
     base_seed: int,
     use_shared_memory: bool,
     drop_last: bool,
+    ring: Any = None,
 ) -> None:
     global _worker_info
     _worker_info = WorkerInfo(
@@ -161,7 +162,7 @@ def _worker_loop(
                     result_q.put((task[0], "__end__", None))
                     return
                 out = collate(batch)
-                _send(result_q, task[0], out, use_shared_memory)
+                _send(result_q, task[0], out, use_shared_memory, ring)
         else:
             while True:
                 task = task_q.get()
@@ -169,7 +170,7 @@ def _worker_loop(
                     return
                 batch_idx, indices = task
                 out = collate([dataset[i] for i in indices])
-                _send(result_q, batch_idx, out, use_shared_memory)
+                _send(result_q, batch_idx, out, use_shared_memory, ring)
     except KeyboardInterrupt:
         pass
     except BaseException as exc:  # noqa: BLE001 - surface in parent
@@ -178,7 +179,18 @@ def _worker_loop(
         result_q.put((-1, "__error__", f"{type(exc).__name__}: {exc}\n{traceback.format_exc()}"))
 
 
-def _send(result_q: Any, batch_idx: int, out: Any, use_shared_memory: bool) -> None:
+def _send(result_q: Any, batch_idx: int, out: Any, use_shared_memory: bool,
+          ring: Any = None) -> None:
+    if ring is not None:
+        # native ring arena: slots are reused, no per-batch segment
+        # create/unlink churn; oversized batches fall through to the
+        # per-segment path below
+        import pickle
+
+        payload = pickle.dumps(out, protocol=4)
+        if len(payload) <= ring.slot_bytes and ring.put(payload, tag=batch_idx):
+            result_q.put((batch_idx, "__ring__", None))
+            return
     if use_shared_memory:
         segments: List[Any] = []
         desc = _tree_to_shm(out, segments)
@@ -216,6 +228,31 @@ class WorkerPool:
         self._num_workers = num_workers
         self._timeout = timeout
         self._iterable = iterable_mode
+        # native shared-memory ring (cpp/shm_ring.cpp): slot reuse instead of
+        # per-batch segment create/unlink; fork inherits the mapping. Python
+        # shared_memory stays as the fallback (ring absent / oversized batch).
+        self._ring = None
+        if use_shared_memory and ctx.get_start_method() == "fork":
+            try:
+                import os as _os
+
+                from paddle_tpu_native.shm_ring import ShmRing, available
+
+                if available():
+                    import time as _time
+
+                    slot_bytes = int(
+                        _os.environ.get("PADDLE_SHM_RING_SLOT_BYTES", str(8 << 20))
+                    )
+                    self._ring = ShmRing(
+                        f"/pt_dl_{_os.getpid()}_{int(_time.monotonic() * 1e6) & 0xFFFFFF}",
+                        nslots=max(4, num_workers * 2),
+                        slot_bytes=slot_bytes,
+                        create=True,
+                    )
+            except Exception:  # noqa: BLE001 - fallback transport covers it
+                self._ring = None
+        self._ring_buf: Dict[int, Any] = {}
         base_seed = int(np.random.randint(0, 2**31 - 1))
         self._procs = [
             ctx.Process(
@@ -223,7 +260,7 @@ class WorkerPool:
                 args=(
                     dataset, iterable_mode, self._task_q, self._result_q,
                     collate_np, worker_init_fn, wid, num_workers, base_seed,
-                    use_shared_memory, drop_last,
+                    use_shared_memory, drop_last, self._ring,
                 ),
                 daemon=True,
             )
@@ -272,7 +309,21 @@ class WorkerPool:
                     break  # queued tasks have no worker left to serve them
                 feed()
                 continue
-            data = _tree_from_shm(payload) if kind == "__shm__" else payload
+            if kind == "__ring__":
+                import pickle
+
+                while idx not in self._ring_buf:
+                    got = self._ring.get(timeout=self._timeout if self._timeout > 0 else -1.0)
+                    if got is None:
+                        self.shutdown()
+                        raise RuntimeError("shm ring read timed out")
+                    blob, tag = got
+                    self._ring_buf[tag] = pickle.loads(blob)
+                data = self._ring_buf.pop(idx)
+            elif kind == "__shm__":
+                data = _tree_from_shm(payload)
+            else:
+                data = payload
             buf[idx] = data
             feed()
             while next_idx in buf:
@@ -310,3 +361,9 @@ class WorkerPool:
         for q in (self._task_q, self._result_q):
             q.cancel_join_thread()
             q.close()
+        if self._ring is not None:
+            try:
+                self._ring.close()
+            except Exception:  # noqa: BLE001
+                pass
+            self._ring = None

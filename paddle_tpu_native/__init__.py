@@ -7,9 +7,11 @@ accelerator runtime is unhealthy or absent (reference keeps its store in
 below the device layer, ``tcp_store.h:121``).
 
 Contents:
-  - ``loader``  — ctypes loader for ``cpp/build/libpaddle_tpu_native.so``
-  - ``store``   — Store / TCPStore rendezvous key-value store
+  - ``loader``   — ctypes loader for ``cpp/build/libpaddle_tpu_native.so``
+  - ``store``    — Store / TCPStore rendezvous key-value store
+  - ``shm_ring`` — shared-memory ring arena (DataLoader batch handoff)
 """
 
 from paddle_tpu_native.loader import load_native  # noqa: F401
 from paddle_tpu_native.store import Store, TCPStore  # noqa: F401
+from paddle_tpu_native.shm_ring import ShmRing  # noqa: F401

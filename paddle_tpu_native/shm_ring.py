@@ -1,0 +1,132 @@
+"""ShmRing: ctypes binding for the native shared-memory ring arena.
+
+Reference analog: the C++ shared-memory transport under the reference's
+multiprocess DataLoader (``mmap_allocator.cc`` + worker shared-memory tensor
+conversion). One POSIX shm segment holds N fixed-size slots; producers
+(forked workers) claim EMPTY slots, memcpy the payload, and commit with a
+monotone ticket; the consumer (parent) drains in commit order. Per-batch
+``SharedMemory`` create/unlink churn is replaced by slot reuse.
+
+Stdlib-only (ctypes); falls back unavailable when the native lib is absent.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional, Tuple
+
+from paddle_tpu_native.loader import load_native
+
+__all__ = ["ShmRing", "available"]
+
+
+def _bind():
+    lib = load_native()
+    if lib is None:
+        return None
+    try:
+        lib.shm_ring_open.restype = ctypes.c_void_p
+        lib.shm_ring_open.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint32, ctypes.c_uint64, ctypes.c_int,
+        ]
+        lib.shm_ring_slot_bytes.restype = ctypes.c_uint64
+        lib.shm_ring_slot_bytes.argtypes = [ctypes.c_void_p]
+        lib.shm_ring_nslots.restype = ctypes.c_uint32
+        lib.shm_ring_nslots.argtypes = [ctypes.c_void_p]
+        lib.shm_ring_acquire_write.restype = ctypes.c_int
+        lib.shm_ring_acquire_write.argtypes = [ctypes.c_void_p, ctypes.c_double]
+        lib.shm_ring_slot_ptr.restype = ctypes.c_void_p
+        lib.shm_ring_slot_ptr.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.shm_ring_commit_write.restype = ctypes.c_int
+        lib.shm_ring_commit_write.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_uint64, ctypes.c_int64,
+        ]
+        lib.shm_ring_abort_write.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.shm_ring_acquire_read.restype = ctypes.c_int
+        lib.shm_ring_acquire_read.argtypes = [
+            ctypes.c_void_p, ctypes.c_double,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.shm_ring_release_read.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.shm_ring_close.argtypes = [ctypes.c_void_p]
+    except AttributeError:
+        return None
+    return lib
+
+
+_LIB = _bind()
+
+
+def available() -> bool:
+    return _LIB is not None
+
+
+class ShmRing:
+    """Fixed-slot shared-memory ring. ``create=True`` owns the segment
+    (unlinked on close); workers attach by name after fork/spawn."""
+
+    def __init__(self, name: str, nslots: int = 8, slot_bytes: int = 1 << 20,
+                 create: bool = True) -> None:
+        if _LIB is None:
+            raise RuntimeError("native library not built (make -C cpp)")
+        self._h = _LIB.shm_ring_open(
+            name.encode(), int(nslots), int(slot_bytes), 1 if create else 0
+        )
+        if not self._h:
+            raise OSError(f"shm_ring_open failed for {name!r} (create={create})")
+        self.name = name
+        self.nslots = int(_LIB.shm_ring_nslots(self._h))
+        self.slot_bytes = int(_LIB.shm_ring_slot_bytes(self._h))
+
+    # -- producer -----------------------------------------------------------
+    def put(self, data: bytes, tag: int = 0, timeout: float = -1.0) -> bool:
+        """Copy ``data`` into a free slot and publish it. False on timeout."""
+        if len(data) > self.slot_bytes:
+            raise ValueError(f"payload {len(data)} > slot_bytes {self.slot_bytes}")
+        slot = _LIB.shm_ring_acquire_write(self._h, float(timeout))
+        if slot < 0:
+            return False
+        try:
+            ptr = _LIB.shm_ring_slot_ptr(self._h, slot)
+            ctypes.memmove(ptr, data, len(data))
+            rc = _LIB.shm_ring_commit_write(self._h, slot, len(data), int(tag))
+            if rc != 0:
+                raise OSError(f"shm_ring_commit_write rc={rc}")
+            return True
+        except Exception:
+            _LIB.shm_ring_abort_write(self._h, slot)
+            raise
+
+    # -- consumer -----------------------------------------------------------
+    def get(self, timeout: float = -1.0) -> Optional[Tuple[bytes, int]]:
+        """Next payload in commit order as (bytes, tag); None on timeout."""
+        size = ctypes.c_uint64()
+        tag = ctypes.c_int64()
+        slot = _LIB.shm_ring_acquire_read(
+            self._h, float(timeout), ctypes.byref(size), ctypes.byref(tag)
+        )
+        if slot < 0:
+            return None
+        try:
+            ptr = _LIB.shm_ring_slot_ptr(self._h, slot)
+            data = ctypes.string_at(ptr, size.value)
+        finally:
+            _LIB.shm_ring_release_read(self._h, slot)
+        return data, int(tag.value)
+
+    def close(self) -> None:
+        if getattr(self, "_h", None):
+            _LIB.shm_ring_close(self._h)
+            self._h = None
+
+    def __enter__(self) -> "ShmRing":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self) -> None:
+        try:
+            self.close()
+        except Exception:
+            pass
