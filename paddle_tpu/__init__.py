@@ -113,6 +113,7 @@ from paddle_tpu import autograd  # noqa: F401
 from paddle_tpu import distributed  # noqa: F401
 from paddle_tpu import distribution  # noqa: F401
 from paddle_tpu import fft  # noqa: F401
+from paddle_tpu import signal  # noqa: F401
 from paddle_tpu import hapi  # noqa: F401
 from paddle_tpu import io  # noqa: F401
 from paddle_tpu import jit  # noqa: F401

@@ -1,0 +1,142 @@
+"""``paddle_tpu.signal`` — STFT / ISTFT.
+
+Reference: ``python/paddle/signal.py`` (frame/overlap_add ops + stft/istft
+over the fft kernels). TPU-native: framing is a gather with static frame
+geometry, the FFT is XLA-native, and overlap-add is a scatter-add — the
+whole transform jits as one fused program and is differentiable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import call_op
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def frame(x: Any, frame_length: int, hop_length: int, axis: int = -1) -> Tensor:
+    """Slice overlapping frames (reference ``signal.frame``): the framed axis
+    becomes ``(..., num_frames, frame_length)`` at ``axis``."""
+    if axis not in (-1, getattr(x, "ndim", 1) - 1):
+        raise NotImplementedError("frame supports the last axis")
+
+    def fn(a: jnp.ndarray) -> jnp.ndarray:
+        n = a.shape[-1]
+        num = 1 + (n - frame_length) // hop_length
+        starts = jnp.arange(num) * hop_length
+        idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+        return a[..., idx]  # [..., num, frame_length]
+
+    return call_op("frame", fn, x)
+
+
+def overlap_add(x: Any, hop_length: int, axis: int = -1) -> Tensor:
+    """Inverse of :func:`frame` (reference ``signal.overlap_add``)."""
+
+    def fn(a: jnp.ndarray) -> jnp.ndarray:
+        *lead, num, fl = a.shape
+        n = (num - 1) * hop_length + fl
+        starts = jnp.arange(num) * hop_length
+        idx = (starts[:, None] + jnp.arange(fl)[None, :]).reshape(-1)
+        flat = a.reshape(*lead, num * fl)
+        out = jnp.zeros((*lead, n), a.dtype)
+        return out.at[..., idx].add(flat)
+
+    return call_op("overlap_add", fn, x)
+
+
+def stft(
+    x: Any,
+    n_fft: int,
+    hop_length: Optional[int] = None,
+    win_length: Optional[int] = None,
+    window: Any = None,
+    center: bool = True,
+    pad_mode: str = "reflect",
+    normalized: bool = False,
+    onesided: bool = True,
+    name: Any = None,
+) -> Tensor:
+    """Short-time Fourier transform (reference ``signal.stft``): input
+    ``[..., T]`` → ``[..., n_fft(/2+1), num_frames]`` complex."""
+    hop = hop_length if hop_length is not None else n_fft // 4
+    wl = win_length if win_length is not None else n_fft
+    if window is not None:
+        w = window._data if isinstance(window, Tensor) else jnp.asarray(window)
+    else:
+        w = jnp.ones((wl,), jnp.float32)
+    if wl < n_fft:  # center-pad the window to n_fft (paddle semantics)
+        lpad = (n_fft - wl) // 2
+        w = jnp.pad(w, (lpad, n_fft - wl - lpad))
+
+    def fn(a: jnp.ndarray, wa: jnp.ndarray) -> jnp.ndarray:
+        if center:
+            pad = [(0, 0)] * (a.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            a = jnp.pad(a, pad, mode=pad_mode)
+        n = a.shape[-1]
+        num = 1 + (n - n_fft) // hop
+        starts = jnp.arange(num) * hop
+        idx = starts[:, None] + jnp.arange(n_fft)[None, :]
+        frames = a[..., idx] * wa  # [..., num, n_fft]
+        spec = (jnp.fft.rfft if onesided else jnp.fft.fft)(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        return jnp.swapaxes(spec, -1, -2)  # [..., freq, num_frames]
+
+    return call_op("stft", fn, x, Tensor(w) if not isinstance(w, Tensor) else w)
+
+
+def istft(
+    x: Any,
+    n_fft: int,
+    hop_length: Optional[int] = None,
+    win_length: Optional[int] = None,
+    window: Any = None,
+    center: bool = True,
+    normalized: bool = False,
+    onesided: bool = True,
+    length: Optional[int] = None,
+    return_complex: bool = False,
+    name: Any = None,
+) -> Tensor:
+    """Inverse STFT with window-envelope normalization (reference
+    ``signal.istft``)."""
+    hop = hop_length if hop_length is not None else n_fft // 4
+    wl = win_length if win_length is not None else n_fft
+    if window is not None:
+        w = window._data if isinstance(window, Tensor) else jnp.asarray(window)
+    else:
+        w = jnp.ones((wl,), jnp.float32)
+    if wl < n_fft:
+        lpad = (n_fft - wl) // 2
+        w = jnp.pad(w, (lpad, n_fft - wl - lpad))
+
+    def fn(spec: jnp.ndarray, wa: jnp.ndarray) -> jnp.ndarray:
+        s = jnp.swapaxes(spec, -1, -2)  # [..., num_frames, freq]
+        if normalized:
+            s = s * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        frames = (jnp.fft.irfft(s, n=n_fft, axis=-1) if onesided
+                  else jnp.fft.ifft(s, axis=-1).real)
+        frames = frames * wa
+        *lead, num, fl = frames.shape
+        n = (num - 1) * hop + fl
+        starts = jnp.arange(num) * hop
+        idx = (starts[:, None] + jnp.arange(fl)[None, :]).reshape(-1)
+        out = jnp.zeros((*lead, n), frames.dtype).at[..., idx].add(
+            frames.reshape(*lead, num * fl)
+        )
+        env = jnp.zeros((n,), wa.dtype).at[idx].add(
+            jnp.broadcast_to(wa * wa, (num, fl)).reshape(-1)
+        )
+        out = out / jnp.maximum(env, 1e-11)
+        if center:
+            out = out[..., n_fft // 2 : n - n_fft // 2]
+        if length is not None:
+            out = out[..., :length]
+        return out
+
+    return call_op("istft", fn, x, Tensor(w) if not isinstance(w, Tensor) else w)

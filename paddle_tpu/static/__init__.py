@@ -29,9 +29,22 @@ class InputSpec:
 
 
 def save_inference_model(path_prefix: str, feed_vars: Any, fetch_vars: Any, executor: Any = None, **kwargs: Any) -> None:
-    raise NotImplementedError(
-        "static save_inference_model: use paddle_tpu.jit.save(layer, path, input_spec=...)"
-    )
+    """Trace-mode bridge for the static API (reference
+    ``paddle/static/io.py`` save_inference_model): ``feed_vars`` is a list of
+    :class:`InputSpec` (the trace-mode analog of feed Variables) and
+    ``fetch_vars`` the Layer whose forward produces the fetches. Writes the
+    same serialized-program bundle as ``paddle_tpu.jit.save``."""
+    from paddle_tpu.jit import save as jit_save
+    from paddle_tpu.nn.layer.layers import Layer
+
+    layer = fetch_vars if isinstance(fetch_vars, Layer) else kwargs.get("program")
+    if not isinstance(layer, Layer):
+        raise TypeError(
+            "trace-mode save_inference_model needs the model Layer as "
+            "fetch_vars (or program=layer) and InputSpecs as feed_vars"
+        )
+    specs = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
+    jit_save(layer, path_prefix, input_spec=list(specs))
 
 
 def load_inference_model(path_prefix: str, executor: Any = None, **kwargs: Any) -> Any:

@@ -91,3 +91,65 @@ def test_bincount():
         rtol=1e-6,
     )
     np.testing.assert_array_equal(np.asarray(t.bincount().numpy()), np.bincount(v))
+
+
+class TestSignal:
+    """paddle.signal (reference python/paddle/signal.py): frame/overlap_add
+    and stft/istft round trip + scipy-free numpy oracle."""
+
+    def test_frame_overlap_add_roundtrip_ones_window(self):
+        from paddle_tpu import signal
+
+        x = RNG.normal(size=(120,)).astype(np.float32)
+        f = signal.frame(paddle.to_tensor(x), frame_length=16, hop_length=16)
+        assert list(f.shape) == [120 // 16, 16][:1] + [16] or f.shape[-1] == 16
+        back = signal.overlap_add(f, hop_length=16)
+        np.testing.assert_allclose(np.asarray(back.numpy()), x[: f.shape[-2] * 16], rtol=1e-6)
+
+    def test_stft_matches_numpy_oracle(self):
+        from paddle_tpu import signal
+
+        n_fft, hop = 16, 4
+        x = RNG.normal(size=(2, 64)).astype(np.float32)
+        w = np.hanning(n_fft).astype(np.float32)
+        out = signal.stft(
+            paddle.to_tensor(x), n_fft, hop_length=hop,
+            window=paddle.to_tensor(w), center=False,
+        ).numpy()
+        # manual oracle
+        num = 1 + (64 - n_fft) // hop
+        ref = np.stack(
+            [np.fft.rfft(x[:, i * hop : i * hop + n_fft] * w) for i in range(num)],
+            axis=-1,
+        )  # [2, freq, num] after transpose of stack axis
+        ref = np.transpose(ref, (0, 2, 1)).transpose(0, 2, 1)  # keep [2, freq, num]
+        np.testing.assert_allclose(np.asarray(out), ref.astype(out.dtype), rtol=1e-4, atol=1e-4)
+
+    def test_stft_istft_roundtrip(self):
+        from paddle_tpu import signal
+
+        n_fft, hop = 32, 8
+        x = RNG.normal(size=(3, 160)).astype(np.float32)
+        w = np.hanning(n_fft).astype(np.float32)
+        spec = signal.stft(paddle.to_tensor(x), n_fft, hop_length=hop, window=paddle.to_tensor(w))
+        back = signal.istft(
+            spec, n_fft, hop_length=hop, window=paddle.to_tensor(w), length=160
+        ).numpy()
+        np.testing.assert_allclose(np.asarray(back), x, rtol=1e-3, atol=1e-3)
+
+    def test_save_inference_model_bridge(self, tmp_path):
+        from paddle_tpu import nn
+        from paddle_tpu.static import InputSpec, load_inference_model, save_inference_model
+
+        paddle.seed(0)
+        net = nn.Linear(4, 2)
+        net.eval()
+        path = str(tmp_path / "static_im")
+        save_inference_model(path, [InputSpec([2, 4], "float32", name="x")], net)
+        loaded = load_inference_model(path)
+        x = RNG.normal(size=(2, 4)).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(loaded(paddle.to_tensor(x)).numpy()),
+            np.asarray(net(paddle.to_tensor(x)).numpy()),
+            rtol=1e-5, atol=1e-6,
+        )
