@@ -58,7 +58,7 @@ def _fused_moe_impl(
         half = h.shape[-1] // 2
         h = jax.nn.silu(h[:, :half]) * h[:, half:]
     elif activation == "gelu":
-        h = jax.nn.gelu(h)
+        h = jax.nn.gelu(h, approximate=False)  # erf-exact, paddle default
     elif activation == "relu":
         h = jax.nn.relu(h)
     else:

@@ -188,7 +188,11 @@ def _send(result_q: Any, batch_idx: int, out: Any, use_shared_memory: bool,
         import pickle
 
         payload = pickle.dumps(out, protocol=4)
-        if len(payload) <= ring.slot_bytes and ring.put(payload, tag=batch_idx):
+        # finite timeout: a full ring with a stopped parent must not trap the
+        # worker in the C spin loop — fall through to the per-segment path
+        if len(payload) <= ring.slot_bytes and ring.put(
+            payload, tag=batch_idx, timeout=5.0
+        ):
             result_q.put((batch_idx, "__ring__", None))
             return
     if use_shared_memory:

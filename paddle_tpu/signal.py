@@ -4,11 +4,15 @@ Reference: ``python/paddle/signal.py`` (frame/overlap_add ops + stft/istft
 over the fft kernels). TPU-native: framing is a gather with static frame
 geometry, the FFT is XLA-native, and overlap-add is a scatter-add — the
 whole transform jits as one fused program and is differentiable.
+
+Layout parity: like the reference, ``frame`` produces
+``[..., frame_length, num_frames]`` (frames as columns) and ``overlap_add``
+consumes that layout.
 """
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Any, Optional, Tuple
 
 import jax.numpy as jnp
 
@@ -18,33 +22,57 @@ from paddle_tpu.core.tensor import Tensor
 __all__ = ["frame", "overlap_add", "stft", "istft"]
 
 
+def _frame_impl(a: jnp.ndarray, frame_length: int, hop_length: int) -> jnp.ndarray:
+    """[..., T] -> [..., num_frames, frame_length] (internal row layout)."""
+    n = a.shape[-1]
+    num = 1 + (n - frame_length) // hop_length
+    starts = jnp.arange(num) * hop_length
+    idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+    return a[..., idx]
+
+
+def _overlap_add_impl(frames: jnp.ndarray, hop_length: int) -> jnp.ndarray:
+    """[..., num_frames, frame_length] -> [..., T] scatter-add (internal)."""
+    *lead, num, fl = frames.shape
+    n = (num - 1) * hop_length + fl
+    starts = jnp.arange(num) * hop_length
+    idx = (starts[:, None] + jnp.arange(fl)[None, :]).reshape(-1)
+    out = jnp.zeros((*lead, n), frames.dtype)
+    return out.at[..., idx].add(frames.reshape(*lead, num * fl))
+
+
+def _prep_window(n_fft: int, win_length: Optional[int], window: Any) -> jnp.ndarray:
+    """Default/center-pad the analysis window to n_fft (shared by stft/istft)."""
+    wl = win_length if win_length is not None else n_fft
+    if window is not None:
+        w = window._data if isinstance(window, Tensor) else jnp.asarray(window)
+    else:
+        w = jnp.ones((wl,), jnp.float32)
+    if wl < n_fft:
+        lpad = (n_fft - wl) // 2
+        w = jnp.pad(w, (lpad, n_fft - wl - lpad))
+    return w
+
+
 def frame(x: Any, frame_length: int, hop_length: int, axis: int = -1) -> Tensor:
-    """Slice overlapping frames (reference ``signal.frame``): the framed axis
-    becomes ``(..., num_frames, frame_length)`` at ``axis``."""
+    """Slice overlapping frames (reference ``signal.frame``): for the default
+    ``axis=-1`` the result is ``[..., frame_length, num_frames]`` — frames as
+    columns, matching paddle."""
     if axis not in (-1, getattr(x, "ndim", 1) - 1):
         raise NotImplementedError("frame supports the last axis")
 
     def fn(a: jnp.ndarray) -> jnp.ndarray:
-        n = a.shape[-1]
-        num = 1 + (n - frame_length) // hop_length
-        starts = jnp.arange(num) * hop_length
-        idx = starts[:, None] + jnp.arange(frame_length)[None, :]
-        return a[..., idx]  # [..., num, frame_length]
+        return jnp.swapaxes(_frame_impl(a, frame_length, hop_length), -1, -2)
 
     return call_op("frame", fn, x)
 
 
 def overlap_add(x: Any, hop_length: int, axis: int = -1) -> Tensor:
-    """Inverse of :func:`frame` (reference ``signal.overlap_add``)."""
+    """Inverse of :func:`frame` — input ``[..., frame_length, num_frames]``
+    (reference ``signal.overlap_add``)."""
 
     def fn(a: jnp.ndarray) -> jnp.ndarray:
-        *lead, num, fl = a.shape
-        n = (num - 1) * hop_length + fl
-        starts = jnp.arange(num) * hop_length
-        idx = (starts[:, None] + jnp.arange(fl)[None, :]).reshape(-1)
-        flat = a.reshape(*lead, num * fl)
-        out = jnp.zeros((*lead, n), a.dtype)
-        return out.at[..., idx].add(flat)
+        return _overlap_add_impl(jnp.swapaxes(a, -1, -2), hop_length)
 
     return call_op("overlap_add", fn, x)
 
@@ -64,30 +92,19 @@ def stft(
     """Short-time Fourier transform (reference ``signal.stft``): input
     ``[..., T]`` → ``[..., n_fft(/2+1), num_frames]`` complex."""
     hop = hop_length if hop_length is not None else n_fft // 4
-    wl = win_length if win_length is not None else n_fft
-    if window is not None:
-        w = window._data if isinstance(window, Tensor) else jnp.asarray(window)
-    else:
-        w = jnp.ones((wl,), jnp.float32)
-    if wl < n_fft:  # center-pad the window to n_fft (paddle semantics)
-        lpad = (n_fft - wl) // 2
-        w = jnp.pad(w, (lpad, n_fft - wl - lpad))
+    w = _prep_window(n_fft, win_length, window)
 
     def fn(a: jnp.ndarray, wa: jnp.ndarray) -> jnp.ndarray:
         if center:
             pad = [(0, 0)] * (a.ndim - 1) + [(n_fft // 2, n_fft // 2)]
             a = jnp.pad(a, pad, mode=pad_mode)
-        n = a.shape[-1]
-        num = 1 + (n - n_fft) // hop
-        starts = jnp.arange(num) * hop
-        idx = starts[:, None] + jnp.arange(n_fft)[None, :]
-        frames = a[..., idx] * wa  # [..., num, n_fft]
+        frames = _frame_impl(a, n_fft, hop) * wa  # [..., num, n_fft]
         spec = (jnp.fft.rfft if onesided else jnp.fft.fft)(frames, axis=-1)
         if normalized:
             spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
         return jnp.swapaxes(spec, -1, -2)  # [..., freq, num_frames]
 
-    return call_op("stft", fn, x, Tensor(w) if not isinstance(w, Tensor) else w)
+    return call_op("stft", fn, x, Tensor(w))
 
 
 def istft(
@@ -104,39 +121,40 @@ def istft(
     name: Any = None,
 ) -> Tensor:
     """Inverse STFT with window-envelope normalization (reference
-    ``signal.istft``)."""
+    ``signal.istft``). ``return_complex=True`` keeps the complex time signal
+    (requires ``onesided=False`` — a onesided spectrum already asserts a real
+    signal, matching paddle's constraint)."""
+    if return_complex and onesided:
+        raise ValueError(
+            "return_complex=True requires onesided=False (a onesided spectrum "
+            "implies a real-valued signal)"
+        )
     hop = hop_length if hop_length is not None else n_fft // 4
-    wl = win_length if win_length is not None else n_fft
-    if window is not None:
-        w = window._data if isinstance(window, Tensor) else jnp.asarray(window)
-    else:
-        w = jnp.ones((wl,), jnp.float32)
-    if wl < n_fft:
-        lpad = (n_fft - wl) // 2
-        w = jnp.pad(w, (lpad, n_fft - wl - lpad))
+    w = _prep_window(n_fft, win_length, window)
 
     def fn(spec: jnp.ndarray, wa: jnp.ndarray) -> jnp.ndarray:
         s = jnp.swapaxes(spec, -1, -2)  # [..., num_frames, freq]
         if normalized:
             s = s * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
-        frames = (jnp.fft.irfft(s, n=n_fft, axis=-1) if onesided
-                  else jnp.fft.ifft(s, axis=-1).real)
+        if onesided:
+            frames = jnp.fft.irfft(s, n=n_fft, axis=-1)
+        else:
+            frames = jnp.fft.ifft(s, axis=-1)
+            if not return_complex:
+                frames = frames.real
         frames = frames * wa
-        *lead, num, fl = frames.shape
-        n = (num - 1) * hop + fl
+        num, fl = frames.shape[-2], frames.shape[-1]
+        out = _overlap_add_impl(frames, hop)
         starts = jnp.arange(num) * hop
         idx = (starts[:, None] + jnp.arange(fl)[None, :]).reshape(-1)
-        out = jnp.zeros((*lead, n), frames.dtype).at[..., idx].add(
-            frames.reshape(*lead, num * fl)
-        )
-        env = jnp.zeros((n,), wa.dtype).at[idx].add(
+        env = jnp.zeros((out.shape[-1],), wa.dtype).at[idx].add(
             jnp.broadcast_to(wa * wa, (num, fl)).reshape(-1)
         )
         out = out / jnp.maximum(env, 1e-11)
         if center:
-            out = out[..., n_fft // 2 : n - n_fft // 2]
+            out = out[..., n_fft // 2 : out.shape[-1] - n_fft // 2]
         if length is not None:
             out = out[..., :length]
         return out
 
-    return call_op("istft", fn, x, Tensor(w) if not isinstance(w, Tensor) else w)
+    return call_op("istft", fn, x, Tensor(w))

@@ -97,14 +97,30 @@ class TestSignal:
     """paddle.signal (reference python/paddle/signal.py): frame/overlap_add
     and stft/istft round trip + scipy-free numpy oracle."""
 
-    def test_frame_overlap_add_roundtrip_ones_window(self):
+    def test_frame_overlap_add_paddle_layout(self):
         from paddle_tpu import signal
 
         x = RNG.normal(size=(120,)).astype(np.float32)
         f = signal.frame(paddle.to_tensor(x), frame_length=16, hop_length=16)
-        assert list(f.shape) == [120 // 16, 16][:1] + [16] or f.shape[-1] == 16
+        # paddle layout: [..., frame_length, num_frames] — frames as COLUMNS
+        assert list(f.shape) == [16, 120 // 16]
+        np.testing.assert_allclose(np.asarray(f.numpy())[:, 2], x[32:48], rtol=1e-6)
         back = signal.overlap_add(f, hop_length=16)
-        np.testing.assert_allclose(np.asarray(back.numpy()), x[: f.shape[-2] * 16], rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(back.numpy()), x[: 16 * (120 // 16)], rtol=1e-6)
+
+    def test_frame_overlap(self):
+        from paddle_tpu import signal
+
+        x = np.arange(8, dtype=np.float32)
+        f = signal.frame(paddle.to_tensor(x), frame_length=4, hop_length=2)
+        assert list(f.shape) == [4, 3]
+        np.testing.assert_array_equal(np.asarray(f.numpy()).T, [[0, 1, 2, 3], [2, 3, 4, 5], [4, 5, 6, 7]])
+        # overlap_add sums overlapping regions
+        back = signal.overlap_add(f, hop_length=2).numpy()
+        ref = np.zeros(8, np.float32)
+        for i in range(3):
+            ref[i * 2 : i * 2 + 4] += x[i * 2 : i * 2 + 4]
+        np.testing.assert_allclose(np.asarray(back), ref, rtol=1e-6)
 
     def test_stft_matches_numpy_oracle(self):
         from paddle_tpu import signal
@@ -136,6 +152,25 @@ class TestSignal:
             spec, n_fft, hop_length=hop, window=paddle.to_tensor(w), length=160
         ).numpy()
         np.testing.assert_allclose(np.asarray(back), x, rtol=1e-3, atol=1e-3)
+
+    def test_istft_return_complex(self):
+        from paddle_tpu import signal
+
+        n_fft, hop = 16, 4
+        xc = (RNG.normal(size=(64,)) + 1j * RNG.normal(size=(64,))).astype(np.complex64)
+        w = np.hanning(n_fft).astype(np.float32)
+        spec = signal.stft(
+            paddle.to_tensor(xc), n_fft, hop_length=hop,
+            window=paddle.to_tensor(w), onesided=False,
+        )
+        back = signal.istft(
+            spec, n_fft, hop_length=hop, window=paddle.to_tensor(w),
+            onesided=False, return_complex=True, length=64,
+        ).numpy()
+        assert np.iscomplexobj(np.asarray(back))
+        np.testing.assert_allclose(np.asarray(back), xc, rtol=1e-3, atol=1e-3)
+        with pytest.raises(ValueError, match="onesided"):
+            signal.istft(spec, n_fft, onesided=True, return_complex=True)
 
     def test_save_inference_model_bridge(self, tmp_path):
         from paddle_tpu import nn
