@@ -270,7 +270,11 @@ def main() -> None:
     # v5e peak 197 bf16 TFLOP/s; 6*N*T FLOPs/token (fwd+bwd, weight FLOPs)
     mfu = 6.0 * n_params * tokens_per_sec / 197e12 if platform == "tpu" else 0.0
 
-    secondary = [_bench_ernie(paddle, platform), _bench_sd_unet(paddle, platform)]
+    secondary = [
+        _bench_ernie(paddle, platform),
+        _bench_sd_unet(paddle, platform),
+        _bench_resnet_pipeline(paddle, platform),
+    ]
     print(
         json.dumps(
             {
@@ -388,6 +392,101 @@ def _bench_sd_unet(paddle, platform: str) -> dict:
         }
     except Exception as exc:  # noqa: BLE001
         return {"metric": "sd15_unet_inference_images_per_sec", "error": f"{exc!r}"[:300]}
+
+
+def _bench_resnet_pipeline(paddle, platform: str) -> dict:
+    """Quaternary metric (BASELINE.md config #1): ResNet classification
+    throughput through the REAL input pipeline — on-disk dataset, multiprocess
+    DataLoader workers (shared-memory/native-ring handoff), train step under
+    jit. Synthetic images (this environment has no ImageNet), but every byte
+    crosses disk -> worker process -> parent -> device."""
+    import shutil
+    import tempfile
+
+    from paddle_tpu.io import DataLoader
+    from paddle_tpu.vision.datasets import DatasetFolder
+    from paddle_tpu.vision.models.resnet import resnet18, resnet50
+
+    tmp = tempfile.mkdtemp(prefix="bench_resnet_")
+    try:
+        if platform == "tpu":
+            build, batch, hw, n_imgs, classes, steps, workers = resnet50, 64, 224, 512, 8, 6, 4
+        else:
+            build, batch, hw, n_imgs, classes, steps, workers = resnet18, 8, 32, 32, 4, 2, 2
+
+        rng = np.random.default_rng(3)
+        per = n_imgs // classes
+        for c in range(classes):
+            d = f"{tmp}/class_{c}"
+            import os as _os
+
+            _os.makedirs(d, exist_ok=True)
+            for i in range(per):
+                np.save(
+                    f"{d}/{i}.npy",
+                    rng.integers(0, 255, (3, hw, hw)).astype(np.uint8),
+                )
+
+        def to_float(img):
+            return img.astype(np.float32) / 255.0
+
+        ds = DatasetFolder(tmp, transform=to_float)
+        loader = DataLoader(
+            ds, batch_size=batch, num_workers=workers, shuffle=True,
+            drop_last=True, persistent_workers=True,
+        )
+        paddle.seed(0)
+        model = build(num_classes=classes)
+        if platform == "tpu":
+            model = model.to(dtype="bfloat16")
+        opt = paddle.optimizer.Momentum(
+            learning_rate=0.1, momentum=0.9, parameters=model.parameters()
+        )
+
+        @paddle.jit.to_static
+        def step(model, opt, x, y):
+            logits = model(x)
+            loss = paddle.nn.functional.cross_entropy(
+                logits.astype("float32"), y
+            )
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        # warmup epoch fragment (compile + settle workers)
+        it = iter(loader)
+        xb, yb = next(it)
+        lv = float(step(model, opt, xb.astype("bfloat16" if platform == "tpu" else "float32"), yb))
+        n_done = 0
+        t0 = time.perf_counter()
+        while n_done < steps:
+            for xb, yb in loader:
+                last = step(
+                    model, opt,
+                    xb.astype("bfloat16" if platform == "tpu" else "float32"), yb,
+                )
+                n_done += 1
+                if n_done >= steps:
+                    break
+        lv = float(last)
+        dt = time.perf_counter() - t0
+        assert np.isfinite(lv), f"non-finite resnet loss {lv}"
+        pool = getattr(loader, "_pool", None)
+        if pool is not None:
+            pool.shutdown()
+        return {
+            "metric": "resnet_train_images_per_sec_with_input_pipeline",
+            "value": round(batch * steps / dt, 1),
+            "unit": "images/s",
+            "batch": batch,
+            "image": hw,
+            "workers": workers,
+        }
+    except Exception as exc:  # noqa: BLE001
+        return {"metric": "resnet_train_images_per_sec_with_input_pipeline", "error": f"{exc!r}"[:300]}
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 if __name__ == "__main__":
