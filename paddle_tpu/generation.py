@@ -108,6 +108,113 @@ class GenerationMixin:
         out = cache[cfg](arrays, ids, jax.random.PRNGKey(seed))
         return Tensor(out)
 
+    def generate_paged(
+        self,
+        input_ids: Any,
+        max_new_tokens: int = 32,
+        block_size: int = 16,
+        num_blocks: Optional[int] = None,
+        eos_token_id: Optional[int] = None,
+        pad_token_id: Optional[int] = None,
+    ) -> Any:
+        """Greedy decode over the PAGED KV cache (reference
+        ``block_multihead_attention_``): physical blocks are allocated to
+        sequences as they grow and reclaimed at the end — the serving-side
+        memory model, vs ``generate()``'s fixed dense buffers. The host
+        allocator runs between steps; each decode step is one jitted program
+        (block tables and lengths are data, so shapes never change)."""
+        import numpy as np
+
+        from paddle_tpu.core.tensor import Tensor
+        from paddle_tpu.incubate.nn.functional import BlockKVCache, block_cache_prefill
+
+        ids = input_ids._data if isinstance(input_ids, Tensor) else jnp.asarray(input_ids)
+        ids = ids.astype(jnp.int32)
+        b, prompt = ids.shape
+        if max_new_tokens <= 0:
+            return Tensor(ids)
+        cfg = self.config
+        kvh = cfg.num_key_value_heads
+        hd = cfg.hidden_size // cfg.num_attention_heads
+        max_len = prompt + max_new_tokens
+        if getattr(cfg, "max_position_embeddings", None) and max_len > cfg.max_position_embeddings:
+            raise ValueError(
+                f"prompt ({prompt}) + max_new_tokens ({max_new_tokens}) exceeds "
+                f"max_position_embeddings ({cfg.max_position_embeddings})"
+            )
+        mbs = -(-max_len // block_size)
+        if num_blocks is None:
+            num_blocks = b * mbs
+        dtype = next(iter(self.parameters())).dtype
+        L = cfg.num_hidden_layers
+        mgr = BlockKVCache(num_blocks, block_size, kvh, hd, mbs, dtype=dtype)
+        for i in range(b):
+            mgr.allocate(i, prompt)
+        tables = mgr.block_table(range(b))
+        lens = jnp.full((b,), prompt, jnp.int32)
+
+        if pad_token_id is None:
+            pad_token_id = eos_token_id if eos_token_id is not None else 0
+
+        # prefill: dense forward once, then pour each layer's K/V into blocks
+        import paddle_tpu
+
+        with paddle_tpu.no_grad():
+            logits, dense_caches = self(Tensor(ids), use_cache=True)
+        layer_caches = []
+        for k_t, v_t in dense_caches:
+            kc = jnp.zeros((num_blocks, block_size, kvh, hd), dtype)
+            vc = jnp.zeros_like(kc)
+            kc, vc = block_cache_prefill(kc, vc, k_t._data, v_t._data, tables, lens)
+            layer_caches.append((kc, vc))
+        tok = jnp.argmax(logits._data[:, -1, :].astype(jnp.float32), axis=-1).astype(jnp.int32)
+        done = tok == eos_token_id if eos_token_id is not None else jnp.zeros((b,), bool)
+
+        named = list(self.named_parameters())
+
+        @jax.jit
+        def step(param_arrays, tok, caches, tables, lens):
+            saved = [p._data for _, p in named]
+            try:
+                for (_n, p), a in zip(named, param_arrays):
+                    p._data = a
+                pkv = [
+                    (Tensor(kc), Tensor(vc), Tensor(tables), Tensor(lens))
+                    for kc, vc in caches
+                ]
+                with paddle_tpu.no_grad():
+                    step_logits, new_caches = self(
+                        Tensor(tok[:, None]),
+                        past_key_values=pkv,
+                        use_cache=True,
+                        cache_position=Tensor(lens),
+                    )
+                nxt = jnp.argmax(
+                    step_logits._data[:, -1, :].astype(jnp.float32), axis=-1
+                ).astype(jnp.int32)
+                out_caches = [(c[0]._data, c[1]._data) for c in new_caches]
+                return nxt, out_caches
+            finally:
+                for (_n, p), s_ in zip(named, saved):
+                    p._data = s_
+
+        arrays = [p._data for _, p in named]
+        out_toks = [tok]
+        for _ in range(max_new_tokens - 1):
+            for i in range(b):
+                mgr.allocate(i, 1)
+            tables = mgr.block_table(range(b))
+            nxt, layer_caches = step(arrays, tok, layer_caches, tables, lens)
+            lens = lens + 1
+            nxt = jnp.where(done, jnp.int32(pad_token_id), nxt)
+            if eos_token_id is not None:
+                done = done | (nxt == eos_token_id)
+            out_toks.append(nxt)
+            tok = nxt
+        for i in range(b):
+            mgr.free(i)
+        return Tensor(jnp.concatenate([ids] + [t[:, None] for t in out_toks], axis=1))
+
     # traced: runs once per (shape, sampling config), then pure XLA
     def _generate_impl(
         self,

@@ -131,6 +131,34 @@ class LlamaAttention(nn.Layer):
         q = reshape(self.q_proj(hidden_states), [b, s, self.num_heads, self.head_dim])
         k = reshape(self.k_proj(hidden_states), [b, s, self.num_kv_heads, self.head_dim])
         v = reshape(self.v_proj(hidden_states), [b, s, self.num_kv_heads, self.head_dim])
+        if (
+            cache_position is not None
+            and past_key_value is not None
+            and len(past_key_value) == 4
+        ):
+            # paged decode: past is (key_cache [NB,BS,HK,D], value_cache,
+            # block_tables [B,MBS], seq_lens [B]) — vLLM-style serving cache
+            # (reference `block_multihead_attention_` fused_ops.yaml:45).
+            # Positions are ragged per sequence: rope tables gather per-seq.
+            from paddle_tpu.core.tensor import Tensor as _T
+            from paddle_tpu.incubate.nn.functional import block_multihead_attention
+
+            kc, vc, tables, lens = past_key_value
+            lens_t = lens if isinstance(lens, _T) else _T(lens)
+            lens_arr = lens_t._data
+            cos, sin = self.rotary_emb(s, lens_t)  # ragged: [B, 1, 1, D]
+            q, k, _ = fused_rotary_position_embedding(q, k, None, sin=sin, cos=cos)
+            out_a, kc2, vc2 = block_multihead_attention(
+                q._data,
+                k._data,
+                v._data,
+                kc._data if isinstance(kc, _T) else kc,
+                vc._data if isinstance(vc, _T) else vc,
+                tables._data if isinstance(tables, _T) else tables,
+                lens_arr,
+            )
+            out = self.o_proj(reshape(_T(out_a), [b, s, self.num_heads * self.head_dim]))
+            return (out, (_T(kc2), _T(vc2), tables, lens)) if use_cache else out
         if cache_position is not None and past_key_value is not None:
             # static-cache decode: past is a FIXED [B, S_max, HK, D] buffer
             # pair; append this step's K/V at cache_position and attend with a
