@@ -1,5 +1,6 @@
 """``paddle_tpu.autograd`` (reference ``python/paddle/autograd``)."""
 
+from paddle_tpu.autograd.functional import hessian, jacobian, jvp, vjp  # noqa: F401
 from paddle_tpu.autograd.py_layer import PyLayer, PyLayerContext  # noqa: F401
 from paddle_tpu.core.autograd import grad  # noqa: F401
 from paddle_tpu.core.autograd import run_backward as _run_backward

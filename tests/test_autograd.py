@@ -280,3 +280,55 @@ def test_first_order_backward_through_inplace_on_nonleaf():
     y.scale_(2.0)
     y.sum().backward()
     np.testing.assert_allclose(x.grad.numpy(), 4 * np.array([1.0, 4.0]), rtol=1e-6)
+
+
+class TestFunctionalAutograd:
+    """jacobian/hessian/jvp/vjp (reference autograd.py:461 +
+    incubate.autograd): numpy oracles on small closed forms."""
+
+    def test_jacobian(self):
+        def f(x):
+            return x * x * paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+
+        x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+        J = paddle.autograd.jacobian(f, x)
+        ref = np.diag(2 * np.array([1.0, 2.0, 3.0]) * np.array([1.0, 2.0, 3.0]))
+        np.testing.assert_allclose(np.asarray(J.numpy()), ref, rtol=1e-5)
+        Jf = paddle.autograd.jacobian(f, x, mode="fwd")
+        np.testing.assert_allclose(np.asarray(Jf.numpy()), ref, rtol=1e-5)
+
+    def test_hessian(self):
+        def f(x):
+            return (x * x * x).sum()
+
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        H = paddle.autograd.hessian(f, x)
+        np.testing.assert_allclose(np.asarray(H.numpy()), np.diag([6.0, 12.0]), rtol=1e-5)
+        with pytest.raises(ValueError, match="scalar"):
+            paddle.autograd.hessian(lambda x: x * 2, x)
+
+    def test_jvp_vjp(self):
+        def f(x):
+            return paddle.sin(x)
+
+        x = paddle.to_tensor(np.array([0.5, 1.0], np.float32))
+        v = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        out, tang = paddle.autograd.jvp(f, x, v)
+        np.testing.assert_allclose(np.asarray(out.numpy()), np.sin([0.5, 1.0]), rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(tang.numpy()), np.cos([0.5, 1.0]) * [1.0, 2.0], rtol=1e-5
+        )
+        out2, grads = paddle.autograd.vjp(f, x, v)
+        np.testing.assert_allclose(
+            np.asarray(grads.numpy()), np.cos([0.5, 1.0]) * [1.0, 2.0], rtol=1e-5
+        )
+
+    def test_multi_input_jacobian(self):
+        def f(a, b):
+            return a * b
+
+        a = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        b = paddle.to_tensor(np.array([3.0, 4.0], np.float32))
+        Ja, Jb = paddle.autograd.jacobian(f, [a, b])
+        np.testing.assert_allclose(np.asarray(Ja.numpy()), np.diag([3.0, 4.0]), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(Jb.numpy()), np.diag([1.0, 2.0]), rtol=1e-6)
