@@ -454,21 +454,20 @@ def _bench_resnet_pipeline(paddle, platform: str) -> dict:
             opt.clear_grad()
             return loss
 
-        # warmup epoch fragment (compile + settle workers)
-        it = iter(loader)
-        xb, yb = next(it)
-        lv = float(step(model, opt, xb.astype("bfloat16" if platform == "tpu" else "float32"), yb))
-        n_done = 0
+        dt_dtype = "bfloat16" if platform == "tpu" else "float32"
+        # warmup: one FULL epoch (compile + settle workers). Epochs always
+        # drain completely — a mid-epoch break would tear down the persistent
+        # pool and let leftover results poison the timed epoch.
+        last = None
+        for xb, yb in loader:
+            last = step(model, opt, xb.astype(dt_dtype), yb)
+        float(last)
         t0 = time.perf_counter()
-        while n_done < steps:
+        n_done = 0
+        while n_done < steps:  # whole timed epochs until enough steps
             for xb, yb in loader:
-                last = step(
-                    model, opt,
-                    xb.astype("bfloat16" if platform == "tpu" else "float32"), yb,
-                )
+                last = step(model, opt, xb.astype(dt_dtype), yb)
                 n_done += 1
-                if n_done >= steps:
-                    break
         lv = float(last)
         dt = time.perf_counter() - t0
         assert np.isfinite(lv), f"non-finite resnet loss {lv}"
@@ -477,7 +476,7 @@ def _bench_resnet_pipeline(paddle, platform: str) -> dict:
             pool.shutdown()
         return {
             "metric": "resnet_train_images_per_sec_with_input_pipeline",
-            "value": round(batch * steps / dt, 1),
+            "value": round(batch * n_done / dt, 1),
             "unit": "images/s",
             "batch": batch,
             "image": hw,

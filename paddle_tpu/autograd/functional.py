@@ -66,7 +66,16 @@ def jacobian(
     xs_list = [xs] if single else list(xs)
     arrays = [_unwrap(x) for x in xs_list]
     jac_t = jax.jacrev if mode == "rev" else jax.jacfwd
-    out = jac_t(_functionalize(func), argnums=tuple(range(len(arrays))))(*arrays)
+    fn = _functionalize(func)
+    jac_fn = jac_t(fn, argnums=tuple(range(len(arrays))))
+    if batch_axis is not None:
+        # batched Jacobian [B, out, in] (reference batch_axis=0 semantics):
+        # vmap over the batch instead of materializing the O(B^2) cross-batch
+        # Jacobian with its zero blocks
+        if batch_axis != 0:
+            raise NotImplementedError("jacobian supports batch_axis=0 or None")
+        jac_fn = jax.vmap(jac_fn)
+    out = jac_fn(*arrays)
     out = out[0] if single and isinstance(out, tuple) and len(out) == 1 else out
     return _wrap(out)
 
@@ -88,8 +97,15 @@ def hessian(func: Callable, xs: Any, batch_axis: Any = None) -> Any:
             )
         return jnp.reshape(out, ())
 
-    h = jax.jacfwd(jax.jacrev(scalar_fn, argnums=tuple(range(len(arrays)))),
-                   argnums=tuple(range(len(arrays))))(*arrays)
+    hess_fn = jax.jacfwd(
+        jax.jacrev(scalar_fn, argnums=tuple(range(len(arrays)))),
+        argnums=tuple(range(len(arrays))),
+    )
+    if batch_axis is not None:
+        if batch_axis != 0:
+            raise NotImplementedError("hessian supports batch_axis=0 or None")
+        hess_fn = jax.vmap(hess_fn)
+    h = hess_fn(*arrays)
     if single:
         return _wrap(h[0][0])
     return _wrap(h)
@@ -118,11 +134,18 @@ def vjp(func: Callable, xs: Any, v: Any = None) -> Tuple[Any, Any]:
     arrays = [_unwrap(x) for x in xs_list]
     out, pullback = jax.vjp(_functionalize(func), *arrays)
     if v is None:
-        cot = jnp.ones_like(out) if not isinstance(out, (list, tuple)) else type(out)(
-            jnp.ones_like(o) for o in out
-        )
+        cot = jax.tree_util.tree_map(jnp.ones_like, out)
     else:
-        cot = _unwrap(v)
+        # normalize the user cotangent onto the OUTPUT's pytree structure —
+        # paddle convention passes multi-output v as a list, while the
+        # function may return a tuple
+        out_leaves, out_tree = jax.tree_util.tree_flatten(out)
+        v_items = list(v) if isinstance(v, (list, tuple)) else [v]
+        if len(v_items) != len(out_leaves):
+            raise ValueError(
+                f"vjp cotangent has {len(v_items)} leaves; output has {len(out_leaves)}"
+            )
+        cot = jax.tree_util.tree_unflatten(out_tree, [_unwrap(t) for t in v_items])
     grads = pullback(cot)
     grads = grads[0] if single and len(grads) == 1 else grads
     return _wrap(out), _wrap(grads)

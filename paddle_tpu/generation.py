@@ -171,9 +171,18 @@ class GenerationMixin:
         done = tok == eos_token_id if eos_token_id is not None else jnp.zeros((b,), bool)
 
         named = list(self.named_parameters())
+        # one compiled decode program per geometry, cached across calls
+        # (re-jitting per request would pay a full XLA compile per serve)
+        step_cache = getattr(self, "_paged_step_cache", None)
+        if step_cache is None:
+            step_cache = {}
+            object.__setattr__(self, "_paged_step_cache", step_cache)
+        step_key = (b, L, num_blocks, block_size, mbs, str(dtype))
+        if step_key not in step_cache and len(step_cache) >= 8:
+            step_cache.pop(next(iter(step_cache)))
 
         @jax.jit
-        def step(param_arrays, tok, caches, tables, lens):
+        def _paged_step(param_arrays, tok, caches, tables, lens):
             saved = [p._data for _, p in named]
             try:
                 for (_n, p), a in zip(named, param_arrays):
@@ -197,6 +206,8 @@ class GenerationMixin:
             finally:
                 for (_n, p), s_ in zip(named, saved):
                     p._data = s_
+
+        step = step_cache.setdefault(step_key, _paged_step)
 
         arrays = [p._data for _, p in named]
         out_toks = [tok]

@@ -46,11 +46,36 @@ class BlockKVCache:
         self.block_size = int(block_size)
         self.num_blocks = int(num_blocks)
         self.max_blocks_per_seq = int(max_blocks_per_seq)
-        self.key_cache = jnp.zeros((num_blocks, block_size, num_heads, head_dim), dtype)
-        self.value_cache = jnp.zeros((num_blocks, block_size, num_heads, head_dim), dtype)
+        self._shape = (int(num_blocks), int(block_size), int(num_heads), int(head_dim))
+        self._dtype = dtype
+        # device buffers are LAZY: callers that only use the host-side
+        # allocator/tables (e.g. generate_paged, which owns per-layer pools)
+        # never pay this HBM
+        self._key_cache = None
+        self._value_cache = None
         self._free: List[int] = list(range(num_blocks - 1, -1, -1))
         self._tables: dict = {}  # seq id -> list of physical block ids
         self._lens: dict = {}  # seq id -> tokens stored
+
+    @property
+    def key_cache(self) -> Any:
+        if self._key_cache is None:
+            self._key_cache = jnp.zeros(self._shape, self._dtype)
+        return self._key_cache
+
+    @key_cache.setter
+    def key_cache(self, v: Any) -> None:
+        self._key_cache = v
+
+    @property
+    def value_cache(self) -> Any:
+        if self._value_cache is None:
+            self._value_cache = jnp.zeros(self._shape, self._dtype)
+        return self._value_cache
+
+    @value_cache.setter
+    def value_cache(self, v: Any) -> None:
+        self._value_cache = v
 
     # -- allocator ----------------------------------------------------------
     def allocate(self, seq_id: int, num_tokens: int) -> None:

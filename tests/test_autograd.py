@@ -332,3 +332,29 @@ class TestFunctionalAutograd:
         Ja, Jb = paddle.autograd.jacobian(f, [a, b])
         np.testing.assert_allclose(np.asarray(Ja.numpy()), np.diag([3.0, 4.0]), rtol=1e-6)
         np.testing.assert_allclose(np.asarray(Jb.numpy()), np.diag([1.0, 2.0]), rtol=1e-6)
+
+    def test_batched_jacobian_and_hessian(self):
+        def f(x):
+            return (x * x).sum(-1)  # per-sample scalar
+
+        xb = paddle.to_tensor(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+        Jb = paddle.autograd.jacobian(f, xb, 0)
+        np.testing.assert_allclose(
+            np.asarray(Jb.numpy()), 2 * np.array([[1.0, 2.0], [3.0, 4.0]]), rtol=1e-5
+        )
+        Hb = paddle.autograd.hessian(lambda x: (x * x).sum(), xb, 0)
+        np.testing.assert_allclose(
+            np.asarray(Hb.numpy()), np.stack([2 * np.eye(2)] * 2), rtol=1e-5
+        )
+        with pytest.raises(NotImplementedError):
+            paddle.autograd.jacobian(f, xb, 1)
+
+    def test_vjp_multi_output_list_cotangent(self):
+        def f(x):
+            return x, 2 * x
+
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        v1 = paddle.to_tensor(np.array([1.0, 1.0], np.float32))
+        v2 = paddle.to_tensor(np.array([10.0, 10.0], np.float32))
+        _, g = paddle.autograd.vjp(f, x, [v1, v2])  # list v onto tuple output
+        np.testing.assert_allclose(np.asarray(g.numpy()), [21.0, 21.0], rtol=1e-6)
