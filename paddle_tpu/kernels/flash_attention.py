@@ -172,6 +172,11 @@ def _run_fwd(q, k, v, idx, *, sq, sk, scale, causal, blk_q, blk_k, interpret):
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
+        # every (batch, head, q-block) cell is independent — Mosaic may split
+        # them across TensorCores (megacore on v4/v5p)
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel")
+        ),
         in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, blk_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
@@ -327,6 +332,9 @@ def _run_bwd(q, k, v, idx, g, out, lse, *, sq, sk, scale, causal, blk_q, blk_k, 
     dq = pl.pallas_call(
         dq_kernel,
         grid=(b, h, sq_pad // blk_q),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel")
+        ),
         in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, 1, blk_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b, h, sq_pad, d), q.dtype),
@@ -369,6 +377,9 @@ def _run_bwd(q, k, v, idx, g, out, lse, *, sq, sk, scale, causal, blk_q, blk_k, 
     dk_h, dv_h = pl.pallas_call(
         dkv_kernel,
         grid=(b, h, sk_pad // blk_k),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel")
+        ),
         in_specs=dkv_specs,
         out_specs=[
             pl.BlockSpec((1, 1, blk_k, d), lambda bi, hi, ki: (bi, hi, ki, 0)),

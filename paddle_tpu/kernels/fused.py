@@ -58,6 +58,8 @@ def _make_rms(rows, h, eps, blk_rows, interpret):
         return pl.pallas_call(
             functools.partial(_rms_fwd_kernel, eps=eps),
             grid=grid,
+            # independent row blocks: megacore-splittable
+            compiler_params=pltpu.CompilerParams(dimension_semantics=("parallel",)),
             in_specs=[
                 pl.BlockSpec((1, blk_rows, h), lambda i: (0, i, 0)),
                 pl.BlockSpec((h,), lambda i: (0,)),
@@ -87,6 +89,9 @@ def _make_rms(rows, h, eps, blk_rows, interpret):
         dx, dw = pl.pallas_call(
             functools.partial(_rms_bwd_kernel, eps=eps),
             grid=grid,
+            # dw accumulates across the grid in one output block: the grid
+            # MUST run sequentially ("arbitrary"), never be split
+            compiler_params=pltpu.CompilerParams(dimension_semantics=("arbitrary",)),
             in_specs=[
                 pl.BlockSpec((1, blk_rows, h), lambda i: (0, i, 0)),
                 pl.BlockSpec((h,), lambda i: (0,)),
@@ -188,6 +193,8 @@ def _make_rope(bh, s, d, interpret):
         return pl.pallas_call(
             kernel,
             grid=grid,
+            # independent (batch*head) cells
+            compiler_params=pltpu.CompilerParams(dimension_semantics=("parallel",)),
             in_specs=in_specs,
             out_specs=out_spec,
             out_shape=jax.ShapeDtypeStruct((bh, 1, s, d), xh.dtype),
