@@ -207,7 +207,10 @@ class Predictor:
 
         layer = config._layer
         layer.eval()
-        params = {k: v._data for k, v in layer.state_dict().items()}
+        # host-normalize: mesh-sharded training weights must not bake an
+        # N-device calling convention into the serving program
+        params = {k: np.asarray(v._data) for k, v in layer.state_dict().items()}
+        params = {k: jnp.asarray(v) for k, v in params.items()}
         tgt = None
         if config.precision in (PrecisionType.Bfloat16, PrecisionType.Half, PrecisionType.Int8):
             tgt = jnp.float16 if config.precision == PrecisionType.Half else jnp.bfloat16

@@ -249,6 +249,17 @@ class StaticFunction:
                     if p.name in st["acc"]:
                         opt._accumulators[id(p)] = st["acc"][p.name]
                 opt._step_count += 1
+            # the key comes back replicated over the step's mesh; committing
+            # it that way would silently place every LATER tensor creation on
+            # the mesh (fresh layers, exports, ... inherit 8-device
+            # shardings). Round-trip the 16-byte key through host so it
+            # becomes an UNCOMMITTED default-device array — compatible with
+            # both later single-device work and the next sharded step.
+            sharding = getattr(new_rng, "sharding", None)
+            if sharding is not None and len(getattr(sharding, "device_set", ())) > 1:
+                import numpy as _np
+
+                new_rng = jnp.asarray(_np.asarray(new_rng))
             _rng.default_generator()._key = new_rng
         return jax.tree_util.tree_map(
             lambda o: Tensor(o) if isinstance(o, jax.Array) else o, out_arrays

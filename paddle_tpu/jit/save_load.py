@@ -71,8 +71,15 @@ def _export_layer(layer: Any, input_spec: Sequence[Any], params: dict) -> "jax.e
     """
     import sys
 
+    import numpy as _np
+
     pure = _pure_forward(layer)
     specs = specs_from_input_spec(input_spec)
+    # normalize params to HOST buffers: training may have left them sharded
+    # over a device mesh, and exporting mesh-placed weights records an
+    # N-device calling convention that a single-device serving context
+    # cannot satisfy. The bundle must be mesh-agnostic.
+    params = jax.tree_util.tree_map(lambda a: _np.asarray(a), params)
     from paddle_tpu.core import autograd as _ag
 
     with _ag.set_grad_enabled(False):

@@ -135,3 +135,54 @@ def test_jit_save_load(tmp_path):
         loaded.state_dict()["weight"].numpy(), model.weight.numpy()
     )
     assert loaded.program_text is not None and "stablehlo" in loaded.program_text or "module" in loaded.program_text
+
+
+def test_rng_key_not_mesh_committed_after_sharded_step():
+    """r4 drive regression: a jitted sharded step hands the global RNG key
+    back replicated over the mesh; committing it that way silently placed
+    every LATER tensor creation on the mesh (fresh layers inherited 8-device
+    shardings, jit.save recorded an 8-device calling convention that broke
+    single-device serving)."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.placements import Replicate, Shard
+    from paddle_tpu.static import InputSpec
+
+    paddle.seed(0)
+    mesh = dist.ProcessMesh(shape=[8], dim_names=["dp"])
+    lin = nn.Linear(8, 8)
+    for p in lin.parameters():
+        from paddle_tpu.distributed.api import apply_placement
+
+        apply_placement(p, mesh, [Replicate()])
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=lin.parameters())
+
+    @paddle.jit.to_static
+    def step(lin, opt, x):
+        y = nn.functional.dropout(lin(x), p=0.1, training=True)  # consumes RNG
+        loss = (y * y).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    x = paddle.to_tensor(np.random.rand(8, 8).astype(np.float32))
+    x = dist.shard_tensor(x, mesh, [Shard(0)])
+    float(step(lin, opt, x))
+
+    # fresh params after the sharded step stay single-device
+    fresh = nn.Linear(4, 4)
+    for p in fresh.parameters():
+        assert not isinstance(p._data.sharding, NamedSharding), (
+            "fresh layer inherited a mesh sharding via the RNG key"
+        )
+    # and exports stay mesh-agnostic (1-device calling convention)
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        fresh.eval()
+        paddle.jit.save(fresh, f"{d}/m", input_spec=[InputSpec([2, 4], "float32")])
+        loaded = paddle.jit.load(f"{d}/m")
+        assert loaded._exported.nr_devices == 1
