@@ -121,7 +121,6 @@ class AutoTuner:
     def _build_queue(self) -> List[Dict[str, Any]]:
         cand = default_candidates(self.tuner_cfg)
         out: List[Dict[str, Any]] = []
-        seen = set()
         for mp, pp, sd, st, mbs, rc in itertools.product(
             cand["mp_degree"],
             cand["pp_degree"],
@@ -150,10 +149,6 @@ class AutoTuner:
                 "use_recompute": rc,
                 "acc_steps": (gbs // dp) // mbs,
             }
-            key = tuple(sorted((k, v) for k, v in cfg.items()))
-            if key in seen:
-                continue
-            seen.add(key)
             if prune_by_memory(cfg, self.tuner_cfg):
                 continue
             out.append(cfg)
