@@ -32,6 +32,7 @@ __all__ = [
     "pinv",
     "solve",
     "triangular_solve",
+    "lstsq",
     "cholesky_solve",
     "det",
     "slogdet",
@@ -359,3 +360,22 @@ def bincount(x, weights=None, minlength=0, name=None):
 
 
 register_tensor_method("bincount", bincount)
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    """Least-squares solve (reference ``linalg.lstsq``): returns
+    (solution, residuals, rank, singular_values)."""
+    import jax
+
+    from paddle_tpu.core.dispatch import call_op
+    from paddle_tpu.core.tensor import Tensor
+
+    def fn(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank.astype(jnp.int32), sv
+
+    out = call_op("lstsq", fn, x, y)
+    return out
+
+
+register_tensor_method("lstsq", lstsq)

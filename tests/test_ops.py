@@ -274,3 +274,15 @@ class TestR4CoverageOps:
         x.stop_gradient = False
         paddle.take(x, paddle.to_tensor(np.array([0, 5], np.int32))).sum().backward()
         np.testing.assert_allclose(x.grad.numpy(), [[1, 0, 0], [0, 0, 1]])
+
+    def test_lstsq(self):
+        import paddle_tpu.linalg as L
+
+        rng = np.random.default_rng(0)
+        a = rng.normal(size=(6, 3)).astype(np.float32)
+        b = rng.normal(size=(6, 2)).astype(np.float32)
+        sol, res, rank, sv = L.lstsq(paddle.to_tensor(a), paddle.to_tensor(b))
+        ref_sol, _res, ref_rank, ref_sv = np.linalg.lstsq(a, b, rcond=None)
+        np.testing.assert_allclose(np.asarray(sol.numpy()), ref_sol, rtol=1e-3, atol=1e-4)
+        assert int(rank.numpy()) == ref_rank
+        np.testing.assert_allclose(np.asarray(sv.numpy()), ref_sv, rtol=1e-4)
