@@ -46,6 +46,9 @@ class LlamaConfig:
     tie_word_embeddings: bool = False
     use_flash_attention: bool = True
     recompute: bool = False  # per-decoder-layer activation checkpointing
+    # context parallelism: shard the SEQUENCE over the mesh's 'sep' axis and
+    # run ring attention (long-context training; SURVEY §5.7)
+    context_parallel: bool = False
     dtype: str = "bfloat16"
 
     @staticmethod
@@ -180,6 +183,23 @@ class LlamaAttention(nn.Layer):
             k = concat([past_key_value[0], k], axis=1)
             v = concat([past_key_value[1], v], axis=1)
         new_cache = (k, v) if use_cache else None
+        if self.config.context_parallel and not use_cache:
+            from paddle_tpu.distributed.mesh import get_mesh
+
+            mesh = get_mesh()
+            if (
+                mesh is not None
+                and "sep" in mesh.dim_names
+                and mesh.get_dim_size("sep") > 1
+            ):
+                if startend_row_indices is not None:
+                    raise NotImplementedError(
+                        "FlashMask + context parallelism is not supported; "
+                        "ring attention exchanges KV blocks in ring order"
+                    )
+                out = F.ring_flash_attention(q, k, v, causal=True)
+                out = reshape(out, [b, s, self.num_heads * self.head_dim])
+                return self.o_proj(out)
         out = F.flashmask_attention(
             q, k, v, startend_row_indices=startend_row_indices, causal=True
         )
