@@ -42,7 +42,8 @@ def default_candidates(tuner_cfg: Dict[str, Any]) -> Dict[str, List[Any]]:
         v = tuner_cfg.get(key, "auto")
         if v == "auto" or v is None:
             return default
-        return list(v) if isinstance(v, (list, tuple)) else [v]
+        vals = list(v) if isinstance(v, (list, tuple)) else [v]
+        return list(dict.fromkeys(vals))  # user lists may repeat; dedupe
 
     mp_default = [
         d for d in divisor(n)

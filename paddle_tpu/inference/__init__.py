@@ -205,12 +205,13 @@ class Predictor:
         from paddle_tpu.core import autograd as _ag
         from paddle_tpu.jit.save_load import _pure_forward, specs_from_input_spec
 
+        from paddle_tpu.jit.save_load import decommit_from_mesh
+
         layer = config._layer
         layer.eval()
-        # host-normalize: mesh-sharded training weights must not bake an
-        # N-device calling convention into the serving program
-        params = {k: np.asarray(v._data) for k, v in layer.state_dict().items()}
-        params = {k: jnp.asarray(v) for k, v in params.items()}
+        # mesh-sharded training weights must not bake an N-device calling
+        # convention into the serving program
+        params = decommit_from_mesh({k: v._data for k, v in layer.state_dict().items()})
         tgt = None
         if config.precision in (PrecisionType.Bfloat16, PrecisionType.Half, PrecisionType.Int8):
             tgt = jnp.float16 if config.precision == PrecisionType.Half else jnp.bfloat16

@@ -47,6 +47,20 @@ def _pure_forward(layer: Any) -> Callable:
     return pure_forward
 
 
+def decommit_from_mesh(tree: Any) -> Any:
+    """Round-trip multi-device-sharded arrays through host so they become
+    uncommitted single-device arrays (mesh-agnostic). Single-device arrays
+    pass through untouched — no gratuitous D2H copy."""
+
+    def fix(a: Any) -> Any:
+        sharding = getattr(a, "sharding", None)
+        if sharding is not None and len(getattr(sharding, "device_set", ())) > 1:
+            return jnp.asarray(np.asarray(a))
+        return a
+
+    return jax.tree_util.tree_map(fix, tree)
+
+
 def specs_from_input_spec(
     input_spec: Sequence[Any], float_dtype: Any = None
 ) -> List[jax.ShapeDtypeStruct]:
@@ -71,15 +85,13 @@ def _export_layer(layer: Any, input_spec: Sequence[Any], params: dict) -> "jax.e
     """
     import sys
 
-    import numpy as _np
-
     pure = _pure_forward(layer)
     specs = specs_from_input_spec(input_spec)
-    # normalize params to HOST buffers: training may have left them sharded
-    # over a device mesh, and exporting mesh-placed weights records an
-    # N-device calling convention that a single-device serving context
-    # cannot satisfy. The bundle must be mesh-agnostic.
-    params = jax.tree_util.tree_map(lambda a: _np.asarray(a), params)
+    # training may have left params sharded over a device mesh; exporting
+    # mesh-placed weights records an N-device calling convention that a
+    # single-device serving context cannot satisfy. Decommit to keep the
+    # bundle mesh-agnostic.
+    params = decommit_from_mesh(params)
     from paddle_tpu.core import autograd as _ag
 
     with _ag.set_grad_enabled(False):

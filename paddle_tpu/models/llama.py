@@ -183,7 +183,11 @@ class LlamaAttention(nn.Layer):
             k = concat([past_key_value[0], k], axis=1)
             v = concat([past_key_value[1], v], axis=1)
         new_cache = (k, v) if use_cache else None
-        if self.config.context_parallel and not use_cache:
+        if (
+            self.config.context_parallel
+            and not use_cache
+            and past_key_value is None  # ring assumes sq == sk (no prefix KV)
+        ):
             from paddle_tpu.distributed.mesh import get_mesh
 
             mesh = get_mesh()

@@ -364,18 +364,26 @@ register_tensor_method("bincount", bincount)
 
 def lstsq(x, y, rcond=None, driver=None, name=None):
     """Least-squares solve (reference ``linalg.lstsq``): returns
-    (solution, residuals, rank, singular_values)."""
-    import jax
-
+    (solution, residuals, rank, singular_values). Residuals are empty for
+    underdetermined systems (m <= n), matching numpy/the reference; for tall
+    rank-deficient systems (data-dependent rank < n, which static shapes
+    cannot express) the computed residual vector is returned instead of the
+    reference's empty tensor."""
     from paddle_tpu.core.dispatch import call_op
-    from paddle_tpu.core.tensor import Tensor
+
+    if driver not in (None, "gels", "gelsy", "gelsd", "gelss"):
+        raise ValueError(f"unknown lstsq driver {driver!r}")
+
+    m = x.shape[-2]
+    n = x.shape[-1]
 
     def fn(a, b):
         sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        if m <= n:
+            res = jnp.zeros((0,), sol.dtype)
         return sol, res, rank.astype(jnp.int32), sv
 
-    out = call_op("lstsq", fn, x, y)
-    return out
+    return call_op("lstsq", fn, x, y)
 
 
 register_tensor_method("lstsq", lstsq)

@@ -286,3 +286,14 @@ class TestR4CoverageOps:
         np.testing.assert_allclose(np.asarray(sol.numpy()), ref_sol, rtol=1e-3, atol=1e-4)
         assert int(rank.numpy()) == ref_rank
         np.testing.assert_allclose(np.asarray(sv.numpy()), ref_sv, rtol=1e-4)
+
+    def test_lstsq_underdetermined_empty_residuals(self):
+        import paddle_tpu.linalg as L
+
+        rng = np.random.default_rng(1)
+        a = rng.normal(size=(2, 4)).astype(np.float32)
+        b = rng.normal(size=(2, 1)).astype(np.float32)
+        _sol, res, _rank, _sv = L.lstsq(paddle.to_tensor(a), paddle.to_tensor(b))
+        assert list(res.shape) == [0]  # numpy/reference semantics
+        with pytest.raises(ValueError, match="driver"):
+            L.lstsq(paddle.to_tensor(a), paddle.to_tensor(b), driver="bogus")
