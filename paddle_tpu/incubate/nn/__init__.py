@@ -1,1 +1,2 @@
 from paddle_tpu.incubate.nn import functional  # noqa: F401
+from paddle_tpu.incubate.nn.fused_transformer import FusedMultiTransformer  # noqa: F401
