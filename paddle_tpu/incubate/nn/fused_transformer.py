@@ -153,6 +153,7 @@ class FusedMultiTransformer(Layer):
         cache: Optional[Tuple[Tensor, Tensor]],
         time_step: Optional[Tensor],
         use_cache: bool,
+        rotary_embs: Any = None,
     ) -> Any:
         b, s, e = h.shape
         nh, hd = self.num_heads, self.head_dim
@@ -160,6 +161,29 @@ class FusedMultiTransformer(Layer):
         qkv = h @ qkv_w.t() + reshape(self.qkv_biases[i], [3 * nh * hd])
         qkv = reshape(qkv, [b, s, 3, nh, hd])
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if rotary_embs is not None:
+            # (cos, sin) tables [max_pos, head_dim]: prefill slices [0:s);
+            # decode gathers the row at time_step (same position for the
+            # whole batch — the reference decode convention)
+            from paddle_tpu.incubate.nn.functional import (
+                fused_rotary_position_embedding,
+            )
+
+            cos_tab, sin_tab = rotary_embs
+            cos_a = cos_tab._data if isinstance(cos_tab, Tensor) else jnp.asarray(cos_tab)
+            sin_a = sin_tab._data if isinstance(sin_tab, Tensor) else jnp.asarray(sin_tab)
+            if cache is not None and time_step is not None:
+                import jax
+
+                ts = time_step._data if isinstance(time_step, Tensor) else jnp.asarray(time_step)
+                cos_s = jax.lax.dynamic_slice_in_dim(cos_a, ts.reshape(()), s, axis=0)
+                sin_s = jax.lax.dynamic_slice_in_dim(sin_a, ts.reshape(()), s, axis=0)
+            else:
+                cos_s, sin_s = cos_a[:s], sin_a[:s]
+            q, k, _ = fused_rotary_position_embedding(
+                q, k, None, sin=Tensor(sin_s), cos=Tensor(cos_s),
+                use_neox_rotary_style=self.use_neox_rotary_style,
+            )
         if cache is not None and time_step is not None:
             from paddle_tpu.incubate.nn.functional import masked_multihead_attention
 
@@ -191,7 +215,7 @@ class FusedMultiTransformer(Layer):
             x = self._norm(h, self.ln_scales[i], self.ln_biases[i] if self.ln_biases else None)
             attn_out, cache_i = self._attn(
                 i, x, attn_mask, caches[i] if caches is not None else None,
-                time_step, use_cache,
+                time_step, use_cache, rotary_embs,
             )
             attn_out = attn_out @ self.linear_weights[i] + self.linear_biases[i]
             h = residual + attn_out

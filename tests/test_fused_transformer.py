@@ -62,3 +62,35 @@ def test_prefill_then_decode_matches_full_forward(norm):
 def test_post_layernorm_rejected():
     with pytest.raises(NotImplementedError):
         FusedMultiTransformer(E, H, FF, normalize_before=False)
+
+
+def test_rotary_embs_prefill_decode_parity():
+    """rotary_embs (cos, sin) are applied in both prefill and cached decode;
+    the cached step must match the full rotated forward."""
+    import jax.numpy as jnp
+
+    m = _model(norm="rmsnorm")
+    rng = np.random.default_rng(2)
+    x = paddle.to_tensor(rng.normal(size=(B, S, E)).astype(np.float32))
+    hd = E // H
+    inv = 1.0 / (10000 ** (np.arange(0, hd, 2) / hd))
+    t = np.arange(32)[:, None] * inv[None, :]
+    cos = paddle.to_tensor(np.concatenate([np.cos(t), np.cos(t)], -1).astype(np.float32))
+    sin = paddle.to_tensor(np.concatenate([np.sin(t), np.sin(t)], -1).astype(np.float32))
+
+    full = m(x, rotary_embs=(cos, sin)).numpy()
+
+    prefix = paddle.to_tensor(np.asarray(x.numpy())[:, : S - 1])
+    hid, kv_list = m.forward(prefix, rotary_embs=(cos, sin), time_step=paddle.to_tensor(S - 1))
+    pads = [
+        (
+            paddle.to_tensor(jnp.pad(k._data, ((0, 0), (0, 1), (0, 0), (0, 0)))),
+            paddle.to_tensor(jnp.pad(v._data, ((0, 0), (0, 1), (0, 0), (0, 0)))),
+        )
+        for k, v in kv_list
+    ]
+    last = paddle.to_tensor(np.asarray(x.numpy())[:, S - 1 : S])
+    step_out, _ = m(last, caches=pads, time_step=paddle.to_tensor(S - 1), rotary_embs=(cos, sin))
+    np.testing.assert_allclose(
+        np.asarray(step_out.numpy())[:, 0], full[:, -1], rtol=2e-4, atol=2e-5
+    )
