@@ -13,6 +13,7 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -20,10 +21,54 @@ import numpy as np
 
 BASELINE_TOKENS_PER_SEC_PER_CHIP = 2500.0
 
+# Persisted Pallas block-size autotune cache: a short accelerator-tunnel
+# window must not be burned re-sweeping block sizes, so sweep results are
+# written next to the bench and committed (kernels/autotune.py loads it).
+AUTOTUNE_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)), "autotune_cache.json")
 
-def _fail_json(error: str) -> None:
+
+def _retry_loop(retries: int, wait: float) -> None:
+    """Re-run the bench in a child process until the backend comes up.
+
+    Retrying inside one process is unsafe: a hung backend-init thread holds
+    jax's backend lock forever, so the parent re-execs itself (child runs
+    with BENCH_NO_RETRY=1). Only backend-init failures are retried — a real
+    bench error propagates immediately."""
+    import subprocess
+
+    env = dict(os.environ, BENCH_NO_RETRY="1")
+    for attempt in range(retries + 1):
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, stdout=subprocess.PIPE, text=True,
+        )
+        out = proc.stdout.strip()
+        tail = out.rsplit("\n", 1)[-1] if out else ""
+        try:
+            rec = json.loads(tail)
+        except ValueError:
+            rec = {"error": f"no JSON line (rc={proc.returncode})"}
+        err = str(rec.get("error", ""))
+        backend_down = proc.returncode != 0 and bool(rec.get("backend_down"))
+        if not backend_down or attempt == retries:
+            if out:
+                print(out, flush=True)
+            else:
+                _fail_json(err or f"bench child produced no output (rc={proc.returncode})")
+            sys.exit(proc.returncode)
+        print(
+            f"bench: backend down (attempt {attempt + 1}/{retries + 1}), "
+            f"retrying in {wait:.0f}s: {err[:200]}",
+            file=sys.stderr, flush=True,
+        )
+        time.sleep(wait)
+
+
+def _fail_json(error: str, backend_down: bool = False) -> None:
     """One parseable failure line on stdout — the driver records stdout
-    verbatim, so every exit path must leave a JSON record."""
+    verbatim, so every exit path must leave a JSON record. ``backend_down``
+    tags backend-init failures explicitly so the retry wrapper never has to
+    guess from message text."""
     print(
         json.dumps(
             {
@@ -32,6 +77,7 @@ def _fail_json(error: str) -> None:
                 "unit": "tokens/s/chip",
                 "vs_baseline": 0.0,
                 "error": error[:500],
+                "backend_down": backend_down,
             }
         ),
         flush=True,
@@ -138,7 +184,8 @@ def _resolve_backend() -> str:
             result.get(
                 "error",
                 "jax backend initialization timed out (accelerator tunnel down?)",
-            )
+            ),
+            backend_down=True,
         )
         sys.stderr.flush()
         os._exit(1)  # the hung probe thread would block a normal exit
@@ -206,11 +253,19 @@ def main() -> None:
     _preflight_pallas(platform, cfg, seq)
     if platform == "tpu":
         # benchmark-driven Pallas block-size selection; the A/B timing lines
-        # land on stderr (autotune: flash_attention ... -> (bq, bk))
-        import os as _os
+        # land on stderr (autotune: flash_attention ... -> (bq, bk)).
+        # The flags live in kernels.autotune, which kernel modules import
+        # only lazily — register them before set_flags can see them.
+        import paddle_tpu.kernels.autotune  # noqa: F401
 
-        _os.environ.setdefault("PADDLE_TPU_AUTOTUNE_VERBOSE", "1")
-        paddle.set_flags({"FLAGS_use_kernel_autotune": True})
+        os.environ.setdefault("PADDLE_TPU_AUTOTUNE_VERBOSE", "1")
+        paddle.set_flags(
+            {
+                "FLAGS_use_kernel_autotune": True,
+                # committed cache file: re-runs (and retries) skip the sweep
+                "FLAGS_kernel_autotune_cache": AUTOTUNE_CACHE,
+            }
+        )
     paddle.seed(0)
     model = LlamaForCausalLM(cfg).to(dtype="bfloat16")
     n_params = _count_params(model)
@@ -418,9 +473,7 @@ def _bench_resnet_pipeline(paddle, platform: str) -> dict:
         per = n_imgs // classes
         for c in range(classes):
             d = f"{tmp}/class_{c}"
-            import os as _os
-
-            _os.makedirs(d, exist_ok=True)
+            os.makedirs(d, exist_ok=True)
             for i in range(per):
                 np.save(
                     f"{d}/{i}.npy",
@@ -489,6 +542,18 @@ def _bench_resnet_pipeline(paddle, platform: str) -> dict:
 
 
 if __name__ == "__main__":
+    import argparse
+
+    _ap = argparse.ArgumentParser(description=__doc__)
+    _ap.add_argument("--retry", type=int, default=int(os.environ.get("BENCH_RETRY", "2")),
+                     help="re-run the bench this many extra times if backend init fails")
+    _ap.add_argument("--retry-wait", type=float,
+                     default=float(os.environ.get("BENCH_RETRY_WAIT", "60")),
+                     help="seconds between backend-init retries")
+    _args = _ap.parse_args()
+    if _args.retry > 0 and not os.environ.get("BENCH_NO_RETRY"):
+        _retry_loop(_args.retry, _args.retry_wait)
+        raise SystemExit  # _retry_loop always exits; belt-and-braces
     try:
         main()
     except Exception as exc:  # noqa: BLE001
