@@ -254,26 +254,54 @@ class Engine:
             self._train_step = self._build_train_step()
         loader = self._loader(train_data, batch_size, shuffle=True)
         k_steps = max(1, int(self._strategy.gradient_merge.k_steps)) if self._strategy.gradient_merge.enable else 1
-        step_idx = 0  # global (drives gradient-merge k-cycles)
         for _epoch in range(epochs):
             epoch_step = 0
             for batch in loader:
                 parts = [self._shard_batch(b) for b in self._as_batch(batch)]
                 if k_steps > 1:
                     # gradient merge: accumulate k micro-steps, then step once
-                    loss = self._accumulate_step(parts, step_idx, k_steps)
+                    loss = self._accumulate_step(parts, k_steps)
                 else:
                     loss = self._train_step(self._model, self._optimizer, *parts)
                 self.history["loss"].append(float(loss))
-                step_idx += 1
                 epoch_step += 1
                 if steps_per_epoch is not None and epoch_step >= steps_per_epoch:
                     break
+        if k_steps > 1:
+            self._flush_merge_bufs(k_steps)
         if save_dir:
             self.save(save_dir)
         return self.history
 
-    def _accumulate_step(self, parts: Sequence[Any], step_idx: int, k: int) -> Any:
+    def _flush_merge_bufs(self, k: int) -> None:
+        """Apply any partial gradient-merge window left when fit() ends (total
+        steps not a multiple of k). Without this the tail micro-batches'
+        grads would be dropped AND leak into the next fit()'s first window."""
+        count = getattr(self, "_merge_count", 0)
+        if not count or getattr(self, "_merge_bufs", None) is None:
+            self._merge_bufs = None
+            self._merge_count = 0
+            return
+        import warnings
+
+        warnings.warn(
+            f"gradient_merge: applying a partial window of {count}/{k} "
+            "micro-batches at end of fit()",
+            stacklevel=3,
+        )
+        # with avg=True each micro-loss was pre-divided by k; rescale so the
+        # partial window is the mean over `count` micro-batches
+        scale = float(k) / float(count) if self._strategy.gradient_merge.avg else 1.0
+        trainable = [p for p in self._model.parameters() if not p.stop_gradient]
+        for p, g in zip(trainable, self._merge_bufs):
+            if g is not None:
+                p.grad = g * scale if scale != 1.0 else g
+        self._optimizer.step()
+        self._optimizer.clear_grad()
+        self._merge_bufs = None
+        self._merge_count = 0
+
+    def _accumulate_step(self, parts: Sequence[Any], k: int) -> Any:
         """Gradient merge (reference ``gradient_merge_pass``): k jitted
         micro-steps each RETURN their grads (jit state capture does not
         persist ``.grad`` side effects); the Engine accumulates them in device
@@ -304,15 +332,20 @@ class Engine:
 
             self._accum_step_fn = accum_step
             self._merge_bufs = None
+            self._merge_count = 0
         loss, grads = self._accum_step_fn(self._model, *parts)
         if self._merge_bufs is None:
             self._merge_bufs = list(grads)
+            self._merge_count = 1
         else:
             self._merge_bufs = [
                 g if b is None else (b if g is None else b + g)
                 for b, g in zip(self._merge_bufs, grads)
             ]
-        if (step_idx + 1) % k == 0:
+            self._merge_count += 1
+        # key the apply on the ACCUMULATED count, not the global step index —
+        # a steps_per_epoch break mid-window must not desync later windows
+        if self._merge_count >= k:
             trainable = [p for p in self._model.parameters() if not p.stop_gradient]
             for p, g in zip(trainable, self._merge_bufs):
                 if g is not None:
@@ -320,6 +353,7 @@ class Engine:
             self._optimizer.step()
             self._optimizer.clear_grad()
             self._merge_bufs = None
+            self._merge_count = 0
         return loss
 
     def evaluate(
@@ -368,6 +402,10 @@ class Engine:
         outs: List[Any] = []
         for i, batch in enumerate(loader):
             parts = [self._shard_batch(b) for b in self._as_batch(batch)]
+            if test_sample_split is not None:
+                # reference Engine semantics: sample[:split] are the inputs,
+                # sample[split:] are labels — predict feeds inputs only
+                parts = parts[: int(test_sample_split)]
             outs.append(self._pred_step(self._model, *parts))
             if steps is not None and i + 1 >= steps:
                 break

@@ -99,6 +99,9 @@ class FusedMultiTransformer(Layer):
             return self.create_parameter(list(shape), default_initializer=init)
 
         e, ff = embed_dim, dim_feedforward
+        # swiglu is a gated split silu(a)*b, so ffn1 projects to 2*ff (the
+        # reference fused_bias_act layout); everything else keeps width ff
+        ff1 = 2 * ff if activation == "swiglu" else ff
         s1, s2 = 1.0 / np.sqrt(e), 1.0 / np.sqrt(ff)
         self.ln_scales = [_ones((e,)) for _ in range(num_layers)]
         self.ln_biases = [_zeros((e,)) for _ in range(num_layers)] if norm_type == "layernorm" else None
@@ -109,8 +112,8 @@ class FusedMultiTransformer(Layer):
         self.linear_biases = [_zeros((e,)) for _ in range(num_layers)]
         self.ffn_ln_scales = [_ones((e,)) for _ in range(num_layers)]
         self.ffn_ln_biases = [_zeros((e,)) for _ in range(num_layers)] if norm_type == "layernorm" else None
-        self.ffn1_weights = [_w((e, ff), s1) for _ in range(num_layers)]
-        self.ffn1_biases = [_zeros((ff,)) for _ in range(num_layers)]
+        self.ffn1_weights = [_w((e, ff1), s1) for _ in range(num_layers)]
+        self.ffn1_biases = [_zeros((ff1,)) for _ in range(num_layers)]
         self.ffn2_weights = [_w((ff, e), s2) for _ in range(num_layers)]
         self.ffn2_biases = [_zeros((e,)) for _ in range(num_layers)]
         for i in range(num_layers):
@@ -141,8 +144,10 @@ class FusedMultiTransformer(Layer):
             return F.gelu(x)
         if self.activation == "relu":
             return F.relu(x)
-        if self.activation in ("swiglu", "silu"):
-            return x * F.sigmoid(x)
+        if self.activation == "swiglu":
+            return F.swiglu(x)  # gated split: silu(x[..., :ff]) * x[..., ff:]
+        if self.activation == "silu":
+            return F.silu(x)
         raise ValueError(f"unsupported activation {self.activation!r}")
 
     def _attn(
@@ -187,11 +192,33 @@ class FusedMultiTransformer(Layer):
         if cache is not None and time_step is not None:
             from paddle_tpu.incubate.nn.functional import masked_multihead_attention
 
+            if attn_mask is not None:
+                raise NotImplementedError(
+                    "FusedMultiTransformer: attn_mask is not supported in the "
+                    "cached decode path (masking there is governed by "
+                    "time_step); pass attn_mask only for prefill"
+                )
             out, ck, cv = masked_multihead_attention(
                 q, k, v, cache[0], cache[1], time_step
             )
             return reshape(out, [b, s, e]), (ck, cv)
-        out, _ = F.flash_attention(q, k, v, causal=True)
+        if attn_mask is not None:
+            # Reference semantics (fused_transformer.py:1071): the caller's
+            # attn_mask IS the full visibility mask (causal+padding combined),
+            # so it replaces the causal default. The flash kernel is
+            # causal-only; route through the shared masked-attention op.
+            m = attn_mask._data if isinstance(attn_mask, Tensor) else jnp.asarray(attn_mask)
+            if m.dtype != jnp.bool_:
+                # clamp to the framework's additive-mask floor (-1e30) so a
+                # fully-masked row softmaxes finitely instead of to NaN
+                m = jnp.maximum(m.astype(jnp.float32), -1e30)
+            if m.ndim == 2:  # [s_q, s_k] -> broadcast over batch and heads
+                m = m[None, None]
+            elif m.ndim == 3:  # [b, s_q, s_k] -> [b, 1, s_q, s_k]
+                m = m[:, None]
+            out = F.scaled_dot_product_attention(q, k, v, attn_mask=Tensor(m))
+        else:
+            out, _ = F.flash_attention(q, k, v, causal=True)
         new_cache = (k, v) if use_cache else None
         return reshape(out, [b, s, e]), new_cache
 

@@ -273,6 +273,11 @@ class Predictor:
         - direct style: ``outs = predictor.run([arr, ...])`` returns numpy.
         """
         if inputs is not None:
+            if len(inputs) != len(self._inputs):
+                raise ValueError(
+                    f"Predictor.run expects {len(self._inputs)} inputs "
+                    f"({self.get_input_names()}), got {len(inputs)}"
+                )
             for h, a in zip(self._inputs, inputs):
                 h.copy_from_cpu(a)
         arrays = []

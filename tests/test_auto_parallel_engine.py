@@ -110,3 +110,27 @@ def test_save_load_roundtrip(tmp_path):
     w1 = model.gpt.embeddings.word_embeddings.weight.numpy()
     w2 = model2.gpt.embeddings.word_embeddings.weight.numpy()
     np.testing.assert_array_equal(np.asarray(w1), np.asarray(w2))
+
+
+def test_predict_honors_test_sample_split():
+    """(features, label) datasets: predict must feed only sample[:split] to
+    the model (ADVICE r4 — the label used to ride along as an extra arg)."""
+    engine, _ = _engine()
+    engine.prepare()
+    outs = engine.predict(LMDataset(), test_sample_split=1, batch_size=4, steps=2)
+    assert len(outs) == 2
+    assert list(outs[0].shape) == [4, 16, VOCAB]
+
+
+def test_gradient_merge_partial_tail_applies_update():
+    """Total steps not a multiple of k: the tail window is applied (with a
+    warning) at end of fit, and the accumulation state is left clean."""
+    strategy = Strategy({"gradient_merge": {"enable": True, "k_steps": 2}})
+    engine, model = _engine(strategy=strategy)
+    engine.prepare()
+    w0 = np.asarray(model.gpt.embeddings.word_embeddings.weight.numpy()).copy()
+    with pytest.warns(UserWarning, match="partial window"):
+        engine.fit(LMDataset(), batch_size=4, epochs=1, steps_per_epoch=1)
+    w1 = np.asarray(model.gpt.embeddings.word_embeddings.weight.numpy())
+    assert np.abs(w1 - w0).max() > 0, "tail micro-batch grads were dropped"
+    assert engine._merge_bufs is None and engine._merge_count == 0

@@ -94,3 +94,93 @@ def test_rotary_embs_prefill_decode_parity():
     np.testing.assert_allclose(
         np.asarray(step_out.numpy())[:, 0], full[:, -1], rtol=2e-4, atol=2e-5
     )
+
+def test_attn_mask_causal_matches_default():
+    """A pure-causal additive attn_mask must reproduce the no-mask (causal
+    flash) path — proves the mask is actually applied with the right
+    convention, not ignored (ADVICE r4 medium)."""
+    m = _model()
+    rng = np.random.default_rng(3)
+    x = paddle.to_tensor(rng.normal(size=(B, S, E)).astype(np.float32))
+    neg = np.finfo(np.float32).min
+    causal = np.where(np.tril(np.ones((S, S), bool)), 0.0, neg).astype(np.float32)
+    mask = paddle.to_tensor(np.broadcast_to(causal, (B, 1, S, S)).copy())
+    np.testing.assert_allclose(
+        m(x, attn_mask=mask).numpy(), m(x).numpy(), rtol=2e-4, atol=2e-5
+    )
+
+
+def test_attn_mask_padding_changes_output():
+    """Masking out the first key column must change outputs for positions that
+    could previously attend to it — silently ignoring the mask would not.
+    Uses a per-sample [B, S, S] mask (3-D broadcast path) and leaves row 0
+    fully masked: the clamp must keep the output finite, not NaN."""
+    m = _model()
+    rng = np.random.default_rng(4)
+    x = paddle.to_tensor(rng.normal(size=(B, S, E)).astype(np.float32))
+    neg = np.finfo(np.float32).min
+    causal = np.where(np.tril(np.ones((S, S), bool)), 0.0, neg).astype(np.float32)
+    padded = np.broadcast_to(causal, (B, S, S)).copy()
+    padded[:, :, 0] = neg  # no one may attend to key 0 (row 0 fully masked)
+    out_causal = m(x, attn_mask=paddle.to_tensor(np.broadcast_to(causal, (B, S, S)).copy())).numpy()
+    out_padded = m(x, attn_mask=paddle.to_tensor(padded)).numpy()
+    assert np.isfinite(out_padded).all(), "fully-masked query row produced NaN"
+    assert np.abs(out_causal[:, 1:] - out_padded[:, 1:]).max() > 1e-4
+
+
+def test_attn_mask_bool_accepted():
+    m = _model()
+    rng = np.random.default_rng(5)
+    x = paddle.to_tensor(rng.normal(size=(B, S, E)).astype(np.float32))
+    mask = paddle.to_tensor(np.tril(np.ones((S, S), bool)))
+    np.testing.assert_allclose(
+        m(x, attn_mask=mask).numpy(), m(x).numpy(), rtol=2e-4, atol=2e-5
+    )
+
+
+def test_attn_mask_rejected_in_decode():
+    import jax.numpy as jnp
+
+    m = _model()
+    rng = np.random.default_rng(6)
+    x = paddle.to_tensor(rng.normal(size=(B, S, E)).astype(np.float32))
+    _, kv_list = m.forward(x, time_step=paddle.to_tensor(S))
+    pads = [
+        (
+            paddle.to_tensor(jnp.pad(k._data, ((0, 0), (0, 1), (0, 0), (0, 0)))),
+            paddle.to_tensor(jnp.pad(v._data, ((0, 0), (0, 1), (0, 0), (0, 0)))),
+        )
+        for k, v in kv_list
+    ]
+    last = paddle.to_tensor(rng.normal(size=(B, 1, E)).astype(np.float32))
+    with pytest.raises(NotImplementedError):
+        m(last, attn_mask=paddle.to_tensor(np.zeros((1, 1), np.float32)),
+          caches=pads, time_step=paddle.to_tensor(S))
+
+
+def test_swiglu_is_gated_split():
+    """swiglu allocates ffn1 at 2*ff and computes silu(a)*b (ADVICE r4: the
+    old path did x*sigmoid(x) over width ff — wrong math AND wrong layout)."""
+    m = _model(act="swiglu")
+    assert list(m.ffn1_weights[0].shape) == [E, 2 * FF]
+    rng = np.random.default_rng(7)
+    x = paddle.to_tensor(rng.normal(size=(B, S, E)).astype(np.float32))
+    out = m(x)
+    assert list(out.shape) == [B, S, E]
+    # manual recomputation through the public weights, on a 1-layer model
+    import jax
+    import jax.numpy as jnp
+
+    paddle.seed(0)
+    m1 = FusedMultiTransformer(E, H, FF, num_layers=1, activation="swiglu")
+    out1 = m1(x).numpy()
+    # recompute for m1's weights
+    ln = m1._norm(x, m1.ln_scales[0], m1.ln_biases[0])
+    attn, _ = m1._attn(0, ln, None, None, None, False)
+    h1 = x.numpy() + (attn @ m1.linear_weights[0] + m1.linear_biases[0]).numpy()
+    ln2 = m1._norm(paddle.to_tensor(h1), m1.ffn_ln_scales[0], m1.ffn_ln_biases[0]).numpy()
+    z = ln2 @ m1.ffn1_weights[0].numpy() + m1.ffn1_biases[0].numpy()
+    a, b = z[..., :FF], z[..., FF:]
+    gated = np.asarray(jax.nn.silu(jnp.asarray(a))) * b
+    expect = h1 + gated @ m1.ffn2_weights[0].numpy() + m1.ffn2_biases[0].numpy()
+    np.testing.assert_allclose(out1, expect, rtol=2e-4, atol=2e-5)

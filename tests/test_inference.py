@@ -116,3 +116,14 @@ def test_static_load_inference_model(bundle):
     loaded = paddle.static.load_inference_model(path)
     out = loaded(paddle.to_tensor(x))
     np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_direct_run_validates_input_count(bundle):
+    path, x, _ref = bundle
+    config = inference.Config(path + ".pdmodel")
+    config.enable_memory_optim(False)
+    predictor = inference.create_predictor(config)
+    with pytest.raises(ValueError, match="expects 1 inputs"):
+        predictor.run([x, x])
+    with pytest.raises(ValueError, match="expects 1 inputs"):
+        predictor.run([])
