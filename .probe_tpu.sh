@@ -2,6 +2,9 @@
 # Background TPU tunnel probe (round 5). The axon tunnel goes down for hours;
 # this loop retries backend init every ~3 min and runs the full bench the
 # moment it comes up, persisting the autotune cache for the driver's own run.
+# A real bench failure (backend_down=false in the JSON) stops the loop so a
+# deterministic bug doesn't burn the TPU window re-running, and its record
+# is preserved instead of clobbered.
 cd /root/repo || exit 1
 for i in $(seq 1 200); do
   if timeout 150 python -c "import jax; b=jax.default_backend(); assert b != 'cpu', b; print('UP', b, len(jax.devices()))" >> .tunnel_probe.log 2>&1; then
@@ -10,6 +13,10 @@ for i in $(seq 1 200); do
     rc=$?
     echo "$(date -u +%FT%TZ) bench rc=$rc" >> .tunnel_probe.log
     if [ "$rc" -eq 0 ]; then exit 0; fi
+    if ! grep -q '"backend_down": true' .bench_probe.json 2>/dev/null; then
+      echo "$(date -u +%FT%TZ) real bench failure (not tunnel) -- stopping probe" >> .tunnel_probe.log
+      exit 2
+    fi
   else
     echo "$(date -u +%FT%TZ) attempt $i: tunnel down" >> .tunnel_probe.log
   fi

@@ -237,11 +237,15 @@ class PipelineLayer(Layer):
         num_microbatches: int,
         axis_name: str = "pp",
         checkpoint_stages: bool = False,
+        schedule: str = "auto",
     ) -> Any:
         """The TPU pipeline-parallel path: run this model's decoder region
         through the scan+ppermute circular executor with stage weights sharded
         over ``axis_name`` (see ``spmd_pipeline.SpmdPipelineExecutor``).
-        Virtual stages (``num_virtual_pipeline_stages``) become ring laps."""
+        Virtual stages (``num_virtual_pipeline_stages``) become ring laps.
+        ``schedule``: ``auto`` (interleaved ring when V > 1, else circular
+        1F1B analog) or ``zero_bubble`` (dx-only reverse ring + off-ring
+        batched weight grads, reference ``pipeline_zero_bubble.py``)."""
         from paddle_tpu.distributed.fleet.meta_parallel.spmd_pipeline import (
             SpmdPipelineExecutor,
         )
@@ -252,6 +256,7 @@ class PipelineLayer(Layer):
             num_microbatches,
             axis_name=axis_name,
             checkpoint_stages=checkpoint_stages,
+            schedule=schedule,
         )
 
     # --- execution -----------------------------------------------------
