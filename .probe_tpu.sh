@@ -13,8 +13,11 @@ for i in $(seq 1 200); do
     rc=$?
     echo "$(date -u +%FT%TZ) bench rc=$rc" >> .tunnel_probe.log
     if [ "$rc" -eq 0 ]; then exit 0; fi
-    if ! grep -q '"backend_down": true' .bench_probe.json 2>/dev/null; then
+    # stop only on an EXPLICIT non-tunnel failure; a missing/stale file means
+    # the tunnel likely dropped mid-bench -- keep retrying
+    if grep -q '"backend_down": false' .bench_probe.json 2>/dev/null; then
       echo "$(date -u +%FT%TZ) real bench failure (not tunnel) -- stopping probe" >> .tunnel_probe.log
+      cp .bench_probe.json ".bench_probe.fail.$i.json"
       exit 2
     fi
   else

@@ -99,13 +99,16 @@ from paddle_tpu.ops.random import (  # noqa: F401
     bernoulli,
     multinomial,
     normal,
+    poisson,
     rand,
     randint,
+    randint_like,
     randn,
     randperm,
     standard_normal,
     uniform,
 )
+from paddle_tpu.ops.parity import *  # noqa: F401,F403
 
 # ---- subpackages ------------------------------------------------------------
 from paddle_tpu import amp  # noqa: F401
@@ -159,3 +162,10 @@ def enable_static() -> None:  # pragma: no cover - compat stub
 
 def in_dynamic_mode() -> bool:
     return True
+
+
+# Tensor-method parity pass: bind module-level ops the reference also exposes
+# as methods (runs last so every op surface above is importable)
+from paddle_tpu.ops.parity import bind_missing_tensor_methods as _bind_methods  # noqa: E402
+
+_bind_methods()

@@ -711,6 +711,13 @@ class SpmdPipelineExecutor:
                 f"num_stages*virtual ({self._S}*{self._V})"
             )
         self._C = L // (self._S * self._V)  # blocks per (stage, lap) chunk
+        if schedule == "zero_bubble" and self._S > 1 and (
+            self._M < self._S or self._M % self._S != 0
+        ):
+            raise ValueError(
+                f"zero_bubble schedule needs num_microbatches ({self._M}) to be "
+                f"a multiple of num_stages ({self._S}) and >= it"
+            )
         self._blocks = pipe._built[start:end]
         self._template = self._blocks[0]
         self._param_names = [n for n, _ in self._template.named_parameters()]

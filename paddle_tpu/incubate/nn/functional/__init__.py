@@ -276,3 +276,31 @@ def fused_dropout_add(x: Any, y: Any, p: float = 0.5, training: bool = True, mod
     from paddle_tpu.nn.functional.common import dropout
 
     return dropout(x, p=p, training=training, mode=mode) + y
+
+
+def fused_softmax_mask(x: Any, mask: Any) -> Any:
+    """Reference ``fused_softmax_mask kernel``: softmax(x + mask) in one
+    fused step (XLA fuses the add into the softmax)."""
+    from paddle_tpu.core.dispatch import call_op
+
+    def _impl(x, m):
+        return jax.nn.softmax(x.astype(jnp.float32) + m.astype(jnp.float32), axis=-1).astype(x.dtype)
+
+    return call_op("fused_softmax_mask", _impl, x, mask)
+
+
+def fused_softmax_mask_upper_triangle(x: Any) -> Any:
+    """Reference ``fused_softmax_mask_upper_triangle``: causal-masked softmax
+    over the last two dims (scores [B, H, Sq, Sk])."""
+    from paddle_tpu.core.dispatch import call_op
+
+    def _impl(x):
+        s_q, s_k = x.shape[-2], x.shape[-1]
+        keep = jnp.tril(jnp.ones((s_q, s_k), bool))
+        z = jnp.where(keep, x.astype(jnp.float32), -1e30)
+        return jax.nn.softmax(z, axis=-1).astype(x.dtype)
+
+    return call_op("fused_softmax_mask_upper_triangle", _impl, x)
+
+
+__all__ += ["fused_softmax_mask", "fused_softmax_mask_upper_triangle"]

@@ -8,7 +8,9 @@ from paddle_tpu.nn.functional.loss import *  # noqa: F401,F403
 from paddle_tpu.nn.functional.ring_attention import ring_flash_attention  # noqa: F401
 from paddle_tpu.nn.functional.flash_attention import (  # noqa: F401
     flash_attention,
+    flash_attn_qkvpacked,
     flash_attn_unpadded,
+    flash_attn_varlen_qkvpacked,
     flashmask_attention,
     scaled_dot_product_attention,
     sdp_kernel,

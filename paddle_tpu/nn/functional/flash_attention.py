@@ -29,6 +29,8 @@ __all__ = [
     "scaled_dot_product_attention",
     "flashmask_attention",
     "flash_attn_unpadded",
+    "flash_attn_qkvpacked",
+    "flash_attn_varlen_qkvpacked",
     "sdp_kernel",
 ]
 
@@ -325,3 +327,51 @@ class sdp_kernel:  # noqa: N801 - context-manager compat shim
         from paddle_tpu.flags import set_flags
 
         set_flags({"use_pallas_attention": self._prev})
+
+
+def flash_attn_qkvpacked(
+    qkv,
+    dropout=0.0,
+    causal=False,
+    return_softmax=False,
+    fixed_seed_offset=None,
+    rng_name="",
+    training=True,
+    name=None,
+):
+    """Packed-QKV flash attention (reference ``flash_attn_qkvpacked``):
+    ``qkv`` is ``[B, S, 3, H, D]`` (or ``[B, S, 3*H, D]``); unpacks and
+    dispatches to :func:`flash_attention`."""
+    if len(qkv.shape) == 4:  # [B, S, 3*H, D]
+        h3 = qkv.shape[2]
+        qkv = qkv.reshape([qkv.shape[0], qkv.shape[1], 3, h3 // 3, qkv.shape[3]])
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    return flash_attention(
+        q, k, v, dropout=dropout, causal=causal, return_softmax=return_softmax,
+        training=training,
+    )
+
+
+def flash_attn_varlen_qkvpacked(
+    qkv,
+    cu_seqlens_q,
+    cu_seqlens_k,
+    max_seqlen_q,
+    max_seqlen_k,
+    scale=1.0,
+    dropout=0.0,
+    causal=False,
+    return_softmax=False,
+    fixed_seed_offset=None,
+    rng_name="",
+    training=True,
+    name=None,
+):
+    """Packed-QKV varlen attention (reference ``flash_attn_varlen_qkvpacked``)
+    over the unpadded [total_tokens, 3, H, D] layout."""
+    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+    return flash_attn_unpadded(
+        q, k, v, cu_seqlens_q, cu_seqlens_k, max_seqlen_q, max_seqlen_k,
+        scale=scale, dropout=dropout, causal=causal,
+        return_softmax=return_softmax, training=training,
+    )

@@ -10,6 +10,9 @@ from paddle_tpu.optimizer.optimizers import (  # noqa: F401
     Adam,
     Adamax,
     AdamW,
+    DecayedAdagrad,
+    Dpsgd,
+    Ftrl,
     Lamb,
     Momentum,
     NAdam,

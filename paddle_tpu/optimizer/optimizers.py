@@ -337,3 +337,71 @@ class Rprop(Optimizer):
         grad = jnp.where(sign < 0, jnp.zeros_like(grad), grad)
         new_param = param - jnp.sign(grad) * new_lr
         return new_param, {"prev_grad": grad, "lr": new_lr}
+
+
+class Ftrl(Optimizer):
+    """FTRL-Proximal (reference ``ftrl op``, ``paddle/phi/kernels/*/ftrl*``):
+    the classic online-learning rule with per-coordinate adaptive lr and
+    L1/L2 proximal shrinkage."""
+
+    def __init__(self, learning_rate=0.001, l1=0.0, l2=0.0, lr_power=-0.5,
+                 parameters=None, grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, multi_precision, name)
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def init_state(self, param):
+        return {"squared": jnp.zeros_like(param), "linear": jnp.zeros_like(param)}
+
+    def update(self, param, grad, state, *, lr, step, weight_decay):
+        n, z = state["squared"], state["linear"]
+        new_n = n + jnp.square(grad)
+        p = -self._lr_power
+        sigma = (jnp.power(new_n, p) - jnp.power(n, p)) / lr
+        new_z = z + grad - sigma * param
+        denom = jnp.power(new_n, p) / lr + 2.0 * self._l2
+        new_param = jnp.where(
+            jnp.abs(new_z) > self._l1,
+            -(new_z - jnp.sign(new_z) * self._l1) / denom,
+            jnp.zeros_like(param),
+        )
+        return new_param, {"squared": new_n, "linear": new_z}
+
+
+class DecayedAdagrad(Optimizer):
+    """Decayed Adagrad (reference ``decayed_adagrad op``): Adagrad whose
+    accumulator decays, preventing the lr from vanishing."""
+
+    def __init__(self, learning_rate=0.001, decay=0.95, epsilon=1e-6,
+                 parameters=None, grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, multi_precision, name)
+        self._decay, self._epsilon = decay, epsilon
+
+    def init_state(self, param):
+        return {"moment": jnp.zeros_like(param)}
+
+    def update(self, param, grad, state, *, lr, step, weight_decay):
+        m = self._decay * state["moment"] + (1 - self._decay) * jnp.square(grad)
+        return param - lr * grad / (jnp.sqrt(m) + self._epsilon), {"moment": m}
+
+
+class Dpsgd(Optimizer):
+    """Differentially-private SGD (reference ``dpsgd op``): per-step gradient
+    clipping + calibrated Gaussian noise."""
+
+    def __init__(self, learning_rate=0.001, clip=10.0, batch_size=16.0, sigma=1.0,
+                 parameters=None, grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, multi_precision, name)
+        self._clip, self._batch, self._sigma = clip, batch_size, sigma
+
+    def init_state(self, param):
+        return {}
+
+    def update(self, param, grad, state, *, lr, step, weight_decay):
+        import paddle_tpu.core.rng as _rng
+
+        norm = jnp.sqrt(jnp.sum(jnp.square(grad)))
+        g = grad * jnp.minimum(1.0, self._clip / jnp.maximum(norm, 1e-10))
+        noise = self._clip * self._sigma * jax.random.normal(
+            _rng.next_key(), g.shape, g.dtype
+        )
+        return param - lr * (g + noise / self._batch), state

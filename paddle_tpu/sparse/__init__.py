@@ -57,6 +57,20 @@ __all__ = [
     "transpose",
     "sum",
     "coalesce",
+    "acos",
+    "acosh",
+    "isnan",
+    "leaky_relu",
+    "relu6",
+    "divide_scalar",
+    "scale",
+    "full_like",
+    "mv",
+    "addmm",
+    "mask_as",
+    "reshape",
+    "slice",
+    "softmax",
 ]
 
 
@@ -104,7 +118,12 @@ class SparseCooTensor:
         return Tensor(self._bcoo.data)
 
     def to_dense(self) -> Tensor:
-        return Tensor(self._bcoo.todense())
+        b = self._bcoo
+        if b.data.dtype == jnp.bool_:
+            # scatter-add (todense) rejects bool; widen and cast back
+            d = jsparse.BCOO((b.data.astype(jnp.int8), b.indices), shape=b.shape)
+            return Tensor(d.todense().astype(jnp.bool_))
+        return Tensor(b.todense())
 
     def to_sparse_csr(self) -> "SparseCsrTensor":
         return SparseCsrTensor.from_coo(self)
@@ -454,6 +473,116 @@ def sum(x: Any, axis: Optional[int] = None, dtype: Any = None, keepdim: bool = F
 
 def coalesce(x: SparseCooTensor) -> SparseCooTensor:
     return _coo(x).coalesce()
+
+
+# -- sparse long-tail parity (VERDICT r5: close sparse_ops.yaml gaps) --------
+
+acos = _unary("acos", jnp.arccos)
+acosh = _unary("acosh", jnp.arccosh)
+isnan = _unary("isnan", jnp.isnan)
+leaky_relu = _unary("leaky_relu", jax.nn.leaky_relu)
+relu6 = _unary("relu6", lambda v: jnp.clip(v, 0.0, 6.0))
+
+
+def divide_scalar(x: Any, scalar: float) -> SparseCooTensor:
+    return _coo(x)._map_values(lambda v: v / scalar)
+
+
+def scale(x: Any, scale: float = 1.0, bias: float = 0.0, bias_after_scale: bool = True):
+    if bias != 0.0:
+        raise ValueError("sparse.scale with bias would densify; bias must be 0")
+    return _coo(x)._map_values(lambda v: v * scale)
+
+
+def full_like(x: Any, fill_value: float, dtype: Any = None):
+    from paddle_tpu.core.dtypes import convert_dtype
+
+    dt = convert_dtype(dtype) if dtype else None
+    return _coo(x)._map_values(lambda v: jnp.full_like(v, fill_value, dtype=dt))
+
+
+def mv(x: Any, vec: Any) -> Tensor:
+    """Sparse matrix x dense vector (reference ``sparse mv kernel``)."""
+    v = vec._data if isinstance(vec, Tensor) else jnp.asarray(vec)
+    return Tensor(_coo(x)._bcoo @ v)
+
+
+def addmm(input: Any, x: Any, y: Any, beta: float = 1.0, alpha: float = 1.0):  # noqa: A002
+    """beta * input + alpha * (x @ y) with sparse ``x`` (reference addmm)."""
+    yv = y._data if isinstance(y, Tensor) else jnp.asarray(y)
+    iv = input._data if isinstance(input, Tensor) else jnp.asarray(input)
+    return Tensor(beta * iv + alpha * (_coo(x)._bcoo @ yv))
+
+
+def mask_as(x: Any, mask: Any) -> SparseCooTensor:
+    """Take dense ``x``'s values at ``mask``'s sparsity pattern (reference
+    ``sparse mask_as``)."""
+    xv = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    mb = _coo(mask)._bcoo
+    n = mb.indices.shape[0]
+    idx = tuple(mb.indices[:, d] for d in range(mb.indices.shape[1]))
+    vals = xv[idx]
+    return SparseCooTensor(jsparse.BCOO((vals, mb.indices), shape=mb.shape))
+
+
+def reshape(x: Any, shape: Sequence[int]) -> SparseCooTensor:
+    """Reshape a COO tensor by re-deriving flat indices (reference sparse
+    reshape kernel)."""
+    c = _coo(x).coalesce()._bcoo
+    old_shape = c.shape
+    strides = np.cumprod([1] + list(old_shape[::-1][:-1]))[::-1]
+    flat = jnp.zeros((c.indices.shape[0],), c.indices.dtype)
+    for d in range(len(old_shape)):  # builtin sum is shadowed by sparse.sum
+        flat = flat + c.indices[:, d] * int(strides[d])
+    shape = tuple(int(s) for s in shape)
+    if int(np.prod(shape)) != int(np.prod(old_shape)):
+        raise ValueError(f"cannot reshape {old_shape} to {shape}")
+    new_strides = np.cumprod([1] + list(shape[::-1][:-1]))[::-1]
+    new_idx = jnp.stack(
+        [(flat // int(new_strides[d])) % shape[d] for d in range(len(shape))], axis=1
+    )
+    return SparseCooTensor(jsparse.BCOO((c.data, new_idx), shape=shape))
+
+
+def slice(x: Any, axes: Sequence[int], starts: Sequence[int], ends: Sequence[int]):  # noqa: A001
+    """Slice a COO tensor (reference sparse slice kernel): filter coordinates
+    into the window, shift, rebuild — stays sparse, static nnz bound."""
+    c = _coo(x).coalesce()._bcoo
+    shp = list(c.shape)
+    keep = jnp.ones((c.indices.shape[0],), bool)
+    shift = [0] * len(shp)
+    for ax, st, en in zip(axes, starts, ends):
+        ax = int(ax) % len(shp)
+        st = int(st) if st >= 0 else int(st) + shp[ax]
+        en = min(int(en) if en >= 0 else int(en) + shp[ax], shp[ax])
+        keep = keep & (c.indices[:, ax] >= st) & (c.indices[:, ax] < en)
+        shift[ax] = st
+        shp[ax] = en - st
+    data = jnp.where(keep, c.data, 0)
+    idx = c.indices - jnp.asarray(shift, c.indices.dtype)[None, :]
+    idx = jnp.where(keep[:, None], idx, 0)  # parked at origin with value 0
+    out = jsparse.BCOO((data, idx), shape=tuple(shp)).sum_duplicates()
+    return SparseCooTensor(out)
+
+
+def softmax(x: Any, axis: int = -1):
+    """Sparse softmax over the last axis (reference ``sparse softmax
+    kernel``): softmax over the nonzeros of each row, zeros stay zero."""
+    if axis != -1:
+        raise NotImplementedError("sparse.softmax supports axis=-1")
+    c = _coo(x).coalesce()._bcoo
+    nd = len(c.shape)
+    row_shape = c.shape[:-1]
+    row_strides = np.cumprod([1] + list(row_shape[::-1][:-1]))[::-1]
+    row = jnp.zeros((c.indices.shape[0],), c.indices.dtype)
+    for d in range(nd - 1):  # builtin sum is shadowed by sparse.sum
+        row = row + c.indices[:, d] * int(row_strides[d])
+    n_rows = int(np.prod(c.shape[:-1]))
+    row = row.astype(jnp.int32)
+    row_max = jax.ops.segment_max(c.data, row, n_rows)
+    e = jnp.exp(c.data - row_max[row])
+    denom = jax.ops.segment_sum(e, row, n_rows)
+    return SparseCooTensor(jsparse.BCOO((e / denom[row], c.indices), shape=c.shape))
 
 
 # -- install dense-Tensor conversions (paddle Tensor API parity) -------------

