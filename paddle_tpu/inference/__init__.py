@@ -41,7 +41,46 @@ class PrecisionType:
     Float32 = "float32"
     Half = "float16"
     Bfloat16 = "bfloat16"
-    Int8 = "int8"  # accepted, mapped to bf16 (no TPU int8 serving path yet)
+    Int8 = "int8"  # weight-only int8: int8 weights in HBM, bf16 compute
+
+
+def _quantize_weight_only_int8(params: Dict[str, Any], black: Any = ()) -> Dict[str, Any]:
+    """Weight-only int8 (reference WINT8 / ``weight_only_linear``): every
+    >=2-D float param becomes ``<name>@int8`` + per-output-channel
+    ``<name>@scale``; the rest cast to bf16. Flat keys keep the pytree
+    serializable through the existing bundle machinery. Halves weight bytes
+    in HBM/on disk; the dequant multiply fuses into each consumer matmul.
+    The scale/clip math is the quantization module's — ONE definition of
+    int8 quantization in the codebase."""
+    from paddle_tpu.quantization import _scales_absmax, quantize_linear
+
+    out: Dict[str, Any] = {}
+    for k, v in params.items():
+        if (
+            k not in black
+            and jnp.issubdtype(v.dtype, jnp.floating)
+            and v.ndim >= 2
+            and v.shape[-1] >= 4
+        ):
+            s = _scales_absmax(v, v.ndim - 1, 8)
+            out[k + "@int8"] = quantize_linear(v, s, bits=8, axis=v.ndim - 1)._data
+            out[k + "@scale"] = s.astype(jnp.float32)
+        elif jnp.issubdtype(v.dtype, jnp.floating):
+            out[k] = v.astype(jnp.bfloat16)
+        else:
+            out[k] = v
+    return out
+
+
+def _dequantize_params(qparams: Dict[str, Any], dtype: Any = jnp.bfloat16) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for k, v in qparams.items():
+        if k.endswith("@int8"):
+            name = k[: -len("@int8")]
+            out[name] = v.astype(dtype) * qparams[name + "@scale"].astype(dtype)
+        elif not k.endswith("@scale"):
+            out[k] = v
+    return out
 
 
 class Config:
@@ -181,10 +220,34 @@ class Predictor:
                 "jit.save(layer, path, input_spec=...)"
             )
         params = {k: t._data for k, t in bundle.state_dict().items()}
-        # NOTE: precision conversion cannot be applied to an already-exported
+        # Precision conversion cannot be applied to an already-exported
         # program (dtypes are baked into the StableHLO signature) — that is a
         # save-time pass here (convert_to_mixed_precision), exactly like the
-        # reference's offline convert_to_mixed_precision.cc tool.
+        # reference's offline convert_to_mixed_precision.cc tool. Requesting
+        # one here must not silently serve the baked precision; only suppress
+        # the warning when the request matches what the bundle bakes.
+        float_dtypes = {
+            str(v.dtype) for v in params.values() if jnp.issubdtype(v.dtype, jnp.floating)
+        }
+        if any(k.endswith("@int8") for k in params):
+            baked = PrecisionType.Int8
+        elif float_dtypes == {"bfloat16"}:
+            baked = PrecisionType.Bfloat16
+        elif float_dtypes == {"float16"}:
+            baked = PrecisionType.Half
+        else:
+            baked = PrecisionType.Float32
+        request_matches_bundle = config.precision in (PrecisionType.Float32, baked)
+        if not request_matches_bundle:
+            import warnings
+
+            warnings.warn(
+                f"Config precision={config.precision!r} is ignored for a "
+                "serialized bundle (dtypes are baked at save time); convert "
+                "offline with inference.convert_to_mixed_precision, or build "
+                "the predictor with Config.from_layer",
+                stacklevel=3,
+            )
         exported = bundle._exported
         call = exported.call
         n_in = len(bundle.input_spec)
@@ -213,13 +276,22 @@ class Predictor:
         # convention into the serving program
         params = decommit_from_mesh({k: v._data for k, v in layer.state_dict().items()})
         tgt = None
-        if config.precision in (PrecisionType.Bfloat16, PrecisionType.Half, PrecisionType.Int8):
+        int8 = config.precision == PrecisionType.Int8
+        if config.precision in (PrecisionType.Bfloat16, PrecisionType.Half) or int8:
             tgt = jnp.float16 if config.precision == PrecisionType.Half else jnp.bfloat16
-            params = {
-                k: v.astype(tgt) if jnp.issubdtype(v.dtype, jnp.floating) else v
-                for k, v in params.items()
-            }
-        pure = _pure_forward(layer)
+            if int8:
+                # weight-only int8: int8 weights resident in HBM (half the
+                # bf16 footprint), dequant fused into consumers, bf16 compute
+                params = _quantize_weight_only_int8(params)
+            else:
+                params = {
+                    k: v.astype(tgt) if jnp.issubdtype(v.dtype, jnp.floating) else v
+                    for k, v in params.items()
+                }
+        base_pure = _pure_forward(layer)
+        pure = (
+            (lambda p, *xs: base_pure(_dequantize_params(p), *xs)) if int8 else base_pure
+        )
         # inputs follow the param cast dtype (f16 params get f16 inputs —
         # mixing f16 x bf16 would silently promote every matmul to fp32)
         specs = specs_from_input_spec(config._input_spec, float_dtype=tgt)
@@ -338,8 +410,11 @@ def convert_to_mixed_precision(
     from paddle_tpu.jit.save_load import specs_from_input_spec
 
     layer = layer_or_path
-    tgt = jnp.bfloat16 if mixed_precision != PrecisionType.Half else jnp.float16
     black = set(black_list or ())
+    if mixed_precision == PrecisionType.Int8:
+        _export_weight_only_int8(layer, save_path, input_spec or [], black)
+        return
+    tgt = jnp.bfloat16 if mixed_precision != PrecisionType.Half else jnp.float16
     # cast for the export only — the caller's live (training) weights are
     # restored afterwards, like the reference's offline converter working on
     # a separate saved model
@@ -356,3 +431,40 @@ def convert_to_mixed_precision(
     finally:
         for p, d in saved:
             p._data = d
+
+
+def _export_weight_only_int8(layer: Any, save_path: str, input_spec: Sequence[Any],
+                             black: Any) -> None:
+    """Offline WINT8 export: serialize a program whose parameter inputs ARE
+    the int8 weights + scales (dequant lives inside the StableHLO), so the
+    saved bundle and the served HBM copy are both half-size. The Predictor's
+    bundle loader needs no special casing — the flat ``@int8``/``@scale``
+    keys ride the normal state-dict path, and the on-disk format lives in
+    one place (``save_load.write_bundle``)."""
+    from paddle_tpu.jit.save_load import (
+        _pure_forward,
+        decommit_from_mesh,
+        export_fn,
+        specs_from_input_spec,
+        write_bundle,
+    )
+
+    was_training = bool(getattr(layer, "training", False))
+    layer.eval()
+    try:
+        params = decommit_from_mesh({k: v._data for k, v in layer.state_dict().items()})
+        qparams = _quantize_weight_only_int8(params, black=black)
+        pure = _pure_forward(layer)
+
+        def qfn(qp, *xs):
+            return pure(_dequantize_params(qp), *xs)
+
+        specs = specs_from_input_spec(input_spec, float_dtype=jnp.bfloat16)
+        exported = export_fn(qfn, qparams, specs)
+        write_bundle(
+            save_path, exported, qparams, input_spec, specs=specs,
+            extra_spec={"precision": "int8-weight-only"},
+        )
+    finally:
+        if was_training:
+            layer.train()

@@ -83,8 +83,6 @@ def _export_layer(layer: Any, input_spec: Sequence[Any], params: dict) -> "jax.e
     box runs on the serving chip and vice versa; falls back to the current
     platform when an op lacks multi-platform lowering.
     """
-    import sys
-
     pure = _pure_forward(layer)
     specs = specs_from_input_spec(input_spec)
     # training may have left params sharded over a device mesh; exporting
@@ -92,18 +90,65 @@ def _export_layer(layer: Any, input_spec: Sequence[Any], params: dict) -> "jax.e
     # single-device serving context cannot satisfy. Decommit to keep the
     # bundle mesh-agnostic.
     params = decommit_from_mesh(params)
+    return export_fn(pure, params, specs)
+
+
+def export_fn(fn: Any, params: Any, specs: Sequence[Any]) -> "jax.export.Exported":
+    """Export ``fn(params, *specs)`` portably: cpu+tpu platforms first, with a
+    diagnosed single-platform fallback. Grad recording is disabled for the
+    trace — export must produce a vjp-free forward."""
+    import sys
+
     from paddle_tpu.core import autograd as _ag
 
     with _ag.set_grad_enabled(False):
         try:
-            return jax.export.export(jax.jit(pure), platforms=("cpu", "tpu"))(params, *specs)
+            return jax.export.export(jax.jit(fn), platforms=("cpu", "tpu"))(params, *specs)
         except Exception as exc:  # noqa: BLE001 - per-platform fallback
             print(
                 f"jit.save: multi-platform export failed ({exc!r}); "
                 "falling back to the current platform only"[:500],
                 file=sys.stderr,
             )
-            return jax.export.export(jax.jit(pure))(params, *specs)
+            return jax.export.export(jax.jit(fn))(params, *specs)
+
+
+def write_bundle(
+    path: str,
+    exported: "jax.export.Exported",
+    state: dict,
+    input_spec: Sequence[Any],
+    specs: Optional[Sequence[Any]] = None,
+    extra_spec: Optional[dict] = None,
+) -> None:
+    """Write the three bundle files (the ONE place that knows the on-disk
+    format): ``.pdiparams`` pickled numpy state, ``.pdmodel`` serialized
+    program, ``.pdspec`` feed/fetch signature. ``specs`` (when given) carry
+    the traced input dtypes; ``input_spec`` carries the user-facing names."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path + ".pdiparams", "wb") as f:
+        pickle.dump({k: np.asarray(v) for k, v in state.items()}, f, protocol=4)
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(_MAGIC + exported.serialize())
+    traced = specs if specs is not None else input_spec
+    spec = {
+        "inputs": [
+            {
+                "name": getattr(orig, "name", None) or f"x{i}",
+                "shape": list(s.shape),
+                "dtype": str(jnp.dtype(getattr(s, "dtype", "float32"))),
+            }
+            for i, (orig, s) in enumerate(zip(input_spec, traced))
+        ],
+        "outputs": [
+            {"name": f"fetch{i}", "shape": list(a.shape), "dtype": str(a.dtype)}
+            for i, a in enumerate(exported.out_avals)
+        ],
+        "platforms": list(exported.platforms),
+    }
+    spec.update(extra_spec or {})
+    with open(path + ".pdspec", "w") as f:
+        json.dump(spec, f, indent=1)
 
 
 def save(layer: Any, path: str, input_spec: Optional[Sequence[Any]] = None, **config: Any) -> None:
@@ -121,30 +166,13 @@ def save(layer: Any, path: str, input_spec: Optional[Sequence[Any]] = None, **co
     if not isinstance(layer, Layer):
         raise TypeError("jit.save expects a Layer")
     state = {k: np.asarray(v.numpy()) for k, v in layer.state_dict().items()}
-    with open(path + ".pdiparams", "wb") as f:
-        pickle.dump(state, f, protocol=4)
-    if input_spec:
-        params = {k: v._data for k, v in layer.state_dict().items()}
-        exported = _export_layer(layer, input_spec, params)
-        with open(path + ".pdmodel", "wb") as f:
-            f.write(_MAGIC + exported.serialize())
-        spec = {
-            "inputs": [
-                {
-                    "name": getattr(s, "name", None) or f"x{i}",
-                    "shape": list(s.shape),
-                    "dtype": str(jnp.dtype(getattr(s, "dtype", "float32"))),
-                }
-                for i, s in enumerate(input_spec)
-            ],
-            "outputs": [
-                {"name": f"fetch{i}", "shape": list(a.shape), "dtype": str(a.dtype)}
-                for i, a in enumerate(exported.out_avals)
-            ],
-            "platforms": list(exported.platforms),
-        }
-        with open(path + ".pdspec", "w") as f:
-            json.dump(spec, f, indent=1)
+    if not input_spec:
+        with open(path + ".pdiparams", "wb") as f:
+            pickle.dump(state, f, protocol=4)
+        return
+    params = {k: v._data for k, v in layer.state_dict().items()}
+    exported = _export_layer(layer, input_spec, params)
+    write_bundle(path, exported, state, input_spec)
 
 
 class TranslatedLayer:

@@ -201,13 +201,23 @@ class QuantConfig:
 
 
 class QuantedLinear(Layer):
-    """Inference form: int8 weight + per-output-channel scales, dequantized on
-    the fly (XLA fuses the dequant multiply into the matmul). With an
-    ``act_scale`` (from PTQ calibration) the input is statically
-    quantize-dequantized through the observed range."""
+    """Inference form: int8 weight + per-output-channel scales.
 
-    def __init__(self, linear: Any, bits: int = 8, act_scale: Any = None) -> None:
+    ``kernel="weight_only"`` (default) dequantizes on the fly — XLA fuses the
+    dequant multiply into the matmul read, so the win is HBM footprint/
+    bandwidth. ``kernel="llm.int8"`` additionally quantizes the activation
+    per row and contracts int8 x int8 -> int32 on the MXU
+    (``llm_int8_linear``) — the true int8 dot path. With an ``act_scale``
+    (from PTQ calibration) the input is statically quantize-dequantized
+    through the observed range first."""
+
+    def __init__(self, linear: Any, bits: int = 8, act_scale: Any = None,
+                 kernel: str = "weight_only") -> None:
         super().__init__()
+        if kernel not in ("weight_only", "llm.int8"):
+            raise ValueError(f"kernel must be weight_only/llm.int8, got {kernel!r}")
+        if kernel == "llm.int8" and bits != 8:
+            raise ValueError("llm.int8 kernel requires bits=8")
         w = linear.weight._data  # [in, out]
         qmax = float(2 ** (bits - 1) - 1)
         scales = _scales_absmax(w, axis=1, bits=bits)
@@ -221,31 +231,21 @@ class QuantedLinear(Layer):
         )
         self.bias = linear.bias
         self.bits = bits
+        self.kernel = kernel
 
     def forward(self, x: Tensor) -> Tensor:
         qw = self.qweight
         sc = self.scales
         qmax = float(2 ** (self.bits - 1) - 1)
         has_act = self.act_scale is not None
-
-        def fn(a, q, s, *rest):
-            it = iter(rest)
-            if has_act:
-                a_s = next(it)
-                a = jnp.clip(jnp.round(a / a_s), -qmax - 1, qmax) * a_s
-            w = q.astype(s.dtype) * s[None, :]
-            out = a @ w.astype(a.dtype)
-            b = next(it, None)
-            if b is not None:
-                out = out + b
-            return out
-
-        extras = []
         if has_act:
-            extras.append(self.act_scale)
-        if self.bias is not None:
-            extras.append(self.bias)
-        return call_op("quanted_linear", fn, x, qw, sc, *extras)
+            def pre(a, a_s):
+                return jnp.clip(jnp.round(a / a_s), -qmax - 1, qmax) * a_s
+
+            x = call_op("quant_act", pre, x, self.act_scale)
+        if self.kernel == "llm.int8":
+            return llm_int8_linear(x, qw, self.bias, sc)
+        return weight_only_linear(x, qw, self.bias, sc)
 
 
 class _ObservedLinear(Layer):
@@ -316,14 +316,15 @@ class QAT(Quantization):
         )
         return model
 
-    def convert(self, model: Layer, inplace: bool = False) -> Layer:
-        """Fold trained fake-quant layers into int8 inference layers."""
+    def convert(self, model: Layer, inplace: bool = False, kernel: str = "weight_only") -> Layer:
+        """Fold trained fake-quant layers into int8 inference layers.
+        ``kernel="llm.int8"`` selects the true int8 MXU dot path."""
         if not inplace:
             model = copy.deepcopy(model)
         _replace_sublayers(
             model,
             lambda l: isinstance(l, _QATLinear),  # noqa: E741
-            lambda q: QuantedLinear(q.inner, bits=q.weight_quanter.quant_bits),
+            lambda q: QuantedLinear(q.inner, bits=q.weight_quanter.quant_bits, kernel=kernel),
         )
         return model
 
@@ -343,9 +344,10 @@ class PTQ(Quantization):
         )
         return model
 
-    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+    def convert(self, model: Layer, inplace: bool = False, kernel: str = "weight_only") -> Layer:
         """Calibration results feed the converted layers: the observer's
-        activation scale becomes the static input quantization range."""
+        activation scale becomes the static input quantization range.
+        ``kernel="llm.int8"`` selects the true int8 MXU dot path."""
         if not inplace:
             model = copy.deepcopy(model)
         cfg = self._config
@@ -354,7 +356,9 @@ class PTQ(Quantization):
             act_scale = (
                 obs.act_observer.scales() if obs.act_observer._absmax is not None else None
             )
-            return QuantedLinear(obs.inner, bits=cfg._weight_bits(), act_scale=act_scale)
+            return QuantedLinear(
+                obs.inner, bits=cfg._weight_bits(), act_scale=act_scale, kernel=kernel
+            )
 
         _replace_sublayers(
             model,

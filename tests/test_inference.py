@@ -127,3 +127,52 @@ def test_predictor_direct_run_validates_input_count(bundle):
         predictor.run([x, x])
     with pytest.raises(ValueError, match="expects 1 inputs"):
         predictor.run([])
+
+
+class TestInt8Serving:
+    """Weight-only int8 serving (VERDICT r4 #4: stop silently serving bf16)."""
+
+    def _net_and_data(self):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(16, 64), nn.ReLU(), nn.Linear(64, 8))
+        net.eval()
+        x = np.random.default_rng(0).normal(size=(4, 16)).astype(np.float32)
+        return net, x, net(paddle.to_tensor(x)).numpy()
+
+    def test_from_layer_int8_parity_and_residency(self):
+        net, x, ref = self._net_and_data()
+        cfg = inference.Config.from_layer(net, [InputSpec([4, 16], "float32", name="x")])
+        cfg.enable_mixed_precision(inference.PrecisionType.Int8)
+        cfg.enable_memory_optim(False)
+        pred = inference.create_predictor(cfg)
+        out = pred.run([x])[0].astype(np.float32)
+        assert np.abs(out - ref).max() / np.abs(ref).max() < 0.01  # <=1% drop
+        # the served weights are genuinely int8 in memory
+        int8_keys = [k for k, v in pred._params.items()
+                     if k.endswith("@int8") and np.asarray(v).dtype == np.int8]
+        assert len(int8_keys) == 2
+
+    def test_offline_int8_convert_roundtrip(self, tmp_path):
+        import pickle
+
+        net, x, ref = self._net_and_data()
+        p = str(tmp_path / "int8" / "inference")
+        inference.convert_to_mixed_precision(
+            net, p, [InputSpec([4, 16], "float32", name="x")], inference.PrecisionType.Int8
+        )
+        state = pickle.load(open(p + ".pdiparams", "rb"))
+        assert sum(1 for k in state if k.endswith("@int8")) == 2
+        pred = inference.create_predictor(inference.Config(p + ".pdmodel"))
+        out = pred.run([x])[0].astype(np.float32)
+        assert np.abs(out - ref).max() / np.abs(ref).max() < 0.01
+
+    def test_bundle_precision_request_warns(self, bundle):
+        import warnings
+
+        path, x, _ref = bundle
+        config = inference.Config(path + ".pdmodel")
+        config.enable_mixed_precision(inference.PrecisionType.Int8)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            inference.create_predictor(config)
+        assert any("ignored for a serialized bundle" in str(i.message) for i in w)

@@ -143,3 +143,40 @@ def test_config_bits_honored():
     per_col = np.asarray(out.numpy())
     col = per_col[:, 0]
     assert len(np.unique(np.round(col / (np.abs(col).max() / 7 + 1e-12)))) <= 16
+
+
+class TestLlmInt8Kernel:
+    def test_quanted_linear_llm_int8_parity(self):
+        import paddle_tpu.quantization as q
+
+        paddle.seed(0)
+        lin = paddle.nn.Linear(32, 16)
+        x = paddle.to_tensor(np.random.default_rng(0).normal(size=(4, 32)).astype(np.float32))
+        ref = lin(x).numpy()
+        wol = q.QuantedLinear(lin, kernel="weight_only")(x).numpy()
+        i8 = q.QuantedLinear(lin, kernel="llm.int8")(x).numpy()
+        scale = np.abs(ref).max()
+        assert np.abs(wol - ref).max() / scale < 0.02
+        assert np.abs(i8 - ref).max() / scale < 0.03
+
+    def test_kernel_plumbs_through_ptq_convert(self):
+        import paddle_tpu.quantization as q
+
+        paddle.seed(0)
+        net = paddle.nn.Sequential(paddle.nn.Linear(16, 16), paddle.nn.ReLU(), paddle.nn.Linear(16, 4))
+        ptq = q.PTQ(q.QuantConfig())
+        observed = ptq.quantize(net)
+        x = paddle.to_tensor(np.random.default_rng(1).normal(size=(8, 16)).astype(np.float32))
+        observed(x)  # calibrate
+        converted = ptq.convert(observed, kernel="llm.int8")
+        quanted = [l for _, l in converted.named_sublayers() if isinstance(l, q.QuantedLinear)]
+        assert len(quanted) == 2 and all(l.kernel == "llm.int8" for l in quanted)
+        ref = net(x).numpy()
+        out = converted(x).numpy()
+        assert np.abs(out - ref).max() / np.abs(ref).max() < 0.06
+
+    def test_rejects_bad_kernel(self):
+        import paddle_tpu.quantization as q
+
+        with pytest.raises(ValueError, match="kernel"):
+            q.QuantedLinear(paddle.nn.Linear(4, 4), kernel="int4")
