@@ -153,6 +153,14 @@ class AutoTuner:
             if prune_by_memory(cfg, self.tuner_cfg):
                 continue
             out.append(cfg)
+        order = self.tuner_cfg.get("order", "memory")
+        if order == "cost" and self.tuner_cfg.get("model_cfg"):
+            # cost-model ordering (reference auto_parallel/static/cost/):
+            # fastest-predicted configs trial first, so a truncated sweep
+            # (task_limit) still covers the promising region
+            from paddle_tpu.distributed.auto_parallel.cost_model import rank_configs
+
+            return rank_configs(out, self.tuner_cfg)
         # memory-friendly first: higher parallelism degrees before plain dp
         # (the reference's memory_sort), so early trials are least likely to OOM
         out.sort(
@@ -184,25 +192,104 @@ class AutoTuner:
 
     # -- TPU-native driver ---------------------------------------------------
     def run(
-        self, trial_fn: Callable[[Dict[str, Any]], float], max_trials: Optional[int] = None
+        self,
+        trial_fn: Callable[[Dict[str, Any]], float],
+        max_trials: Optional[int] = None,
+        isolation: str = "none",
+        trial_timeout: Optional[float] = 600.0,
     ) -> Optional[Dict[str, Any]]:
-        """Trial every candidate in-process: ``trial_fn(cfg)`` returns the
-        metric (tokens/s or step time); exceptions mark the config failed
-        (the reference's OOM/error trials). Returns the best config."""
+        """Trial every candidate: ``trial_fn(cfg)`` returns the metric
+        (tokens/s or step time); exceptions mark the config failed (the
+        reference's OOM/error trials). Returns the best config.
+
+        ``isolation="subprocess"`` forks each trial into its own child
+        (reference ``tuner.py``'s launched-trial model): an XLA OOM, Mosaic
+        crash, or hang (``trial_timeout`` seconds, default 10 min — never
+        None: forking a JAX-multithreaded parent can deadlock the child
+        before it reports, and only the timeout recovers the sweep) kills ONE
+        child and marks that trial failed instead of losing the whole sweep.
+        In-process mode remains the default for CPU tests."""
+        if isolation not in ("none", "subprocess"):
+            raise ValueError(f"isolation must be none/subprocess, got {isolation!r}")
         trials = 0
         while max_trials is None or trials < max_trials:
             cfg = self.search_once()
             if cfg is None:
                 break
             trials += 1
-            try:
-                cfg["metric"] = float(trial_fn(dict(cfg)))
-                cfg["status"] = "ok"
-            except Exception as exc:  # noqa: BLE001 - failed trial, keep searching
-                cfg["metric"] = None
-                cfg["status"] = f"failed: {exc}"[:200]
+            if isolation == "subprocess":
+                metric, err = _run_trial_in_subprocess(trial_fn, dict(cfg), trial_timeout)
+                cfg["metric"] = metric
+                cfg["status"] = "ok" if err is None else err
+            else:
+                try:
+                    cfg["metric"] = float(trial_fn(dict(cfg)))
+                    cfg["status"] = "ok"
+                except Exception as exc:  # noqa: BLE001 - failed trial, keep searching
+                    cfg["metric"] = None
+                    cfg["status"] = f"failed: {exc}"[:200]
             self.add_cfg(cfg)
         return self.get_best_cfg()
+
+
+def _run_trial_in_subprocess(
+    trial_fn: Callable[[Dict[str, Any]], float],
+    cfg: Dict[str, Any],
+    timeout: Optional[float],
+):
+    """One trial in a forked child. Returns ``(metric, None)`` on success or
+    ``(None, "failed: ...")`` — a hard crash (OOM kill, Mosaic abort) or a
+    timeout only takes the child with it."""
+    import multiprocessing as mp
+    import os as _os
+
+    ctx = mp.get_context("fork")  # closures need fork; spawn can't pickle them
+    recv, send = ctx.Pipe(duplex=False)
+
+    def child(conn, cfg):
+        code = 0
+        try:
+            conn.send(("ok", float(trial_fn(cfg))))
+        except BaseException as exc:  # noqa: BLE001 - report, then die
+            code = 1
+            try:
+                conn.send(("err", f"{type(exc).__name__}: {exc}"[:200]))
+            except Exception:  # noqa: BLE001
+                pass
+        conn.close()
+        _os._exit(code)  # skip atexit/jax teardown in the fork
+
+    proc = ctx.Process(target=child, args=(send, cfg), daemon=True)
+    proc.start()
+    send.close()
+    msg = None
+    timed_out = False
+    try:
+        # poll(None) blocks until data or EOF, so timed_out can only be set
+        # when a real timeout was given (a dying child delivers EOF, which
+        # must classify as "died", not "timed out" — is_alive() races there)
+        if recv.poll(timeout):
+            msg = recv.recv()
+        else:
+            timed_out = True
+    except (EOFError, OSError):
+        msg = None
+    finally:
+        recv.close()
+    if timed_out:
+        proc.terminate()
+        proc.join(5)
+        if proc.is_alive():
+            proc.kill()
+            proc.join(5)
+        return None, f"failed: trial timed out after {timeout}s"
+    proc.join(10)
+    if msg is None:
+        return None, f"failed: trial process died (exitcode {proc.exitcode})"
+    kind, payload = msg
+    if kind == "ok":
+        return payload, None
+    return None, f"failed: {payload}"
 
 
 Tuner = AutoTuner  # reference alias

@@ -112,3 +112,58 @@ def test_min_mode_picks_smallest():
     assert best["mp_degree"] == min(
         c["mp_degree"] for c in t.history_cfgs if c["metric"] is not None
     )
+
+
+class TestSubprocessIsolation:
+    """isolation='subprocess' (VERDICT r5 #5): a hard-crashing or hung trial
+    kills one child, not the sweep."""
+
+    def test_survives_hard_process_death(self):
+        t = AutoTuner(_cfg(task_limit=6))
+        doomed = [dict(t._queue[0]), dict(t._queue[2])]  # two hard crashes
+
+        def trial(cfg):
+            if any(all(cfg[k] == v for k, v in d.items()) for d in doomed):
+                import os
+                os._exit(137)  # simulates an XLA OOM / Mosaic abort killing the process
+            return 100.0 * cfg["mp_degree"] + cfg["micro_batch_size"]
+
+        best = t.run(trial, isolation="subprocess")
+        assert best is not None and best["status"] == "ok"
+        died = [c for c in t.history_cfgs if "died" in str(c["status"])]
+        assert len(died) == 2 and all(c["metric"] is None for c in died)
+        ok = [c for c in t.history_cfgs if c["metric"] is not None]
+        assert len(ok) == 4 and best["metric"] == max(c["metric"] for c in ok)
+
+    def test_python_exception_reported(self):
+        t = AutoTuner(_cfg(task_limit=8))
+
+        def trial(cfg):
+            if cfg["use_recompute"]:
+                raise MemoryError("RESOURCE_EXHAUSTED: out of memory")
+            return float(cfg["micro_batch_size"])
+
+        best = t.run(trial, isolation="subprocess")
+        failed = [c for c in t.history_cfgs if c["metric"] is None]
+        assert failed and all("MemoryError" in c["status"] for c in failed)
+        assert best is not None and not best["use_recompute"]
+
+    def test_hung_trial_times_out(self):
+        t = AutoTuner(_cfg(task_limit=4))
+        first = dict(t._queue[0])  # poison exactly the first trial
+
+        def trial(cfg):
+            if all(cfg[k] == v for k, v in first.items()):
+                import time
+                time.sleep(300)
+            return float(cfg["mp_degree"])
+
+        best = t.run(trial, isolation="subprocess", trial_timeout=3.0)
+        hung = [c for c in t.history_cfgs if "timed out" in str(c["status"])]
+        assert len(hung) == 1 and hung[0]["metric"] is None
+        assert best is not None and best["status"] == "ok"
+
+    def test_rejects_unknown_isolation(self):
+        t = AutoTuner(_cfg())
+        with pytest.raises(ValueError, match="isolation"):
+            t.run(lambda cfg: 1.0, isolation="thread")
