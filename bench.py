@@ -134,6 +134,19 @@ def _preflight_pallas(platform: str, cfg, seq: int) -> None:
         )(q, k, v),
         q, kv, kv,
     )
+    from paddle_tpu.kernels.paged_attention import paged_flash_decode
+
+    bs_, mbs_, nb_ = 16, 8, 64
+    pq = jnp.zeros((2, cfg.num_attention_heads, hd), jnp.bfloat16)
+    pkc = jnp.zeros((nb_, cfg.num_key_value_heads, bs_, hd), jnp.bfloat16)
+    ptab = jnp.zeros((2, mbs_), jnp.int32)
+    plen = jnp.ones((2,), jnp.int32)
+    check(
+        "paged_flash_decode",
+        "FLAGS_use_pallas_paged_attention",
+        lambda q_, kc_, vc_, t_, l_: paged_flash_decode(q_, kc_, vc_, t_, l_),
+        pq, pkc, pkc, ptab, plen,
+    )
     x = jnp.zeros((2, seq, cfg.hidden_size), jnp.bfloat16)
     w = jnp.zeros((cfg.hidden_size,), jnp.bfloat16)
     rope_x = jnp.zeros((1, seq, cfg.num_attention_heads, hd), jnp.bfloat16)
@@ -329,6 +342,8 @@ def main() -> None:
         _bench_ernie(paddle, platform),
         _bench_sd_unet(paddle, platform),
         _bench_resnet_pipeline(paddle, platform),
+        _bench_int8_decode(paddle, platform),
+        _bench_paged_decode(paddle, platform),
     ]
     print(
         json.dumps(
@@ -447,6 +462,114 @@ def _bench_sd_unet(paddle, platform: str) -> dict:
         }
     except Exception as exc:  # noqa: BLE001
         return {"metric": "sd15_unet_inference_images_per_sec", "error": f"{exc!r}"[:300]}
+
+
+def _bench_int8_decode(paddle, platform: str) -> dict:
+    """int8 vs bf16 at the decode-dominant shape (VERDICT r5 #4): a GEMV-like
+    [tokens, in] x [in, out] MLP projection is HBM-bandwidth-bound at decode,
+    so int8 weights (half the bytes) should approach 2x. Measures bf16
+    matmul vs weight-only int8 vs true-int8 (llm.int8) through jit."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu.quantization as q
+
+    try:
+        if platform == "tpu":
+            tokens, d_in, d_out, iters, warm = 8, 4096, 11008, 50, 5
+        else:
+            tokens, d_in, d_out, iters, warm = 2, 128, 256, 3, 1
+        rng = np.random.default_rng(4)
+        w = paddle.to_tensor(rng.normal(size=(d_in, d_out)).astype(np.float32) / np.sqrt(d_in))
+        x = paddle.to_tensor(rng.normal(size=(tokens, d_in)).astype(np.float32))
+        wb = w.astype("bfloat16")
+        xb = x.astype("bfloat16")
+        qw, sc = q.weight_quantize(w)
+
+        bf16_fn = jax.jit(lambda a, ww: a @ ww)
+        wol_fn = jax.jit(lambda a, qq, ss: q.weight_only_linear(
+            paddle.to_tensor(a), paddle.to_tensor(qq), weight_scale=paddle.to_tensor(ss)
+        )._data)
+        i8_fn = jax.jit(lambda a, qq, ss: q.llm_int8_linear(
+            paddle.to_tensor(a), paddle.to_tensor(qq), weight_scale=paddle.to_tensor(ss)
+        )._data)
+
+        def timed(fn, *args):
+            for _ in range(warm):
+                fn(*args).block_until_ready()
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = fn(*args)
+            out.block_until_ready()
+            return (time.perf_counter() - t0) / iters * 1e3
+
+        t_bf16 = timed(bf16_fn, xb._data, wb._data)
+        t_wol = timed(wol_fn, xb._data, qw._data, sc._data)
+        t_i8 = timed(i8_fn, xb._data, qw._data, sc._data)
+        return {
+            "metric": "int8_decode_matmul_ms",
+            "bf16_ms": round(t_bf16, 4),
+            "weight_only_int8_ms": round(t_wol, 4),
+            "llm_int8_ms": round(t_i8, 4),
+            "weight_only_speedup_vs_bf16": round(t_bf16 / t_wol, 3),
+            "shape": [tokens, d_in, d_out],
+        }
+    except Exception as exc:  # noqa: BLE001
+        return {"metric": "int8_decode_matmul_ms", "error": f"{exc!r}"[:300]}
+
+
+def _bench_paged_decode(paddle, platform: str) -> dict:
+    """Paged-cache decode step: Pallas block-table flash-decode vs the XLA
+    dense-gather path (VERDICT r5 #6 A/B). Serving shape: the whole paged
+    decode step (append + attend) jitted, per-step latency."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.incubate.nn.functional.block_attention import (
+        block_multihead_attention,
+    )
+
+    try:
+        if platform == "tpu":
+            b, hq, hkv, d, bs, mbs, nb, iters, warm = 16, 32, 32, 128, 16, 64, 1024, 30, 5
+        else:
+            b, hq, hkv, d, bs, mbs, nb, iters, warm = 2, 4, 4, 64, 16, 4, 16, 2, 1
+        rng = np.random.default_rng(5)
+        q = jnp.asarray(rng.normal(size=(b, 1, hq, d)), jnp.bfloat16)
+        kv = jnp.asarray(rng.normal(size=(b, 1, hkv, d)), jnp.bfloat16)
+        kc = jnp.asarray(rng.normal(size=(nb, hkv, bs, d)), jnp.bfloat16)
+        vc = jnp.asarray(rng.normal(size=(nb, hkv, bs, d)), jnp.bfloat16)
+        tables = jnp.asarray(
+            rng.permutation(nb)[: b * mbs].reshape(b, mbs), jnp.int32
+        )
+        lens = jnp.asarray(rng.integers(bs, mbs * bs - 1, (b,)), jnp.int32)
+        step = jax.jit(block_multihead_attention)
+
+        def timed(flag: bool) -> float:
+            paddle.set_flags({"FLAGS_use_pallas_paged_attention": flag})
+            jax.clear_caches()  # the flag is baked at trace time
+            for _ in range(warm):
+                out, _, _ = step(q, kv, kv, kc, vc, tables, lens)
+            out.block_until_ready()
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out, _, _ = step(q, kv, kv, kc, vc, tables, lens)
+            out.block_until_ready()
+            return (time.perf_counter() - t0) / iters * 1e3
+
+        t_xla = timed(False)
+        t_pallas = timed(True) if platform == "tpu" else None
+        rec = {
+            "metric": "paged_decode_step_ms",
+            "xla_gather_ms": round(t_xla, 4),
+            "batch": b, "heads": hq, "ctx": int(mbs * bs),
+        }
+        if t_pallas is not None:
+            rec["pallas_flash_decode_ms"] = round(t_pallas, 4)
+            rec["pallas_speedup_vs_gather"] = round(t_xla / t_pallas, 3)
+        return rec
+    except Exception as exc:  # noqa: BLE001
+        return {"metric": "paged_decode_step_ms", "error": f"{exc!r}"[:300]}
 
 
 def _bench_resnet_pipeline(paddle, platform: str) -> dict:

@@ -84,6 +84,7 @@ def _define_builtin_flags() -> None:
     d("eager_op_cache_size", int, 4096, "Max entries in the eager per-op compiled-executable cache.")
     d("use_pallas_attention", bool, True, "Use Pallas flash-attention kernels on TPU when applicable.")
     d("use_pallas_fused", bool, True, "Use Pallas fused rms_norm/rope kernels on TPU when applicable.")
+    d("use_pallas_paged_attention", bool, True, "Use the Pallas block-table flash-decode kernel on TPU.")
     d("benchmark", bool, False, "Block on every op (sync dispatch) for timing.")
     d("log_memory_stats", bool, False, "Log live/peak device memory stats per allocation event.")
     d("allocator_strategy", str, "xla", "Allocator backing; on TPU the XLA/PJRT allocator owns HBM.")

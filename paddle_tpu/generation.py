@@ -163,7 +163,8 @@ class GenerationMixin:
             logits, dense_caches = self(Tensor(ids), use_cache=True)
         layer_caches = []
         for k_t, v_t in dense_caches:
-            kc = jnp.zeros((num_blocks, block_size, kvh, hd), dtype)
+            # paged layout [NB, H, BS, D] (see BlockKVCache)
+            kc = jnp.zeros((num_blocks, kvh, block_size, hd), dtype)
             vc = jnp.zeros_like(kc)
             kc, vc = block_cache_prefill(kc, vc, k_t._data, v_t._data, tables, lens)
             layer_caches.append((kc, vc))

@@ -6,10 +6,13 @@ vLLM-style paged cache: KV lives in fixed-size physical blocks; a per-sequence
 ``block_table`` maps logical block index → physical block id, so sequences
 grow without reserving max_seq_len per slot and freed blocks are reused.
 
-TPU-native shape: the cache is a dense ``[num_blocks, block_size, H, D]``
-array; appends are batched scatters (``.at[phys, off].set``) and attention
-gathers each sequence's blocks with a static ``max_blocks_per_seq`` bound —
-all static shapes, so the whole decode step jits once. The block allocator is
+TPU-native shape: the cache is a dense ``[num_blocks, H, block_size, D]``
+array (heads OUTSIDE the token dim, so one head's physical block tiles as an
+``(block_size, D)`` VMEM plane); appends are batched scatters
+(``.at[phys, :, off].set``) and decode attention runs the Pallas block-table
+flash-decode kernel (``kernels/paged_attention.py``) when enabled, falling
+back to a dense gather with a static ``max_blocks_per_seq`` bound — all
+static shapes, so the whole decode step jits once. The block allocator is
 host-side Python (it runs between steps, not inside the program), mirroring
 the reference where block tables are produced by the serving scheduler.
 """
@@ -46,7 +49,9 @@ class BlockKVCache:
         self.block_size = int(block_size)
         self.num_blocks = int(num_blocks)
         self.max_blocks_per_seq = int(max_blocks_per_seq)
-        self._shape = (int(num_blocks), int(block_size), int(num_heads), int(head_dim))
+        # [NB, H, BS, D]: heads OUTSIDE the token dim so a TPU kernel block
+        # (one head, one physical block) tiles as (BS, D) — (8k, 128)-friendly
+        self._shape = (int(num_blocks), int(num_heads), int(block_size), int(head_dim))
         self._dtype = dtype
         # device buffers are LAZY: callers that only use the host-side
         # allocator/tables (e.g. generate_paged, which owns per-layer pools)
@@ -120,7 +125,7 @@ class BlockKVCache:
 
 
 def block_cache_append(
-    key_cache: jax.Array,  # [NB, BS, H, D]
+    key_cache: jax.Array,  # [NB, H, BS, D]
     value_cache: jax.Array,
     k: jax.Array,  # [B, H, D] one new token per sequence
     v: jax.Array,
@@ -128,12 +133,12 @@ def block_cache_append(
     positions: jax.Array,  # [B] token index being written (0-based)
 ) -> Tuple[jax.Array, jax.Array]:
     """Scatter one new KV token per sequence into its physical block slot."""
-    bs = key_cache.shape[1]
+    bs = key_cache.shape[2]
     blk_idx = positions // bs
     off = positions % bs
     phys = jnp.take_along_axis(block_tables, blk_idx[:, None], axis=1)[:, 0]
-    key_cache = key_cache.at[phys, off].set(k.astype(key_cache.dtype))
-    value_cache = value_cache.at[phys, off].set(v.astype(value_cache.dtype))
+    key_cache = key_cache.at[phys, :, off].set(k.astype(key_cache.dtype))
+    value_cache = value_cache.at[phys, :, off].set(v.astype(value_cache.dtype))
     return key_cache, value_cache
 
 
@@ -149,7 +154,7 @@ def block_cache_prefill(
     reference kernel). Positions past ``seq_lens`` scatter into a scratch
     slot (block 0 / slot recomputed) are avoided via clamping + final mask."""
     b, s, h, d = k.shape
-    nb, bs = key_cache.shape[0], key_cache.shape[1]
+    nb, bs = key_cache.shape[0], key_cache.shape[2]
     t = jnp.arange(s)[None, :]  # [1, S]
     valid = t < seq_lens[:, None]  # [B, S]
     blk_idx = jnp.minimum(t // bs, block_tables.shape[1] - 1)
@@ -163,8 +168,8 @@ def block_cache_prefill(
     flat_off = jnp.broadcast_to(off, phys.shape).reshape(-1)
     flat_k = k.reshape(b * s, h, d).astype(key_cache.dtype)
     flat_v = v.reshape(b * s, h, d).astype(value_cache.dtype)
-    key_cache = key_cache.at[flat_phys, flat_off].set(flat_k, mode="drop")
-    value_cache = value_cache.at[flat_phys, flat_off].set(flat_v, mode="drop")
+    key_cache = key_cache.at[flat_phys, :, flat_off].set(flat_k, mode="drop")
+    value_cache = value_cache.at[flat_phys, :, flat_off].set(flat_v, mode="drop")
     return key_cache, value_cache
 
 
@@ -172,7 +177,7 @@ def block_multihead_attention(
     q: jax.Array,  # [B, 1, HQ, D] decode query (one token per sequence)
     k: jax.Array,  # [B, 1, HKV, D] new key
     v: jax.Array,  # [B, 1, HKV, D] new value
-    key_cache: jax.Array,  # [NB, BS, HKV, D]
+    key_cache: jax.Array,  # [NB, HKV, BS, D]
     value_cache: jax.Array,
     block_tables: jax.Array,  # [B, MBS] int32
     seq_lens: jax.Array,  # [B] tokens already cached (EXCLUDING this one)
@@ -189,10 +194,40 @@ def block_multihead_attention(
     key_cache, value_cache = block_cache_append(
         key_cache, value_cache, k[:, 0], v[:, 0], block_tables, seq_lens
     )
-    # gather each sequence's blocks: [B, MBS, BS, HKV, D] -> [B, L, HKV, D]
-    gk = key_cache[block_tables]
-    gv = value_cache[block_tables]
-    mbs, bs = block_tables.shape[1], key_cache.shape[1]
+    from paddle_tpu.kernels.select import pallas_enabled, warn_fallback
+
+    if pallas_enabled("use_pallas_paged_attention"):
+        # block-table flash-decode kernel: streams only this sequence's
+        # physical blocks HBM -> VMEM (no dense [B, MBS*BS, H, D] gather).
+        # Applicability is checked with a cached host-side lowering probe
+        # BEFORE the kernel is baked into the trace — a Mosaic error inside
+        # a jitted decode step could not be caught here at run time.
+        from paddle_tpu.kernels.paged_attention import (
+            lowering_supported,
+            paged_flash_decode,
+        )
+
+        nb, hkv_c, bs, d_c = key_cache.shape
+        if lowering_supported(
+            b, hq, hkv_c, d_c, nb, bs, block_tables.shape[1], str(q.dtype)
+        ):
+            try:
+                out = paged_flash_decode(
+                    q[:, 0], key_cache, value_cache, block_tables,
+                    seq_lens + 1,  # kernel masks pos < len INCLUDING this token
+                    scale=scale,
+                )
+                return out[:, None], key_cache, value_cache
+            except Exception as exc:  # noqa: BLE001 - XLA fallback below
+                warn_fallback("paged_flash_decode", exc)
+        else:
+            warn_fallback(
+                "paged_flash_decode", RuntimeError("Mosaic lowering unsupported for geometry")
+            )
+    # gather each sequence's blocks: [B, MBS, HKV, BS, D] -> [B, L, HKV, D]
+    gk = jnp.moveaxis(key_cache[block_tables], 2, 3)
+    gv = jnp.moveaxis(value_cache[block_tables], 2, 3)
+    mbs, bs = block_tables.shape[1], key_cache.shape[2]
     L = mbs * bs
     gk = gk.reshape(b, L, hkv, d)
     gv = gv.reshape(b, L, hkv, d)

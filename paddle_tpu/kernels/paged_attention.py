@@ -1,0 +1,162 @@
+"""Pallas TPU paged-attention decode kernel.
+
+Replaces the dense-gather XLA path of
+``incubate/nn/functional/block_attention.py`` (reference CUDA kernel:
+``paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu``) with a
+block-table-aware flash-decode kernel: each grid cell walks ONE sequence's
+logical blocks, the scalar-prefetched block table steers the BlockSpec index
+map so only that sequence's physical KV blocks are streamed HBM -> VMEM
+(never the dense ``[B, MBS*BS, H, D]`` gather), and an online softmax
+accumulates in fp32 VMEM scratch. Grouped-query attention keeps the G query
+heads of one KV head together as the kernel's row dimension.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _decode_kernel(
+    tables_ref,  # scalar prefetch: [B, MBS] int32
+    lens_ref,  # scalar prefetch: [B] int32 (length INCLUDING current token)
+    q_ref,  # [1, 1, G, D]
+    k_ref,  # [1, 1, BS, D] this logical block's physical KV (one head)
+    v_ref,
+    o_ref,  # [1, 1, G, D]
+    m_ref,  # VMEM [G, 1] running max
+    l_ref,  # VMEM [G, 1] running denom
+    acc_ref,  # VMEM [G, D] running numerator
+    *,
+    scale: float,
+    block_size: int,
+    num_blocks: int,
+):
+    bi = pl.program_id(0)
+    i = pl.program_id(2)
+
+    @pl.when(i == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0, 0].astype(jnp.float32) * scale  # [G, D]
+    k = k_ref[0, 0].astype(jnp.float32)  # [BS, D]
+    v = v_ref[0, 0].astype(jnp.float32)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )  # [G, BS]
+    pos = i * block_size + jax.lax.broadcasted_iota(jnp.int32, (1, block_size), 1)
+    valid = pos < lens_ref[bi]
+    s = jnp.where(valid, s, NEG_INF)
+
+    m_prev = m_ref[...]  # [G, 1]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)
+    # the explicit valid multiply keeps fully-masked rows at p == 0: with
+    # every position masked, m_new == NEG_INF and exp(s - m_new) would be 1
+    # everywhere — silent garbage for zero-length sequences
+    p = jnp.exp(s - m_new) * valid.astype(jnp.float32)  # [G, BS]
+    l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    m_ref[...] = m_new
+
+    @pl.when(i == num_blocks - 1)
+    def _finish():
+        denom = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0, 0] = (acc_ref[...] / denom).astype(o_ref.dtype)
+
+
+@functools.lru_cache(maxsize=64)
+def lowering_supported(b: int, hq: int, hkv: int, d: int, nb: int, bs: int, mbs: int,
+                       dtype: str) -> bool:
+    """Static Mosaic-lowering probe, cached per geometry. A lowering error
+    inside a captured (jitted) decode step is uncatchable at run time — this
+    check runs host-side at TRACE time so the caller can route to the XLA
+    path instead (same rule as the bench preflight)."""
+    import numpy as np
+
+    q = jax.ShapeDtypeStruct((b, hq, d), np.dtype(dtype))
+    kc = jax.ShapeDtypeStruct((nb, hkv, bs, d), np.dtype(dtype))
+    tb = jax.ShapeDtypeStruct((b, mbs), np.int32)
+    ln = jax.ShapeDtypeStruct((b,), np.int32)
+    try:
+        jax.export.export(
+            jax.jit(lambda q, kc, vc, t, l: paged_flash_decode(q, kc, vc, t, l)),
+            platforms=["tpu"],
+        )(q, kc, kc, tb, ln)
+        return True
+    except Exception:  # noqa: BLE001 - any lowering failure means "don't"
+        return False
+
+
+def paged_flash_decode(
+    q: jax.Array,  # [B, HQ, D]
+    key_cache: jax.Array,  # [NB, HKV, BS, D]
+    value_cache: jax.Array,
+    block_tables: jax.Array,  # [B, MBS] int32
+    seq_lens: jax.Array,  # [B] length INCLUDING the current token
+    scale: Optional[float] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Flash decode over the paged cache. Returns ``[B, HQ, D]``."""
+    b, hq, d = q.shape
+    nb, hkv, bs, _ = key_cache.shape
+    mbs = block_tables.shape[1]
+    if hq % hkv != 0:
+        raise ValueError(f"q heads {hq} not a multiple of kv heads {hkv}")
+    g = hq // hkv
+    if scale is None:
+        scale = 1.0 / (d**0.5)
+    qg = q.reshape(b, hkv, g, d)
+
+    grid = (b, hkv, mbs)
+    kernel = functools.partial(
+        _decode_kernel, scale=float(scale), block_size=bs, num_blocks=mbs
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1, g, d), lambda bi, hi, i, tables, lens: (bi, hi, 0, 0)),
+                # the block table steers which PHYSICAL block is streamed in;
+                # block (1, 1, BS, D) tiles the (BS, D) plane of one head
+                pl.BlockSpec(
+                    (1, 1, bs, d),
+                    lambda bi, hi, i, tables, lens: (tables[bi, i], hi, 0, 0),
+                ),
+                pl.BlockSpec(
+                    (1, 1, bs, d),
+                    lambda bi, hi, i, tables, lens: (tables[bi, i], hi, 0, 0),
+                ),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, g, d), lambda bi, hi, i, tables, lens: (bi, hi, 0, 0)
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((g, 1), jnp.float32),
+                pltpu.VMEM((g, 1), jnp.float32),
+                pltpu.VMEM((g, d), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, hkv, g, d), q.dtype),
+        # batch and kv-head cells are independent; the block walk accumulates
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), seq_lens.astype(jnp.int32), qg, key_cache, value_cache)
+    return out.reshape(b, hq, d)

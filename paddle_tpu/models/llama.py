@@ -139,7 +139,7 @@ class LlamaAttention(nn.Layer):
             and past_key_value is not None
             and len(past_key_value) == 4
         ):
-            # paged decode: past is (key_cache [NB,BS,HK,D], value_cache,
+            # paged decode: past is (key_cache [NB,HK,BS,D], value_cache,
             # block_tables [B,MBS], seq_lens [B]) — vLLM-style serving cache
             # (reference `block_multihead_attention_` fused_ops.yaml:45).
             # Positions are ragged per sequence: rope tables gather per-seq.

@@ -148,5 +148,6 @@ class TestPagedAttention:
         )
         # seq 1 wrote into its own block at pos 3; seq 0 into a new block.
         # positions 0..2 of seq 1's first block are untouched
+        # (cache layout [NB, H, BS, D]: token positions are axis 2)
         after = np.asarray(kc2[np.asarray(t2[1][:1])])
-        np.testing.assert_array_equal(before[0, :3], after[0, :3])
+        np.testing.assert_array_equal(before[0, :, :3], after[0, :, :3])
