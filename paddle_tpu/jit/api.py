@@ -27,6 +27,15 @@ import jax.numpy as jnp
 from paddle_tpu.core import autograd as _ag
 from paddle_tpu.core.tensor import Tensor
 
+# trace failures that mean "this fragment is not capturable", not user bugs:
+# a tracer leaked into Python control flow / indexing / int conversion
+_TRACE_BREAK_ERRORS = (
+    jax.errors.ConcretizationTypeError,  # includes TracerBoolConversionError
+    jax.errors.TracerArrayConversionError,
+    jax.errors.TracerIntegerConversionError,
+    jax.errors.NonConcreteBooleanIndexError,
+)
+
 __all__ = ["to_static", "StaticFunction", "not_to_static", "ignore_module"]
 
 
@@ -143,6 +152,14 @@ class StaticFunction:
         self._input_spec = input_spec
         self._cache: Dict[Any, Any] = {}
         self._bound_self = getattr(fn, "__self__", None)
+        # full_graph=False is the SOT analog (reference jit/sot/translate.py:
+        # guard-based capture with graph breaks): on an untraceable fragment
+        # (data-dependent Python control flow) the call falls back to eager
+        # for that guard key instead of raising; the key set below is the
+        # guard cache, so later calls with the same signature skip the
+        # doomed re-trace.
+        self._full_graph = bool(full_graph)
+        self._eager_keys: set = set()
 
     @property
     def function(self) -> Callable:
@@ -156,7 +173,10 @@ class StaticFunction:
         name = getattr(self._fn, "__name__", "forward")
         cached = instance.__dict__.get(f"__static_{name}__")
         if cached is None:
-            cached = StaticFunction(self._fn.__get__(instance, owner), self._input_spec)
+            cached = StaticFunction(
+                self._fn.__get__(instance, owner), self._input_spec,
+                full_graph=self._full_graph,
+            )
             instance.__dict__[f"__static_{name}__"] = cached
         return cached
 
@@ -187,6 +207,9 @@ class StaticFunction:
             scan_objs.append(self._bound_self)
         state = _discover_state(scan_objs)
         key = self._cache_key(leaves, treedef, state, scan_objs)
+
+        if key in self._eager_keys:  # guard cache: known graph break
+            return self._fn(*args, **kwargs)
 
         tensor_pos = [i for i, l in enumerate(leaves) if isinstance(l, (Tensor, jax.Array))]
         in_arrays = [leaves[i]._data if isinstance(leaves[i], Tensor) else leaves[i] for i in tensor_pos]
@@ -234,9 +257,26 @@ class StaticFunction:
 
             self._cache[key] = jax.jit(staged, donate_argnums=(0, 1))
 
-        out_arrays, new_state, new_opt, new_rng = self._cache[key](
-            state_arrays, opt_states, rng_key, in_arrays
-        )
+        try:
+            out_arrays, new_state, new_opt, new_rng = self._cache[key](
+                state_arrays, opt_states, rng_key, in_arrays
+            )
+        except _TRACE_BREAK_ERRORS as exc:
+            if self._full_graph:
+                raise
+            # graph break (reference SOT's fallback-to-eager): drop the doomed
+            # compile-cache entry, remember the guard key, run eagerly
+            import warnings
+
+            self._cache.pop(key, None)
+            self._eager_keys.add(key)
+            warnings.warn(
+                f"to_static({getattr(self._fn, '__name__', '?')}): graph break — "
+                f"falling back to eager for this input signature "
+                f"({type(exc).__name__}); pass full_graph=True to make this an error",
+                stacklevel=2,
+            )
+            return self._fn(*args, **kwargs)
         # Commit mutated state back into the framework objects.
         import paddle_tpu.core.rng as _rng
 
@@ -285,7 +325,7 @@ def to_static(
         from paddle_tpu.nn.layer.layers import Layer
 
         if isinstance(fn, Layer):
-            fn.forward = StaticFunction(fn.forward, input_spec)
+            fn.forward = StaticFunction(fn.forward, input_spec, full_graph=full_graph)
             return fn
         return StaticFunction(fn, input_spec, build_strategy, full_graph)
 

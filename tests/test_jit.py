@@ -186,3 +186,90 @@ def test_rng_key_not_mesh_committed_after_sharded_step():
         paddle.jit.save(fresh, f"{d}/m", input_spec=[InputSpec([2, 4], "float32")])
         loaded = paddle.jit.load(f"{d}/m")
         assert loaded._exported.nr_devices == 1
+
+
+class TestGraphBreakFallback:
+    """to_static(full_graph=False) — the SOT analog (reference
+    jit/sot/translate.py): untraceable data-dependent Python control flow
+    falls back to eager with a per-signature guard cache."""
+
+    def test_data_dependent_control_flow_runs(self):
+        import warnings
+
+        calls = {"n": 0}
+
+        @paddle.jit.to_static(full_graph=False)
+        def fn(x):
+            calls["n"] += 1
+            if float(x.sum()) > 0:  # data-dependent Python branch
+                return x * 2
+            return x - 1
+
+        pos = paddle.to_tensor(np.ones((2, 2), np.float32))
+        neg = paddle.to_tensor(-np.ones((2, 2), np.float32))
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            np.testing.assert_allclose(fn(pos).numpy(), 2 * np.ones((2, 2)))
+        assert any("graph break" in str(i.message) for i in w)
+        # both branches work (pure-eager semantics)
+        np.testing.assert_allclose(fn(neg).numpy(), -2 * np.ones((2, 2)))
+
+    def test_guard_cache_skips_retrace(self):
+        traces = {"n": 0}
+
+        @paddle.jit.to_static(full_graph=False)
+        def fn(x):
+            traces["n"] += 1
+            if float(x.max()) > 100:
+                return x * 0
+            return x + 1
+
+        x = paddle.to_tensor(np.zeros((3,), np.float32))
+        fn(x)
+        n_after_first = traces["n"]  # trace attempt + eager run
+        fn(x)
+        fn(x)
+        # guard cache: each later call is exactly ONE eager execution
+        assert traces["n"] == n_after_first + 2
+
+    def test_full_graph_still_raises(self):
+        @paddle.jit.to_static  # default full_graph=True
+        def fn(x):
+            if float(x.sum()) > 0:
+                return x * 2
+            return x
+
+        with pytest.raises(Exception):
+            fn(paddle.to_tensor(np.ones((2,), np.float32)))
+
+    def test_traceable_fn_still_compiles_under_partial_graph(self):
+        """full_graph=False must not force eager for traceable functions."""
+        traces = {"n": 0}
+
+        @paddle.jit.to_static(full_graph=False)
+        def fn(x):
+            traces["n"] += 1
+            return x * 3 + 1
+
+        x = paddle.to_tensor(np.ones((4,), np.float32))
+        fn(x)
+        fn(x)
+        fn(x)
+        assert traces["n"] == 1  # traced once, compiled cache after
+
+    def test_layer_mode_change_keeps_guard_per_signature(self):
+        """A shape change is a NEW guard key: it gets its own trace attempt."""
+
+        @paddle.jit.to_static(full_graph=False)
+        def fn(x):
+            if float(x.sum()) > 0:
+                return x * 2
+            return x
+
+        import warnings
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            a = fn(paddle.to_tensor(np.ones((2,), np.float32)))
+            b = fn(paddle.to_tensor(np.ones((3, 3), np.float32)))
+        np.testing.assert_allclose(a.numpy(), 2 * np.ones((2,)))
+        np.testing.assert_allclose(b.numpy(), 2 * np.ones((3, 3)))
