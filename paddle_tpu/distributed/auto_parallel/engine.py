@@ -431,6 +431,33 @@ class Engine:
         if load_optimizer and self._optimizer is not None and os.path.exists(opt_path):
             self._optimizer.set_state_dict(paddle.load(opt_path))
 
+    def cost(self, model_cfg: Dict[str, Any], global_batch_size: int = 1,
+             **knobs: Any) -> Dict[str, float]:
+        """Analytic step-time estimate for THIS engine's mesh + strategy
+        (reference ``auto_parallel/static/cost/``): a sanity check that the
+        chosen sharding isn't comm- or bubble-dominated before training."""
+        from paddle_tpu.distributed.auto_parallel.cost_model import estimate_step_time
+
+        mesh = self._mesh
+        shape = dict(zip(mesh.dim_names, mesh.shape)) if mesh is not None else {}
+        s = self._strategy
+        acc = s.gradient_merge.k_steps if s.gradient_merge.enable else 1
+        dp = max(shape.get("dp", 1), 1)
+        cfg = {
+            "dp_degree": dp,
+            "mp_degree": shape.get("mp", shape.get("tp", 1)),
+            "pp_degree": shape.get("pp", 1),
+            "sharding_degree": dp if s.sharding.enable else 1,
+            "sharding_stage": s.sharding.stage if s.sharding.enable else 1,
+            "use_recompute": s.recompute.enable,
+            # the per-dp batch splits into acc micro-batches
+            "micro_batch_size": max(1, global_batch_size // (dp * acc)),
+            "acc_steps": acc,
+        }
+        tuner_cfg = {"model_cfg": model_cfg, "global_batch_size": global_batch_size}
+        tuner_cfg.update(knobs)
+        return estimate_step_time(cfg, tuner_cfg)
+
     # parity introspection
     @property
     def strategy(self) -> Strategy:
