@@ -68,3 +68,58 @@ class TestLaunchRun:
         rc = launch(["--nproc_per_node", "2", script])
         assert rc == 3
         assert time.time() - t0 < 25  # watcher killed the sleeper
+
+
+class TestTwoNodeHandshake:
+    """End-to-end jax.distributed coordination on localhost (VERDICT r5 #9):
+    two `launch` node-processes, one worker each, real coordinator handshake
+    through PADDLE_MASTER -> init_parallel_env -> cross-process allgather."""
+
+    def test_two_node_localhost_coordination(self, tmp_path):
+        import socket
+        import time
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+
+        worker = tmp_path / "worker.py"
+        worker.write_text(textwrap.dedent("""
+            import os
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            import paddle_tpu.distributed as dist
+
+            dist.init_parallel_env()  # wires jax.distributed from PADDLE_* env
+            assert jax.process_count() == 2, jax.process_count()
+            rank = jax.process_index()
+            assert rank == int(os.environ["PADDLE_TRAINER_ID"])
+            import jax.numpy as jnp
+            from jax.experimental import multihost_utils
+
+            x = jnp.ones((1,), jnp.float32) * (rank + 1)
+            g = multihost_utils.process_allgather(x)
+            assert float(g.sum()) == 3.0, g  # 1 + 2 across the two nodes
+            print("HANDSHAKE_OK", rank, flush=True)
+        """))
+
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("XLA_FLAGS", None)  # no virtual 8-device split in workers
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        logs = [str(tmp_path / f"node{r}") for r in range(2)]
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-m", "paddle_tpu.distributed.launch",
+                 "--master", f"127.0.0.1:{port}", "--nnodes", "2", "--rank", str(r),
+                 "--nproc_per_node", "1", "--log_dir", logs[r], str(worker)],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            )
+            for r in range(2)
+        ]
+        deadline = time.time() + 180
+        for p in procs:
+            p.wait(timeout=max(5.0, deadline - time.time()))
+        outs = [open(os.path.join(logs[r], "workerlog.0")).read() for r in range(2)]
+        assert procs[0].returncode == 0 and procs[1].returncode == 0, outs
+        assert "HANDSHAKE_OK 0" in outs[0] and "HANDSHAKE_OK 1" in outs[1], outs
