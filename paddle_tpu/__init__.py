@@ -112,6 +112,7 @@ from paddle_tpu.ops.parity import *  # noqa: F401,F403
 
 # ---- subpackages ------------------------------------------------------------
 from paddle_tpu import amp  # noqa: F401
+from paddle_tpu import audio  # noqa: F401
 from paddle_tpu import autograd  # noqa: F401
 from paddle_tpu import distributed  # noqa: F401
 from paddle_tpu import distribution  # noqa: F401
@@ -127,6 +128,7 @@ from paddle_tpu import nn  # noqa: F401
 from paddle_tpu import optimizer  # noqa: F401
 from paddle_tpu import profiler  # noqa: F401
 from paddle_tpu import static  # noqa: F401
+from paddle_tpu import text  # noqa: F401
 from paddle_tpu import inference  # noqa: F401
 from paddle_tpu import sparse  # noqa: F401
 from paddle_tpu import incubate  # noqa: F401

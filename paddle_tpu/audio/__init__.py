@@ -1,0 +1,23 @@
+"""``paddle_tpu.audio`` — audio feature extraction.
+
+Reference: ``python/paddle/audio/`` (``functional/window.py`` window
+families, ``functional/functional.py`` mel/dct math, ``features/layers.py``
+Spectrogram / MelSpectrogram / LogMelSpectrogram / MFCC layers).
+
+TPU-native shape: every feature is a composition of the framework's
+``signal.stft`` (batched matmul-friendly framing) and dense mel/DCT
+projection matrices built host-side with numpy — the whole pipeline jits
+into a handful of XLA ops, no librosa dependency.
+"""
+
+from paddle_tpu.audio import functional  # noqa: F401
+from paddle_tpu.audio.features import (  # noqa: F401
+    MFCC,
+    LogMelSpectrogram,
+    MelSpectrogram,
+    Spectrogram,
+)
+
+__all__ = ["functional", "features", "Spectrogram", "MelSpectrogram",
+           "LogMelSpectrogram", "MFCC"]
+from paddle_tpu.audio import features  # noqa: F401,E402

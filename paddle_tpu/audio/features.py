@@ -1,0 +1,106 @@
+"""Audio feature layers (reference ``python/paddle/audio/features/layers.py``):
+Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC — thin Layers over
+``signal.stft`` + host-built mel/DCT projection matrices."""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Union
+
+import jax.numpy as jnp
+
+import paddle_tpu.signal as signal
+from paddle_tpu.audio.functional import (
+    compute_fbank_matrix,
+    create_dct,
+    get_window,
+    power_to_db,
+)
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn.layer.layers import Layer
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft: int = 512, hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: Union[str, tuple] = "hann",
+                 power: float = 2.0, center: bool = True, pad_mode: str = "reflect",
+                 dtype: str = "float32") -> None:
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.window = get_window(window, self.win_length, fftbins=True, dtype=dtype)
+
+    def forward(self, x: Any) -> Tensor:
+        spec = signal.stft(
+            x, self.n_fft, self.hop_length, self.win_length, self.window,
+            center=self.center, pad_mode=self.pad_mode,
+        )
+        mag = spec.abs() if hasattr(spec, "abs") else Tensor(jnp.abs(spec._data))
+        if self.power == 1.0:
+            return mag
+        return Tensor(jnp.power(mag._data.astype(jnp.float32), self.power))
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, n_fft: int = 2048, hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: Union[str, tuple] = "hann",
+                 power: float = 2.0, center: bool = True, pad_mode: str = "reflect",
+                 n_mels: int = 64, f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm: Union[str, float] = "slaney",
+                 dtype: str = "float32") -> None:
+        super().__init__()
+        self.spectrogram = Spectrogram(
+            n_fft, hop_length, win_length, window, power, center, pad_mode, dtype
+        )
+        self.fbank = compute_fbank_matrix(
+            sr, n_fft, n_mels, f_min, f_max, htk, norm, dtype
+        )  # [n_mels, freq]
+
+    def forward(self, x: Any) -> Tensor:
+        s = self.spectrogram(x)  # [..., freq, frames]
+        return Tensor(jnp.einsum("mf,...ft->...mt", self.fbank._data, s._data))
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, n_fft: int = 2048, hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: Union[str, tuple] = "hann",
+                 power: float = 2.0, center: bool = True, pad_mode: str = "reflect",
+                 n_mels: int = 64, f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm: Union[str, float] = "slaney",
+                 ref_value: float = 1.0, amin: float = 1e-10,
+                 top_db: Optional[float] = None, dtype: str = "float32") -> None:
+        super().__init__()
+        self.mel = MelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center, pad_mode,
+            n_mels, f_min, f_max, htk, norm, dtype,
+        )
+        self.ref_value, self.amin, self.top_db = ref_value, amin, top_db
+
+    def forward(self, x: Any) -> Tensor:
+        return power_to_db(self.mel(x), self.ref_value, self.amin, self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr: int = 22050, n_mfcc: int = 40, n_fft: int = 2048,
+                 hop_length: Optional[int] = None, win_length: Optional[int] = None,
+                 window: Union[str, tuple] = "hann", power: float = 2.0,
+                 center: bool = True, pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None, htk: bool = False,
+                 norm: Union[str, float] = "slaney", ref_value: float = 1.0,
+                 amin: float = 1e-10, top_db: Optional[float] = None,
+                 dtype: str = "float32") -> None:
+        super().__init__()
+        self.logmel = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center, pad_mode,
+            n_mels, f_min, f_max, htk, norm, ref_value, amin, top_db, dtype,
+        )
+        self.dct = create_dct(n_mfcc, n_mels, dtype=dtype)  # [n_mels, n_mfcc]
+
+    def forward(self, x: Any) -> Tensor:
+        lm = self.logmel(x)  # [..., n_mels, frames]
+        return Tensor(jnp.einsum("mk,...mt->...kt", self.dct._data, lm._data))

@@ -1,0 +1,190 @@
+"""Audio functional ops (reference ``python/paddle/audio/functional``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = [
+    "get_window", "hz_to_mel", "mel_to_hz", "mel_frequencies",
+    "fft_frequencies", "compute_fbank_matrix", "power_to_db", "create_dct",
+]
+
+
+def get_window(window: Union[str, tuple], win_length: int, fftbins: bool = True,
+               dtype: str = "float64") -> Tensor:
+    """Window families (reference ``window.py:get_window``): hamming, hann,
+    blackman, bartlett, kaiser, gaussian, exponential, taylor, bohman,
+    nuttall, cosine, tukey, triang, rect."""
+    name, args = (window, ()) if isinstance(window, str) else (window[0], tuple(window[1:]))
+    M = int(win_length)
+    sym = not fftbins
+    n = M if sym else M + 1  # periodic windows drop the last symmetric point
+    t = np.arange(n, dtype=np.float64)
+
+    def cosine_sum(coeffs):
+        w = np.zeros(n, np.float64)
+        for k, a in enumerate(coeffs):
+            w += (-1) ** k * a * np.cos(2 * np.pi * k * t / max(n - 1, 1))
+        return w
+
+    if name in ("rect", "boxcar", "rectangular"):
+        w = np.ones(n)
+    elif name == "hamming":
+        w = cosine_sum([0.54, 0.46])
+    elif name in ("hann", "hanning"):
+        w = cosine_sum([0.5, 0.5])
+    elif name == "blackman":
+        w = cosine_sum([0.42, 0.5, 0.08])
+    elif name == "nuttall":
+        w = cosine_sum([0.3635819, 0.4891775, 0.1365995, 0.0106411])
+    elif name == "bartlett":
+        w = 1.0 - np.abs(2 * t / max(n - 1, 1) - 1.0)
+    elif name == "triang":
+        # scipy triang differs from bartlett: nonzero endpoints
+        if n % 2 == 0:
+            half = np.arange(1, n // 2 + 1)
+            rising = (2 * half - 1.0) / n
+            w = np.concatenate([rising, rising[::-1]])
+        else:
+            half = np.arange(1, (n + 1) // 2 + 1)
+            rising = 2 * half / (n + 1.0)
+            w = np.concatenate([rising, rising[-2::-1]])
+    elif name == "cosine":
+        w = np.sin(np.pi / n * (t + 0.5))
+    elif name == "bohman":
+        x = np.abs(2 * t / max(n - 1, 1) - 1.0)
+        w = (1 - x) * np.cos(np.pi * x) + np.sin(np.pi * x) / np.pi
+    elif name == "kaiser":
+        beta = args[0] if args else 12.0
+        w = np.i0(beta * np.sqrt(1 - (2 * t / max(n - 1, 1) - 1) ** 2)) / np.i0(beta)
+    elif name == "gaussian":
+        std = args[0] if args else 7.0
+        w = np.exp(-0.5 * ((t - (n - 1) / 2.0) / std) ** 2)
+    elif name == "exponential":
+        center = args[0] if len(args) > 0 and args[0] is not None else (n - 1) / 2.0
+        tau = args[1] if len(args) > 1 else 1.0
+        w = np.exp(-np.abs(t - center) / tau)
+    elif name == "tukey":
+        alpha = args[0] if args else 0.5
+        w = np.ones(n)
+        edge = int(np.floor(alpha * (n - 1) / 2.0))
+        if edge > 0:
+            ramp = 0.5 * (1 + np.cos(np.pi * (2 * t[: edge + 1] / (alpha * (n - 1)) - 1)))
+            w[: edge + 1] = ramp
+            w[-(edge + 1):] = ramp[::-1]
+    elif name == "taylor":
+        # 4-term, 30 dB sidelobe Taylor window, peak-normalized (the
+        # reference's norm=True default)
+        nbar, sll = (int(args[0]) if args else 4), (args[1] if len(args) > 1 else 30.0)
+        B = 10 ** (sll / 20)
+        A = np.arccosh(B) / np.pi
+        s2 = nbar**2 / (A**2 + (nbar - 0.5) ** 2)
+        ma = np.arange(1, nbar)
+        Fm = np.empty(nbar - 1)
+        signs = np.empty_like(ma, float)
+        signs[::2] = 1
+        signs[1::2] = -1
+        m2 = ma**2
+        for mi, _m in enumerate(ma):
+            numer = signs[mi] * np.prod(1 - m2[mi] / s2 / (A**2 + (ma - 0.5) ** 2))
+            denom = 2 * np.prod(1 - m2[mi] / m2[:mi]) * np.prod(1 - m2[mi] / m2[mi + 1:])
+            Fm[mi] = numer / denom
+        w = np.ones(n)
+        pos = (t - (n - 1) / 2.0) / n
+        for mi, m in enumerate(ma):
+            w = w + 2 * Fm[mi] * np.cos(2 * np.pi * m * pos)
+        w = w / (1.0 + 2.0 * Fm.sum())  # peak normalization (center == 1)
+    else:
+        raise ValueError(f"unsupported window {name!r}")
+    if not sym:
+        w = w[:-1]
+    # jnp.asarray honors the request when x64 is enabled; under the default
+    # config float64 downcasts to float32 with jax's usual truncation warning
+    return Tensor(jnp.asarray(w, jnp.dtype(dtype)))
+
+
+def hz_to_mel(freq: Any, htk: bool = False):
+    f = np.asarray(freq, np.float64) if not isinstance(freq, Tensor) else freq.numpy()
+    if htk:
+        out = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:  # Slaney
+        f_min, f_sp = 0.0, 200.0 / 3
+        out = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = np.log(6.4) / 27.0
+        out = np.where(f >= min_log_hz, min_log_mel + np.log(np.maximum(f, 1e-10) / min_log_hz) / logstep, out)
+    return float(out) if np.ndim(out) == 0 else out
+
+
+def mel_to_hz(mel: Any, htk: bool = False):
+    m = np.asarray(mel, np.float64) if not isinstance(mel, Tensor) else mel.numpy()
+    if htk:
+        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        out = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = np.log(6.4) / 27.0
+        out = np.where(m >= min_log_mel, min_log_hz * np.exp(logstep * (m - min_log_mel)), out)
+    return float(out) if np.ndim(out) == 0 else out
+
+
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0, f_max: float = 11025.0,
+                    htk: bool = False):
+    return mel_to_hz(np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk), n_mels), htk)
+
+
+def fft_frequencies(sr: int, n_fft: int):
+    return np.linspace(0, sr / 2.0, 1 + n_fft // 2)
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64, f_min: float = 0.0,
+                         f_max: Optional[float] = None, htk: bool = False,
+                         norm: Union[str, float] = "slaney", dtype: str = "float32") -> Tensor:
+    """Mel filterbank ``[n_mels, 1 + n_fft//2]`` (reference
+    ``functional.py:compute_fbank_matrix``)."""
+    f_max = f_max if f_max is not None else sr / 2.0
+    fftfreqs = fft_frequencies(sr, n_fft)
+    melfreqs = mel_frequencies(n_mels + 2, f_min, f_max, htk)
+    fdiff = np.diff(melfreqs)
+    ramps = melfreqs[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = np.maximum(0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (melfreqs[2 : n_mels + 2] - melfreqs[:n_mels])
+        weights *= enorm[:, None]
+    elif isinstance(norm, (int, float)):
+        weights /= np.maximum(np.linalg.norm(weights, ord=norm, axis=-1, keepdims=True), 1e-10)
+    return Tensor(jnp.asarray(weights, jnp.dtype(dtype)))
+
+
+def power_to_db(spect: Any, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db: Optional[float] = 80.0) -> Tensor:
+    x = spect._data if isinstance(spect, Tensor) else jnp.asarray(spect)
+    log_spec = 10.0 * jnp.log10(jnp.maximum(x, amin))
+    log_spec = log_spec - 10.0 * jnp.log10(jnp.maximum(ref_value, amin))
+    if top_db is not None:
+        log_spec = jnp.maximum(log_spec, jnp.max(log_spec) - top_db)
+    return Tensor(log_spec)
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm: Optional[str] = "ortho",
+               dtype: str = "float32") -> Tensor:
+    """DCT-II matrix ``[n_mels, n_mfcc]`` (reference ``create_dct``)."""
+    n = np.arange(n_mels, dtype=np.float64)
+    k = np.arange(n_mfcc, dtype=np.float64)[None, :]
+    dct = np.cos(np.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm == "ortho":
+        dct[:, 0] *= np.sqrt(1.0 / n_mels)
+        dct[:, 1:] *= np.sqrt(2.0 / n_mels)
+    else:
+        dct *= 2.0
+    return Tensor(jnp.asarray(dct, jnp.dtype(dtype)))

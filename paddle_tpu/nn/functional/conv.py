@@ -219,12 +219,18 @@ def _adaptive_pool(x, output_size, n, data_format, op):
     for dim, target in zip(spatial_dims, os_):
         size = out.shape[dim]
         if size % target != 0:
-            # general case: average over variable windows via segment reduce
-            idx = (np.arange(size) * target) // size
-            one_hot = jax.nn.one_hot(jnp.asarray(idx), target, dtype=out.dtype)
+            # general case (covers upsampling, target > size): window i reads
+            # inputs [floor(i*size/target), ceil((i+1)*size/target)) — never
+            # empty, matching paddle/torch adaptive-pool semantics
+            i = np.arange(target)
+            starts = (i * size) // target
+            ends = np.maximum(-(-((i + 1) * size) // target), starts + 1)
+            j = np.arange(size)[:, None]
+            member = (j >= starts[None, :]) & (j < ends[None, :])  # [size, target]
+            one_hot = jnp.asarray(member, out.dtype)
             moved = jnp.moveaxis(out, dim, -1)
             if op == "avg":
-                counts = jnp.asarray(np.bincount(idx, minlength=target), out.dtype)
+                counts = jnp.asarray(member.sum(0), out.dtype)
                 red = jnp.matmul(moved, one_hot) / counts
             else:
                 red = jnp.max(

@@ -1,0 +1,36 @@
+"""``paddle_tpu.text`` — text utilities and datasets.
+
+Reference: ``python/paddle/text/`` (``viterbi_decode.py`` ViterbiDecoder +
+datasets). The decode math lives in the op layer (``ops/parity.py``
+``viterbi_decode`` — a ``lax.scan`` max-sum DP); datasets parse local files
+only (this environment has zero egress; the reference's downloader is
+replaced by an explicit ``data_file`` argument).
+"""
+
+from typing import Any, Optional
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn.layer.layers import Layer
+from paddle_tpu.ops.parity import viterbi_decode  # noqa: F401
+from paddle_tpu.text.datasets import Imdb, Imikolov, UCIHousing  # noqa: F401
+
+__all__ = ["viterbi_decode", "ViterbiDecoder", "UCIHousing", "Imdb", "Imikolov"]
+
+
+class ViterbiDecoder(Layer):
+    """Reference ``text/viterbi_decode.py:110``: holds the transition matrix,
+    decodes emission potentials to (scores, best tag paths)."""
+
+    def __init__(self, transitions: Any, include_bos_eos_tag: bool = True,
+                 name: Optional[str] = None) -> None:
+        super().__init__()
+        self.transitions = (
+            transitions if isinstance(transitions, Tensor) else Tensor(transitions)
+        )
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials: Any, lengths: Any = None):
+        return viterbi_decode(
+            potentials, self.transitions, lengths,
+            include_bos_eos_tag=self.include_bos_eos_tag,
+        )

@@ -1,0 +1,134 @@
+"""Text datasets (reference ``python/paddle/text/datasets/``): parse the
+reference's file formats from a LOCAL ``data_file`` (no downloader — this
+environment has zero egress; point ``data_file`` at the archive/file)."""
+
+from __future__ import annotations
+
+import collections
+import os
+import re
+import tarfile
+from typing import Optional
+
+import numpy as np
+
+from paddle_tpu.io import Dataset
+
+__all__ = ["UCIHousing", "Imdb", "Imikolov"]
+
+
+def _require(data_file: Optional[str], name: str) -> str:
+    if not data_file or not os.path.exists(data_file):
+        raise FileNotFoundError(
+            f"{name} needs an explicit local data_file (no downloader in this "
+            f"environment); got {data_file!r}"
+        )
+    return data_file
+
+
+class UCIHousing(Dataset):
+    """Reference ``uci_housing.py:51``: 13 features + 1 target, whitespace
+    floats, feature-normalized over the whole file; 80/20 train/test split."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train") -> None:
+        path = _require(data_file, "UCIHousing")
+        raw = np.loadtxt(path, dtype=np.float32).reshape(-1, 14)
+        features = raw[:, :13]
+        maxs, mins, avgs = features.max(0), features.min(0), features.mean(0)
+        denom = np.where(maxs - mins == 0, 1.0, maxs - mins)
+        raw[:, :13] = (features - avgs) / denom
+        split = int(raw.shape[0] * 0.8)
+        self.data = raw[:split] if mode == "train" else raw[split:]
+        self.mode = mode
+
+    def __len__(self) -> int:
+        return len(self.data)
+
+    def __getitem__(self, idx: int):
+        row = self.data[idx]
+        return row[:13], row[13:]
+
+
+_TOKEN = re.compile(rb"[A-Za-z0-9']+")
+
+
+class Imdb(Dataset):
+    """Reference ``imdb.py:39``: sentiment pairs from the aclImdb tar —
+    builds a frequency-cutoff vocabulary over the reviews and yields
+    ``(ids int64[...], label int64)`` with 0=pos, 1=neg."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 cutoff: int = 150) -> None:
+        path = _require(data_file, "Imdb")
+        with tarfile.open(path) as tf:
+            members = [
+                m for m in tf.getmembers()
+                if m.isfile() and re.match(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$", m.name)
+            ]
+            docs, labels = [], []
+            freq: collections.Counter = collections.Counter()
+            for m in members:
+                words = _TOKEN.findall(tf.extractfile(m).read().lower())
+                docs.append(words)
+                labels.append(0 if "/pos/" in m.name else 1)
+                freq.update(words)
+        vocab_words = sorted(
+            (w for w, c in freq.items() if c >= cutoff), key=lambda w: (-freq[w], w)
+        )
+        self.word_idx = {w: i for i, w in enumerate(vocab_words)}
+        unk = self.word_idx[b"<unk>"] = len(self.word_idx)
+        self.docs = [
+            np.asarray([self.word_idx.get(w, unk) for w in d], np.int64) for d in docs
+        ]
+        self.labels = np.asarray(labels, np.int64)
+
+    def __len__(self) -> int:
+        return len(self.docs)
+
+    def __getitem__(self, idx: int):
+        return self.docs[idx], int(self.labels[idx])
+
+
+class Imikolov(Dataset):
+    """Reference ``imikolov.py``: PTB language-model n-grams. ``data_file``
+    points at the ``simple-examples`` tar or a plain tokenized text file."""
+
+    def __init__(self, data_file: Optional[str] = None, data_type: str = "NGRAM",
+                 window_size: int = 5, mode: str = "train", min_word_freq: int = 50) -> None:
+        path = _require(data_file, "Imikolov")
+        name = {"train": "ptb.train.txt", "test": "ptb.valid.txt"}[mode]
+        if tarfile.is_tarfile(path):
+            with tarfile.open(path) as tf:
+                member = next(m for m in tf.getmembers() if m.name.endswith(name))
+                lines = tf.extractfile(member).read().decode().splitlines()
+        else:
+            lines = open(path).read().splitlines()
+        freq: collections.Counter = collections.Counter()
+        sents = []
+        for line in lines:
+            words = line.strip().split()
+            sents.append(words)
+            freq.update(words)
+        vocab = sorted(
+            (w for w, c in freq.items() if c >= min_word_freq and w != "<unk>"),
+            key=lambda w: (-freq[w], w),
+        )
+        self.word_idx = {w: i for i, w in enumerate(vocab)}
+        unk = self.word_idx["<unk>"] = len(self.word_idx)
+        bos = self.word_idx["<s>"] = len(self.word_idx)
+        eos = self.word_idx["<e>"] = len(self.word_idx)
+        self.data = []
+        for words in sents:
+            # reference wraps every sentence as <s> ... <e>
+            ids = [bos] + [self.word_idx.get(w, unk) for w in words] + [eos]
+            if data_type.upper() == "NGRAM":
+                for i in range(len(ids) - window_size + 1):
+                    self.data.append(np.asarray(ids[i : i + window_size], np.int64))
+            else:  # SEQ
+                self.data.append(np.asarray(ids, np.int64))
+
+    def __len__(self) -> int:
+        return len(self.data)
+
+    def __getitem__(self, idx: int):
+        return self.data[idx]

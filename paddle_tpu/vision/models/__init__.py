@@ -6,3 +6,30 @@ from paddle_tpu.vision.models.resnet import (  # noqa: F401
     resnet101,
     resnet152,
 )
+from paddle_tpu.vision.models.vgg import (  # noqa: F401
+    VGG,
+    vgg11,
+    vgg13,
+    vgg16,
+    vgg19,
+)
+from paddle_tpu.vision.models.mobilenet import (  # noqa: F401
+    MobileNetV1,
+    MobileNetV2,
+    MobileNetV3Large,
+    MobileNetV3Small,
+    mobilenet_v1,
+    mobilenet_v2,
+    mobilenet_v3_large,
+    mobilenet_v3_small,
+)
+from paddle_tpu.vision.models.misc import (  # noqa: F401
+    AlexNet,
+    LeNet,
+    ShuffleNetV2,
+    SqueezeNet,
+    alexnet,
+    shufflenet_v2_x1_0,
+    squeezenet1_0,
+    squeezenet1_1,
+)

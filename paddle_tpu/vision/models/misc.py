@@ -1,0 +1,212 @@
+"""LeNet / AlexNet / SqueezeNet / ShuffleNetV2 (reference
+``python/paddle/vision/models/{lenet,alexnet,squeezenet,shufflenetv2}.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+import jax.numpy as jnp
+
+import paddle_tpu.nn as nn
+from paddle_tpu.core.dispatch import call_op
+
+__all__ = [
+    "LeNet", "AlexNet", "SqueezeNet", "ShuffleNetV2",
+    "alexnet", "squeezenet1_0", "squeezenet1_1", "shufflenet_v2_x1_0",
+]
+
+
+class LeNet(nn.Layer):
+    """Reference ``lenet.py``: MNIST-scale convnet ([N, 1, 28, 28])."""
+
+    def __init__(self, num_classes: int = 10) -> None:
+        super().__init__()
+        self.features = nn.Sequential(
+            nn.Conv2D(1, 6, 3, stride=1, padding=1), nn.ReLU(),
+            nn.MaxPool2D(2, 2),
+            nn.Conv2D(6, 16, 5, stride=1, padding=0), nn.ReLU(),
+            nn.MaxPool2D(2, 2),
+        )
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.fc = nn.Sequential(
+                nn.Linear(400, 120), nn.Linear(120, 84), nn.Linear(84, num_classes)
+            )
+
+    def forward(self, x: Any) -> Any:
+        x = self.features(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.fc(x)
+        return x
+
+
+class AlexNet(nn.Layer):
+    """Reference ``alexnet.py``."""
+
+    def __init__(self, num_classes: int = 1000) -> None:
+        super().__init__()
+        self.features = nn.Sequential(
+            nn.Conv2D(3, 64, 11, stride=4, padding=2), nn.ReLU(), nn.MaxPool2D(3, 2),
+            nn.Conv2D(64, 192, 5, padding=2), nn.ReLU(), nn.MaxPool2D(3, 2),
+            nn.Conv2D(192, 384, 3, padding=1), nn.ReLU(),
+            nn.Conv2D(384, 256, 3, padding=1), nn.ReLU(),
+            nn.Conv2D(256, 256, 3, padding=1), nn.ReLU(), nn.MaxPool2D(3, 2),
+        )
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Dropout(), nn.Linear(256 * 6 * 6, 4096), nn.ReLU(),
+                nn.Dropout(), nn.Linear(4096, 4096), nn.ReLU(),
+                nn.Linear(4096, num_classes),
+            )
+        self.pool = nn.AdaptiveAvgPool2D((6, 6))
+
+    def forward(self, x: Any) -> Any:
+        x = self.pool(self.features(x))
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.classifier(x)
+        return x
+
+
+class _Fire(nn.Layer):
+    def __init__(self, in_c: int, squeeze: int, e1: int, e3: int) -> None:
+        super().__init__()
+        self.squeeze = nn.Sequential(nn.Conv2D(in_c, squeeze, 1), nn.ReLU())
+        self.expand1 = nn.Sequential(nn.Conv2D(squeeze, e1, 1), nn.ReLU())
+        self.expand3 = nn.Sequential(nn.Conv2D(squeeze, e3, 3, padding=1), nn.ReLU())
+
+    def forward(self, x: Any) -> Any:
+        import paddle_tpu as paddle
+
+        s = self.squeeze(x)
+        return paddle.concat([self.expand1(s), self.expand3(s)], axis=1)
+
+
+class SqueezeNet(nn.Layer):
+    """Reference ``squeezenet.py`` (1.0 / 1.1 variants)."""
+
+    def __init__(self, version: str = "1.0", num_classes: int = 1000) -> None:
+        super().__init__()
+        if version == "1.0":
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 96, 7, stride=2), nn.ReLU(), nn.MaxPool2D(3, 2),
+                _Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64), _Fire(128, 32, 128, 128),
+                nn.MaxPool2D(3, 2),
+                _Fire(256, 32, 128, 128), _Fire(256, 48, 192, 192),
+                _Fire(384, 48, 192, 192), _Fire(384, 64, 256, 256),
+                nn.MaxPool2D(3, 2), _Fire(512, 64, 256, 256),
+            )
+        else:
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 64, 3, stride=2), nn.ReLU(), nn.MaxPool2D(3, 2),
+                _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
+                nn.MaxPool2D(3, 2),
+                _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+                nn.MaxPool2D(3, 2),
+                _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+                _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256),
+            )
+        self.classifier = nn.Sequential(
+            nn.Dropout(0.5), nn.Conv2D(512, num_classes, 1), nn.ReLU(),
+            nn.AdaptiveAvgPool2D(1),
+        )
+
+    def forward(self, x: Any) -> Any:
+        x = self.classifier(self.features(x))
+        return x.flatten(1)
+
+
+def _channel_shuffle(x: Any, groups: int) -> Any:
+    def fn(a):
+        n, c, h, w = a.shape
+        a = a.reshape(n, groups, c // groups, h, w)
+        a = jnp.swapaxes(a, 1, 2)
+        return a.reshape(n, c, h, w)
+
+    return call_op("shufflenet_channel_shuffle", fn, x)
+
+
+class _ShuffleUnit(nn.Layer):
+    def __init__(self, in_c: int, out_c: int, stride: int) -> None:
+        super().__init__()
+        self.stride = stride
+        branch = out_c // 2
+        if stride == 2:
+            self.branch1 = nn.Sequential(
+                nn.Conv2D(in_c, in_c, 3, stride=2, padding=1, groups=in_c, bias_attr=False),
+                nn.BatchNorm2D(in_c),
+                nn.Conv2D(in_c, branch, 1, bias_attr=False), nn.BatchNorm2D(branch), nn.ReLU(),
+            )
+            b2_in = in_c
+        else:
+            self.branch1 = None
+            b2_in = in_c // 2
+        self.branch2 = nn.Sequential(
+            nn.Conv2D(b2_in, branch, 1, bias_attr=False), nn.BatchNorm2D(branch), nn.ReLU(),
+            nn.Conv2D(branch, branch, 3, stride=stride, padding=1, groups=branch, bias_attr=False),
+            nn.BatchNorm2D(branch),
+            nn.Conv2D(branch, branch, 1, bias_attr=False), nn.BatchNorm2D(branch), nn.ReLU(),
+        )
+
+    def forward(self, x: Any) -> Any:
+        import paddle_tpu as paddle
+
+        if self.stride == 1:
+            c = x.shape[1] // 2
+            x1, x2 = x[:, :c], x[:, c:]
+            out = paddle.concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = paddle.concat([self.branch1(x), self.branch2(x)], axis=1)
+        return _channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    """Reference ``shufflenetv2.py`` (x1.0)."""
+
+    def __init__(self, scale: float = 1.0, num_classes: int = 1000) -> None:
+        super().__init__()
+        stages = {0.5: [48, 96, 192, 1024], 1.0: [116, 232, 464, 1024],
+                  1.5: [176, 352, 704, 1024], 2.0: [244, 488, 976, 2048]}[scale]
+        self.conv1 = nn.Sequential(
+            nn.Conv2D(3, 24, 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(24), nn.ReLU(),
+        )
+        self.maxpool = nn.MaxPool2D(3, 2, padding=1)
+        in_c = 24
+        blocks: List[Any] = []
+        for out_c, repeat in zip(stages[:3], (4, 8, 4)):
+            blocks.append(_ShuffleUnit(in_c, out_c, 2))
+            for _ in range(repeat - 1):
+                blocks.append(_ShuffleUnit(out_c, out_c, 1))
+            in_c = out_c
+        self.stages = nn.Sequential(*blocks)
+        self.conv_last = nn.Sequential(
+            nn.Conv2D(in_c, stages[3], 1, bias_attr=False),
+            nn.BatchNorm2D(stages[3]), nn.ReLU(),
+        )
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc = nn.Linear(stages[3], num_classes)
+
+    def forward(self, x: Any) -> Any:
+        x = self.maxpool(self.conv1(x))
+        x = self.conv_last(self.stages(x))
+        x = self.pool(x).flatten(1)
+        return self.fc(x)
+
+
+def alexnet(pretrained: bool = False, **kwargs: Any) -> AlexNet:
+    return AlexNet(**kwargs)
+
+
+def squeezenet1_0(pretrained: bool = False, **kwargs: Any) -> SqueezeNet:
+    return SqueezeNet("1.0", **kwargs)
+
+
+def squeezenet1_1(pretrained: bool = False, **kwargs: Any) -> SqueezeNet:
+    return SqueezeNet("1.1", **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained: bool = False, **kwargs: Any) -> ShuffleNetV2:
+    return ShuffleNetV2(scale=1.0, **kwargs)

@@ -1,0 +1,241 @@
+"""MobileNet V1/V2/V3 (reference ``python/paddle/vision/models/mobilenetv1.py``
+/ ``mobilenetv2.py`` / ``mobilenetv3.py``). Depthwise convs are ``groups=C``
+``Conv2D`` — XLA lowers them to TPU depthwise convolutions directly."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import paddle_tpu.nn as nn
+
+__all__ = [
+    "MobileNetV1", "MobileNetV2", "MobileNetV3Small", "MobileNetV3Large",
+    "mobilenet_v1", "mobilenet_v2", "mobilenet_v3_small", "mobilenet_v3_large",
+]
+
+
+def _make_divisible(v: float, divisor: int = 8, min_value: Optional[int] = None) -> int:
+    min_value = min_value or divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+def _conv_bn(in_c: int, out_c: int, k: int, stride: int = 1, groups: int = 1,
+             act: Any = nn.ReLU) -> nn.Sequential:
+    layers: List[Any] = [
+        nn.Conv2D(in_c, out_c, k, stride=stride, padding=(k - 1) // 2,
+                  groups=groups, bias_attr=False),
+        nn.BatchNorm2D(out_c),
+    ]
+    if act is not None:
+        layers.append(act())
+    return nn.Sequential(*layers)
+
+
+class MobileNetV1(nn.Layer):
+    """Reference ``mobilenetv1.py``: depthwise-separable stacks."""
+
+    def __init__(self, scale: float = 1.0, num_classes: int = 1000,
+                 with_pool: bool = True) -> None:
+        super().__init__()
+        s = lambda c: int(c * scale)  # noqa: E731
+        cfg = [  # (out, stride) per depthwise-separable block
+            (64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+            (512, 1), (512, 1), (512, 1), (512, 1), (512, 1), (1024, 2), (1024, 1),
+        ]
+        layers: List[Any] = [_conv_bn(3, s(32), 3, stride=2)]
+        in_c = s(32)
+        for out, stride in cfg:
+            layers.append(_conv_bn(in_c, in_c, 3, stride=stride, groups=in_c))
+            layers.append(_conv_bn(in_c, s(out), 1))
+            in_c = s(out)
+        self.features = nn.Sequential(*layers)
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(s(1024), num_classes)
+
+    def forward(self, x: Any) -> Any:
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.fc(x)
+        return x
+
+
+class _InvertedResidual(nn.Layer):
+    def __init__(self, in_c: int, out_c: int, stride: int, expand: int) -> None:
+        super().__init__()
+        hidden = in_c * expand
+        self.use_res = stride == 1 and in_c == out_c
+        layers: List[Any] = []
+        if expand != 1:
+            layers.append(_conv_bn(in_c, hidden, 1, act=nn.ReLU6))
+        layers.append(_conv_bn(hidden, hidden, 3, stride=stride, groups=hidden, act=nn.ReLU6))
+        layers.append(_conv_bn(hidden, out_c, 1, act=None))
+        self.conv = nn.Sequential(*layers)
+
+    def forward(self, x: Any) -> Any:
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(nn.Layer):
+    """Reference ``mobilenetv2.py``: inverted residuals with linear
+    bottlenecks."""
+
+    def __init__(self, scale: float = 1.0, num_classes: int = 1000,
+                 with_pool: bool = True) -> None:
+        super().__init__()
+        cfg = [  # t (expand), c, n, s
+            (1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+            (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1),
+        ]
+        in_c = _make_divisible(32 * scale)
+        last = _make_divisible(1280 * max(1.0, scale))
+        layers: List[Any] = [_conv_bn(3, in_c, 3, stride=2, act=nn.ReLU6)]
+        for t, c, n, s in cfg:
+            out_c = _make_divisible(c * scale)
+            for i in range(n):
+                layers.append(_InvertedResidual(in_c, out_c, s if i == 0 else 1, t))
+                in_c = out_c
+        layers.append(_conv_bn(in_c, last, 1, act=nn.ReLU6))
+        self.features = nn.Sequential(*layers)
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(nn.Dropout(0.2), nn.Linear(last, num_classes))
+
+    def forward(self, x: Any) -> Any:
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.classifier(x)
+        return x
+
+
+class _SqueezeExcite(nn.Layer):
+    def __init__(self, c: int, squeeze: int) -> None:
+        super().__init__()
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(c, squeeze, 1)
+        self.fc2 = nn.Conv2D(squeeze, c, 1)
+        self.relu = nn.ReLU()
+        self.hsig = nn.Hardsigmoid()
+
+    def forward(self, x: Any) -> Any:
+        s = self.hsig(self.fc2(self.relu(self.fc1(self.pool(x)))))
+        return x * s
+
+
+class _V3Block(nn.Layer):
+    def __init__(self, in_c: int, exp: int, out_c: int, k: int, stride: int,
+                 se: bool, act: Any) -> None:
+        super().__init__()
+        self.use_res = stride == 1 and in_c == out_c
+        layers: List[Any] = []
+        if exp != in_c:
+            layers.append(_conv_bn(in_c, exp, 1, act=act))
+        layers.append(_conv_bn(exp, exp, k, stride=stride, groups=exp, act=act))
+        if se:
+            layers.append(_SqueezeExcite(exp, _make_divisible(exp // 4)))
+        layers.append(_conv_bn(exp, out_c, 1, act=None))
+        self.conv = nn.Sequential(*layers)
+
+    def forward(self, x: Any) -> Any:
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+_V3_SMALL = [  # k, exp, out, se, act, stride
+    (3, 16, 16, True, nn.ReLU, 2), (3, 72, 24, False, nn.ReLU, 2),
+    (3, 88, 24, False, nn.ReLU, 1), (5, 96, 40, True, nn.Hardswish, 2),
+    (5, 240, 40, True, nn.Hardswish, 1), (5, 240, 40, True, nn.Hardswish, 1),
+    (5, 120, 48, True, nn.Hardswish, 1), (5, 144, 48, True, nn.Hardswish, 1),
+    (5, 288, 96, True, nn.Hardswish, 2), (5, 576, 96, True, nn.Hardswish, 1),
+    (5, 576, 96, True, nn.Hardswish, 1),
+]
+_V3_LARGE = [
+    (3, 16, 16, False, nn.ReLU, 1), (3, 64, 24, False, nn.ReLU, 2),
+    (3, 72, 24, False, nn.ReLU, 1), (5, 72, 40, True, nn.ReLU, 2),
+    (5, 120, 40, True, nn.ReLU, 1), (5, 120, 40, True, nn.ReLU, 1),
+    (3, 240, 80, False, nn.Hardswish, 2), (3, 200, 80, False, nn.Hardswish, 1),
+    (3, 184, 80, False, nn.Hardswish, 1), (3, 184, 80, False, nn.Hardswish, 1),
+    (3, 480, 112, True, nn.Hardswish, 1), (3, 672, 112, True, nn.Hardswish, 1),
+    (5, 672, 160, True, nn.Hardswish, 2), (5, 960, 160, True, nn.Hardswish, 1),
+    (5, 960, 160, True, nn.Hardswish, 1),
+]
+
+
+class _MobileNetV3(nn.Layer):
+    def __init__(self, cfg: List, last_exp: int, scale: float, num_classes: int,
+                 with_pool: bool) -> None:
+        super().__init__()
+        in_c = _make_divisible(16 * scale)
+        layers: List[Any] = [_conv_bn(3, in_c, 3, stride=2, act=nn.Hardswish)]
+        for k, exp, out, se, act, stride in cfg:
+            layers.append(
+                _V3Block(in_c, _make_divisible(exp * scale),
+                         _make_divisible(out * scale), k, stride, se, act)
+            )
+            in_c = _make_divisible(out * scale)
+        last_c = _make_divisible(last_exp * scale)
+        layers.append(_conv_bn(in_c, last_c, 1, act=nn.Hardswish))
+        self.features = nn.Sequential(*layers)
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            hidden = 1024 if last_exp == 576 else 1280
+            self.classifier = nn.Sequential(
+                nn.Linear(last_c, hidden), nn.Hardswish(), nn.Dropout(0.2),
+                nn.Linear(hidden, num_classes),
+            )
+
+    def forward(self, x: Any) -> Any:
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.classifier(x)
+        return x
+
+
+class MobileNetV3Small(_MobileNetV3):
+    def __init__(self, scale: float = 1.0, num_classes: int = 1000,
+                 with_pool: bool = True) -> None:
+        super().__init__(_V3_SMALL, 576, scale, num_classes, with_pool)
+
+
+class MobileNetV3Large(_MobileNetV3):
+    def __init__(self, scale: float = 1.0, num_classes: int = 1000,
+                 with_pool: bool = True) -> None:
+        super().__init__(_V3_LARGE, 960, scale, num_classes, with_pool)
+
+
+def mobilenet_v1(pretrained: bool = False, scale: float = 1.0, **kwargs: Any) -> MobileNetV1:
+    return MobileNetV1(scale=scale, **kwargs)
+
+
+def mobilenet_v2(pretrained: bool = False, scale: float = 1.0, **kwargs: Any) -> MobileNetV2:
+    return MobileNetV2(scale=scale, **kwargs)
+
+
+def mobilenet_v3_small(pretrained: bool = False, scale: float = 1.0, **kwargs: Any) -> MobileNetV3Small:
+    return MobileNetV3Small(scale=scale, **kwargs)
+
+
+def mobilenet_v3_large(pretrained: bool = False, scale: float = 1.0, **kwargs: Any) -> MobileNetV3Large:
+    return MobileNetV3Large(scale=scale, **kwargs)

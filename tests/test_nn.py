@@ -292,3 +292,18 @@ class TestInitializers:
         p = paddle.create_parameter([16, 16], default_initializer=I.Orthogonal())
         eye = p.numpy() @ p.numpy().T
         np.testing.assert_allclose(eye, np.eye(16), atol=1e-4)
+
+
+def test_adaptive_pool_upsampling_no_nan():
+    """Adaptive pooling with output > input duplicates values (window
+    [floor(i*in/out), ceil((i+1)*in/out)) is never empty) — regression for
+    NaN via empty-window division."""
+    import paddle_tpu.nn.functional as F
+
+    x = paddle.to_tensor(np.arange(4, dtype=np.float32).reshape(1, 1, 2, 2))
+    out = F.adaptive_avg_pool2d(x, (6, 6)).numpy()
+    assert np.isfinite(out).all()
+    # corner windows replicate the corner input values
+    assert out[0, 0, 0, 0] == 0.0 and out[0, 0, 5, 5] == 3.0
+    mx = F.adaptive_max_pool2d(x, (3, 3)).numpy()
+    assert np.isfinite(mx).all() and mx[0, 0, 2, 2] == 3.0
