@@ -10,7 +10,9 @@ projection matrices built host-side with numpy — the whole pipeline jits
 into a handful of XLA ops, no librosa dependency.
 """
 
+from paddle_tpu.audio import backends  # noqa: F401
 from paddle_tpu.audio import functional  # noqa: F401
+from paddle_tpu.audio.backends import info, load, save  # noqa: F401
 from paddle_tpu.audio.features import (  # noqa: F401
     MFCC,
     LogMelSpectrogram,
@@ -18,6 +20,7 @@ from paddle_tpu.audio.features import (  # noqa: F401
     Spectrogram,
 )
 
-__all__ = ["functional", "features", "Spectrogram", "MelSpectrogram",
-           "LogMelSpectrogram", "MFCC"]
-from paddle_tpu.audio import features  # noqa: F401,E402
+from paddle_tpu.audio import features  # noqa: F401
+
+__all__ = ["functional", "features", "backends", "info", "load", "save",
+           "Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]

@@ -1,0 +1,98 @@
+"""Audio IO backend (reference ``python/paddle/audio/backends/wave_backend.py``):
+WAV load/save/info over the stdlib ``wave`` module — no external codec."""
+
+from __future__ import annotations
+
+import wave
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["AudioInfo", "info", "load", "save", "list_available_backends",
+           "get_current_backend", "set_backend"]
+
+
+class AudioInfo:
+    def __init__(self, sample_rate: int, num_frames: int, num_channels: int,
+                 bits_per_sample: int, encoding: str) -> None:
+        self.sample_rate = sample_rate
+        self.num_frames = num_frames
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+
+def info(filepath: str) -> AudioInfo:
+    with wave.open(filepath, "rb") as f:
+        return AudioInfo(
+            f.getframerate(), f.getnframes(), f.getnchannels(),
+            f.getsampwidth() * 8, f"PCM_{'S' if f.getsampwidth() > 1 else 'U'}",
+        )
+
+
+def load(filepath: str, frame_offset: int = 0, num_frames: int = -1,
+         normalize: bool = True, channels_first: bool = True) -> Tuple[Tensor, int]:
+    """Returns ``(waveform [C, T] (or [T, C]), sample_rate)`` like the
+    reference; 16-bit PCM normalized to [-1, 1] when ``normalize``."""
+    with wave.open(filepath, "rb") as f:
+        sr = f.getframerate()
+        channels = f.getnchannels()
+        width = f.getsampwidth()
+        f.setpos(frame_offset)
+        n = f.getnframes() - frame_offset if num_frames < 0 else num_frames
+        raw = f.readframes(n)
+    if width == 2:
+        data = np.frombuffer(raw, np.int16)
+    elif width == 1:
+        data = np.frombuffer(raw, np.uint8).astype(np.int16) - 128
+    elif width == 4:
+        data = np.frombuffer(raw, np.int32)
+    else:
+        raise ValueError(f"unsupported sample width {width}")
+    data = data.reshape(-1, channels)
+    if normalize:
+        data = data.astype(np.float32) / float(2 ** (8 * width - 1))
+    if channels_first:
+        data = data.T
+    return Tensor(np.ascontiguousarray(data)), sr
+
+
+def save(filepath: str, src: Any, sample_rate: int, channels_first: bool = True,
+         encoding: str = "PCM_16", bits_per_sample: Optional[int] = 16) -> None:
+    if encoding != "PCM_16" or bits_per_sample not in (None, 16):
+        raise NotImplementedError(
+            f"wave backend writes PCM_16 only; got encoding={encoding!r}, "
+            f"bits_per_sample={bits_per_sample!r}"
+        )
+    arr = np.asarray(src.numpy() if isinstance(src, Tensor) else src)
+    if channels_first:
+        arr = arr.T  # -> [T, C]
+    if arr.dtype.kind == "f":
+        arr = np.clip(arr, -1.0, 1.0)
+        arr = (arr * (2**15 - 1)).astype(np.int16)
+    elif arr.dtype == np.int32:
+        arr = (arr >> 16).astype(np.int16)  # rescale, don't wrap modulo 2^16
+    elif arr.dtype == np.uint8:
+        arr = ((arr.astype(np.int16) - 128) << 8).astype(np.int16)
+    elif arr.dtype != np.int16:
+        raise TypeError(f"unsupported sample dtype {arr.dtype}")
+    with wave.open(filepath, "wb") as f:
+        f.setnchannels(arr.shape[1] if arr.ndim == 2 else 1)
+        f.setsampwidth(2)
+        f.setframerate(int(sample_rate))
+        f.writeframes(np.ascontiguousarray(arr).tobytes())
+
+
+def list_available_backends() -> list:
+    return ["wave_backend"]
+
+
+def get_current_backend() -> str:
+    return "wave_backend"
+
+
+def set_backend(backend_name: str) -> None:
+    if backend_name != "wave_backend":
+        raise NotImplementedError("only the stdlib wave backend exists on this build")

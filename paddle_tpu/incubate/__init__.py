@@ -4,3 +4,4 @@ the API parity matters, the fusion is the compiler's job."""
 
 from paddle_tpu.incubate import distributed  # noqa: F401
 from paddle_tpu.incubate import nn  # noqa: F401
+from paddle_tpu.incubate import asp  # noqa: F401,E402

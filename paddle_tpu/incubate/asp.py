@@ -1,0 +1,199 @@
+"""ASP — automatic structured (n:m) sparsity.
+
+Reference: ``python/paddle/incubate/asp/`` (``utils.py`` mask generation,
+``asp.py`` ASPHelper / ``prune_model`` / ``decorate``). The reference targets
+Ampere sparse tensor cores; the TPU MXU has no 2:4 sparse mode, so here ASP
+is an honest *algorithmic* capability: n:m-pruned weights (same training
+recipe, same masks) with the mask re-applied after every optimizer step via
+the decorated optimizer — the win on TPU is model-compression research parity
+and the memory/bandwidth gains of shipping pruned weights, not a matmul
+speedup.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn.layer.layers import Layer
+
+__all__ = [
+    "calculate_density", "check_mask_1d", "get_mask_1d", "check_mask_2d",
+    "get_mask_2d_greedy", "create_mask", "check_sparsity", "prune_model",
+    "decorate", "set_excluded_layers", "reset_excluded_layers",
+    "OptimizerWithSparsityGuarantee",
+]
+
+_EXCLUDED: set = set()
+
+
+def calculate_density(x: Any) -> float:
+    a = np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+    return float(np.count_nonzero(a)) / max(a.size, 1)
+
+
+def check_mask_1d(mat: Any, n: int = 2, m: int = 4) -> bool:
+    """True when every 1-D window of ``m`` has at most ``n`` nonzeros
+    (reference ``utils.py:check_mask_1d``)."""
+    a = np.asarray(mat).reshape(-1)
+    pad = (-len(a)) % m
+    a = np.pad(a, (0, pad))
+    return bool((np.count_nonzero(a.reshape(-1, m), axis=1) <= n).all())
+
+
+def get_mask_1d(mat: Any, n: int = 2, m: int = 4) -> np.ndarray:
+    """Keep the ``n`` largest-|w| of every ``m`` consecutive weights
+    (reference ``utils.py:get_mask_1d``)."""
+    a = np.asarray(mat)
+    flat = a.reshape(-1)
+    pad = (-len(flat)) % m
+    padded = np.pad(flat, (0, pad))
+    groups = np.abs(padded.reshape(-1, m))
+    order = np.argsort(-groups, axis=1, kind="stable")
+    mask = np.zeros_like(groups)
+    np.put_along_axis(mask, order[:, :n], 1.0, axis=1)
+    return mask.reshape(-1)[: len(flat)].reshape(a.shape).astype(a.dtype if a.dtype.kind == "f" else np.float32)
+
+
+def check_mask_2d(mat: Any, n: int = 2, m: int = 4) -> bool:
+    """True when every ``m x m`` block has <= ``n`` nonzeros per row AND per
+    column (reference ``utils.py:check_mask_2d``)."""
+    a = np.asarray(mat)
+    if a.ndim != 2:
+        a = a.reshape(a.shape[0], -1)
+    rows = (-a.shape[0]) % m
+    cols = (-a.shape[1]) % m
+    a = np.pad(a, ((0, rows), (0, cols)))
+    R, C = a.shape
+    blocks = a.reshape(R // m, m, C // m, m).transpose(0, 2, 1, 3)
+    nz = blocks != 0
+    return bool(
+        (nz.sum(axis=3) <= n).all() and (nz.sum(axis=2) <= n).all()
+    )
+
+
+def get_mask_2d_greedy(mat: Any, n: int = 2, m: int = 4) -> np.ndarray:
+    """Greedy 2-D n:m mask (reference ``utils.py:get_mask_2d_greedy``): per
+    ``m x m`` block, pick entries largest-first subject to per-row AND
+    per-column budgets of ``n``."""
+    a = np.asarray(mat)
+    orig_shape = a.shape
+    if a.ndim != 2:
+        a = a.reshape(a.shape[0], -1)
+    rows = (-a.shape[0]) % m
+    cols = (-a.shape[1]) % m
+    ap = np.pad(a, ((0, rows), (0, cols)))
+    R, C = ap.shape
+    mask = np.zeros_like(ap, dtype=np.float32)
+    for bi in range(0, R, m):
+        for bj in range(0, C, m):
+            block = np.abs(ap[bi : bi + m, bj : bj + m])
+            order = np.dstack(np.unravel_index(np.argsort(-block, axis=None), block.shape))[0]
+            row_budget = np.full(m, n)
+            col_budget = np.full(m, n)
+            for r, c in order:
+                if row_budget[r] > 0 and col_budget[c] > 0:
+                    mask[bi + r, bj + c] = 1.0
+                    row_budget[r] -= 1
+                    col_budget[c] -= 1
+    return mask[: a.shape[0], : a.shape[1]].reshape(orig_shape)
+
+
+def create_mask(tensor: Any, func_name: str = "get_mask_1d", n: int = 2, m: int = 4) -> np.ndarray:
+    fn = {"get_mask_1d": get_mask_1d, "get_mask_2d_greedy": get_mask_2d_greedy}[
+        func_name if isinstance(func_name, str) else func_name.__name__
+    ]
+    return fn(tensor.numpy() if isinstance(tensor, Tensor) else tensor, n, m)
+
+
+def check_sparsity(tensor: Any, func_name: str = "check_mask_1d", n: int = 2, m: int = 4) -> bool:
+    fn = {"check_mask_1d": check_mask_1d, "check_mask_2d": check_mask_2d}[
+        func_name if isinstance(func_name, str) else func_name.__name__
+    ]
+    return fn(tensor.numpy() if isinstance(tensor, Tensor) else tensor, n, m)
+
+
+def set_excluded_layers(param_names: List[str], main_program: Any = None) -> None:
+    _EXCLUDED.update(param_names)
+
+
+def reset_excluded_layers(main_program: Any = None) -> None:
+    _EXCLUDED.clear()
+
+
+def _prunable(name: str, param: Any) -> bool:
+    if name in _EXCLUDED:
+        return False
+    # the reference prunes weight matrices of FC/conv layers, never
+    # biases/norms; the n:m pattern needs at least one full group
+    return (
+        not param.stop_gradient
+        and len(param.shape) >= 2
+        and "weight" in name.split(".")[-1]
+        and int(np.prod(param.shape)) >= 4
+    )
+
+
+def prune_model(model: Layer, n: int = 2, m: int = 4,
+                mask_algo: str = "mask_1d", with_mask: bool = True) -> Dict[str, np.ndarray]:
+    """Apply n:m masks to every prunable weight (reference
+    ``asp.py:prune_model``). Returns ``{param_name: mask}`` — the same dict
+    ``decorate`` keeps to re-mask after each optimizer step."""
+    algo = {"mask_1d": "get_mask_1d", "mask_2d_greedy": "get_mask_2d_greedy",
+            "mask_2d_best": "get_mask_2d_greedy"}[mask_algo]
+    masks: Dict[str, np.ndarray] = {}
+    for name, p in model.named_parameters():
+        if not _prunable(name, p):
+            continue
+        mask = create_mask(p, algo, n, m)
+        p._data = p._data * jnp.asarray(mask, p._data.dtype)
+        if with_mask:
+            masks[name] = mask
+    return masks
+
+
+class OptimizerWithSparsityGuarantee:
+    """Reference ``asp.py:949``: wraps an optimizer so every ``step()``
+    re-applies the pruning masks — weights stay n:m sparse through training."""
+
+    def __init__(self, optimizer: Any) -> None:
+        self._optimizer = optimizer
+        self._masks: Dict[int, Any] = {}  # id(param) -> device mask
+
+    def attach_masks(self, model: Layer, masks: Dict[str, np.ndarray]) -> None:
+        named = dict(model.named_parameters())
+        for name, mask in masks.items():
+            p = named[name]
+            self._masks[id(p)] = jnp.asarray(mask, p._data.dtype)
+
+    def step(self) -> None:
+        self._optimizer.step()
+        from paddle_tpu.core import autograd as _ag
+
+        with _ag.set_grad_enabled(False):
+            for p in self._optimizer._parameters:
+                mask = self._masks.get(id(p))
+                if mask is not None:
+                    p._data = p._data * mask
+
+    def __getattr__(self, name: str) -> Any:  # delegate everything else
+        return getattr(self._optimizer, name)
+
+
+def decorate(optimizer: Any) -> OptimizerWithSparsityGuarantee:
+    """Reference ``asp.py:233``: returns the sparsity-preserving optimizer.
+    Call :func:`prune_model` first, then ``attach_masks`` (or let
+    ``prune_and_decorate`` do both)."""
+    return OptimizerWithSparsityGuarantee(optimizer)
+
+
+def prune_and_decorate(model: Layer, optimizer: Any, n: int = 2, m: int = 4,
+                       mask_algo: str = "mask_1d") -> OptimizerWithSparsityGuarantee:
+    """Convenience composition used by the tests: prune + decorate + attach."""
+    masks = prune_model(model, n, m, mask_algo)
+    opt = decorate(optimizer)
+    opt.attach_masks(model, masks)
+    return opt

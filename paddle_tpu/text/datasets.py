@@ -61,17 +61,20 @@ class Imdb(Dataset):
                  cutoff: int = 150) -> None:
         path = _require(data_file, "Imdb")
         with tarfile.open(path) as tf:
+            # the vocabulary ALWAYS comes from the train split (reference
+            # behavior) so train/test instances share token ids
+            freq: collections.Counter = collections.Counter()
+            for m in tf.getmembers():
+                if m.isfile() and re.match(r"aclImdb/train/(pos|neg)/.*\.txt$", m.name):
+                    freq.update(_TOKEN.findall(tf.extractfile(m).read().lower()))
             members = [
                 m for m in tf.getmembers()
                 if m.isfile() and re.match(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$", m.name)
             ]
             docs, labels = [], []
-            freq: collections.Counter = collections.Counter()
             for m in members:
-                words = _TOKEN.findall(tf.extractfile(m).read().lower())
-                docs.append(words)
+                docs.append(_TOKEN.findall(tf.extractfile(m).read().lower()))
                 labels.append(0 if "/pos/" in m.name else 1)
-                freq.update(words)
         vocab_words = sorted(
             (w for w, c in freq.items() if c >= cutoff), key=lambda w: (-freq[w], w)
         )
@@ -97,18 +100,26 @@ class Imikolov(Dataset):
                  window_size: int = 5, mode: str = "train", min_word_freq: int = 50) -> None:
         path = _require(data_file, "Imikolov")
         name = {"train": "ptb.train.txt", "test": "ptb.valid.txt"}[mode]
-        if tarfile.is_tarfile(path):
-            with tarfile.open(path) as tf:
-                member = next(m for m in tf.getmembers() if m.name.endswith(name))
-                lines = tf.extractfile(member).read().decode().splitlines()
-        else:
-            lines = open(path).read().splitlines()
+
+        def read(fname: str):
+            if tarfile.is_tarfile(path):
+                with tarfile.open(path) as tf:
+                    member = next(
+                        (m for m in tf.getmembers() if m.name.endswith(fname)), None
+                    )
+                    if member is None:
+                        return None
+                    return tf.extractfile(member).read().decode().splitlines()
+            return open(path).read().splitlines()
+
+        lines = read(name)
+        # vocabulary ALWAYS from the train file (shared ids across modes);
+        # plain-text inputs have a single file serving both roles
+        vocab_lines = read("ptb.train.txt") or lines
         freq: collections.Counter = collections.Counter()
-        sents = []
-        for line in lines:
-            words = line.strip().split()
-            sents.append(words)
-            freq.update(words)
+        for line in vocab_lines:
+            freq.update(line.strip().split())
+        sents = [line.strip().split() for line in lines]
         vocab = sorted(
             (w for w, c in freq.items() if c >= min_word_freq and w != "<unk>"),
             key=lambda w: (-freq[w], w),

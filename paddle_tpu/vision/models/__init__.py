@@ -33,3 +33,16 @@ from paddle_tpu.vision.models.misc import (  # noqa: F401
     squeezenet1_0,
     squeezenet1_1,
 )
+from paddle_tpu.vision.models.densenet import (  # noqa: F401
+    DenseNet,
+    densenet121,
+    densenet161,
+    densenet169,
+    densenet201,
+)
+from paddle_tpu.vision.models.inception import (  # noqa: F401
+    GoogLeNet,
+    InceptionV3,
+    googlenet,
+    inception_v3,
+)

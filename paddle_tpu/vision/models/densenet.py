@@ -1,0 +1,100 @@
+"""DenseNet (reference ``python/paddle/vision/models/densenet.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+import paddle_tpu.nn as nn
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169", "densenet201"]
+
+_CFGS = {
+    121: (64, 32, (6, 12, 24, 16)),
+    161: (96, 48, (6, 12, 36, 24)),
+    169: (64, 32, (6, 12, 32, 32)),
+    201: (64, 32, (6, 12, 48, 32)),
+}
+
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, in_c: int, growth: int, bn_size: int, dropout: float) -> None:
+        super().__init__()
+        self.block = nn.Sequential(
+            nn.BatchNorm2D(in_c), nn.ReLU(),
+            nn.Conv2D(in_c, bn_size * growth, 1, bias_attr=False),
+            nn.BatchNorm2D(bn_size * growth), nn.ReLU(),
+            nn.Conv2D(bn_size * growth, growth, 3, padding=1, bias_attr=False),
+        )
+        self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def forward(self, x: Any) -> Any:
+        import paddle_tpu as paddle
+
+        y = self.block(x)
+        if self.dropout is not None:
+            y = self.dropout(y)
+        return paddle.concat([x, y], axis=1)
+
+
+class _Transition(nn.Layer):
+    def __init__(self, in_c: int, out_c: int) -> None:
+        super().__init__()
+        self.block = nn.Sequential(
+            nn.BatchNorm2D(in_c), nn.ReLU(),
+            nn.Conv2D(in_c, out_c, 1, bias_attr=False), nn.AvgPool2D(2, 2),
+        )
+
+    def forward(self, x: Any) -> Any:
+        return self.block(x)
+
+
+class DenseNet(nn.Layer):
+    def __init__(self, layers: int = 121, bn_size: int = 4, dropout: float = 0.0,
+                 num_classes: int = 1000, with_pool: bool = True) -> None:
+        super().__init__()
+        init_c, growth, blocks = _CFGS[layers]
+        feats: List[Any] = [
+            nn.Conv2D(3, init_c, 7, stride=2, padding=3, bias_attr=False),
+            nn.BatchNorm2D(init_c), nn.ReLU(), nn.MaxPool2D(3, 2, padding=1),
+        ]
+        c = init_c
+        for bi, n_layers in enumerate(blocks):
+            for _ in range(n_layers):
+                feats.append(_DenseLayer(c, growth, bn_size, dropout))
+                c += growth
+            if bi != len(blocks) - 1:
+                feats.append(_Transition(c, c // 2))
+                c //= 2
+        feats += [nn.BatchNorm2D(c), nn.ReLU()]
+        self.features = nn.Sequential(*feats)
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Linear(c, num_classes)
+
+    def forward(self, x: Any) -> Any:
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.classifier(x)
+        return x
+
+
+def densenet121(pretrained: bool = False, **kw: Any) -> DenseNet:
+    return DenseNet(121, **kw)
+
+
+def densenet161(pretrained: bool = False, **kw: Any) -> DenseNet:
+    return DenseNet(161, **kw)
+
+
+def densenet169(pretrained: bool = False, **kw: Any) -> DenseNet:
+    return DenseNet(169, **kw)
+
+
+def densenet201(pretrained: bool = False, **kw: Any) -> DenseNet:
+    return DenseNet(201, **kw)

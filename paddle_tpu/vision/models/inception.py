@@ -1,0 +1,226 @@
+"""GoogLeNet + InceptionV3 (reference
+``python/paddle/vision/models/{googlenet,inceptionv3}.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+import paddle_tpu.nn as nn
+
+__all__ = ["GoogLeNet", "googlenet", "InceptionV3", "inception_v3"]
+
+
+def _cbr(in_c: int, out_c: int, k: Any, stride: int = 1, padding: Any = 0) -> nn.Sequential:
+    return nn.Sequential(
+        nn.Conv2D(in_c, out_c, k, stride=stride, padding=padding, bias_attr=False),
+        nn.BatchNorm2D(out_c), nn.ReLU(),
+    )
+
+
+def _cat(tensors: List[Any]) -> Any:
+    import paddle_tpu as paddle
+
+    return paddle.concat(tensors, axis=1)
+
+
+class _Inception(nn.Layer):
+    """GoogLeNet inception block: 1x1 / 3x3 / 5x5 / pool-proj branches."""
+
+    def __init__(self, in_c: int, c1: int, c3r: int, c3: int, c5r: int, c5: int,
+                 proj: int) -> None:
+        super().__init__()
+        self.b1 = _cbr(in_c, c1, 1)
+        self.b3 = nn.Sequential(_cbr(in_c, c3r, 1), _cbr(c3r, c3, 3, padding=1))
+        self.b5 = nn.Sequential(_cbr(in_c, c5r, 1), _cbr(c5r, c5, 5, padding=2))
+        self.bp = nn.Sequential(nn.MaxPool2D(3, 1, padding=1), _cbr(in_c, proj, 1))
+
+    def forward(self, x: Any) -> Any:
+        return _cat([self.b1(x), self.b3(x), self.b5(x), self.bp(x)])
+
+
+class _AuxHead(nn.Layer):
+    def __init__(self, in_c: int, num_classes: int) -> None:
+        super().__init__()
+        self.pool = nn.AdaptiveAvgPool2D((4, 4))
+        self.conv = _cbr(in_c, 128, 1)
+        self.fc1 = nn.Linear(128 * 16, 1024)
+        self.relu = nn.ReLU()
+        self.dropout = nn.Dropout(0.7)
+        self.fc2 = nn.Linear(1024, num_classes)
+
+    def forward(self, x: Any) -> Any:
+        x = self.conv(self.pool(x)).flatten(1)
+        return self.fc2(self.dropout(self.relu(self.fc1(x))))
+
+
+class GoogLeNet(nn.Layer):
+    """Reference ``googlenet.py``: returns ``(out, aux1, aux2)`` like the
+    reference — aux heads hang off inception 4a/4d and train the weighted
+    auxiliary losses; in eval they still compute (the reference returns them
+    unconditionally too)."""
+
+    def __init__(self, num_classes: int = 1000, with_pool: bool = True) -> None:
+        super().__init__()
+        self.stem = nn.Sequential(
+            _cbr(3, 64, 7, stride=2, padding=3), nn.MaxPool2D(3, 2, padding=1),
+            _cbr(64, 64, 1), _cbr(64, 192, 3, padding=1), nn.MaxPool2D(3, 2, padding=1),
+        )
+        self.i3a = _Inception(192, 64, 96, 128, 16, 32, 32)
+        self.i3b = _Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = nn.MaxPool2D(3, 2, padding=1)
+        self.i4a = _Inception(480, 192, 96, 208, 16, 48, 64)
+        self.i4b = _Inception(512, 160, 112, 224, 24, 64, 64)
+        self.i4c = _Inception(512, 128, 128, 256, 24, 64, 64)
+        self.i4d = _Inception(512, 112, 144, 288, 32, 64, 64)
+        self.i4e = _Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = nn.MaxPool2D(3, 2, padding=1)
+        self.i5a = _Inception(832, 256, 160, 320, 32, 128, 128)
+        self.i5b = _Inception(832, 384, 192, 384, 48, 128, 128)
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.2)
+            self.fc = nn.Linear(1024, num_classes)
+            self.aux1 = _AuxHead(512, num_classes)
+            self.aux2 = _AuxHead(528, num_classes)
+
+    def forward(self, x: Any) -> Any:
+        x = self.stem(x)
+        x = self.pool3(self.i3b(self.i3a(x)))
+        x = self.i4a(x)
+        aux1 = self.aux1(x) if self.num_classes > 0 else None
+        x = self.i4d(self.i4c(self.i4b(x)))
+        aux2 = self.aux2(x) if self.num_classes > 0 else None
+        x = self.pool4(self.i4e(x))
+        x = self.i5b(self.i5a(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(x.flatten(1)))
+            return x, aux1, aux2
+        return x
+
+
+class _InceptionA(nn.Layer):
+    def __init__(self, in_c: int, pool_c: int) -> None:
+        super().__init__()
+        self.b1 = _cbr(in_c, 64, 1)
+        self.b5 = nn.Sequential(_cbr(in_c, 48, 1), _cbr(48, 64, 5, padding=2))
+        self.b3 = nn.Sequential(
+            _cbr(in_c, 64, 1), _cbr(64, 96, 3, padding=1), _cbr(96, 96, 3, padding=1)
+        )
+        self.bp = nn.Sequential(nn.AvgPool2D(3, 1, padding=1), _cbr(in_c, pool_c, 1))
+
+    def forward(self, x: Any) -> Any:
+        return _cat([self.b1(x), self.b5(x), self.b3(x), self.bp(x)])
+
+
+class _InceptionB(nn.Layer):  # grid reduction
+    def __init__(self, in_c: int) -> None:
+        super().__init__()
+        self.b3 = _cbr(in_c, 384, 3, stride=2)
+        self.b33 = nn.Sequential(
+            _cbr(in_c, 64, 1), _cbr(64, 96, 3, padding=1), _cbr(96, 96, 3, stride=2)
+        )
+        self.bp = nn.MaxPool2D(3, 2)
+
+    def forward(self, x: Any) -> Any:
+        return _cat([self.b3(x), self.b33(x), self.bp(x)])
+
+
+class _InceptionC(nn.Layer):  # factorized 7x7
+    def __init__(self, in_c: int, c7: int) -> None:
+        super().__init__()
+        self.b1 = _cbr(in_c, 192, 1)
+        self.b7 = nn.Sequential(
+            _cbr(in_c, c7, 1), _cbr(c7, c7, (1, 7), padding=(0, 3)),
+            _cbr(c7, 192, (7, 1), padding=(3, 0)),
+        )
+        self.b77 = nn.Sequential(
+            _cbr(in_c, c7, 1), _cbr(c7, c7, (7, 1), padding=(3, 0)),
+            _cbr(c7, c7, (1, 7), padding=(0, 3)), _cbr(c7, c7, (7, 1), padding=(3, 0)),
+            _cbr(c7, 192, (1, 7), padding=(0, 3)),
+        )
+        self.bp = nn.Sequential(nn.AvgPool2D(3, 1, padding=1), _cbr(in_c, 192, 1))
+
+    def forward(self, x: Any) -> Any:
+        return _cat([self.b1(x), self.b7(x), self.b77(x), self.bp(x)])
+
+
+class _InceptionD(nn.Layer):  # grid reduction
+    def __init__(self, in_c: int) -> None:
+        super().__init__()
+        self.b3 = nn.Sequential(_cbr(in_c, 192, 1), _cbr(192, 320, 3, stride=2))
+        self.b7 = nn.Sequential(
+            _cbr(in_c, 192, 1), _cbr(192, 192, (1, 7), padding=(0, 3)),
+            _cbr(192, 192, (7, 1), padding=(3, 0)), _cbr(192, 192, 3, stride=2),
+        )
+        self.bp = nn.MaxPool2D(3, 2)
+
+    def forward(self, x: Any) -> Any:
+        return _cat([self.b3(x), self.b7(x), self.bp(x)])
+
+
+class _InceptionE(nn.Layer):  # expanded filter bank
+    def __init__(self, in_c: int) -> None:
+        super().__init__()
+        self.b1 = _cbr(in_c, 320, 1)
+        self.b3_stem = _cbr(in_c, 384, 1)
+        self.b3_a = _cbr(384, 384, (1, 3), padding=(0, 1))
+        self.b3_b = _cbr(384, 384, (3, 1), padding=(1, 0))
+        self.b33_stem = nn.Sequential(_cbr(in_c, 448, 1), _cbr(448, 384, 3, padding=1))
+        self.b33_a = _cbr(384, 384, (1, 3), padding=(0, 1))
+        self.b33_b = _cbr(384, 384, (3, 1), padding=(1, 0))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, 1, padding=1), _cbr(in_c, 192, 1))
+
+    def forward(self, x: Any) -> Any:
+        s = self.b3_stem(x)
+        t = self.b33_stem(x)
+        return _cat([
+            self.b1(x), _cat([self.b3_a(s), self.b3_b(s)]),
+            _cat([self.b33_a(t), self.b33_b(t)]), self.bp(x),
+        ])
+
+
+class InceptionV3(nn.Layer):
+    """Reference ``inceptionv3.py``."""
+
+    def __init__(self, num_classes: int = 1000, with_pool: bool = True) -> None:
+        super().__init__()
+        self.stem = nn.Sequential(
+            _cbr(3, 32, 3, stride=2), _cbr(32, 32, 3), _cbr(32, 64, 3, padding=1),
+            nn.MaxPool2D(3, 2), _cbr(64, 80, 1), _cbr(80, 192, 3), nn.MaxPool2D(3, 2),
+        )
+        self.blocks = nn.Sequential(
+            _InceptionA(192, 32), _InceptionA(256, 64), _InceptionA(288, 64),
+            _InceptionB(288),
+            _InceptionC(768, 128), _InceptionC(768, 160), _InceptionC(768, 160),
+            _InceptionC(768, 192),
+            _InceptionD(768),
+            _InceptionE(1280), _InceptionE(2048),
+        )
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.5)
+            self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x: Any) -> Any:
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(x.flatten(1)))
+        return x
+
+
+def googlenet(pretrained: bool = False, **kw: Any) -> GoogLeNet:
+    return GoogLeNet(**kw)
+
+
+def inception_v3(pretrained: bool = False, **kw: Any) -> InceptionV3:
+    return InceptionV3(**kw)

@@ -5,10 +5,7 @@ from __future__ import annotations
 
 from typing import Any, List
 
-import jax.numpy as jnp
-
 import paddle_tpu.nn as nn
-from paddle_tpu.core.dispatch import call_op
 
 __all__ = [
     "LeNet", "AlexNet", "SqueezeNet", "ShuffleNetV2",
@@ -119,13 +116,9 @@ class SqueezeNet(nn.Layer):
 
 
 def _channel_shuffle(x: Any, groups: int) -> Any:
-    def fn(a):
-        n, c, h, w = a.shape
-        a = a.reshape(n, groups, c // groups, h, w)
-        a = jnp.swapaxes(a, 1, 2)
-        return a.reshape(n, c, h, w)
+    import paddle_tpu.nn.functional as F
 
-    return call_op("shufflenet_channel_shuffle", fn, x)
+    return F.channel_shuffle(x, groups)
 
 
 class _ShuffleUnit(nn.Layer):

@@ -1,0 +1,108 @@
+"""ASP n:m structured sparsity (reference python/paddle/incubate/asp/):
+mask generation, sparsity checks, prune_model, sparsity-preserving optimizer."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate import asp
+
+rng = np.random.default_rng(0)
+
+
+class TestMasks:
+    def test_mask_1d_is_2_of_4(self):
+        w = rng.normal(size=(8, 16)).astype(np.float32)
+        mask = asp.get_mask_1d(w, 2, 4)
+        assert asp.check_mask_1d(mask, 2, 4)
+        assert mask.sum() == w.size // 2  # exactly 2 of every 4 kept
+        # the kept entries are the largest-|w| of each group
+        groups = np.abs(w.reshape(-1, 4))
+        kept = mask.reshape(-1, 4)
+        for g, k in zip(groups, kept):
+            assert set(np.where(k > 0)[0]) == set(np.argsort(-g, kind="stable")[:2])
+
+    def test_mask_2d_greedy_row_and_col_budget(self):
+        w = rng.normal(size=(8, 8)).astype(np.float32)
+        mask = asp.get_mask_2d_greedy(w, 2, 4)
+        assert asp.check_mask_2d(mask, 2, 4)
+        assert not asp.check_mask_2d(np.ones((8, 8)), 2, 4)
+
+    def test_check_rejects_dense(self):
+        assert not asp.check_mask_1d(np.ones(8), 2, 4)
+        assert asp.check_mask_1d(np.array([1, 1, 0, 0, 0, 1, 0, 1]), 2, 4)
+
+    def test_density(self):
+        assert asp.calculate_density(np.array([1.0, 0, 0, 2])) == 0.5
+
+
+class TestPruneModel:
+    def _model(self):
+        paddle.seed(0)
+        return paddle.nn.Sequential(
+            paddle.nn.Linear(16, 32), paddle.nn.ReLU(), paddle.nn.Linear(32, 8)
+        )
+
+    def test_prunes_weights_not_biases(self):
+        m = self._model()
+        masks = asp.prune_model(m, 2, 4)
+        named = dict(m.named_parameters())
+        weight_names = [n for n in named if n.endswith("weight")]
+        assert set(masks) == set(weight_names)
+        for n in weight_names:
+            assert asp.check_sparsity(named[n], "check_mask_1d", 2, 4)
+            assert abs(asp.calculate_density(named[n]) - 0.5) < 0.01
+        for n, p in named.items():
+            if n.endswith("bias"):
+                assert asp.calculate_density(p) >= 0.0  # untouched (no mask)
+                assert n not in masks
+
+    def test_excluded_layers(self):
+        m = self._model()
+        names = [n for n, _ in m.named_parameters() if n.endswith("weight")]
+        asp.set_excluded_layers([names[0]])
+        try:
+            masks = asp.prune_model(m, 2, 4)
+            assert names[0] not in masks and len(masks) == 1
+        finally:
+            asp.reset_excluded_layers()
+
+    def test_sparsity_survives_training(self):
+        import paddle_tpu.nn.functional as F
+
+        m = self._model()
+        opt = asp.prune_and_decorate(
+            m, paddle.optimizer.Adam(learning_rate=1e-2, parameters=m.parameters())
+        )
+        x = paddle.to_tensor(rng.normal(size=(8, 16)).astype(np.float32))
+        y = paddle.to_tensor(rng.normal(size=(8, 8)).astype(np.float32))
+        losses = []
+        for _ in range(6):
+            loss = F.mse_loss(m(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0], "decorated optimizer failed to train"
+        for n, p in m.named_parameters():
+            if n.endswith("weight"):
+                assert asp.check_sparsity(p, "check_mask_1d", 2, 4), n
+                assert abs(asp.calculate_density(p) - 0.5) < 0.01
+
+    def test_undecorated_training_breaks_sparsity(self):
+        """Negative control: without the decorated optimizer the masks decay
+        (Adam moments resurrect pruned weights)."""
+        import paddle_tpu.nn.functional as F
+
+        m = self._model()
+        asp.prune_model(m, 2, 4)
+        opt = paddle.optimizer.Adam(learning_rate=1e-2, parameters=m.parameters())
+        x = paddle.to_tensor(rng.normal(size=(8, 16)).astype(np.float32))
+        y = paddle.to_tensor(rng.normal(size=(8, 8)).astype(np.float32))
+        for _ in range(3):
+            loss = F.mse_loss(m(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        dens = [asp.calculate_density(p) for n, p in m.named_parameters() if n.endswith("weight")]
+        assert any(d > 0.6 for d in dens)

@@ -188,3 +188,96 @@ class TestText:
 
         with pytest.raises(FileNotFoundError, match="data_file"):
             T.UCIHousing(data_file=None)
+
+
+class TestVisionModelsRound2:
+    @pytest.mark.parametrize(
+        "build,size",
+        [(M.densenet121, 64), (M.inception_v3, 128)],
+        ids=["densenet121", "inception_v3"],
+    )
+    def test_forward_shape(self, build, size):
+        paddle.seed(0)
+        m = build(num_classes=4)
+        m.eval()
+        x = paddle.to_tensor(rng.normal(size=(1, 3, size, size)).astype(np.float32))
+        out = m(x)
+        assert list(out.shape) == [1, 4]
+        assert np.isfinite(out.numpy()).all()
+
+    def test_googlenet_returns_main_and_aux(self):
+        paddle.seed(0)
+        m = M.googlenet(num_classes=4)
+        m.eval()
+        x = paddle.to_tensor(rng.normal(size=(1, 3, 96, 96)).astype(np.float32))
+        out, aux1, aux2 = m(x)
+        for o in (out, aux1, aux2):
+            assert list(o.shape) == [1, 4]
+            assert np.isfinite(o.numpy()).all()
+
+    def test_densenet_variants_channel_math(self):
+        # densenet161: init 96, growth 48 -> final features 2208
+        m = M.densenet161(num_classes=3)
+        assert m.classifier.weight.shape[0] == 2208
+
+
+class TestAudioBackend:
+    def test_wav_roundtrip(self, tmp_path):
+        import paddle_tpu.audio as A
+
+        sr = 8000
+        wav = np.sin(2 * np.pi * 440 * np.arange(1600) / sr).astype(np.float32)
+        path = str(tmp_path / "tone.wav")
+        A.save(path, paddle.to_tensor(wav[None]), sr)
+        meta = A.info(path)
+        assert meta.sample_rate == sr and meta.num_frames == 1600
+        assert meta.num_channels == 1 and meta.bits_per_sample == 16
+        loaded, sr2 = A.load(path)
+        assert sr2 == sr and list(loaded.shape) == [1, 1600]
+        np.testing.assert_allclose(loaded.numpy()[0], wav, atol=1e-3)
+
+    def test_wav_offset_and_channels_last(self, tmp_path):
+        import paddle_tpu.audio as A
+
+        sr = 4000
+        stereo = np.stack([np.ones(100, np.float32) * 0.5,
+                           -np.ones(100, np.float32) * 0.5])
+        path = str(tmp_path / "st.wav")
+        A.save(path, paddle.to_tensor(stereo), sr)
+        out, _ = A.load(path, frame_offset=10, num_frames=20, channels_first=False)
+        assert list(out.shape) == [20, 2]
+        assert abs(float(out.numpy()[0, 0]) - 0.5) < 1e-3
+
+    def test_save_rejects_unsupported_encoding(self, tmp_path):
+        import paddle_tpu.audio as A
+
+        with pytest.raises(NotImplementedError, match="PCM_16"):
+            A.save(str(tmp_path / "x.wav"), np.zeros((1, 10), np.float32), 8000,
+                   encoding="PCM_32", bits_per_sample=32)
+
+    def test_save_int32_rescales(self, tmp_path):
+        import paddle_tpu.audio as A
+
+        full = np.full((1, 16), 2**30, np.int32)  # half of int32 full scale
+        p = str(tmp_path / "i32.wav")
+        A.save(p, full, 8000)
+        out, _ = A.load(p)
+        assert abs(float(out.numpy()[0, 0]) - 0.5) < 1e-3
+
+    def test_train_test_vocab_shared(self, tmp_path):
+        import paddle_tpu.text as T
+
+        tar_path = tmp_path / "aclImdb.tar.gz"
+        with tarfile.open(tar_path, "w:gz") as tf:
+            for split, pol, i, text in [
+                ("train", "pos", 0, b"great great great movie"),
+                ("train", "neg", 1, b"bad bad bad film"),
+                ("test", "pos", 2, b"great film"),
+                ("test", "neg", 3, b"bad movie"),
+            ]:
+                info = tarfile.TarInfo(f"aclImdb/{split}/{pol}/{i}.txt")
+                info.size = len(text)
+                tf.addfile(info, io.BytesIO(text))
+        tr = T.Imdb(data_file=str(tar_path), mode="train", cutoff=2)
+        te = T.Imdb(data_file=str(tar_path), mode="test", cutoff=2)
+        assert tr.word_idx == te.word_idx  # shared (train-derived) ids
