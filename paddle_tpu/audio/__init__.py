@@ -20,7 +20,8 @@ from paddle_tpu.audio.features import (  # noqa: F401
     Spectrogram,
 )
 
+from paddle_tpu.audio import datasets  # noqa: F401
 from paddle_tpu.audio import features  # noqa: F401
 
-__all__ = ["functional", "features", "backends", "info", "load", "save",
+__all__ = ["functional", "features", "backends", "datasets", "info", "load", "save",
            "Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]

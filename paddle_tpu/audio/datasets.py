@@ -1,0 +1,126 @@
+"""Audio datasets (reference ``python/paddle/audio/datasets/``: ESC50, TESS
+over an ``AudioClassificationDataset`` base). Local-archive parsers only (no
+downloader — zero-egress environment; point ``data_dir`` at the extracted
+archive root). Feature modes mirror the reference: ``raw`` waveforms or
+``mfcc``/``logmelspectrogram``/``melspectrogram``/``spectrogram`` computed
+through :mod:`paddle_tpu.audio.features`.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from paddle_tpu.audio import backends, features
+from paddle_tpu.io import Dataset
+
+__all__ = ["AudioClassificationDataset", "ESC50", "TESS"]
+
+_FEATURES = {
+    "raw": None,
+    "spectrogram": features.Spectrogram,
+    "melspectrogram": features.MelSpectrogram,
+    "logmelspectrogram": features.LogMelSpectrogram,
+    "mfcc": features.MFCC,
+}
+
+
+def _require_dir(data_dir: Optional[str], name: str) -> str:
+    if not data_dir or not os.path.isdir(data_dir):
+        raise FileNotFoundError(
+            f"{name} needs a local data_dir with the extracted archive (no "
+            f"downloader in this environment); got {data_dir!r}"
+        )
+    return data_dir
+
+
+class AudioClassificationDataset(Dataset):
+    """Reference ``datasets/dataset.py``: (waveform-or-feature, label) pairs
+    from a file list; the feature extractor runs lazily per item."""
+
+    def __init__(self, files: List[str], labels: List[int], feat_type: str = "raw",
+                 sample_rate: Optional[int] = None, **feat_kwargs: Any) -> None:
+        if feat_type not in _FEATURES:
+            raise ValueError(
+                f"feat_type must be one of {sorted(_FEATURES)}, got {feat_type!r}"
+            )
+        self.files = files
+        self.labels = labels
+        self.feat_type = feat_type
+        self._sample_rate = sample_rate
+        self._feat_kwargs = feat_kwargs
+        self._extractors: dict = {}  # sr -> layer (mixed-rate dirs stay correct)
+
+    def _feature(self, wav, sr: int):
+        if self.feat_type == "raw":
+            return wav
+        if sr not in self._extractors:
+            kwargs = dict(self._feat_kwargs)
+            if self.feat_type != "spectrogram":  # Spectrogram takes no sr
+                kwargs.setdefault("sr", sr)
+            self._extractors[sr] = _FEATURES[self.feat_type](**kwargs)
+        return self._extractors[sr](wav)
+
+    def __len__(self) -> int:
+        return len(self.files)
+
+    def __getitem__(self, idx: int) -> Tuple[Any, int]:
+        wav, sr = backends.load(self.files[idx])
+        if self._sample_rate is not None and sr != self._sample_rate:
+            raise ValueError(
+                f"{self.files[idx]}: sample rate {sr} != expected {self._sample_rate}"
+            )
+        return self._feature(wav, sr), int(self.labels[idx])
+
+
+class ESC50(AudioClassificationDataset):
+    """Reference ``esc50.py``: 50-class environmental sounds; 5 cross-
+    validation folds — ``mode='train'`` takes folds != split_fold,
+    ``mode='dev'`` takes fold == split_fold."""
+
+    def __init__(self, data_dir: Optional[str] = None, mode: str = "train",
+                 split_fold: int = 1, feat_type: str = "raw", **feat_kwargs: Any) -> None:
+        root = _require_dir(data_dir, "ESC50")
+        meta = os.path.join(root, "meta", "esc50.csv")
+        audio_dir = os.path.join(root, "audio")
+        files, labels = [], []
+        with open(meta) as f:
+            for row in csv.DictReader(f):
+                fold = int(row["fold"])
+                keep = fold != split_fold if mode == "train" else fold == split_fold
+                if keep:
+                    files.append(os.path.join(audio_dir, row["filename"]))
+                    labels.append(int(row["target"]))
+        super().__init__(files, labels, feat_type, **feat_kwargs)
+
+
+class TESS(AudioClassificationDataset):
+    """Reference ``tess.py``: Toronto emotional speech set — 7 emotions
+    parsed from filenames ``<speaker>_<word>_<emotion>.wav``; ``n_folds``-way
+    modulo split over the sorted file list."""
+
+    EMOTIONS = ["angry", "disgust", "fear", "happy", "neutral", "ps", "sad"]
+
+    def __init__(self, data_dir: Optional[str] = None, mode: str = "train",
+                 n_folds: int = 5, split_fold: int = 1, feat_type: str = "raw",
+                 **feat_kwargs: Any) -> None:
+        root = _require_dir(data_dir, "TESS")
+        wavs: List[str] = []
+        for dirpath, _dirs, names in os.walk(root):
+            wavs.extend(os.path.join(dirpath, n) for n in names if n.endswith(".wav"))
+        wavs.sort()
+        files, labels = [], []
+        for i, path in enumerate(wavs):
+            fold = i % n_folds + 1
+            keep = fold != split_fold if mode == "train" else fold == split_fold
+            if not keep:
+                continue
+            emotion = os.path.splitext(os.path.basename(path))[0].split("_")[-1].lower()
+            if emotion not in self.EMOTIONS:
+                continue
+            files.append(path)
+            labels.append(self.EMOTIONS.index(emotion))
+        super().__init__(files, labels, feat_type, **feat_kwargs)

@@ -28,6 +28,11 @@ __all__ = [
 ]
 
 _EXCLUDED: set = set()
+# id(param) -> device mask. prune_model registers here so decorate()d
+# optimizers pick masks up regardless of call order (reference allows
+# decorate-then-prune); the params outlive the registry entries (they are
+# the model's live Parameters), so id() keys stay valid.
+_MASK_REGISTRY: Dict[int, Any] = {}
 
 
 def calculate_density(x: Any) -> float:
@@ -152,16 +157,20 @@ def prune_model(model: Layer, n: int = 2, m: int = 4,
         p._data = p._data * jnp.asarray(mask, p._data.dtype)
         if with_mask:
             masks[name] = mask
+            _MASK_REGISTRY[id(p)] = jnp.asarray(mask, p._data.dtype)
     return masks
 
 
 class OptimizerWithSparsityGuarantee:
     """Reference ``asp.py:949``: wraps an optimizer so every ``step()``
-    re-applies the pruning masks — weights stay n:m sparse through training."""
+    re-applies the pruning masks — weights stay n:m sparse through training.
+    Masks come from the module registry that :func:`prune_model` fills, so
+    the reference's both call orders (prune-then-decorate AND
+    decorate-then-prune) work."""
 
     def __init__(self, optimizer: Any) -> None:
         self._optimizer = optimizer
-        self._masks: Dict[int, Any] = {}  # id(param) -> device mask
+        self._masks: Dict[int, Any] = {}  # explicit attach_masks overrides
 
     def attach_masks(self, model: Layer, masks: Dict[str, np.ndarray]) -> None:
         named = dict(model.named_parameters())
@@ -175,7 +184,7 @@ class OptimizerWithSparsityGuarantee:
 
         with _ag.set_grad_enabled(False):
             for p in self._optimizer._parameters:
-                mask = self._masks.get(id(p))
+                mask = self._masks.get(id(p), _MASK_REGISTRY.get(id(p)))
                 if mask is not None:
                     p._data = p._data * mask
 

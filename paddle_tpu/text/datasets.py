@@ -62,19 +62,23 @@ class Imdb(Dataset):
         path = _require(data_file, "Imdb")
         with tarfile.open(path) as tf:
             # the vocabulary ALWAYS comes from the train split (reference
-            # behavior) so train/test instances share token ids
+            # behavior) so train/test instances share token ids; in train
+            # mode the vocab pass doubles as the doc pass (one tar scan)
             freq: collections.Counter = collections.Counter()
-            for m in tf.getmembers():
-                if m.isfile() and re.match(r"aclImdb/train/(pos|neg)/.*\.txt$", m.name):
-                    freq.update(_TOKEN.findall(tf.extractfile(m).read().lower()))
-            members = [
-                m for m in tf.getmembers()
-                if m.isfile() and re.match(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$", m.name)
-            ]
             docs, labels = [], []
-            for m in members:
-                docs.append(_TOKEN.findall(tf.extractfile(m).read().lower()))
-                labels.append(0 if "/pos/" in m.name else 1)
+            for m in tf.getmembers():
+                if not m.isfile():
+                    continue
+                is_train = re.match(r"aclImdb/train/(pos|neg)/.*\.txt$", m.name)
+                wanted = re.match(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$", m.name)
+                if not (is_train or wanted):
+                    continue
+                words = _TOKEN.findall(tf.extractfile(m).read().lower())
+                if is_train:
+                    freq.update(words)
+                if wanted:
+                    docs.append(words)
+                    labels.append(0 if "/pos/" in m.name else 1)
         vocab_words = sorted(
             (w for w, c in freq.items() if c >= cutoff), key=lambda w: (-freq[w], w)
         )
@@ -114,8 +118,9 @@ class Imikolov(Dataset):
 
         lines = read(name)
         # vocabulary ALWAYS from the train file (shared ids across modes);
-        # plain-text inputs have a single file serving both roles
-        vocab_lines = read("ptb.train.txt") or lines
+        # plain-text inputs have a single file serving both roles, and train
+        # mode reuses the lines already read (one tar scan)
+        vocab_lines = lines if mode == "train" else (read("ptb.train.txt") or lines)
         freq: collections.Counter = collections.Counter()
         for line in vocab_lines:
             freq.update(line.strip().split())

@@ -106,3 +106,25 @@ class TestPruneModel:
             opt.clear_grad()
         dens = [asp.calculate_density(p) for n, p in m.named_parameters() if n.endswith("weight")]
         assert any(d > 0.6 for d in dens)
+
+
+def test_reference_call_order_decorate_then_prune():
+    """The reference allows decorate() BEFORE prune_model(); masks must
+    still be re-applied via the registry."""
+    import paddle_tpu.nn.functional as F
+
+    paddle.seed(0)
+    m = paddle.nn.Sequential(paddle.nn.Linear(16, 16), paddle.nn.ReLU(),
+                             paddle.nn.Linear(16, 4))
+    opt = asp.decorate(paddle.optimizer.Adam(learning_rate=1e-2, parameters=m.parameters()))
+    asp.prune_model(m, 2, 4)
+    x = paddle.to_tensor(rng.normal(size=(8, 16)).astype(np.float32))
+    y = paddle.to_tensor(rng.normal(size=(8, 4)).astype(np.float32))
+    for _ in range(4):
+        loss = F.mse_loss(m(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    for n, p in m.named_parameters():
+        if n.endswith("weight"):
+            assert asp.check_sparsity(p, "check_mask_1d", 2, 4), n

@@ -15,6 +15,7 @@ from paddle_tpu.vision import models as M
 rng = np.random.default_rng(0)
 
 
+@pytest.mark.slow
 class TestVisionModels:
     @pytest.mark.parametrize(
         "build",
@@ -190,6 +191,7 @@ class TestText:
             T.UCIHousing(data_file=None)
 
 
+@pytest.mark.slow
 class TestVisionModelsRound2:
     @pytest.mark.parametrize(
         "build,size",
@@ -281,3 +283,74 @@ class TestAudioBackend:
         tr = T.Imdb(data_file=str(tar_path), mode="train", cutoff=2)
         te = T.Imdb(data_file=str(tar_path), mode="test", cutoff=2)
         assert tr.word_idx == te.word_idx  # shared (train-derived) ids
+
+
+class TestAudioDatasets:
+    def _make_esc50(self, tmp_path):
+        import paddle_tpu.audio as A
+
+        root = tmp_path / "ESC-50-master"
+        (root / "meta").mkdir(parents=True)
+        (root / "audio").mkdir()
+        rows = ["filename,fold,target,category,esc10,src_file,take"]
+        for i in range(6):
+            name = f"clip{i}.wav"
+            wav = np.sin(np.arange(400) * (0.1 + 0.01 * i)).astype(np.float32)
+            A.save(str(root / "audio" / name), wav[None], 8000)
+            rows.append(f"{name},{i % 3 + 1},{i % 2},x,False,s,1")
+        (root / "meta" / "esc50.csv").write_text("\n".join(rows))
+        return str(root)
+
+    def test_esc50_folds_and_features(self, tmp_path):
+        from paddle_tpu.audio.datasets import ESC50
+
+        root = self._make_esc50(tmp_path)
+        train = ESC50(data_dir=root, mode="train", split_fold=1)
+        dev = ESC50(data_dir=root, mode="dev", split_fold=1)
+        assert len(train) == 4 and len(dev) == 2  # folds 2,3 vs fold 1
+        wav, label = train[0]
+        assert wav.shape[-1] == 400 and label in (0, 1)
+        mfcc_ds = ESC50(data_dir=root, mode="dev", split_fold=1,
+                        feat_type="mfcc", n_mfcc=13, n_fft=128, n_mels=20)
+        feat, _ = mfcc_ds[0]
+        assert list(feat.shape)[:2] == [1, 13]
+
+    def test_tess_emotions_from_filenames(self, tmp_path):
+        import paddle_tpu.audio as A
+        from paddle_tpu.audio.datasets import TESS
+
+        root = tmp_path / "TESS"
+        root.mkdir()
+        for i, emo in enumerate(["angry", "happy", "sad", "neutral", "fear"]):
+            wav = np.zeros(100, np.float32)
+            A.save(str(root / f"OAF_word{i}_{emo}.wav"), wav[None], 8000)
+        ds = TESS(data_dir=str(root), mode="train", n_folds=5, split_fold=5)
+        assert len(ds) == 4  # one file held out to fold 5
+        labels = {ds[i][1] for i in range(len(ds))}
+        assert labels <= set(range(7))
+
+    def test_bad_feat_type_rejected(self, tmp_path):
+        from paddle_tpu.audio.datasets import AudioClassificationDataset
+
+        with pytest.raises(ValueError, match="feat_type"):
+            AudioClassificationDataset([], [], feat_type="chromagram")
+
+
+def test_esc50_spectrogram_feat_type(tmp_path):
+    """feat_type='spectrogram' takes no sr kwarg — regression for the
+    extractor-construction crash."""
+    import paddle_tpu.audio as A
+    from paddle_tpu.audio.datasets import ESC50
+
+    root = tmp_path / "ESC-50-master"
+    (root / "meta").mkdir(parents=True)
+    (root / "audio").mkdir()
+    wav = np.sin(np.arange(600) * 0.1).astype(np.float32)
+    A.save(str(root / "audio" / "a.wav"), wav[None], 8000)
+    (root / "meta" / "esc50.csv").write_text(
+        "filename,fold,target,category,esc10,src_file,take\na.wav,1,0,x,False,s,1"
+    )
+    ds = ESC50(data_dir=str(root), mode="dev", split_fold=1,
+               feat_type="spectrogram", n_fft=128)
+    feat, label = ds[0]
+    assert feat.shape[-2] == 65 and label == 0  # n_fft//2+1 freq bins
