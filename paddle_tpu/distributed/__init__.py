@@ -10,10 +10,12 @@ from paddle_tpu.distributed import fleet  # noqa: F401
 from paddle_tpu.distributed import sharding  # noqa: F401
 from paddle_tpu.distributed import utils  # noqa: F401
 from paddle_tpu.distributed.api import (  # noqa: F401
+    ShardDataloader,
     dtensor_from_local,
     dtensor_to_local,
     get_placements,
     reshard,
+    shard_dataloader,
     shard_layer,
     shard_optimizer,
     shard_tensor,

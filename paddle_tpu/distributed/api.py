@@ -223,3 +223,82 @@ def shard_optimizer(optimizer: Any, shard_fn: Optional[Callable] = None) -> Any:
 
     optimizer._state_for = sharded_state_for
     return optimizer
+
+
+class ShardDataloader:
+    """Reference ``auto_parallel/api.py:shard_dataloader``: wrap a DataLoader
+    so every yielded batch comes out as DistTensors sharded over the mesh.
+
+    TPU-native semantics: the wrapped loader produces GLOBAL batches (this is
+    a single-controller SPMD program — there is no per-rank loader process),
+    and each tensor is placed with ``Shard(0)`` along ``shard_dims`` (the dp
+    axis) and ``Replicate`` elsewhere; XLA partitions the actual transfer.
+    ``is_dataset_splitted`` is accepted for API parity and must be False:
+    a pre-split per-rank dataset implies the multi-controller model.
+    """
+
+    def __init__(self, dataloader: Any, meshes: Any, input_keys: Optional[Sequence[str]] = None,
+                 shard_dims: Any = None, is_dataset_splitted: bool = False) -> None:
+        if is_dataset_splitted:
+            raise NotImplementedError(
+                "single-controller SPMD feeds global batches; pre-split "
+                "datasets (is_dataset_splitted=True) have no analog here"
+            )
+        if isinstance(meshes, (list, tuple)):
+            if len(meshes) != 1:
+                raise NotImplementedError(
+                    "per-input mesh lists (pipeline-style placement) are not "
+                    "supported; pass ONE mesh — under GSPMD the program, not "
+                    "the loader, decides which stage consumes which input"
+                )
+            meshes = meshes[0]
+        if input_keys is not None:
+            raise NotImplementedError(
+                "input_keys maps dict keys to per-input meshes; with a single "
+                "mesh every key gets the same placement — omit input_keys"
+            )
+        if isinstance(shard_dims, (list, tuple)):
+            if len(shard_dims) != 1:
+                raise NotImplementedError(
+                    "one shard_dim per (single) mesh; got a list of "
+                    f"{len(shard_dims)}"
+                )
+            shard_dims = shard_dims[0]
+        self._loader = dataloader
+        self._mesh = meshes
+        if shard_dims is None:
+            self._placements = [Replicate() for _ in range(meshes.ndim)]
+        else:
+            axis = (
+                meshes.dim_names.index(shard_dims)
+                if isinstance(shard_dims, str) else int(shard_dims)
+            )
+            self._placements = [
+                Shard(0) if i == axis else Replicate() for i in range(meshes.ndim)
+            ]
+
+    def _place(self, item: Any) -> Any:
+        if isinstance(item, dict):
+            return {k: self._place(v) for k, v in item.items()}
+        if isinstance(item, (list, tuple)):
+            parts = [self._place(v) for v in item]
+            if hasattr(item, "_fields"):  # namedtuple batches
+                return type(item)(*parts)
+            return type(item)(parts)
+        return shard_tensor(item, self._mesh, self._placements)
+
+    def __iter__(self):
+        for batch in self._loader:
+            yield self._place(batch)
+
+    def __len__(self) -> int:
+        return len(self._loader)
+
+
+def shard_dataloader(dataloader: Any, meshes: Any, input_keys: Optional[Sequence[str]] = None,
+                     shard_dims: Any = None, is_dataset_splitted: bool = False) -> ShardDataloader:
+    """Reference ``shard_dataloader`` parity — see :class:`ShardDataloader`."""
+    return ShardDataloader(dataloader, meshes, input_keys, shard_dims, is_dataset_splitted)
+
+
+__all__ += ["ShardDataloader", "shard_dataloader"]

@@ -134,3 +134,85 @@ def test_gradient_merge_partial_tail_applies_update():
     w1 = np.asarray(model.gpt.embeddings.word_embeddings.weight.numpy())
     assert np.abs(w1 - w0).max() > 0, "tail micro-batch grads were dropped"
     assert engine._merge_bufs is None and engine._merge_count == 0
+
+
+class TestShardDataloader:
+    """dist.shard_dataloader (reference auto_parallel/api.py:2952): global
+    batches come out as DistTensors sharded over the dp axis."""
+
+    def _loader(self):
+        from paddle_tpu.io import DataLoader
+
+        return DataLoader(LMDataset(), batch_size=8, shuffle=False, drop_last=True)
+
+    def test_batches_are_dp_sharded(self):
+        mesh = dist.ProcessMesh(shape=[2, 4], dim_names=["dp", "mp"], process_ids=list(range(8)))
+        sharded = dist.shard_dataloader(self._loader(), mesh, shard_dims="dp")
+        assert len(sharded) == 2
+        ids, labels = next(iter(sharded))
+        assert dist.get_placements(ids) is not None
+        # batch dim sharded over dp, replicated over mp
+        from paddle_tpu.distributed.placements import Replicate, Shard
+
+        p = dist.get_placements(ids)
+        assert isinstance(p[0], Shard) and p[0].dim == 0
+        assert isinstance(p[1], Replicate)
+        assert list(ids.shape) == [8, 16]  # global view preserved
+
+    def test_trains_through_engine_style_step(self):
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining, gpt_shard_fn
+
+        paddle.seed(0)
+        mesh = dist.ProcessMesh(shape=[2, 4], dim_names=["dp", "mp"], process_ids=list(range(8)))
+        cfg = GPTConfig.tiny(vocab=VOCAB)
+        model = GPTForPretraining(cfg)
+        dist.shard_layer(model, mesh, gpt_shard_fn)
+        opt = paddle.optimizer.SGD(learning_rate=0.05, parameters=model.parameters())
+        sharded = dist.shard_dataloader(self._loader(), mesh, shard_dims="dp")
+
+        @paddle.jit.to_static
+        def step(model, opt, ids, labels):
+            loss = lm_loss(model(ids), labels)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        losses = [float(step(model, opt, ids, labels)) for ids, labels in sharded]
+        assert all(np.isfinite(l) for l in losses)
+
+    def test_dict_batches_and_presplit_rejected(self):
+        mesh = dist.ProcessMesh(shape=[8], dim_names=["dp"], process_ids=list(range(8)))
+
+        class DictLoader:
+            def __iter__(self):
+                yield {"x": np.zeros((8, 4), np.float32), "y": np.zeros((8,), np.int64)}
+
+            def __len__(self):
+                return 1
+
+        out = next(iter(dist.shard_dataloader(DictLoader(), mesh, shard_dims=0)))
+        assert set(out) == {"x", "y"}
+        with pytest.raises(NotImplementedError, match="single-controller"):
+            dist.shard_dataloader(DictLoader(), mesh, is_dataset_splitted=True)
+        with pytest.raises(NotImplementedError, match="ONE mesh"):
+            dist.shard_dataloader(DictLoader(), [mesh, mesh])
+        with pytest.raises(NotImplementedError, match="input_keys"):
+            dist.shard_dataloader(DictLoader(), mesh, input_keys=["x", "y"])
+
+    def test_namedtuple_batches(self):
+        import collections
+
+        Batch = collections.namedtuple("Batch", ["ids", "labels"])
+        mesh = dist.ProcessMesh(shape=[8], dim_names=["dp"], process_ids=list(range(8)))
+
+        class NTLoader:
+            def __iter__(self):
+                yield Batch(np.zeros((8, 4), np.float32), np.zeros((8,), np.int64))
+
+            def __len__(self):
+                return 1
+
+        out = next(iter(dist.shard_dataloader(NTLoader(), mesh, shard_dims="dp")))
+        assert isinstance(out, Batch) and list(out.ids.shape) == [8, 4]
