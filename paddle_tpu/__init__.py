@@ -129,6 +129,7 @@ from paddle_tpu import optimizer  # noqa: F401
 from paddle_tpu import profiler  # noqa: F401
 from paddle_tpu import static  # noqa: F401
 from paddle_tpu import text  # noqa: F401
+from paddle_tpu import generation  # noqa: F401
 from paddle_tpu import inference  # noqa: F401
 from paddle_tpu import sparse  # noqa: F401
 from paddle_tpu import incubate  # noqa: F401

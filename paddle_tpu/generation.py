@@ -44,6 +44,42 @@ class GenerationMixin:
     ``(input_ids, past_key_values, use_cache, cache_position)`` with
     static-cache decode semantics (see ``LlamaAttention``)."""
 
+    # -- shared decode plumbing (one copy for generate/generate_beam) -------
+    def _decode_prep(self, input_ids: Any, max_new_tokens: int,
+                     eos_token_id: Optional[int], pad_token_id: Optional[int]):
+        """Validate + normalize the common decode arguments. Returns
+        ``(ids_array, pad_token_id)``; raises like ``generate`` always has."""
+        from paddle_tpu.core.tensor import Tensor
+
+        ids = input_ids._data if isinstance(input_ids, Tensor) else jnp.asarray(input_ids)
+        ids = ids.astype(jnp.int32)
+        if max_new_tokens < 0:
+            raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
+        max_pos = getattr(getattr(self, "config", None), "max_position_embeddings", None)
+        if max_pos is not None and ids.shape[1] + max_new_tokens > max_pos:
+            # the decode path's dynamic rope-table slice would silently clamp
+            # past the table end and emit garbage — fail loudly instead
+            raise ValueError(
+                f"prompt ({ids.shape[1]}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds max_position_embeddings ({max_pos})"
+            )
+        if pad_token_id is None:
+            pad_token_id = eos_token_id if eos_token_id is not None else 0
+        return ids, int(pad_token_id)
+
+    def _compiled(self, cfg: tuple, build) -> Any:
+        """Per-model bounded FIFO cache of compiled decode programs."""
+        cache = getattr(self, "_generate_jit_cache", None)
+        if cache is None:
+            cache = {}
+            object.__setattr__(self, "_generate_jit_cache", cache)
+        if cfg not in cache and len(cache) >= 16:
+            # bounded: each entry pins a compiled executable (FIFO eviction)
+            cache.pop(next(iter(cache)))
+        if cfg not in cache:
+            cache[cfg] = build()
+        return cache[cfg]
+
     def generate(
         self,
         input_ids: Any,
@@ -61,37 +97,20 @@ class GenerationMixin:
         padded with ``pad_token_id`` (defaults to eos)."""
         from paddle_tpu.core.tensor import Tensor
 
-        ids = input_ids._data if isinstance(input_ids, Tensor) else jnp.asarray(input_ids)
-        ids = ids.astype(jnp.int32)
+        ids, pad_token_id = self._decode_prep(
+            input_ids, max_new_tokens, eos_token_id, pad_token_id
+        )
         b, prompt = ids.shape
-        if max_new_tokens < 0:
-            raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
         if max_new_tokens == 0:
             return Tensor(ids)
-        max_pos = getattr(getattr(self, "config", None), "max_position_embeddings", None)
-        if max_pos is not None and prompt + max_new_tokens > max_pos:
-            # the decode path's dynamic rope-table slice would silently clamp
-            # past the table end and emit garbage — fail loudly instead
-            raise ValueError(
-                f"prompt ({prompt}) + max_new_tokens ({max_new_tokens}) exceeds "
-                f"max_position_embeddings ({max_pos})"
-            )
-        if pad_token_id is None:
-            pad_token_id = eos_token_id if eos_token_id is not None else 0
 
         cfg = (
             b, prompt, int(max_new_tokens), bool(do_sample), float(temperature),
             int(top_k), float(top_p), eos_token_id, pad_token_id,
         )
-        cache = getattr(self, "_generate_jit_cache", None)
-        if cache is None:
-            cache = {}
-            object.__setattr__(self, "_generate_jit_cache", cache)
-        if cfg not in cache and len(cache) >= 16:
-            # bounded: each entry pins a compiled executable (FIFO eviction)
-            cache.pop(next(iter(cache)))
-        if cfg not in cache:
-            cache[cfg] = jax.jit(
+        fn = self._compiled(
+            cfg,
+            lambda: jax.jit(
                 functools.partial(
                     self._generate_impl,
                     max_new_tokens=int(max_new_tokens),
@@ -102,10 +121,11 @@ class GenerationMixin:
                     eos_token_id=eos_token_id,
                     pad_token_id=int(pad_token_id),
                 )
-            )
+            ),
+        )
         named = list(self.named_parameters())
         arrays = [p._data for _, p in named]
-        out = cache[cfg](arrays, ids, jax.random.PRNGKey(seed))
+        out = fn(arrays, ids, jax.random.PRNGKey(seed))
         return Tensor(out)
 
     def generate_paged(
@@ -305,3 +325,150 @@ class GenerationMixin:
             for (_n, p), s in zip(named, saved):
                 p._data = s
         return jnp.concatenate([ids, tok0[:, None], toks.T], axis=1)
+
+    # -- beam search --------------------------------------------------------
+    def generate_beam(
+        self,
+        input_ids: Any,
+        max_new_tokens: int = 32,
+        num_beams: int = 4,
+        length_penalty: float = 0.0,
+        eos_token_id: Optional[int] = None,
+        pad_token_id: Optional[int] = None,
+    ) -> Any:
+        """Beam-search decode (reference ``beam_search`` op +
+        PaddleNLP ``BeamSearchScorer``): the whole search is ONE compiled
+        scan — beams live as a folded batch axis, each step reorders the KV
+        cache by backpointer, and the final sequences are reconstructed with
+        the ``gather_tree`` op. Returns ``[B, prompt + max_new_tokens]``."""
+        from paddle_tpu.core.tensor import Tensor
+
+        if num_beams < 1:
+            raise ValueError(f"num_beams must be >= 1, got {num_beams}")
+        ids, pad_token_id = self._decode_prep(
+            input_ids, max_new_tokens, eos_token_id, pad_token_id
+        )
+        b, prompt = ids.shape
+        if max_new_tokens == 0:
+            return Tensor(ids)
+
+        cfg = ("beam", b, prompt, int(max_new_tokens), int(num_beams),
+               float(length_penalty), eos_token_id, pad_token_id)
+        fn = self._compiled(
+            cfg,
+            lambda: jax.jit(
+                functools.partial(
+                    self._generate_beam_impl,
+                    max_new_tokens=int(max_new_tokens),
+                    num_beams=int(num_beams),
+                    length_penalty=float(length_penalty),
+                    eos_token_id=eos_token_id,
+                    pad_token_id=int(pad_token_id),
+                )
+            ),
+        )
+        named = list(self.named_parameters())
+        arrays = [p._data for _, p in named]
+        return Tensor(fn(arrays, ids))
+
+    def _generate_beam_impl(
+        self,
+        param_arrays: List[Any],
+        ids: jax.Array,
+        *,
+        max_new_tokens: int,
+        num_beams: int,
+        length_penalty: float,
+        eos_token_id: Optional[int],
+        pad_token_id: int,
+    ) -> jax.Array:
+        import paddle_tpu
+        from paddle_tpu.core.tensor import Tensor
+        from paddle_tpu.ops.parity import gather_tree
+
+        K = num_beams
+        NEG = -1e9
+        b, prompt = ids.shape
+        s_total = prompt + max_new_tokens
+
+        named = list(self.named_parameters())
+        saved = [p._data for _, p in named]
+        try:
+            for (_n, p), a in zip(named, param_arrays):
+                p._data = a
+
+            with paddle_tpu.no_grad():
+                logits, caches = self(Tensor(ids), use_cache=True)
+            logp0 = jax.nn.log_softmax(logits._data[:, -1, :].astype(jnp.float32))
+            V = logp0.shape[-1]
+            scores, tok0 = jax.lax.top_k(logp0, K)  # [B, K]
+            tok0 = tok0.astype(jnp.int32)
+            done = (
+                tok0 == eos_token_id if eos_token_id is not None
+                else jnp.zeros((b, K), bool)
+            )
+            lens = jnp.ones((b, K), jnp.int32)
+            pad_spec = ((0, 0), (0, s_total - prompt), (0, 0), (0, 0))
+            # beams fold into the batch axis: [B*K, S, H, D]
+            cks = [jnp.repeat(jnp.pad(k_t._data, pad_spec), K, axis=0) for k_t, _ in caches]
+            cvs = [jnp.repeat(jnp.pad(v_t._data, pad_spec), K, axis=0) for _, v_t in caches]
+            # one-hot pad row: a finished beam only extends by pad, score frozen
+            pad_row = jnp.full((V,), NEG, jnp.float32).at[pad_token_id].set(0.0)
+
+            def body(carry, _):
+                tok, scores, done, lens, cks, cvs, pos = carry
+                with paddle_tpu.no_grad():
+                    step_logits, new_caches = self(
+                        Tensor(tok.reshape(-1)[:, None]),
+                        past_key_values=[
+                            (Tensor(k), Tensor(v)) for k, v in zip(cks, cvs)
+                        ],
+                        use_cache=True,
+                        cache_position=Tensor(pos),
+                    )
+                logp = jax.nn.log_softmax(
+                    step_logits._data[:, -1, :].astype(jnp.float32)
+                ).reshape(b, K, V)
+                logp = jnp.where(done[:, :, None], pad_row[None, None, :], logp)
+                cand = (scores[:, :, None] + logp).reshape(b, K * V)
+                new_scores, idx = jax.lax.top_k(cand, K)
+                parent = (idx // V).astype(jnp.int32)  # new beam -> old beam
+                new_tok = (idx % V).astype(jnp.int32)
+                flat_parent = (jnp.arange(b)[:, None] * K + parent).reshape(-1)
+                cks2 = [c[0]._data[flat_parent] for c in new_caches]
+                cvs2 = [c[1]._data[flat_parent] for c in new_caches]
+                done_g = jnp.take_along_axis(done, parent, axis=1)
+                lens_g = jnp.take_along_axis(lens, parent, axis=1)
+                lens2 = lens_g + jnp.where(done_g, 0, 1).astype(jnp.int32)
+                done2 = done_g | (
+                    new_tok == eos_token_id if eos_token_id is not None
+                    else jnp.zeros_like(done_g)
+                )
+                return (new_tok, new_scores, done2, lens2, cks2, cvs2, pos + 1), (
+                    new_tok, parent,
+                )
+
+            init = (tok0, scores, done, lens, cks, cvs, jnp.int32(prompt))
+            (tok, scores, done, lens, _, _, _), (toks, parents) = jax.lax.scan(
+                body, init, None, length=max_new_tokens - 1
+            )
+            # [T, B, K] with the step-0 layer (parents 0: all beams came from
+            # the single prefill context)
+            all_toks = jnp.concatenate([tok0[None], toks], axis=0)
+            all_parents = jnp.concatenate(
+                [jnp.zeros((1, b, K), jnp.int32), parents], axis=0
+            )
+            seqs = gather_tree(all_toks, all_parents)  # [T, B, K]
+            seqs = seqs._data if hasattr(seqs, "_data") else seqs
+            if length_penalty != 0.0:
+                final = scores / jnp.power(lens.astype(jnp.float32), length_penalty)
+            else:
+                final = scores
+            best = jnp.argmax(final, axis=-1)  # [B]
+            best_seq = jnp.take_along_axis(
+                seqs, best[None, :, None], axis=2
+            )[:, :, 0]  # [T, B]
+        finally:
+            for (_n, p), s in zip(named, saved):
+                p._data = s
+        return jnp.concatenate([ids, best_seq.T], axis=1)

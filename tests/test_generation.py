@@ -223,3 +223,86 @@ class TestPagedGeneration:
         assert hit.size > 0
         first = 4 + hit[0]
         assert (arr[first + 1 :] == 0).all()
+
+
+class TestBeamSearch:
+    """generate_beam (reference beam_search op + BeamSearchScorer): one
+    compiled scan, beams folded into the batch axis, gather_tree backtrace."""
+
+    def _model(self):
+        paddle.seed(0)
+        cfg = LlamaConfig.tiny()
+        return LlamaForCausalLM(cfg), cfg
+
+    def _seq_logprob(self, model, seq, prompt_len):
+        """Teacher-forced total log-prob of the generated suffix."""
+        import jax
+        import jax.numpy as jnp
+
+        with paddle.no_grad():
+            logits, _ = model(paddle.to_tensor(seq[None, :-1]), use_cache=True)
+        lp = jax.nn.log_softmax(logits._data[0].astype(jnp.float32), axis=-1)
+        tgt = seq[1:]
+        tot = 0.0
+        for t in range(prompt_len - 1, len(tgt)):
+            tot += float(lp[t, tgt[t]])
+        return tot
+
+    def test_beam1_equals_greedy(self):
+        model, cfg = self._model()
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, cfg.vocab_size, (2, 5)).astype(np.int32)
+        greedy = model.generate(paddle.to_tensor(ids), max_new_tokens=6).numpy()
+        beam1 = model.generate_beam(paddle.to_tensor(ids), max_new_tokens=6, num_beams=1).numpy()
+        np.testing.assert_array_equal(greedy, beam1)
+
+    def test_beam_score_at_least_greedy(self):
+        model, cfg = self._model()
+        rng = np.random.default_rng(1)
+        ids = rng.integers(0, cfg.vocab_size, (1, 4)).astype(np.int32)
+        N = 6
+        greedy = model.generate(paddle.to_tensor(ids), max_new_tokens=N).numpy()[0]
+        beam = model.generate_beam(paddle.to_tensor(ids), max_new_tokens=N, num_beams=4).numpy()[0]
+        g = self._seq_logprob(model, greedy, ids.shape[1])
+        bm = self._seq_logprob(model, beam, ids.shape[1])
+        assert bm >= g - 1e-4, f"beam {bm} < greedy {g}"
+
+    def test_beam_shapes_and_batch(self):
+        model, cfg = self._model()
+        rng = np.random.default_rng(2)
+        ids = rng.integers(0, cfg.vocab_size, (3, 4)).astype(np.int32)
+        out = model.generate_beam(paddle.to_tensor(ids), max_new_tokens=5, num_beams=3).numpy()
+        assert out.shape == (3, 9)
+        np.testing.assert_array_equal(out[:, :4], ids)  # prompt preserved
+
+    def test_eos_finishes_and_pads(self):
+        model, cfg = self._model()
+        rng = np.random.default_rng(3)
+        ids = rng.integers(0, cfg.vocab_size, (2, 4)).astype(np.int32)
+        # pick an eos the model will actually emit (batch 0's greedy token)
+        eos = int(model.generate(paddle.to_tensor(ids), max_new_tokens=1).numpy()[0, -1])
+        PAD = cfg.vocab_size - 1
+        out = model.generate_beam(
+            paddle.to_tensor(ids), max_new_tokens=6, num_beams=2,
+            eos_token_id=eos, pad_token_id=PAD,
+        ).numpy()
+        assert out.shape == (2, 10)
+        # after the first eos in a row, EVERY later token must be pad
+        # (the pad_row freeze) — this is the finishing semantics, not shape
+        for row in out:
+            gen = row[4:]
+            hits = np.where(gen == eos)[0]
+            if hits.size:
+                tail = gen[hits[0] + 1 :]
+                assert (tail == PAD).all(), (gen, eos, PAD)
+
+    def test_negative_max_new_tokens_raises_like_generate(self):
+        model, cfg = self._model()
+        ids = paddle.to_tensor(np.zeros((1, 3), np.int32))
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            model.generate_beam(ids, max_new_tokens=-5)
+
+    def test_rejects_bad_beams(self):
+        model, cfg = self._model()
+        with pytest.raises(ValueError, match="num_beams"):
+            model.generate_beam(paddle.to_tensor(np.zeros((1, 3), np.int32)), num_beams=0)

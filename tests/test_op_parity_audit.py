@@ -90,6 +90,7 @@ ALIASES = {
     "shuffle_channel": "nn.functional.channel_shuffle",
     "assign_out_": "assign", "assign_value_": "assign",
     "fused_multi_transformer": "incubate.nn.FusedMultiTransformer",
+    "beam_search": "generation.GenerationMixin.generate_beam",
     "moe": "incubate.nn.functional.fused_moe",
     # quantization kernel family -> the quantization module
     "fake_quantize_abs_max": "quantization.FakeQuanterWithAbsMax",
@@ -183,7 +184,6 @@ SKIP = {
     "reindex_graph": "graph reindexing is host-side data prep",
     "weighted_sample_neighbors": "weighted sampling is host-side data prep",
     # misc
-    "beam_search": "beam decode loop (greedy/sampling/paged decode implemented; gather_tree IS implemented)",
     "calc_reduced_attn_scores": "speculative-decoding helper for a specific CUDA kernel",
     "class_center_sample": "PLSC face-recognition class sampling",
     "margin_cross_entropy": "PLSC margin softmax (model-parallel face rec)",
