@@ -344,6 +344,7 @@ def main() -> None:
         _bench_resnet_pipeline(paddle, platform),
         _bench_int8_decode(paddle, platform),
         _bench_paged_decode(paddle, platform),
+        _bench_engine_decode(paddle, platform),
     ]
     print(
         json.dumps(
@@ -570,6 +571,77 @@ def _bench_paged_decode(paddle, platform: str) -> dict:
         return rec
     except Exception as exc:  # noqa: BLE001
         return {"metric": "paged_decode_step_ms", "error": f"{exc!r}"[:300]}
+
+
+def _bench_engine_decode(paddle, platform: str) -> dict:
+    """Continuous-batching decode throughput: a mixed-length request stream
+    through the two-signature engine (``inference.ContinuousBatchingEngine``)
+    — generated tokens/sec with slots refilled as sequences finish. The
+    compiled-signature count rides along as an honesty check: > 2 means the
+    engine retraced mid-serve and the number is measuring compiles."""
+    from paddle_tpu.inference import ContinuousBatchingEngine
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    # pin the attention path explicitly (and restore it on the way out) —
+    # _bench_paged_decode toggles this flag while timing, and the value it
+    # happens to leave behind would otherwise decide which kernel this
+    # metric measures
+    flag_name = "FLAGS_use_pallas_paged_attention"
+    prior_flag = paddle.get_flags([flag_name])[flag_name]
+    use_pallas = platform == "tpu"
+    try:
+        if platform == "tpu":
+            cfg = LlamaConfig(
+                vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+                num_hidden_layers=8, num_attention_heads=16,
+                num_key_value_heads=16, max_position_embeddings=1024,
+            )
+            slots, bs, bucket, n_req, max_new = 8, 16, 128, 24, 64
+        else:
+            cfg = LlamaConfig.tiny()
+            slots, bs, bucket, n_req, max_new = 2, 4, 16, 4, 6
+
+        paddle.set_flags({flag_name: use_pallas})
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg)
+        if platform == "tpu":
+            model = model.to(dtype="bfloat16")
+        model.eval()
+        engine = ContinuousBatchingEngine(
+            model, max_slots=slots, block_size=bs, prompt_bucket=bucket
+        )
+        rng = np.random.default_rng(6)
+
+        def submit(n: int) -> None:
+            for _ in range(n):
+                plen = int(rng.integers(max(bucket // 4, 1), bucket + 1))
+                engine.add_request(
+                    rng.integers(0, cfg.vocab_size, (plen,)).astype(np.int32),
+                    max_new_tokens=int(rng.integers(max_new // 2, max_new + 1)),
+                )
+
+        submit(2)  # warmup: compiles the prefill + decode signatures
+        engine.run()
+        submit(n_req)
+        t0 = time.perf_counter()
+        out = engine.run()
+        dt = time.perf_counter() - t0
+        toks = sum(len(r.generated) for r in out.values())
+        return {
+            "metric": "engine_decode_tokens_per_sec",
+            "value": round(toks / dt, 2),
+            "unit": "tokens/s",
+            "requests": n_req,
+            "generated_tokens": toks,
+            "max_slots": slots,
+            "attention_path": "pallas" if use_pallas else "xla_gather",
+            "compiled_signatures": engine.stats["prefill_traces"]
+            + engine.stats["decode_traces"],
+        }
+    except Exception as exc:  # noqa: BLE001 - secondary must never kill primary
+        return {"metric": "engine_decode_tokens_per_sec", "error": f"{exc!r}"[:300]}
+    finally:
+        paddle.set_flags({flag_name: prior_flag})
 
 
 def _bench_resnet_pipeline(paddle, platform: str) -> dict:

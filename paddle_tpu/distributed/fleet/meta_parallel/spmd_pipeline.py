@@ -26,7 +26,24 @@ from jax.sharding import PartitionSpec as P
 
 shard_map = getattr(jax, "shard_map", None)
 if shard_map is None:  # pragma: no cover - jax<0.6 fallback
-    from jax.experimental.shard_map import shard_map  # type: ignore
+    import inspect
+
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+    _SM_PARAMS = inspect.signature(_experimental_shard_map).parameters
+
+    def shard_map(f, mesh=None, **kw):  # type: ignore[misc]
+        """New-API ``jax.shard_map`` surface over the experimental one:
+        ``axis_names={...}`` becomes its complement in ``auto=``, and
+        ``check_vma=`` maps back to its old name ``check_rep=``."""
+        if "axis_names" in kw and "axis_names" not in _SM_PARAMS:
+            axis_names = kw.pop("axis_names")
+            auto = frozenset(getattr(mesh, "axis_names", ())) - frozenset(axis_names)
+            if auto:
+                kw["auto"] = auto
+        if "check_vma" in kw and "check_vma" not in _SM_PARAMS:
+            kw["check_rep"] = kw.pop("check_vma")
+        return _experimental_shard_map(f, mesh=mesh, **kw)
 
 __all__ = [
     "pipeline",

@@ -17,6 +17,8 @@ from typing import Any, List, Optional
 import jax
 import jax.numpy as jnp
 
+from paddle_tpu.nn.layer.layers import bind_param_arrays
+
 __all__ = ["GenerationMixin"]
 
 
@@ -142,7 +144,13 @@ class GenerationMixin:
         sequences as they grow and reclaimed at the end — the serving-side
         memory model, vs ``generate()``'s fixed dense buffers. The host
         allocator runs between steps; each decode step is one jitted program
-        (block tables and lengths are data, so shapes never change)."""
+        (block tables and lengths are data, so shapes never change).
+
+        This runs ONE static batch to completion (a finished sequence holds
+        its slot and blocks until all are done); for mixed-length serving
+        traffic use ``paddle_tpu.inference.ContinuousBatchingEngine``, which
+        admits/evicts per step over a shared pool with the same numerics —
+        the engine's per-sequence outputs match this method token-for-token."""
         import numpy as np
 
         from paddle_tpu.core.tensor import Tensor
@@ -204,10 +212,7 @@ class GenerationMixin:
 
         @jax.jit
         def _paged_step(param_arrays, tok, caches, tables, lens):
-            saved = [p._data for _, p in named]
-            try:
-                for (_n, p), a in zip(named, param_arrays):
-                    p._data = a
+            with bind_param_arrays(named, param_arrays):
                 pkv = [
                     (Tensor(kc), Tensor(vc), Tensor(tables), Tensor(lens))
                     for kc, vc in caches
@@ -224,9 +229,6 @@ class GenerationMixin:
                 ).astype(jnp.int32)
                 out_caches = [(c[0]._data, c[1]._data) for c in new_caches]
                 return nxt, out_caches
-            finally:
-                for (_n, p), s_ in zip(named, saved):
-                    p._data = s_
 
         step = step_cache.setdefault(step_key, _paged_step)
 
@@ -277,11 +279,7 @@ class GenerationMixin:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
         named = list(self.named_parameters())
-        saved = [p._data for _, p in named]
-        try:
-            for (_n, p), a in zip(named, param_arrays):
-                p._data = a
-
+        with bind_param_arrays(named, param_arrays):
             with paddle_tpu.no_grad():
                 logits, caches = self(Tensor(ids), use_cache=True)
             key, sub = jax.random.split(key)
@@ -321,9 +319,6 @@ class GenerationMixin:
             # result is discarded)
             init = (tok0, cks, cvs, jnp.int32(prompt), done0, key)
             _, toks = jax.lax.scan(body, init, None, length=max_new_tokens - 1)
-        finally:
-            for (_n, p), s in zip(named, saved):
-                p._data = s
         return jnp.concatenate([ids, tok0[:, None], toks.T], axis=1)
 
     # -- beam search --------------------------------------------------------
@@ -392,11 +387,7 @@ class GenerationMixin:
         s_total = prompt + max_new_tokens
 
         named = list(self.named_parameters())
-        saved = [p._data for _, p in named]
-        try:
-            for (_n, p), a in zip(named, param_arrays):
-                p._data = a
-
+        with bind_param_arrays(named, param_arrays):
             with paddle_tpu.no_grad():
                 logits, caches = self(Tensor(ids), use_cache=True)
             logp0 = jax.nn.log_softmax(logits._data[:, -1, :].astype(jnp.float32))
@@ -461,14 +452,16 @@ class GenerationMixin:
             seqs = gather_tree(all_toks, all_parents)  # [T, B, K]
             seqs = seqs._data if hasattr(seqs, "_data") else seqs
             if length_penalty != 0.0:
-                final = scores / jnp.power(lens.astype(jnp.float32), length_penalty)
+                # reference BeamSearchScorer normalization: score divided by
+                # ((5 + len) / 6) ** alpha over the FULL hypothesis length
+                # (prompt + generated) — `lens ** alpha` over generated
+                # tokens only ranks beams differently
+                full_len = (prompt + lens).astype(jnp.float32)
+                final = scores / jnp.power((5.0 + full_len) / 6.0, length_penalty)
             else:
                 final = scores
             best = jnp.argmax(final, axis=-1)  # [B]
             best_seq = jnp.take_along_axis(
                 seqs, best[None, :, None], axis=2
             )[:, :, 0]  # [T, B]
-        finally:
-            for (_n, p), s in zip(named, saved):
-                p._data = s
         return jnp.concatenate([ids, best_seq.T], axis=1)

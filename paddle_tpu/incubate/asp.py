@@ -28,11 +28,14 @@ __all__ = [
 ]
 
 _EXCLUDED: set = set()
-# id(param) -> device mask. prune_model registers here so decorate()d
-# optimizers pick masks up regardless of call order (reference allows
-# decorate-then-prune); the params outlive the registry entries (they are
-# the model's live Parameters), so id() keys stay valid.
-_MASK_REGISTRY: Dict[int, Any] = {}
+# The pruning mask lives ON the Parameter (``p._asp_mask``), not in a
+# module-level ``{id(param): mask}`` registry: after a pruned model is
+# garbage-collected, CPython reuses object ids, so a registry entry keyed by
+# a dead param's id could silently apply the dead model's mask to a fresh
+# unrelated weight. Attribute storage makes the mask's lifetime exactly the
+# parameter's, and decorate()d optimizers still pick masks up regardless of
+# call order (reference allows decorate-then-prune).
+_ASP_MASK_ATTR = "_asp_mask"
 
 
 def calculate_density(x: Any) -> float:
@@ -157,22 +160,28 @@ def prune_model(model: Layer, n: int = 2, m: int = 4,
         p._data = p._data * jnp.asarray(mask, p._data.dtype)
         if with_mask:
             masks[name] = mask
-            _MASK_REGISTRY[id(p)] = jnp.asarray(mask, p._data.dtype)
+            setattr(p, _ASP_MASK_ATTR, jnp.asarray(mask, p._data.dtype))
     return masks
 
 
 class OptimizerWithSparsityGuarantee:
     """Reference ``asp.py:949``: wraps an optimizer so every ``step()``
     re-applies the pruning masks — weights stay n:m sparse through training.
-    Masks come from the module registry that :func:`prune_model` fills, so
-    the reference's both call orders (prune-then-decorate AND
-    decorate-then-prune) work."""
+    Masks live on the Parameters themselves (:func:`prune_model` attaches
+    them), so the reference's both call orders (prune-then-decorate AND
+    decorate-then-prune) work and a mask can never outlive — or be
+    mis-delivered to — its parameter. An explicit :meth:`attach_masks` is a
+    per-optimizer override that beats the Parameter's own mask regardless of
+    call order (id() keys are safe here: the optimizer keeps its parameters
+    alive for this wrapper's whole lifetime)."""
 
     def __init__(self, optimizer: Any) -> None:
         self._optimizer = optimizer
         self._masks: Dict[int, Any] = {}  # explicit attach_masks overrides
 
     def attach_masks(self, model: Layer, masks: Dict[str, np.ndarray]) -> None:
+        """Explicitly (re)attach masks; wins over prune_model's Parameter
+        masks for THIS optimizer even if prune_model runs afterwards."""
         named = dict(model.named_parameters())
         for name, mask in masks.items():
             p = named[name]
@@ -184,7 +193,7 @@ class OptimizerWithSparsityGuarantee:
 
         with _ag.set_grad_enabled(False):
             for p in self._optimizer._parameters:
-                mask = self._masks.get(id(p), _MASK_REGISTRY.get(id(p)))
+                mask = self._masks.get(id(p), getattr(p, _ASP_MASK_ATTR, None))
                 if mask is not None:
                     p._data = p._data * mask
 

@@ -104,8 +104,28 @@ class BlockKVCache:
             self._free.append(b)
         self._lens.pop(seq_id, None)
 
+    def truncate(self, seq_id: int, num_tokens: int) -> None:
+        """Roll ``seq_id`` back to ``num_tokens`` stored tokens, returning
+        now-unused tail blocks to the pool — the undo for a speculative or
+        failed step whose ``allocate`` already ran."""
+        table = self._tables.get(seq_id)
+        if table is None:
+            return
+        keep = -(-num_tokens // self.block_size) if num_tokens > 0 else 0
+        while len(table) > keep:
+            self._free.append(table.pop())
+        self._lens[seq_id] = num_tokens
+
     def seq_len(self, seq_id: int) -> int:
         return self._lens.get(seq_id, 0)
+
+    def blocks_allocated(self, seq_id: Optional[int] = None) -> int:
+        """Physical blocks held by ``seq_id`` (all sequences when None) —
+        the public accounting surface the serving engine's admission math
+        relies on."""
+        if seq_id is not None:
+            return len(self._tables.get(seq_id, ()))
+        return sum(len(t) for t in self._tables.values())
 
     @property
     def free_blocks(self) -> int:
@@ -131,14 +151,22 @@ def block_cache_append(
     v: jax.Array,
     block_tables: jax.Array,  # [B, MBS]
     positions: jax.Array,  # [B] token index being written (0-based)
+    slot_mask: Optional[jax.Array] = None,  # [B] bool; False = padded slot
 ) -> Tuple[jax.Array, jax.Array]:
-    """Scatter one new KV token per sequence into its physical block slot."""
-    bs = key_cache.shape[2]
+    """Scatter one new KV token per sequence into its physical block slot.
+
+    With ``slot_mask``, masked-off (padded) batch slots write NOTHING: their
+    block-table row may alias physical blocks owned by live sequences (the
+    engine keeps evicted rows at 0), so their scatter is routed out of bounds
+    and dropped instead of clobbering another sequence's KV."""
+    nb, _h, bs, _d = key_cache.shape
     blk_idx = positions // bs
     off = positions % bs
     phys = jnp.take_along_axis(block_tables, blk_idx[:, None], axis=1)[:, 0]
-    key_cache = key_cache.at[phys, :, off].set(k.astype(key_cache.dtype))
-    value_cache = value_cache.at[phys, :, off].set(v.astype(value_cache.dtype))
+    if slot_mask is not None:
+        phys = jnp.where(slot_mask, phys, nb)
+    key_cache = key_cache.at[phys, :, off].set(k.astype(key_cache.dtype), mode="drop")
+    value_cache = value_cache.at[phys, :, off].set(v.astype(value_cache.dtype), mode="drop")
     return key_cache, value_cache
 
 
@@ -182,18 +210,30 @@ def block_multihead_attention(
     block_tables: jax.Array,  # [B, MBS] int32
     seq_lens: jax.Array,  # [B] tokens already cached (EXCLUDING this one)
     scale: Optional[float] = None,
+    slot_mask: Optional[jax.Array] = None,  # [B] bool; False = padded slot
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One paged-cache decode step: append the new KV, attend over the
     sequence's blocks. Returns ``(out [B, 1, HQ, D], key_cache, value_cache)``
     — pass donated caches under jit for true in-place update (the reference
-    op is declared ``inplace``)."""
+    op is declared ``inplace``).
+
+    ``slot_mask`` is the continuous-batching engine's ragged-batch contract:
+    masked-off slots append nothing, attend over nothing (their effective
+    length is forced to 0 so the ragged kernel skips them entirely), and
+    return exactly zeros — in lockstep between the Pallas kernel and this XLA
+    fallback so slot padding never changes numerics."""
     b, one, hq, d = q.shape
     hkv = k.shape[2]
     if scale is None:
         scale = 1.0 / (d**0.5)
     key_cache, value_cache = block_cache_append(
-        key_cache, value_cache, k[:, 0], v[:, 0], block_tables, seq_lens
+        key_cache, value_cache, k[:, 0], v[:, 0], block_tables, seq_lens,
+        slot_mask=slot_mask,
     )
+    # length INCLUDING the freshly appended token; 0 for padded slots
+    attend_lens = seq_lens + 1
+    if slot_mask is not None:
+        attend_lens = jnp.where(slot_mask, attend_lens, 0)
     from paddle_tpu.kernels.select import pallas_enabled, warn_fallback
 
     if pallas_enabled("use_pallas_paged_attention"):
@@ -214,7 +254,7 @@ def block_multihead_attention(
             try:
                 out = paged_flash_decode(
                     q[:, 0], key_cache, value_cache, block_tables,
-                    seq_lens + 1,  # kernel masks pos < len INCLUDING this token
+                    attend_lens,  # kernel masks pos < len INCLUDING this token
                     scale=scale,
                 )
                 return out[:, None], key_cache, value_cache
@@ -240,8 +280,12 @@ def block_multihead_attention(
     qf = q[:, 0].astype(jnp.float32) * scale  # [B, HQ, D]
     scores = jnp.einsum("bhd,blhd->bhl", qf, gk.astype(jnp.float32))
     pos = jnp.arange(L)[None, None, :]
-    mask = pos <= seq_lens[:, None, None]  # attends the freshly-appended token
+    mask = pos < attend_lens[:, None, None]  # attends the freshly-appended token
     scores = jnp.where(mask, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhl,blhd->bhd", probs, gv.astype(jnp.float32))
+    if slot_mask is not None:
+        # fully-masked softmax degenerates to a uniform mean over garbage;
+        # the kernel emits exact zeros for skipped slots — match it
+        out = jnp.where(slot_mask[:, None, None], out, 0.0)
     return out[:, None].astype(q.dtype), key_cache, value_cache

@@ -32,7 +32,14 @@ __all__ = [
     "create_predictor",
     "convert_to_mixed_precision",
     "PrecisionType",
+    "ContinuousBatchingEngine",
+    "InferenceRequest",
 ]
+
+from paddle_tpu.inference.engine import (  # noqa: E402
+    ContinuousBatchingEngine,
+    InferenceRequest,
+)
 
 
 class PrecisionType:

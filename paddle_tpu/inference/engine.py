@@ -1,0 +1,404 @@
+"""Continuous-batching decode engine over a ragged paged KV pool.
+
+The serving-grade decode path: where ``generation.py::generate_paged`` runs
+one static batch to completion (a finished sequence holds its batch slot and
+KV blocks until EVERY sequence is done), this engine admits new requests into
+freed slots every step and reclaims a finished sequence's blocks immediately
+— the scheduling model of vLLM / the reference's serving stack, shaped for
+TPU: all device shapes are FIXED (max-slots batch, dense block tables,
+per-slot lengths as data), so the whole mixed workload runs through exactly
+TWO compiled programs per (model, config):
+
+- one PREFILL signature: ``[1, prompt_bucket]`` padded prompt, scattered into
+  the pool via ``block_cache_prefill`` (positions past the true length are
+  dropped), first token read at the true last position;
+- one DECODE signature: ``[max_slots]`` tokens over the shared block pool,
+  padded slots carried by an active-slot mask (they write no KV, attend over
+  nothing, and the ragged Pallas kernel skips their compute — see
+  ``kernels/paged_attention.py``).
+
+Admits and evictions only rewrite HOST-side numpy state (block tables,
+lengths, the active mask) that is passed to the compiled step as data — the
+program never retraces as the request mix changes. "Ragged Paged Attention"
+(arxiv 2604.15464) is the kernel shape; "Efficient Operation Fusion"
+(arxiv 2502.17728) is why each step stays one fused program.
+
+The block allocator is host-side Python (it runs between steps, not inside
+the program), reusing ``BlockKVCache``'s accounting; admission reserves a
+request's worst-case block need up front so a mid-flight decode step can
+never hit pool exhaustion.
+"""
+
+from __future__ import annotations
+
+import itertools
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["ContinuousBatchingEngine", "InferenceRequest"]
+
+
+class InferenceRequest:
+    """One queued generation request and, after finishing, its result."""
+
+    def __init__(
+        self,
+        req_id: int,
+        prompt: np.ndarray,
+        max_new_tokens: int,
+        eos_token_id: Optional[int],
+    ) -> None:
+        self.req_id = req_id
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_token_id = eos_token_id
+        self.generated: List[int] = []
+        self.finish_reason: Optional[str] = None  # "stop" | "length"
+
+    @property
+    def finished(self) -> bool:
+        return self.finish_reason is not None
+
+    def tokens(self) -> np.ndarray:
+        """Prompt + generated tokens, the ``generate_paged`` layout."""
+        return np.concatenate([self.prompt, np.asarray(self.generated, np.int32)])
+
+
+class ContinuousBatchingEngine:
+    """Host-side scheduler driving one jitted prefill + one jitted decode.
+
+    ``max_slots`` bounds the live batch; ``num_blocks`` sizes the global KV
+    pool shared by all slots; ``prompt_bucket`` is the single padded prompt
+    length every admitted prompt is chunked into (one prefill signature).
+    """
+
+    def __init__(
+        self,
+        model: Any,
+        max_slots: int = 4,
+        block_size: int = 16,
+        num_blocks: Optional[int] = None,
+        prompt_bucket: int = 32,
+        max_model_len: Optional[int] = None,
+    ) -> None:
+        from paddle_tpu.incubate.nn.functional import BlockKVCache
+
+        cfg = model.config
+        self.model = model
+        self.max_slots = int(max_slots)
+        self.block_size = int(block_size)
+        self.prompt_bucket = int(prompt_bucket)
+        self.max_model_len = int(
+            max_model_len
+            or getattr(cfg, "max_position_embeddings", None)
+            or self.prompt_bucket * 4
+        )
+        if self.prompt_bucket > self.max_model_len:
+            raise ValueError(
+                f"prompt_bucket ({self.prompt_bucket}) exceeds max_model_len "
+                f"({self.max_model_len})"
+            )
+        self.max_blocks_per_seq = -(-self.max_model_len // self.block_size)
+        self.num_blocks = int(
+            num_blocks if num_blocks is not None
+            else self.max_slots * self.max_blocks_per_seq
+        )
+
+        kvh = cfg.num_key_value_heads
+        hd = cfg.hidden_size // cfg.num_attention_heads
+        self._num_layers = cfg.num_hidden_layers
+        dtype = next(iter(model.parameters())).dtype
+        # host-side allocator/accounting only; the device pool lives below
+        self._mgr = BlockKVCache(
+            self.num_blocks, self.block_size, kvh, hd,
+            self.max_blocks_per_seq, dtype=dtype,
+        )
+        # ONE global paged pool shared by every layer's sequences would alias
+        # writes across layers — each layer owns its [NB, KVH, BS, D] pair,
+        # all indexed by the SAME block tables (the reference layout).
+        shape = (self.num_blocks, kvh, self.block_size, hd)
+        self._caches = [
+            (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+            for _ in range(self._num_layers)
+        ]
+
+        # per-slot host state (rewritten freely between steps — it is DATA to
+        # the compiled step, never part of its shape)
+        self._slot_req: List[Optional[InferenceRequest]] = [None] * self.max_slots
+        self._ntok = np.zeros((self.max_slots,), np.int32)  # tokens stored in pool
+        self._last_tok = np.zeros((self.max_slots,), np.int32)
+        self._reserved = np.zeros((self.max_slots,), np.int64)  # admission worst case
+        self._waiting: deque = deque()
+        self._ids = itertools.count()
+
+        self._named = list(model.named_parameters())
+        self.stats = {"prefill_traces": 0, "decode_traces": 0, "steps": 0, "admitted": 0}
+        # On donating backends (TPU) a step that fails AFTER dispatch has
+        # already consumed the donated cache buffers: allocator accounting is
+        # rolled back, but the KV contents are unrecoverable — the engine
+        # marks itself broken and refuses further use rather than serving
+        # garbage. On CPU (no donation) failed steps are safely retryable.
+        self._broken = False
+        donate = jax.default_backend() != "cpu"  # donation warns (no-op) on cpu
+        self._prefill_fn = jax.jit(
+            self._prefill_impl, donate_argnums=(1,) if donate else ()
+        )
+        self._decode_fn = jax.jit(
+            self._decode_impl, donate_argnums=(1,) if donate else ()
+        )
+
+    # -- pool accounting -----------------------------------------------------
+    def pool_stats(self) -> Dict[str, int]:
+        return {
+            "total": self.num_blocks,
+            "free": self._mgr.free_blocks,
+            "allocated": self._mgr.blocks_allocated(),
+        }
+
+    def _unreserved_free(self) -> int:
+        """Free blocks not spoken for by live sequences' worst-case growth."""
+        outstanding = 0
+        for slot, req in enumerate(self._slot_req):
+            if req is not None:
+                outstanding += int(self._reserved[slot]) - self._mgr.blocks_allocated(slot)
+        return self._mgr.free_blocks - outstanding
+
+    def _buffers_lost(self) -> bool:
+        return any(
+            getattr(a, "is_deleted", lambda: False)()
+            for kc, vc in self._caches
+            for a in (kc, vc)
+        )
+
+    def _check_usable(self) -> None:
+        if self._broken:
+            raise RuntimeError(
+                "engine KV state was lost (a failed step consumed its donated "
+                "cache buffers); build a new ContinuousBatchingEngine"
+            )
+
+    # -- request intake ------------------------------------------------------
+    def add_request(
+        self,
+        prompt_ids: Any,
+        max_new_tokens: int = 32,
+        eos_token_id: Optional[int] = None,
+    ) -> int:
+        """Queue one prompt; returns the request id. Raises on prompts that
+        can never fit the configured bucket/model length (failing loudly at
+        intake beats wedging the scheduler)."""
+        self._check_usable()
+        prompt = np.asarray(
+            prompt_ids._data if hasattr(prompt_ids, "_data") else prompt_ids,
+            np.int32,
+        ).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if prompt.size > self.prompt_bucket:
+            raise ValueError(
+                f"prompt ({prompt.size} tokens) exceeds prompt_bucket "
+                f"({self.prompt_bucket}); configure a larger bucket"
+            )
+        if prompt.size + max_new_tokens > self.max_model_len:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds max_model_len ({self.max_model_len})"
+            )
+        req = InferenceRequest(next(self._ids), prompt, max_new_tokens, eos_token_id)
+        if self._blocks_needed(req) > self.num_blocks:
+            # a request no eviction can ever make room for would sit at the
+            # FIFO head forever and busy-loop run()
+            raise ValueError(
+                f"request needs {self._blocks_needed(req)} KV blocks worst-case "
+                f"but the pool only has {self.num_blocks}"
+            )
+        self._waiting.append(req)
+        return req.req_id
+
+    def has_work(self) -> bool:
+        return bool(self._waiting) or any(r is not None for r in self._slot_req)
+
+    # -- compiled programs (each traces exactly ONCE per engine) -------------
+    def _param_arrays(self) -> List[Any]:
+        # re-read each call: weight updates after construction are served
+        # without retraces (same shapes/dtypes -> same compiled program)
+        return [p._data for _, p in self._named]
+
+    def _prefill_impl(self, param_arrays, caches, ids, table, ln):
+        """ids [1, prompt_bucket] right-padded; table [1, MBS]; ln [1].
+
+        Dense causal forward over the padded prompt (positions >= ln only
+        read earlier positions, so padding never perturbs real tokens), pour
+        each layer's K/V into this sequence's pool blocks (pad positions are
+        scatter-dropped), take the first greedy token at the true last row.
+        """
+        import paddle_tpu
+        from paddle_tpu.core.tensor import Tensor
+        from paddle_tpu.incubate.nn.functional import block_cache_prefill
+        from paddle_tpu.nn.layer.layers import bind_param_arrays
+
+        self.stats["prefill_traces"] += 1  # Python side: counts TRACES only
+        with bind_param_arrays(self._named, param_arrays):
+            with paddle_tpu.no_grad():
+                logits, dense = self.model(Tensor(ids), use_cache=True)
+            new_caches = []
+            for (kc, vc), (k_t, v_t) in zip(caches, dense):
+                new_caches.append(
+                    block_cache_prefill(kc, vc, k_t._data, v_t._data, table, ln)
+                )
+            row = jnp.take(logits._data[0], ln[0] - 1, axis=0)  # [V] true last
+            tok = jnp.argmax(row.astype(jnp.float32)).astype(jnp.int32)
+            return tok, new_caches
+
+    def _decode_impl(self, param_arrays, caches, toks, tables, lens, active):
+        """toks/lens/active [S]; tables [S, MBS]. One fused step for every
+        slot: append each active slot's last token, ragged-attend, argmax."""
+        import paddle_tpu
+        from paddle_tpu.core.tensor import Tensor
+        from paddle_tpu.nn.layer.layers import bind_param_arrays
+
+        self.stats["decode_traces"] += 1  # Python side: counts TRACES only
+        with bind_param_arrays(self._named, param_arrays):
+            pkv = [
+                (Tensor(kc), Tensor(vc), Tensor(tables), Tensor(lens), Tensor(active))
+                for kc, vc in caches
+            ]
+            with paddle_tpu.no_grad():
+                logits, new_pkv = self.model(
+                    Tensor(toks[:, None]),
+                    past_key_values=pkv,
+                    use_cache=True,
+                    cache_position=Tensor(lens),
+                )
+            nxt = jnp.argmax(
+                logits._data[:, -1, :].astype(jnp.float32), axis=-1
+            ).astype(jnp.int32)
+            return nxt, [(c[0]._data, c[1]._data) for c in new_pkv]
+
+    # -- scheduling ----------------------------------------------------------
+    def _blocks_needed(self, req: InferenceRequest) -> int:
+        # tokens stored by the end: prompt + (max_new - 1) appended during
+        # decode (the final generated token is emitted, never appended)
+        worst = req.prompt.size + req.max_new_tokens - 1
+        return -(-worst // self.block_size)
+
+    def _admit_waiting(self, done: List[InferenceRequest]) -> None:
+        while self._waiting:
+            req = self._waiting[0]
+            free_slots = [i for i, r in enumerate(self._slot_req) if r is None]
+            if not free_slots:
+                return
+            if self._unreserved_free() < self._blocks_needed(req):
+                return  # FIFO: no head-of-line skipping, keeps latency fair
+            self._waiting.popleft()
+            self._admit(req, free_slots[0])
+            if req.finished:  # finished at prefill (eos / max_new_tokens == 1)
+                done.append(req)
+
+    def _admit(self, req: InferenceRequest, slot: int) -> None:
+        plen = req.prompt.size
+        self._mgr.allocate(slot, plen)
+        self._reserved[slot] = self._blocks_needed(req)
+        table = jnp.asarray(self._mgr.block_table([slot]))  # [1, MBS]
+        ids = np.zeros((1, self.prompt_bucket), np.int32)
+        ids[0, :plen] = req.prompt
+        try:
+            tok, self._caches = self._prefill_fn(
+                self._param_arrays(), self._caches, jnp.asarray(ids), table,
+                jnp.asarray([plen], jnp.int32),
+            )
+        except BaseException:
+            # undo the allocation so a transient device failure leaves the
+            # pool accounting exactly as before this admit
+            self._mgr.free(slot)
+            self._reserved[slot] = 0
+            self._waiting.appendleft(req)  # keeps FIFO order for a retry
+            self._broken = self._broken or self._buffers_lost()
+            raise
+        self.stats["admitted"] += 1
+        tok = int(tok)
+        req.generated.append(tok)
+        if req.eos_token_id is not None and tok == req.eos_token_id:
+            req.finish_reason = "stop"
+        elif len(req.generated) >= req.max_new_tokens:
+            req.finish_reason = "length"
+        if req.finished:
+            self._release(slot, req)  # blocks reclaimed before the next admit
+            return
+        self._slot_req[slot] = req
+        self._ntok[slot] = plen
+        self._last_tok[slot] = tok
+
+    def _release(self, slot: int, req: InferenceRequest) -> None:
+        # finished requests are handed back ONLY through step()'s return
+        # value (run() accumulates them); the engine keeps no reference, so
+        # a long-running step()-driven server never grows host memory
+        self._mgr.free(slot)
+        self._reserved[slot] = 0
+        self._slot_req[slot] = None
+        self._ntok[slot] = 0
+        self._last_tok[slot] = 0
+
+    def step(self) -> List[InferenceRequest]:
+        """One engine iteration: reclaim/admit, then one decode step over all
+        active slots. Returns requests that finished during this step — the
+        ONLY handback: the engine keeps no reference to finished requests
+        (a step()-driven server never grows host memory), so a later run()
+        will not re-deliver them."""
+        self._check_usable()
+        done: List[InferenceRequest] = []
+        self._admit_waiting(done)
+        active_slots = [i for i, r in enumerate(self._slot_req) if r is not None]
+        if not active_slots:
+            return done
+        for i in active_slots:
+            self._mgr.allocate(i, 1)  # room for the token appended this step
+        tables = jnp.asarray(self._mgr.block_table(range(self.max_slots)))
+        lens = jnp.asarray(self._ntok)  # EXCLUDING the token being appended
+        active = np.zeros((self.max_slots,), bool)
+        active[active_slots] = True
+        try:
+            nxt, self._caches = self._decode_fn(
+                self._param_arrays(), self._caches, jnp.asarray(self._last_tok),
+                tables, lens, jnp.asarray(active),
+            )
+        except BaseException:
+            # roll the per-step allocations back so repeated failed steps
+            # can't drift mgr lengths past _ntok and break the reservation
+            # invariant (_unreserved_free would over-report and over-admit)
+            for i in active_slots:
+                self._mgr.truncate(i, int(self._ntok[i]))
+            self._broken = self._broken or self._buffers_lost()
+            raise
+        self.stats["steps"] += 1
+        nxt = np.asarray(nxt)
+        for i in active_slots:
+            req = self._slot_req[i]
+            tok = int(nxt[i])
+            req.generated.append(tok)
+            self._ntok[i] += 1
+            self._last_tok[i] = tok
+            if req.eos_token_id is not None and tok == req.eos_token_id:
+                req.finish_reason = "stop"
+            elif len(req.generated) >= req.max_new_tokens:
+                req.finish_reason = "length"
+            if req.finished:
+                self._release(i, req)
+                done.append(req)
+        return done
+
+    def run(self) -> Dict[int, InferenceRequest]:
+        """Drain the queue; returns {req_id: request} for everything that
+        finished DURING this call (results from earlier direct step() calls
+        were already returned by those calls)."""
+        out: Dict[int, InferenceRequest] = {}
+        while self.has_work():
+            for req in self.step():
+                out[req.req_id] = req
+        return out

@@ -221,7 +221,12 @@ class StaticFunction:
             def staged(state_arrays_, opt_states_, rng_key_, in_arrays_):
                 import paddle_tpu.core.rng as _rng
 
-                saved = [(t, t._data) for t in state.tensors]
+                # snapshot .grad alongside ._data: a trace that fails AFTER
+                # backward() has already written tracer-valued grads into the
+                # live Parameters — restoring only _data would hand the
+                # graph-break eager re-run (and grad accumulation) leaked
+                # tracers that poison every later op
+                saved = [(t, t._data, t._grad) for t in state.tensors]
                 saved_opt = [
                     (opt, opt._step_buf, dict(opt._accumulators), opt._lr_array)
                     for opt in state.optimizers
@@ -247,8 +252,9 @@ class StaticFunction:
                     new_state, new_opt, new_rng = state.readback()
                     return out_arrays, new_state, new_opt, new_rng
                 finally:
-                    for t, d in saved:
+                    for t, d, g in saved:
                         t._data = d
+                        t._grad = g
                     for opt, sb, acc, lra in saved_opt:
                         opt._step_buf = sb
                         opt._accumulators = acc

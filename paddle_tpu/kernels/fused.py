@@ -18,6 +18,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from paddle_tpu.kernels.select import _CompilerParams
+
 __all__ = ["fused_rms_norm_pallas", "fused_rope_pallas"]
 
 
@@ -59,7 +61,7 @@ def _make_rms(rows, h, eps, blk_rows, interpret):
             functools.partial(_rms_fwd_kernel, eps=eps),
             grid=grid,
             # independent row blocks: megacore-splittable
-            compiler_params=pltpu.CompilerParams(dimension_semantics=("parallel",)),
+            compiler_params=_CompilerParams(dimension_semantics=("parallel",)),
             in_specs=[
                 pl.BlockSpec((1, blk_rows, h), lambda i: (0, i, 0)),
                 pl.BlockSpec((h,), lambda i: (0,)),
@@ -91,7 +93,7 @@ def _make_rms(rows, h, eps, blk_rows, interpret):
             grid=grid,
             # dw accumulates across the grid in one output block: the grid
             # MUST run sequentially ("arbitrary"), never be split
-            compiler_params=pltpu.CompilerParams(dimension_semantics=("arbitrary",)),
+            compiler_params=_CompilerParams(dimension_semantics=("arbitrary",)),
             in_specs=[
                 pl.BlockSpec((1, blk_rows, h), lambda i: (0, i, 0)),
                 pl.BlockSpec((h,), lambda i: (0,)),
@@ -194,7 +196,7 @@ def _make_rope(bh, s, d, interpret):
             kernel,
             grid=grid,
             # independent (batch*head) cells
-            compiler_params=pltpu.CompilerParams(dimension_semantics=("parallel",)),
+            compiler_params=_CompilerParams(dimension_semantics=("parallel",)),
             in_specs=in_specs,
             out_specs=out_spec,
             out_shape=jax.ShapeDtypeStruct((bh, 1, s, d), xh.dtype),

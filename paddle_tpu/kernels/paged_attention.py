@@ -17,11 +17,14 @@ import functools
 from typing import Optional
 
 import jax
+import jax.export  # noqa: F401  (jax 0.4.x: not re-exported by `import jax`)
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+
+from paddle_tpu.kernels.select import _CompilerParams
 
 
 def _decode_kernel(
@@ -48,29 +51,36 @@ def _decode_kernel(
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    q = q_ref[0, 0].astype(jnp.float32) * scale  # [G, D]
-    k = k_ref[0, 0].astype(jnp.float32)  # [BS, D]
-    v = v_ref[0, 0].astype(jnp.float32)
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    )  # [G, BS]
-    pos = i * block_size + jax.lax.broadcasted_iota(jnp.int32, (1, block_size), 1)
-    valid = pos < lens_ref[bi]
-    s = jnp.where(valid, s, NEG_INF)
+    # ragged skip: a block whose first position is already past this
+    # sequence's length contributes nothing (its p would be masked to 0), so
+    # the MXU work is predicated away entirely. A fully-padded slot
+    # (len == 0) never takes this branch at all — the engine's inactive batch
+    # slots cost no compute, only the final zero-write below.
+    @pl.when(i * block_size < lens_ref[bi])
+    def _attend():
+        q = q_ref[0, 0].astype(jnp.float32) * scale  # [G, D]
+        k = k_ref[0, 0].astype(jnp.float32)  # [BS, D]
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [G, BS]
+        pos = i * block_size + jax.lax.broadcasted_iota(jnp.int32, (1, block_size), 1)
+        valid = pos < lens_ref[bi]
+        s = jnp.where(valid, s, NEG_INF)
 
-    m_prev = m_ref[...]  # [G, 1]
-    m_cur = jnp.max(s, axis=-1, keepdims=True)
-    m_new = jnp.maximum(m_prev, m_cur)
-    alpha = jnp.exp(m_prev - m_new)
-    # the explicit valid multiply keeps fully-masked rows at p == 0: with
-    # every position masked, m_new == NEG_INF and exp(s - m_new) would be 1
-    # everywhere — silent garbage for zero-length sequences
-    p = jnp.exp(s - m_new) * valid.astype(jnp.float32)  # [G, BS]
-    l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
-    acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
-        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-    )
-    m_ref[...] = m_new
+        m_prev = m_ref[...]  # [G, 1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        # the explicit valid multiply keeps fully-masked rows at p == 0: with
+        # every position masked, m_new == NEG_INF and exp(s - m_new) would be
+        # 1 everywhere — silent garbage for zero-length sequences
+        p = jnp.exp(s - m_new) * valid.astype(jnp.float32)  # [G, BS]
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_ref[...] = m_new
 
     @pl.when(i == num_blocks - 1)
     def _finish():
@@ -125,6 +135,18 @@ def paged_flash_decode(
     kernel = functools.partial(
         _decode_kernel, scale=float(scale), block_size=bs, num_blocks=mbs
     )
+
+    def _kv_index(bi, hi, i, tables, lens):
+        # the block table steers which PHYSICAL block is streamed in; block
+        # (1, 1, BS, D) tiles the (BS, D) plane of one head. Logical blocks
+        # past the sequence's last in-use block are clamped onto that last
+        # block: the pipeline sees the same physical index as the previous
+        # grid step and skips the HBM->VMEM copy, so ragged tails (and fully
+        # padded slots, which clamp to block-table entry 0) cost no DMA
+        # traffic — the matching compute skip is the pl.when in the kernel.
+        last = jnp.maximum((lens[bi] + bs - 1) // bs - 1, 0)
+        return (tables[bi, jnp.minimum(i, last)], hi, 0, 0)
+
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -132,16 +154,8 @@ def paged_flash_decode(
             grid=grid,
             in_specs=[
                 pl.BlockSpec((1, 1, g, d), lambda bi, hi, i, tables, lens: (bi, hi, 0, 0)),
-                # the block table steers which PHYSICAL block is streamed in;
-                # block (1, 1, BS, D) tiles the (BS, D) plane of one head
-                pl.BlockSpec(
-                    (1, 1, bs, d),
-                    lambda bi, hi, i, tables, lens: (tables[bi, i], hi, 0, 0),
-                ),
-                pl.BlockSpec(
-                    (1, 1, bs, d),
-                    lambda bi, hi, i, tables, lens: (tables[bi, i], hi, 0, 0),
-                ),
+                pl.BlockSpec((1, 1, bs, d), _kv_index),
+                pl.BlockSpec((1, 1, bs, d), _kv_index),
             ],
             out_specs=pl.BlockSpec(
                 (1, 1, g, d), lambda bi, hi, i, tables, lens: (bi, hi, 0, 0)
@@ -154,7 +168,7 @@ def paged_flash_decode(
         ),
         out_shape=jax.ShapeDtypeStruct((b, hkv, g, d), q.dtype),
         # batch and kv-head cells are independent; the block walk accumulates
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,

@@ -10,8 +10,13 @@ import logging
 from typing import Any
 
 import jax
+from jax.experimental.pallas import tpu as pltpu
 
 from paddle_tpu.flags import GLOBAL_FLAGS
+
+# named TPUCompilerParams before jax 0.5 — the one shared shim every kernel
+# module imports (keep version dances out of the kernels themselves)
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
 
 _logger = logging.getLogger("paddle_tpu.kernels")
 _warned: set = set()

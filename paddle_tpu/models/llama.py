@@ -137,16 +137,21 @@ class LlamaAttention(nn.Layer):
         if (
             cache_position is not None
             and past_key_value is not None
-            and len(past_key_value) == 4
+            and len(past_key_value) in (4, 5)
         ):
             # paged decode: past is (key_cache [NB,HK,BS,D], value_cache,
-            # block_tables [B,MBS], seq_lens [B]) — vLLM-style serving cache
-            # (reference `block_multihead_attention_` fused_ops.yaml:45).
-            # Positions are ragged per sequence: rope tables gather per-seq.
+            # block_tables [B,MBS], seq_lens [B][, slot_mask [B]]) — the
+            # vLLM-style serving cache (reference `block_multihead_attention_`
+            # fused_ops.yaml:45). Positions are ragged per sequence: rope
+            # tables gather per-seq. The optional 5th element is the
+            # continuous-batching engine's active-slot mask: padded batch
+            # slots write no KV and return zeros, so the decode step's shape
+            # stays fixed while the live batch composition changes.
             from paddle_tpu.core.tensor import Tensor as _T
             from paddle_tpu.incubate.nn.functional import block_multihead_attention
 
-            kc, vc, tables, lens = past_key_value
+            kc, vc, tables, lens = past_key_value[:4]
+            slot_mask = past_key_value[4] if len(past_key_value) == 5 else None
             lens_t = lens if isinstance(lens, _T) else _T(lens)
             lens_arr = lens_t._data
             cos, sin = self.rotary_emb(s, lens_t)  # ragged: [B, 1, 1, D]
@@ -159,9 +164,17 @@ class LlamaAttention(nn.Layer):
                 vc._data if isinstance(vc, _T) else vc,
                 tables._data if isinstance(tables, _T) else tables,
                 lens_arr,
+                slot_mask=(
+                    slot_mask._data if isinstance(slot_mask, _T) else slot_mask
+                ),
             )
             out = self.o_proj(reshape(_T(out_a), [b, s, self.num_heads * self.head_dim]))
-            return (out, (_T(kc2), _T(vc2), tables, lens)) if use_cache else out
+            if not use_cache:
+                return out
+            new_past = (_T(kc2), _T(vc2), tables, lens)
+            if len(past_key_value) == 5:
+                new_past = new_past + (slot_mask,)
+            return out, new_past
         if cache_position is not None and past_key_value is not None:
             # static-cache decode: past is a FIXED [B, S_max, HK, D] buffer
             # pair; append this step's K/V at cache_position and attend with a

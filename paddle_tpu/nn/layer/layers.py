@@ -10,6 +10,7 @@ buffers live on device (PJRT); a Layer is also directly traceable by
 from __future__ import annotations
 
 import collections
+import contextlib
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple, Union
 
 import jax.numpy as jnp
@@ -19,6 +20,27 @@ from paddle_tpu.core.dtypes import convert_dtype
 from paddle_tpu.core.tensor import Parameter, Tensor
 from paddle_tpu.errors import InvalidArgumentError
 from paddle_tpu.framework.param_attr import ParamAttr
+
+
+@contextlib.contextmanager
+def bind_param_arrays(named, param_arrays):
+    """Temporarily point each ``(name, Parameter)`` in ``named`` at the
+    corresponding raw jax array, restoring the originals on exit.
+
+    This is THE way compiled inference paths thread live weights into a
+    jitted function (``generation.py``'s three decode paths and the
+    continuous-batching engine all use it): the params become trace inputs,
+    so later weight updates are served by the same compiled program, and the
+    restore runs even when tracing fails — no tracer ever leaks into the
+    live Parameters."""
+    saved = [p._data for _, p in named]
+    for (_n, p), a in zip(named, param_arrays):
+        p._data = a
+    try:
+        yield
+    finally:
+        for (_n, p), s in zip(named, saved):
+            p._data = s
 
 
 class HookRemoveHelper:

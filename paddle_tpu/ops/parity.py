@@ -122,7 +122,14 @@ def truncated_gaussian_random(shape, mean=0.0, std=1.0, seed=0, a=-2.0, b=2.0,
 
 @defop("complex", tensor_method=None)
 def complex(real, imag):  # noqa: A001
-    return jax.lax.complex(jnp.asarray(real, jnp.float32), jnp.asarray(imag, jnp.float32))
+    # promote like the reference kernel (dtype::ToComplex of the common
+    # type): float64 inputs build complex128, not a silent float32 downcast;
+    # integer and half-precision inputs take the float32 floor
+    # (lax.complex supports only f32/f64 operands)
+    dt = jnp.result_type(real, imag)
+    if not jnp.issubdtype(dt, jnp.floating) or jnp.finfo(dt).bits < 32:
+        dt = jnp.float32
+    return jax.lax.complex(jnp.asarray(real, dt), jnp.asarray(imag, dt))
 
 
 @defop("as_complex", tensor_method="as_complex")
@@ -676,10 +683,17 @@ def unpool(x, indices, kernel_size=2, stride=None, padding=0, output_size=None,
 
 
 @defop("nms", tensor_method=None)
-def nms(boxes, threshold=0.3):
-    """Greedy hard-NMS (ref ``nms_kernel``): boxes [N, 4] sorted by caller
-    score order; returns keep mask indices. Fixed-trip fori_loop — static
-    shapes for XLA."""
+def nms(boxes, threshold=0.3, scores=None):
+    """Greedy hard-NMS (ref ``nms_kernel`` / ``paddle.vision.ops.nms``):
+    boxes [N, 4]. Without ``scores`` the boxes are assumed pre-sorted by the
+    caller's score order; with ``scores`` [N] they are sorted internally
+    (descending) and the returned indices map back into the ORIGINAL box
+    order, highest score first. Fixed-trip fori_loop — static shapes for
+    XLA; suppressed tail entries are -1."""
+    order = None
+    if scores is not None:
+        order = jnp.argsort(-jnp.asarray(scores))
+        boxes = jnp.asarray(boxes)[order]
     n = boxes.shape[0]
     x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
     areas = jnp.maximum(x2 - x1, 0.0) * jnp.maximum(y2 - y1, 0.0)
@@ -696,7 +710,10 @@ def nms(boxes, threshold=0.3):
         return keep & ~sup
 
     keep = jax.lax.fori_loop(0, n, body, jnp.ones((n,), bool))
-    return jnp.nonzero(keep, size=n, fill_value=-1)[0]
+    kept = jnp.nonzero(keep, size=n, fill_value=-1)[0]
+    if order is not None:  # map sorted-space indices back to the caller's
+        kept = jnp.where(kept >= 0, order[kept], -1)
+    return kept
 
 
 @defop("box_coder", tensor_method=None)

@@ -29,6 +29,16 @@ import pytest  # noqa: E402
 # accuracy; tests compare against numpy fp32 references.
 jax.config.update("jax_default_matmul_precision", "highest")
 
+if not hasattr(jax, "shard_map"):
+    # jax < 0.5 has only the experimental shard_map (different kwarg surface);
+    # tests use the modern `jax.shard_map` API — install the framework's
+    # compat wrapper so they run against both jax generations.
+    from paddle_tpu.distributed.fleet.meta_parallel.spmd_pipeline import (
+        shard_map as _shard_map_compat,
+    )
+
+    jax.shard_map = _shard_map_compat
+
 
 @pytest.fixture(autouse=True)
 def _seed():

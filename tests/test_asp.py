@@ -128,3 +128,83 @@ def test_reference_call_order_decorate_then_prune():
     for n, p in m.named_parameters():
         if n.endswith("weight"):
             assert asp.check_sparsity(p, "check_mask_1d", 2, 4), n
+
+
+class TestMaskLifetime:
+    """Masks live ON their Parameters (no id(param)-keyed module registry):
+    a dead model's masks can never be applied to a fresh weight whose object
+    id happens to collide (CPython reuses ids after GC)."""
+
+    def _model(self):
+        paddle.seed(0)
+        return paddle.nn.Sequential(
+            paddle.nn.Linear(16, 32), paddle.nn.ReLU(), paddle.nn.Linear(32, 8)
+        )
+
+    def test_no_module_level_registry(self):
+        assert not hasattr(asp, "_MASK_REGISTRY")
+        m = self._model()
+        asp.prune_model(m, 2, 4)
+        pruned = [p for n, p in m.named_parameters() if n.endswith("weight")]
+        assert all(getattr(p, "_asp_mask", None) is not None for p in pruned)
+
+    def test_fresh_model_after_dead_pruned_model_stays_dense(self):
+        """Prune a model, drop it, GC; a NEW model's decorated optimizer must
+        not sparsify anything — deterministically, whatever ids CPython
+        hands out."""
+        import gc
+
+        dead = self._model()
+        asp.prune_model(dead, 2, 4)
+        del dead
+        gc.collect()
+
+        fresh = self._model()
+        opt = asp.decorate(
+            paddle.optimizer.SGD(learning_rate=0.0, parameters=fresh.parameters())
+        )
+        x = paddle.to_tensor(rng.normal(size=(4, 16)).astype(np.float32))
+        loss = paddle.nn.functional.mse_loss(
+            fresh(x), paddle.to_tensor(np.zeros((4, 8), np.float32))
+        )
+        loss.backward()
+        opt.step()
+        for n, p in fresh.named_parameters():
+            if n.endswith("weight"):
+                assert asp.calculate_density(p) > 0.9, n  # still dense
+
+    def test_explicit_attach_masks_beats_later_prune_model(self):
+        """attach_masks is a per-optimizer override: a prune_model that runs
+        AFTERWARDS must not clobber it for this optimizer."""
+        m = self._model()
+        opt = asp.decorate(
+            paddle.optimizer.SGD(learning_rate=0.0, parameters=m.parameters())
+        )
+        name = "0.weight"
+        p = dict(m.named_parameters())[name]
+        custom = np.zeros(tuple(p.shape), np.float32)  # adversarial: all-zero
+        opt.attach_masks(m, {name: custom})
+        asp.prune_model(m, 2, 4)  # later prune must not displace the override
+        x = paddle.to_tensor(rng.normal(size=(4, 16)).astype(np.float32))
+        loss = paddle.nn.functional.mse_loss(
+            m(x), paddle.to_tensor(np.zeros((4, 8), np.float32))
+        )
+        loss.backward()
+        opt.step()
+        assert float(np.abs(p.numpy()).sum()) == 0.0  # custom mask applied
+
+    def test_decorate_then_prune_order_still_works(self):
+        m = self._model()
+        opt = asp.decorate(
+            paddle.optimizer.SGD(learning_rate=1e-2, parameters=m.parameters())
+        )
+        asp.prune_model(m, 2, 4)  # AFTER decorate — reference-allowed order
+        x = paddle.to_tensor(rng.normal(size=(4, 16)).astype(np.float32))
+        loss = paddle.nn.functional.mse_loss(
+            m(x), paddle.to_tensor(np.zeros((4, 8), np.float32))
+        )
+        loss.backward()
+        opt.step()
+        for n, p in m.named_parameters():
+            if n.endswith("weight"):
+                assert asp.check_sparsity(p, "check_mask_1d", 2, 4), n

@@ -151,3 +151,75 @@ class TestPagedAttention:
         # (cache layout [NB, H, BS, D]: token positions are axis 2)
         after = np.asarray(kc2[np.asarray(t2[1][:1])])
         np.testing.assert_array_equal(before[0, :, :3], after[0, :, :3])
+
+
+class TestSlotMask:
+    """Ragged-batch contract for the continuous-batching engine: masked-off
+    slots append nothing, attend over nothing, return zeros — XLA fallback in
+    lockstep with the Pallas kernel."""
+
+    def _setup(self, seed=9):
+        rng = np.random.default_rng(seed)
+        nb, mbs = 8, 2
+        q = jnp.asarray(rng.normal(size=(B, 1, HQ, D)), jnp.float32)
+        k1 = jnp.asarray(rng.normal(size=(B, 1, HKV, D)), jnp.float32)
+        v1 = jnp.asarray(rng.normal(size=(B, 1, HKV, D)), jnp.float32)
+        kc = jnp.asarray(rng.normal(size=(nb, HKV, BS, D)), jnp.float32)
+        vc = jnp.asarray(rng.normal(size=(nb, HKV, BS, D)), jnp.float32)
+        # slot 1's table row deliberately ALIASES slot 0's blocks (an evicted
+        # slot's zeroed row points at block ids a live sequence may own)
+        tables = jnp.asarray([[2, 3], [2, 3]], jnp.int32)
+        lens = jnp.asarray([5, 3], jnp.int32)
+        return q, k1, v1, kc, vc, tables, lens
+
+    def test_masked_slot_writes_nothing_returns_zeros(self):
+        q, k1, v1, kc, vc, tables, lens = self._setup()
+        mask = jnp.asarray([True, False])
+        out, kc2, vc2 = block_multihead_attention(
+            q, k1, v1, kc, vc, tables, lens, slot_mask=mask
+        )
+        # slot 1 returned zeros
+        assert (np.asarray(out)[1] == 0.0).all()
+        assert np.abs(np.asarray(out)[0]).sum() > 0
+        # slot 1's append was dropped: only slot 0's position changed
+        ref_kc = np.array(kc)
+        ref_kc[np.asarray(tables)[0, 5 // BS], :, 5 % BS] = np.asarray(k1)[0, 0]
+        np.testing.assert_array_equal(np.asarray(kc2), ref_kc)
+
+    def test_active_mask_all_true_matches_unmasked(self):
+        q, k1, v1, kc, vc, tables, lens = self._setup(seed=10)
+        tables = jnp.asarray([[2, 3], [4, 5]], jnp.int32)  # disjoint this time
+        out_m, kc_m, vc_m = block_multihead_attention(
+            q, k1, v1, kc, vc, tables, lens, slot_mask=jnp.asarray([True, True])
+        )
+        out_u, kc_u, vc_u = block_multihead_attention(
+            q, k1, v1, kc, vc, tables, lens
+        )
+        np.testing.assert_array_equal(np.asarray(out_m), np.asarray(out_u))
+        np.testing.assert_array_equal(np.asarray(kc_m), np.asarray(kc_u))
+
+    def test_kernel_lockstep_with_xla_fallback(self, monkeypatch):
+        """Same inputs + slot_mask through the Pallas kernel (interpret) and
+        the XLA gather path: identical zeros for the masked slot, matching
+        outputs for the live one."""
+        import paddle_tpu.kernels.paged_attention as pa
+        import paddle_tpu.kernels.select as sel
+
+        q, k1, v1, kc, vc, tables, lens = self._setup(seed=11)
+        mask = jnp.asarray([False, True])
+        out_xla, _, _ = block_multihead_attention(
+            q, k1, v1, kc, vc, tables, lens, slot_mask=mask
+        )
+        monkeypatch.setattr(sel, "pallas_enabled", lambda flag: True)
+        real = pa.paged_flash_decode
+        monkeypatch.setattr(
+            pa, "paged_flash_decode",
+            lambda *a, **kw: real(*a, interpret=True, **kw),
+        )
+        out_k, _, _ = block_multihead_attention(
+            q, k1, v1, kc, vc, tables, lens, slot_mask=mask
+        )
+        assert (np.asarray(out_k)[0] == 0.0).all()
+        np.testing.assert_allclose(
+            np.asarray(out_k), np.asarray(out_xla), rtol=2e-5, atol=2e-5
+        )

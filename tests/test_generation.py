@@ -306,3 +306,138 @@ class TestBeamSearch:
         model, cfg = self._model()
         with pytest.raises(ValueError, match="num_beams"):
             model.generate_beam(paddle.to_tensor(np.zeros((1, 3), np.int32)), num_beams=0)
+
+
+class TestBeamLengthPenalty:
+    """Reference BeamSearchScorer normalization: final score is
+    sum_logprob / (((5 + full_len) / 6) ** alpha) over the FULL hypothesis
+    length (prompt + generated). Verified against a hand-computed beam search
+    over a scripted Markov-chain LM where every candidate score is exact."""
+
+    V = 4  # vocabulary
+
+    class _ToyLM(paddle.generation.GenerationMixin if hasattr(paddle, "generation") else object):
+        """Logits depend only on the previous token: logits[t+1] = T[tok_t].
+        Tiny, deterministic, and fully hand-computable."""
+
+        def __init__(self, T):
+            import jax.numpy as jnp
+
+            self._T = jnp.asarray(T, jnp.float32)
+
+        def named_parameters(self):
+            return []
+
+        def __call__(self, ids, past_key_values=None, use_cache=False,
+                     cache_position=None):
+            import jax.numpy as jnp
+
+            from paddle_tpu.core.tensor import Tensor
+
+            arr = ids._data if hasattr(ids, "_data") else ids
+            logits = self._T[arr]  # [B, S, V]
+            if not use_cache:
+                return Tensor(logits)
+            if past_key_values is not None:
+                return Tensor(logits), past_key_values  # carry unchanged
+            b, s = arr.shape
+            zeros = jnp.zeros((b, s, 1, 1), jnp.float32)
+            return Tensor(logits), [(Tensor(zeros), Tensor(zeros))]
+
+    def _numpy_beam(self, T, prompt, max_new, K, alpha, eos):
+        """Independent numpy implementation of the compiled beam scan +
+        the reference length normalization."""
+        import numpy as np
+
+        def lsm(x):
+            x = x - x.max()
+            return x - np.log(np.exp(x).sum())
+
+        V = T.shape[0]
+        NEG, PAD = -1e9, 0
+        logp0 = lsm(T[prompt[-1]].astype(np.float64))
+        order = np.argsort(-logp0, kind="stable")[:K]
+        scores, toks = logp0[order], order.astype(int)
+        done = toks == eos
+        lens = np.ones(K, int)
+        hist_t, hist_p = [list(toks)], [[0] * K]
+        pad_row = np.full(V, NEG); pad_row[PAD] = 0.0
+        for _ in range(max_new - 1):
+            cand = np.empty((K, V))
+            for k in range(K):
+                cand[k] = scores[k] + (pad_row if done[k] else lsm(T[toks[k]].astype(np.float64)))
+            flat = cand.reshape(-1)
+            idx = np.argsort(-flat, kind="stable")[:K]
+            scores = flat[idx]
+            parent, toks = idx // V, (idx % V).astype(int)
+            done = done[parent] | (toks == eos)
+            lens = lens[parent] + (1 - done[parent].astype(int))
+            hist_t.append(list(toks)); hist_p.append(list(parent))
+        # backtrace
+        full_len = len(prompt) + lens
+        norm = ((5.0 + full_len) / 6.0) ** alpha if alpha != 0.0 else np.ones(K)
+        best = int(np.argmax(scores / norm))
+        seq, k = [], best
+        for t in range(len(hist_t) - 1, -1, -1):
+            seq.append(hist_t[t][k]); k = hist_p[t][k]
+        return np.asarray(seq[::-1], np.int32)
+
+    def _run(self, alpha, seed=0):
+        import numpy as np
+
+        rng = np.random.default_rng(seed)
+        T = rng.normal(size=(self.V, self.V)).astype(np.float32) * 2.0
+        # make token `eos` reachable so beams finish at different lengths
+        eos = 2
+        model = self._ToyLM(T)
+        prompt = np.asarray([1], np.int32)
+        got = model.generate_beam(
+            paddle.to_tensor(prompt[None]), max_new_tokens=5, num_beams=2,
+            length_penalty=alpha, eos_token_id=eos, pad_token_id=0,
+        ).numpy()[0][1:]
+        want = self._numpy_beam(T, prompt, 5, 2, alpha, eos)
+        # compare only up to the winner's eos (past it both emit pad 0)
+        hits = np.where(want == eos)[0]
+        n = (hits[0] + 1) if hits.size else len(want)
+        np.testing.assert_array_equal(got[:n], want[:n])
+        return got, want
+
+    @pytest.mark.parametrize("alpha", [0.0, 1.0, 2.0, -1.0])
+    def test_matches_reference_normalization(self, alpha):
+        # several seeds: at least some produce length-divergent beams where
+        # the normalization formula decides the winner
+        for seed in range(4):
+            self._run(alpha, seed=seed)
+
+    def test_hand_computed_two_beam_case(self):
+        """Fully hand-checkable: chain where beam A ends at eos early (short,
+        high avg logprob) and beam B runs long (higher raw total). alpha
+        picks the winner per the ((5+len)/6)**alpha rule."""
+        import numpy as np
+
+        NEG = -40.0
+        # tokens: 0=pad, 1=start, 2=eos, 3=filler
+        T = np.full((4, 4), NEG, np.float32)
+        # from 1: eos with logp ~ log .6, filler ~ log .4
+        T[1, 2], T[1, 3] = np.log(0.6), np.log(0.4)
+        # filler keeps emitting filler with prob ~1 (logp ~ 0)
+        T[3, 3] = 5.0
+        T[3, 0], T[3, 1], T[3, 2] = NEG, NEG, NEG
+        model = self._ToyLM(T)
+        prompt = paddle.to_tensor(np.asarray([[1]], np.int32))
+        # raw totals after 4 steps: beam-eos = log .6 (len 1, full 2);
+        # beam-filler ~= log .4 (len 4, full 5).  log .6 > log .4 so with
+        # alpha = 0 the eos beam wins outright...
+        out0 = model.generate_beam(prompt, max_new_tokens=4, num_beams=2,
+                                   length_penalty=0.0, eos_token_id=2,
+                                   pad_token_id=0).numpy()[0]
+        assert out0[1] == 2  # eos immediately
+        # ...and a strongly positive alpha REWARDS length (GNMT-style): the
+        # scores are negative, so dividing by the larger ((5+len)/6)**alpha
+        # shrinks the long beam's penalty toward zero. By hand:
+        #   eos:    log .6 / ((5+2)/6)**6 = -0.511 / 2.522 = -0.203
+        #   filler: log .4 / ((5+5)/6)**6 = -0.916 / 21.43 = -0.043  (wins)
+        out_pos = model.generate_beam(prompt, max_new_tokens=4, num_beams=2,
+                                      length_penalty=6.0, eos_token_id=2,
+                                      pad_token_id=0).numpy()[0]
+        assert out_pos[1] == 3  # the long filler beam wins under +6

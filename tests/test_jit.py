@@ -273,3 +273,59 @@ class TestGraphBreakFallback:
             b = fn(paddle.to_tensor(np.ones((3, 3), np.float32)))
         np.testing.assert_allclose(a.numpy(), 2 * np.ones((2,)))
         np.testing.assert_allclose(b.numpy(), 2 * np.ones((3, 3)))
+
+
+class TestGraphBreakGradRestore:
+    """A trace that fails AFTER backward() must not leave tracer-valued
+    grads on the live Parameters — the graph-break eager re-run (and any
+    later grad accumulation) would silently operate on leaked tracers."""
+
+    def test_backward_then_break_leaves_clean_grads(self):
+        import warnings
+
+        paddle.seed(0)
+        layer = paddle.nn.Linear(4, 4)
+
+        @paddle.jit.to_static(full_graph=False)
+        def fn(layer, x):
+            loss = (layer(x) ** 2).sum()
+            loss.backward()  # grads written during the doomed trace
+            if float(loss) > -1.0:  # concretization -> graph break
+                return loss
+            return loss * 0
+
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            out = fn(layer, x)
+        assert np.isfinite(float(out))
+        g = layer.weight.grad
+        assert g is not None  # the eager re-run produced real grads
+        # a leaked tracer explodes on materialization / arithmetic
+        gv = np.asarray(g.numpy())
+        assert np.isfinite(gv).all() and np.abs(gv).sum() > 0
+        # a SECOND call accumulates onto the eager grads without tracer mixing
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            fn(layer, x)
+        np.testing.assert_allclose(layer.weight.grad.numpy(), 2 * gv, rtol=1e-6)
+
+    def test_successful_trace_does_not_persist_tracer_grads(self):
+        """Even WITHOUT a break: after a compiled call that ran backward()
+        inside, params hold either None or concrete grads, never tracers."""
+        paddle.seed(1)
+        layer = paddle.nn.Linear(3, 3)
+
+        @paddle.jit.to_static
+        def step(layer, x):
+            loss = layer(x).sum()
+            loss.backward()
+            grads = [p.grad for p in layer.parameters() if not p.stop_gradient]
+            layer.clear_gradients()
+            return loss, grads
+
+        x = paddle.to_tensor(np.ones((2, 3), np.float32))
+        step(layer, x)
+        for p in layer.parameters():
+            if p.grad is not None:
+                np.asarray(p.grad.numpy())  # must be concrete

@@ -158,3 +158,46 @@ def test_lowering_supported_probe_caches():
     # invalid geometry reports False instead of raising (hq % hkv != 0
     # fails inside the probed call)
     assert lowering_supported(2, 6, 4, 128, 32, 16, 8, "bfloat16") is False
+
+
+class TestRaggedSkip:
+    """The ragged decode path: unused block-table tails and fully-padded
+    slots are never touched (no DMA via the clamped index map, no compute via
+    the pl.when guard)."""
+
+    def test_unused_tail_blocks_never_read(self):
+        """Poison every block past each sequence's last in-use block with
+        NaN: the clamped index map + predicated compute must keep the output
+        bit-identical to clean caches (the old path multiplied masked
+        probabilities into NaN values — 0 * NaN = NaN)."""
+        rng = np.random.default_rng(11)
+        b, hq, d, mbs, nb = 2, 4, 64, 4, 16
+        q = jnp.asarray(rng.normal(size=(b, hq, d)), jnp.float32)
+        kc = jnp.asarray(rng.normal(size=(nb, hq, BS, d)), jnp.float32)
+        vc = jnp.asarray(rng.normal(size=(nb, hq, BS, d)), jnp.float32)
+        tables = jnp.asarray(rng.permutation(nb)[: b * mbs].reshape(b, mbs), jnp.int32)
+        lens = jnp.asarray([BS + 3, 2 * BS], jnp.int32)  # tails: 2 blocks each
+        clean = paged_flash_decode(q, kc, vc, tables, lens, interpret=True)
+        # poison the tail blocks (logical blocks >= ceil(len/BS))
+        kc_p, vc_p = np.array(kc), np.array(vc)
+        for bi in range(b):
+            used = -(-int(lens[bi]) // BS)
+            for lb in range(used, mbs):
+                kc_p[int(tables[bi, lb])] = np.nan
+                vc_p[int(tables[bi, lb])] = np.nan
+        out = paged_flash_decode(
+            q, jnp.asarray(kc_p), jnp.asarray(vc_p), tables, lens, interpret=True
+        )
+        assert np.isfinite(np.asarray(out)).all()
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(clean))
+
+    def test_padded_slot_skips_even_poisoned_pool(self):
+        """A len-0 slot's whole block-table row may point at junk; its output
+        is exact zeros and no NaN leaks in."""
+        rng = np.random.default_rng(12)
+        q, kc, vc, tables, _ = _setup(seed=12)
+        kc = jnp.asarray(np.full(kc.shape, np.nan, np.float32))
+        vc = jnp.asarray(np.full(vc.shape, np.nan, np.float32))
+        lens = jnp.zeros((q.shape[0],), jnp.int32)
+        out = np.asarray(paged_flash_decode(q, kc, vc, tables, lens, interpret=True))
+        assert (out == 0.0).all()

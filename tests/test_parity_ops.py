@@ -53,6 +53,31 @@ class TestComplexViews:
         i = rng.normal(size=(5,)).astype(np.float32)
         np.testing.assert_allclose(paddle.complex(t(r), t(i)).numpy(), r + 1j * i)
 
+    def test_complex_promotes_float64_to_complex128(self):
+        import jax
+
+        with jax.experimental.enable_x64():
+            r = t(np.array([1.0, -2.0], np.float64))
+            i = t(np.array([0.5, 3.0], np.float64))
+            c = paddle.complex(r, i)
+            assert c.numpy().dtype == np.complex128
+            # mixed f32 x f64 promotes to the common (wider) type
+            c2 = paddle.complex(t(np.float32([1.0])), t(np.float64([2.0])))
+            assert c2.numpy().dtype == np.complex128
+
+    def test_complex_half_inputs_take_float32_floor(self):
+        # lax.complex only takes f32/f64 — halves must floor up, not raise
+        c = paddle.complex(
+            t(np.array([1.0], np.float16)), t(np.array([2.0], np.float16))
+        )
+        assert c.numpy().dtype == np.complex64
+        np.testing.assert_allclose(c.numpy(), np.array([1 + 2j], np.complex64))
+
+    def test_complex_integer_inputs_take_float32_floor(self):
+        c = paddle.complex(t(np.array([1, 2], np.int32)), t(np.array([3, 4], np.int32)))
+        assert c.numpy().dtype == np.complex64
+        np.testing.assert_allclose(c.numpy(), np.array([1 + 3j, 2 + 4j], np.complex64))
+
 
 class TestLinalgExtras:
     def test_lu_unpack_reconstructs(self):
@@ -228,6 +253,25 @@ class TestVisionOps:
         )
         keep = paddle.nms(t(boxes), 0.5).numpy()
         assert keep[0] == 0 and keep[1] == 2 and (keep[2:] == -1).all()
+
+    def test_nms_scores_sorts_internally_and_maps_back(self):
+        """Reference ``paddle.vision.ops.nms(boxes, iou_threshold, scores)``:
+        unsorted boxes + scores — nms runs in descending-score order and the
+        returned indices point into the ORIGINAL box order."""
+        boxes = np.array(
+            [[1, 1, 10.5, 10.5], [20, 20, 30, 30], [0, 0, 10, 10], [21, 21, 29, 29]],
+            np.float32,
+        )
+        scores = np.array([0.6, 0.9, 0.8, 0.3], np.float32)
+        keep = paddle.nms(t(boxes), 0.5, scores=t(scores)).numpy()
+        # score order: box1 (.9), box2 (.8), box0 (.6, IoU>0.5 with box2 ->
+        # suppressed), box3 (IoU>0.5 with box1 -> suppressed)
+        assert keep[0] == 1 and keep[1] == 2 and (keep[2:] == -1).all()
+
+    def test_nms_without_scores_unchanged(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 10.5, 10.5]], np.float32)
+        keep = paddle.nms(t(boxes), 0.5).numpy()
+        assert keep[0] == 0 and keep[1] == -1
 
     def test_matrix_nms_decays_overlaps(self):
         boxes = np.array([[0, 0, 10, 10], [0, 0, 10, 10], [50, 50, 60, 60]], np.float32)

@@ -12,6 +12,12 @@ import paddle_tpu.distributed as dist
 from paddle_tpu.kernels.ring_attention import ring_flash_attention as ring_jax
 from paddle_tpu.nn.functional.flash_attention import _xla_attention
 
+# multi-device CPU emulation of the sep x dp mesh costs minutes of XLA
+# compile on the fast tier, so the mesh-heavy cases below are marked slow;
+# the shard_map compat surface stays tier-1-covered by the cheaper
+# test_sequence_parallel / test_collective
+_mesh_heavy = pytest.mark.slow
+
 
 def _qkv(b=2, s=64, h=4, hk=None, d=16, seed=0):
     ks = jax.random.split(jax.random.PRNGKey(seed), 3)
@@ -24,6 +30,7 @@ def _qkv(b=2, s=64, h=4, hk=None, d=16, seed=0):
 
 
 class TestRingAttention:
+    @_mesh_heavy
     @pytest.mark.parametrize("causal", [True, False])
     def test_matches_full_attention(self, causal):
         mesh = dist.ProcessMesh(shape=[4, 2], dim_names=["sep", "dp"])
@@ -32,6 +39,7 @@ class TestRingAttention:
         ref = _xla_attention(q, k, v, causal=causal)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
 
+    @_mesh_heavy
     def test_gqa(self):
         mesh = dist.ProcessMesh(shape=[4], dim_names=["sep"])
         q, k, v = _qkv(h=8, hk=2)
@@ -39,6 +47,7 @@ class TestRingAttention:
         ref = _xla_attention(q, k, v, causal=True)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
 
+    @_mesh_heavy
     def test_grads_match(self):
         mesh = dist.ProcessMesh(shape=[4], dim_names=["sep"])
         q, k, v = _qkv(b=1, s=32, h=2, d=8)
@@ -54,6 +63,7 @@ class TestRingAttention:
         for a, b in zip(gr, gf):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-4)
 
+    @_mesh_heavy
     def test_under_jit_with_sharded_inputs(self):
         mesh = dist.ProcessMesh(shape=[8], dim_names=["sep"])
         q, k, v = _qkv(s=128)
@@ -106,6 +116,7 @@ class TestAttentionDropout:
         assert not np.allclose(out1.numpy(), out2.numpy())
 
 
+@_mesh_heavy
 class TestRingAttentionTensorAPI:
     def test_functional_fwd_bwd(self):
         import paddle_tpu.nn.functional as F
@@ -124,6 +135,7 @@ class TestRingAttentionTensorAPI:
         assert q.grad is not None and np.isfinite(q.grad.numpy()).all()
 
 
+@_mesh_heavy
 def test_llama_context_parallel_matches_dense():
     """config.context_parallel routes attention through the ring over the
     mesh's 'sep' axis with identical numerics to the dense path."""
