@@ -578,7 +578,11 @@ def _bench_engine_decode(paddle, platform: str) -> dict:
     through the two-signature engine (``inference.ContinuousBatchingEngine``)
     — generated tokens/sec with slots refilled as sequences finish. The
     compiled-signature count rides along as an honesty check: > 2 means the
-    engine retraced mid-serve and the number is measuring compiles."""
+    engine retraced mid-serve and the number is measuring compiles. Runs with
+    FLAGS_enable_metrics on, so the record carries the observability snapshot
+    (TTFT/decode-latency percentiles, pool-utilization high-water, and the
+    recompile watchdog's per-function compile counts)."""
+    from paddle_tpu import observability as obs
     from paddle_tpu.inference import ContinuousBatchingEngine
     from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
 
@@ -587,7 +591,7 @@ def _bench_engine_decode(paddle, platform: str) -> dict:
     # happens to leave behind would otherwise decide which kernel this
     # metric measures
     flag_name = "FLAGS_use_pallas_paged_attention"
-    prior_flag = paddle.get_flags([flag_name])[flag_name]
+    prior_flags = paddle.get_flags([flag_name, "FLAGS_enable_metrics"])
     use_pallas = platform == "tpu"
     try:
         if platform == "tpu":
@@ -601,7 +605,9 @@ def _bench_engine_decode(paddle, platform: str) -> dict:
             cfg = LlamaConfig.tiny()
             slots, bs, bucket, n_req, max_new = 2, 4, 16, 4, 6
 
-        paddle.set_flags({flag_name: use_pallas})
+        paddle.set_flags({flag_name: use_pallas, "FLAGS_enable_metrics": True})
+        obs.GLOBAL_METRICS.reset()
+        obs.GLOBAL_WATCHDOG.reset()  # compile ledger counts THIS engine only
         paddle.seed(0)
         model = LlamaForCausalLM(cfg)
         if platform == "tpu":
@@ -622,11 +628,33 @@ def _bench_engine_decode(paddle, platform: str) -> dict:
 
         submit(2)  # warmup: compiles the prefill + decode signatures
         engine.run()
+        # keep the watchdog ledger (warmup compiles ARE the two signatures;
+        # any compile past them is the retrace the honesty check exists for)
+        # but zero the latency/pool metrics so percentiles cover only the
+        # timed window
+        obs.GLOBAL_METRICS.reset()
         submit(n_req)
         t0 = time.perf_counter()
         out = engine.run()
         dt = time.perf_counter() - t0
         toks = sum(len(r.generated) for r in out.values())
+
+        wd = {
+            fn: rec["count"]
+            for fn, rec in obs.GLOBAL_WATCHDOG.report().items()
+            if fn.startswith("ContinuousBatchingEngine.")
+        }
+        ttft = obs.GLOBAL_METRICS.get("engine_ttft_seconds")
+        step_h = obs.GLOBAL_METRICS.get("engine_decode_step_seconds")
+
+        def pct(h) -> dict:
+            return {
+                "p50": round(h.quantile(0.5) * 1e3, 3),
+                "p95": round(h.quantile(0.95) * 1e3, 3),
+                "p99": round(h.quantile(0.99) * 1e3, 3),
+                "count": h.count(),
+            }
+
         return {
             "metric": "engine_decode_tokens_per_sec",
             "value": round(toks / dt, 2),
@@ -635,13 +663,21 @@ def _bench_engine_decode(paddle, platform: str) -> dict:
             "generated_tokens": toks,
             "max_slots": slots,
             "attention_path": "pallas" if use_pallas else "xla_gather",
-            "compiled_signatures": engine.stats["prefill_traces"]
-            + engine.stats["decode_traces"],
+            # the watchdog's numbers, not the engine's ad-hoc counter
+            "compiled_signatures": sum(wd.values()),
+            "metrics": {
+                "ttft_ms": pct(ttft),
+                "decode_step_ms": pct(step_h),
+                "kv_pool_utilization_peak": round(
+                    obs.GLOBAL_METRICS.get("engine_kv_pool_utilization").high_water(), 4
+                ),
+                "compiles_by_fn": wd,
+            },
         }
     except Exception as exc:  # noqa: BLE001 - secondary must never kill primary
         return {"metric": "engine_decode_tokens_per_sec", "error": f"{exc!r}"[:300]}
     finally:
-        paddle.set_flags({flag_name: prior_flag})
+        paddle.set_flags(prior_flags)
 
 
 def _bench_resnet_pipeline(paddle, platform: str) -> dict:

@@ -127,6 +127,7 @@ from paddle_tpu.hapi import Model  # noqa: F401
 from paddle_tpu import nn  # noqa: F401
 from paddle_tpu import optimizer  # noqa: F401
 from paddle_tpu import profiler  # noqa: F401
+from paddle_tpu import observability  # noqa: F401
 from paddle_tpu import static  # noqa: F401
 from paddle_tpu import text  # noqa: F401
 from paddle_tpu import generation  # noqa: F401

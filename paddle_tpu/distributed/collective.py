@@ -11,6 +11,8 @@ NCCL: the compiler emits the communication.
 
 from __future__ import annotations
 
+import functools
+import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -19,6 +21,38 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.observability import metrics as _obs
+
+_coll_calls = _obs.GLOBAL_METRICS.counter(
+    "collective_calls_total",
+    "Collective API invocations, by op.",
+    labelnames=("op",),
+)
+_coll_seconds = _obs.GLOBAL_METRICS.counter(
+    "collective_seconds_total",
+    "Host-side wall time spent inside collective wrappers, by op (trace time "
+    "under jit; eager dispatch time otherwise).",
+    labelnames=("op",),
+)
+
+
+def _instrumented(fn):
+    """Wrap one collective with call/time counters. With metrics off the
+    wrapper is a single cached-bool check — safe on trace-time hot paths."""
+    op = fn.__name__
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        if not _obs.metrics_enabled():
+            return fn(*args, **kwargs)
+        t0 = time.perf_counter()
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            _coll_calls.labels(op=op).inc()
+            _coll_seconds.labels(op=op).inc(time.perf_counter() - t0)
+
+    return wrapper
 
 __all__ = [
     "ReduceOp",
@@ -196,6 +230,7 @@ def _apply(t: Any, fn: Any) -> Any:
     return fn(t)
 
 
+@_instrumented
 def all_reduce(tensor: Any, op: str = ReduceOp.SUM, group: Optional[Group] = None, sync_op: bool = True) -> Any:
     """AllReduce. Inside a shard_map region: ``lax.psum`` over the group axis
     (restricted to the group's ``axis_index_groups`` for sub-groups). On a
@@ -227,6 +262,7 @@ def all_reduce(tensor: Any, op: str = ReduceOp.SUM, group: Optional[Group] = Non
     return result
 
 
+@_instrumented
 def all_gather(tensor_list: Optional[List[Any]], tensor: Any, group: Optional[Group] = None, sync_op: bool = True, axis: int = 0) -> Any:
     """AllGather. With ``tensor_list`` given: appends each member's tensor
     (reference list form). Without: returns the shards CONCATENATED along
@@ -260,6 +296,7 @@ def all_gather_object(object_list: List[Any], obj: Any, group: Optional[Group] =
     object_list.append(obj)
 
 
+@_instrumented
 def reduce(tensor: Any, dst: int = 0, op: str = ReduceOp.SUM, group: Optional[Group] = None, sync_op: bool = True) -> Any:
     """Reduce-to-one: only the ``dst`` member keeps the reduced value; every
     other member's tensor is unchanged (reference ``communication/reduce.py``
@@ -297,6 +334,7 @@ def reduce(tensor: Any, dst: int = 0, op: str = ReduceOp.SUM, group: Optional[Gr
     return result
 
 
+@_instrumented
 def reduce_scatter(tensor: Any, tensor_list: Any = None, op: str = ReduceOp.SUM, group: Optional[Group] = None, sync_op: bool = True) -> Any:
     axis_name = _axis(group)
     if axis_name is None:
@@ -319,6 +357,7 @@ def reduce_scatter(tensor: Any, tensor_list: Any = None, op: str = ReduceOp.SUM,
     return result
 
 
+@_instrumented
 def broadcast(tensor: Any, src: int = 0, group: Optional[Group] = None, sync_op: bool = True) -> Any:
     axis_name = _axis(group)
     if axis_name is None:
@@ -342,6 +381,7 @@ def broadcast(tensor: Any, src: int = 0, group: Optional[Group] = None, sync_op:
     return result
 
 
+@_instrumented
 def scatter(tensor: Any, tensor_list: Any = None, src: int = 0, group: Optional[Group] = None, sync_op: bool = True) -> Any:
     axis_name = _axis(group)
     if axis_name is None:
@@ -370,6 +410,7 @@ def scatter(tensor: Any, tensor_list: Any = None, src: int = 0, group: Optional[
     return result
 
 
+@_instrumented
 def alltoall(out_tensor_list: Any, in_tensor_list: Any, group: Optional[Group] = None, sync_op: bool = True) -> Any:
     axis_name = _axis(group)
     if axis_name is None:
@@ -394,6 +435,7 @@ def alltoall(out_tensor_list: Any, in_tensor_list: Any, group: Optional[Group] =
     return result
 
 
+@_instrumented
 def alltoall_single(
     out_tensor: Any,
     in_tensor: Any,
@@ -415,6 +457,7 @@ def alltoall_single(
     return _apply(in_tensor, fn)
 
 
+@_instrumented
 def ppermute(tensor: Any, perm: Sequence[Any], group: Optional[Group] = None) -> Any:
     """Point-to-point permutation over the group axis: ``perm`` is a list of
     (src_group_rank, dst_group_rank) pairs (each destination at most once) —
@@ -438,6 +481,13 @@ def ppermute(tensor: Any, perm: Sequence[Any], group: Optional[Group] = None) ->
     return _apply(tensor, fn)
 
 
+# internal p2p helpers call the UNWRAPPED ppermute: send/recv/batch_isend_irecv
+# are themselves instrumented, and nesting would double-count every p2p edge
+# under op="ppermute" (calls and overlapping wall time)
+_ppermute_raw = ppermute.__wrapped__
+
+
+@_instrumented
 def send(tensor: Any, dst: int = 0, group: Optional[Group] = None, sync_op: bool = True, src: Optional[int] = None) -> Any:
     """Pairwise send. SPMD programs are rank-agnostic, so the source must be
     explicit: ``send(t, dst=k, src=j)`` ≡ ``ppermute(t, [(j, k)])``. Use
@@ -452,9 +502,10 @@ def send(tensor: Any, dst: int = 0, group: Optional[Group] = None, sync_op: bool
             "dist.ppermute/batch_isend_irecv for shift patterns"
         )
     g = group or _default_group()
-    return ppermute(tensor, [(g.get_group_rank(src), g.get_group_rank(dst))], group)
+    return _ppermute_raw(tensor, [(g.get_group_rank(src), g.get_group_rank(dst))], group)
 
 
+@_instrumented
 def recv(tensor: Any, src: int = 0, group: Optional[Group] = None, sync_op: bool = True, dst: Optional[int] = None) -> Any:
     axis_name = _axis(group)
     if axis_name is None:
@@ -465,7 +516,7 @@ def recv(tensor: Any, src: int = 0, group: Optional[Group] = None, sync_op: bool
             "use dist.ppermute/batch_isend_irecv for shift patterns"
         )
     g = group or _default_group()
-    result = ppermute(tensor, [(g.get_group_rank(src), g.get_group_rank(dst))], group)
+    result = _ppermute_raw(tensor, [(g.get_group_rank(src), g.get_group_rank(dst))], group)
     if isinstance(tensor, Tensor) and isinstance(result, Tensor):
         tensor._replace_(result)
         return tensor
@@ -492,6 +543,7 @@ class P2POp:
         self.src = src
 
 
+@_instrumented
 def batch_isend_irecv(p2p_op_list: Sequence[P2POp]) -> List[Any]:
     """Batched p2p (reference ``pp_utils/p2p_communication.py:570``
     ``_p2p_helper`` batched isend/irecv): ALL ops touching the same buffer
@@ -541,7 +593,7 @@ def batch_isend_irecv(p2p_op_list: Sequence[P2POp]) -> List[Any]:
         op_slots.append(bi)
 
     results = [
-        ppermute(buf, pairs, group) for buf, pairs in zip(buffers, pairs_per_buf)
+        _ppermute_raw(buf, pairs, group) for buf, pairs in zip(buffers, pairs_per_buf)
     ]
     return [results[bi] for bi in op_slots]
 
@@ -550,6 +602,7 @@ isend = send
 irecv = recv
 
 
+@_instrumented
 def barrier(group: Optional[Group] = None) -> None:
     """Device-level barrier: flush async dispatch."""
     from paddle_tpu.core.device import device

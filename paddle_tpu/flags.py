@@ -32,12 +32,25 @@ class FlagRegistry:
     def __init__(self) -> None:
         self._flags: Dict[str, _Flag] = {}
         self._lock = threading.RLock()
+        self._listeners: Dict[str, List[Callable[[Any], None]]] = {}
 
     def define(self, name: str, type_: type, default: Any, help_: str = "") -> None:
         with self._lock:
             if name in self._flags:
                 raise ValueError(f"flag '{name}' already defined")
             self._flags[name] = _Flag(name=name, type=type_, default=default, help=help_, value=default)
+
+    def on_change(self, name: str, callback: Callable[[Any], None]) -> None:
+        """Register a callback fired with the new value whenever ``name`` is
+        set (programmatically or by env seeding at first read). Lets hot paths
+        cache a flag in a plain local instead of taking the registry lock per
+        read — the metrics layer's near-zero-overhead gate."""
+        with self._lock:
+            self._listeners.setdefault(name, []).append(callback)
+
+    def _notify(self, flag: _Flag) -> None:
+        for cb in self._listeners.get(flag.name, ()):
+            cb(flag.value)
 
     def _coerce(self, flag: _Flag, value: Any) -> Any:
         if flag.type is bool:
@@ -48,10 +61,13 @@ class FlagRegistry:
 
     def _maybe_read_env(self, flag: _Flag) -> None:
         if not flag.env_read:
+            # mark BEFORE notifying: a listener that reads the flag back
+            # (re-entrant under the RLock) must not re-enter seeding
+            flag.env_read = True
             env = os.environ.get(f"FLAGS_{flag.name}")
             if env is not None:
                 flag.value = self._coerce(flag, env)
-            flag.env_read = True
+                self._notify(flag)
 
     def get(self, name: str) -> Any:
         with self._lock:
@@ -68,6 +84,7 @@ class FlagRegistry:
             flag = self._flags[name]
             flag.env_read = True
             flag.value = self._coerce(flag, value)
+            self._notify(flag)
 
     def names(self) -> List[str]:
         with self._lock:
@@ -96,6 +113,12 @@ def _define_builtin_flags() -> None:
     d("dist_timeout_seconds", int, 1800, "Collective watchdog timeout (comm_task_manager parity).")
     d("tracer_mkldnn_ops_on", str, "", "Compat no-op on TPU.")
     d("use_stride_kernel", bool, False, "Compat: XLA owns layouts; stride kernels do not apply.")
+    # observability layer (reference: the exported-flags + profiler surface,
+    # SURVEY §5.1); registered here so env seeding works before the
+    # paddle_tpu.observability import runs
+    d("enable_metrics", bool, False, "Record runtime metrics (counters/gauges/histograms) into the global registry; off = every recording call is a no-op.")
+    d("metrics_port", int, 0, "Serve Prometheus text exposition on this localhost port via observability.start_metrics_server(); 0 disables the endpoint.")
+    d("max_compiles_per_fn", int, 16, "Recompile-watchdog budget: warn when one traced function RE-compiles (compiles past its first_call traces) more than this many times; 0 disables the warning.")
 
 
 _define_builtin_flags()

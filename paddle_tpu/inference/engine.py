@@ -32,6 +32,7 @@ never hit pool exhaustion.
 from __future__ import annotations
 
 import itertools
+import time
 from collections import deque
 from typing import Any, Dict, List, Optional
 
@@ -39,7 +40,63 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from paddle_tpu.observability import metrics as _obs
+from paddle_tpu.observability.recompile import (
+    CAUSE_FIRST_CALL,
+    CAUSE_NEW_SHAPE_DTYPE,
+    GLOBAL_WATCHDOG,
+)
+
 __all__ = ["ContinuousBatchingEngine", "InferenceRequest"]
+
+
+def _engine_metrics() -> Dict[str, Any]:
+    """Get-or-create the engine metric families (process-global: every engine
+    in the process reports into the same Prometheus-style families)."""
+    reg = _obs.GLOBAL_METRICS
+    return {
+        "ttft": reg.histogram(
+            "engine_ttft_seconds",
+            "Time from add_request to the request's first generated token.",
+        ),
+        "step": reg.histogram(
+            "engine_decode_step_seconds",
+            "Latency of one decode step over all active slots (incl. host sync).",
+        ),
+        "admitted": reg.counter(
+            "engine_requests_admitted_total",
+            "Requests admitted into a slot (prefill ran).",
+        ),
+        "finished": reg.counter(
+            "engine_requests_finished_total",
+            "Requests finished, by finish reason.",
+            labelnames=("reason",),
+        ),
+        "evicted": reg.counter(
+            "engine_slots_evicted_total",
+            "Slot evictions: a finished sequence's KV blocks reclaimed to the pool.",
+        ),
+        "queue": reg.gauge(
+            "engine_queue_depth", "Requests waiting for a slot (FIFO)."
+        ),
+        "active": reg.gauge(
+            "engine_active_slots", "Slots holding a live (mid-decode) request."
+        ),
+        "blocks_alloc": reg.gauge(
+            "engine_kv_blocks_allocated", "KV pool blocks currently allocated."
+        ),
+        "blocks_free": reg.gauge(
+            "engine_kv_blocks_free", "KV pool blocks currently free."
+        ),
+        "blocks_reserved": reg.gauge(
+            "engine_kv_blocks_reserved",
+            "Worst-case blocks reserved by live sequences (admission guarantee).",
+        ),
+        "util": reg.gauge(
+            "engine_kv_pool_utilization",
+            "allocated/total blocks, 0..1; high-water mark tracked since reset.",
+        ),
+    }
 
 
 class InferenceRequest:
@@ -58,6 +115,7 @@ class InferenceRequest:
         self.eos_token_id = eos_token_id
         self.generated: List[int] = []
         self.finish_reason: Optional[str] = None  # "stop" | "length"
+        self.arrival_time = time.perf_counter()  # TTFT anchor
 
     @property
     def finished(self) -> bool:
@@ -137,12 +195,18 @@ class ContinuousBatchingEngine:
 
         self._named = list(model.named_parameters())
         self.stats = {"prefill_traces": 0, "decode_traces": 0, "steps": 0, "admitted": 0}
+        self._metrics = _engine_metrics()
+        self._update_pool_gauges()
         # On donating backends (TPU) a step that fails AFTER dispatch has
         # already consumed the donated cache buffers: allocator accounting is
         # rolled back, but the KV contents are unrecoverable — the engine
         # marks itself broken and refuses further use rather than serving
         # garbage. On CPU (no donation) failed steps are safely retryable.
         self._broken = False
+        # per-engine "first successful compile recorded" markers: the watchdog
+        # attributes each engine instance's initial trace as first_call
+        self._prefill_recorded = False
+        self._decode_recorded = False
         donate = jax.default_backend() != "cpu"  # donation warns (no-op) on cpu
         self._prefill_fn = jax.jit(
             self._prefill_impl, donate_argnums=(1,) if donate else ()
@@ -158,6 +222,21 @@ class ContinuousBatchingEngine:
             "free": self._mgr.free_blocks,
             "allocated": self._mgr.blocks_allocated(),
         }
+
+    def _update_pool_gauges(self) -> None:
+        """Refresh the pool/queue gauges straight from ``pool_stats()``; called
+        at every admit/evict/step boundary. With metrics off this is one
+        cached-bool check — the engine's hot path stays unmeasured-free."""
+        if not _obs.metrics_enabled():
+            return
+        s = self.pool_stats()
+        m = self._metrics
+        m["blocks_alloc"].set(s["allocated"])
+        m["blocks_free"].set(s["free"])
+        m["blocks_reserved"].set(int(self._reserved.sum()))
+        m["util"].set(s["allocated"] / s["total"] if s["total"] else 0.0)
+        m["queue"].set(len(self._waiting))
+        m["active"].set(sum(r is not None for r in self._slot_req))
 
     def _unreserved_free(self) -> int:
         """Free blocks not spoken for by live sequences' worst-case growth."""
@@ -219,6 +298,7 @@ class ContinuousBatchingEngine:
                 f"but the pool only has {self.num_blocks}"
             )
         self._waiting.append(req)
+        self._update_pool_gauges()  # queue depth changed
         return req.req_id
 
     def has_work(self) -> bool:
@@ -308,6 +388,7 @@ class ContinuousBatchingEngine:
         table = jnp.asarray(self._mgr.block_table([slot]))  # [1, MBS]
         ids = np.zeros((1, self.prompt_bucket), np.int32)
         ids[0, :plen] = req.prompt
+        traces_before = self.stats["prefill_traces"]
         try:
             tok, self._caches = self._prefill_fn(
                 self._param_arrays(), self._caches, jnp.asarray(ids), table,
@@ -321,8 +402,22 @@ class ContinuousBatchingEngine:
             self._waiting.appendleft(req)  # keeps FIFO order for a retry
             self._broken = self._broken or self._buffers_lost()
             raise
+        if self.stats["prefill_traces"] > traces_before:
+            # recorded HERE, after the jit call returned: a trace that died
+            # mid-body bumped the stats counter but produced no program, and
+            # the watchdog ledger must only count compiles that exist
+            GLOBAL_WATCHDOG.record_compile(
+                "ContinuousBatchingEngine.prefill",
+                signature=f"ids[1,{self.prompt_bucket}]",
+                cause=CAUSE_FIRST_CALL
+                if not self._prefill_recorded
+                else CAUSE_NEW_SHAPE_DTYPE,
+            )
+            self._prefill_recorded = True
         self.stats["admitted"] += 1
-        tok = int(tok)
+        tok = int(tok)  # device sync: the first token exists past this line
+        self._metrics["admitted"].inc()
+        self._metrics["ttft"].observe(time.perf_counter() - req.arrival_time)
         req.generated.append(tok)
         if req.eos_token_id is not None and tok == req.eos_token_id:
             req.finish_reason = "stop"
@@ -334,6 +429,7 @@ class ContinuousBatchingEngine:
         self._slot_req[slot] = req
         self._ntok[slot] = plen
         self._last_tok[slot] = tok
+        self._update_pool_gauges()
 
     def _release(self, slot: int, req: InferenceRequest) -> None:
         # finished requests are handed back ONLY through step()'s return
@@ -344,6 +440,9 @@ class ContinuousBatchingEngine:
         self._slot_req[slot] = None
         self._ntok[slot] = 0
         self._last_tok[slot] = 0
+        self._metrics["evicted"].inc()
+        self._metrics["finished"].labels(reason=req.finish_reason or "unknown").inc()
+        self._update_pool_gauges()
 
     def step(self) -> List[InferenceRequest]:
         """One engine iteration: reclaim/admit, then one decode step over all
@@ -363,6 +462,8 @@ class ContinuousBatchingEngine:
         lens = jnp.asarray(self._ntok)  # EXCLUDING the token being appended
         active = np.zeros((self.max_slots,), bool)
         active[active_slots] = True
+        t0 = time.perf_counter()
+        traces_before = self.stats["decode_traces"]
         try:
             nxt, self._caches = self._decode_fn(
                 self._param_arrays(), self._caches, jnp.asarray(self._last_tok),
@@ -376,8 +477,21 @@ class ContinuousBatchingEngine:
                 self._mgr.truncate(i, int(self._ntok[i]))
             self._broken = self._broken or self._buffers_lost()
             raise
+        if self.stats["decode_traces"] > traces_before:
+            # recorded HERE, after the jit call returned: a trace that died
+            # mid-body bumped the stats counter but produced no program, and
+            # the watchdog ledger must only count compiles that exist
+            GLOBAL_WATCHDOG.record_compile(
+                "ContinuousBatchingEngine.decode",
+                signature=f"toks[{self.max_slots}]",
+                cause=CAUSE_FIRST_CALL
+                if not self._decode_recorded
+                else CAUSE_NEW_SHAPE_DTYPE,
+            )
+            self._decode_recorded = True
         self.stats["steps"] += 1
-        nxt = np.asarray(nxt)
+        nxt = np.asarray(nxt)  # device sync: the step's tokens are real here
+        self._metrics["step"].observe(time.perf_counter() - t0)
         for i in active_slots:
             req = self._slot_req[i]
             tok = int(nxt[i])
@@ -391,6 +505,7 @@ class ContinuousBatchingEngine:
             if req.finished:
                 self._release(i, req)
                 done.append(req)
+        self._update_pool_gauges()  # step appended one token per active slot
         return done
 
     def run(self) -> Dict[int, InferenceRequest]:

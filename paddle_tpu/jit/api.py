@@ -26,6 +26,12 @@ import jax.numpy as jnp
 
 from paddle_tpu.core import autograd as _ag
 from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.observability.recompile import (
+    CAUSE_FIRST_CALL,
+    CAUSE_MODE_FLIP,
+    CAUSE_NEW_SHAPE_DTYPE,
+    GLOBAL_WATCHDOG,
+)
 
 # trace failures that mean "this fragment is not capturable", not user bugs:
 # a tracer leaked into Python control flow / indexing / int conversion
@@ -160,6 +166,10 @@ class StaticFunction:
         # doomed re-trace.
         self._full_graph = bool(full_graph)
         self._eager_keys: set = set()
+        # every key ever traced (never popped, unlike _cache): the recompile
+        # watchdog's attribution history — a later key differing ONLY in the
+        # training tuple is a train/eval mode flip, not a new shape bucket
+        self._compiled_keys: set = set()
 
     @property
     def function(self) -> Callable:
@@ -215,7 +225,8 @@ class StaticFunction:
         in_arrays = [leaves[i]._data if isinstance(leaves[i], Tensor) else leaves[i] for i in tensor_pos]
         state_arrays, opt_states, rng_key = state.snapshot()
 
-        if key not in self._cache:
+        cache_miss = key not in self._cache
+        if cache_miss:
             fn = self._fn
 
             def staged(state_arrays_, opt_states_, rng_key_, in_arrays_):
@@ -268,13 +279,13 @@ class StaticFunction:
                 state_arrays, opt_states, rng_key, in_arrays
             )
         except _TRACE_BREAK_ERRORS as exc:
+            self._cache.pop(key, None)
             if self._full_graph:
                 raise
             # graph break (reference SOT's fallback-to-eager): drop the doomed
             # compile-cache entry, remember the guard key, run eagerly
             import warnings
 
-            self._cache.pop(key, None)
             self._eager_keys.add(key)
             warnings.warn(
                 f"to_static({getattr(self._fn, '__name__', '?')}): graph break — "
@@ -283,6 +294,14 @@ class StaticFunction:
                 stacklevel=2,
             )
             return self._fn(*args, **kwargs)
+        except BaseException:
+            if cache_miss:
+                # the first execution failed past the trace-break net (XLA
+                # runtime error, data-dependent check): drop the entry so a
+                # retry re-traces and the watchdog records the compile —
+                # otherwise the cached program serves forever uncounted
+                self._cache.pop(key, None)
+            raise
         # Commit mutated state back into the framework objects.
         import paddle_tpu.core.rng as _rng
 
@@ -307,6 +326,28 @@ class StaticFunction:
 
                 new_rng = jnp.asarray(_np.asarray(new_rng))
             _rng.default_generator()._key = new_rng
+        if cache_miss:
+            # record only HERE — after the trace succeeded AND state was
+            # committed: a graph break above never produced a compiled
+            # program, and a RecompileBudgetWarning escalated to an error
+            # (warnings-as-errors) must not be conflated with an execution
+            # failure — at this point the donated buffers' replacements are
+            # already committed and the cache entry stays valid
+            if not self._compiled_keys:
+                cause = CAUSE_FIRST_CALL
+            elif any(
+                k[:3] == key[:3] and k[3] != key[3] for k in self._compiled_keys
+            ):
+                cause = CAUSE_MODE_FLIP
+            else:
+                cause = CAUSE_NEW_SHAPE_DTYPE
+            self._compiled_keys.add(key)
+            GLOBAL_WATCHDOG.record_compile(
+                getattr(self._fn, "__qualname__", None)
+                or getattr(self._fn, "__name__", "<fn>"),
+                signature=key[1],
+                cause=cause,
+            )
         return jax.tree_util.tree_map(
             lambda o: Tensor(o) if isinstance(o, jax.Array) else o, out_arrays
         )

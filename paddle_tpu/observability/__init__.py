@@ -1,0 +1,67 @@
+"""Runtime telemetry layer (reference: SURVEY §5.1 — exported runtime flags,
+profiler, ``DeviceMemoryStat`` accounting).
+
+Three pieces, one substrate every perf/robustness PR reports through:
+
+- a process-global, thread-safe metrics registry (:mod:`.metrics`):
+  Counter / Gauge / Histogram with fixed log-scale buckets, near-zero
+  overhead while ``FLAGS_enable_metrics`` is off;
+- exporters (:mod:`.exporters`): Prometheus text exposition over an opt-in
+  localhost HTTP endpoint (``FLAGS_metrics_port``), and a JSONL snapshot
+  writer whose snapshots the chrome-trace exporter links into its span
+  stream;
+- a recompile watchdog (:mod:`.recompile`): compile counts with cause
+  attribution (new shape/dtype vs. train/eval flip vs. first call) and a
+  ``FLAGS_max_compiles_per_fn`` budget warning.
+
+Instrumented call sites: ``inference/engine.py`` (TTFT, decode-step latency,
+queue depth, admits/evicts/finished, KV-pool gauges), ``jit/api.py``
+(StaticFunction cache misses feed the watchdog), ``distributed/collective.py``
+(per-op call/time counters).
+"""
+
+from paddle_tpu.observability.metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    GLOBAL_METRICS,
+    get_registry,
+    metrics_enabled,
+)
+from paddle_tpu.observability.recompile import (  # noqa: F401
+    CAUSE_FIRST_CALL,
+    CAUSE_MODE_FLIP,
+    CAUSE_NEW_SHAPE_DTYPE,
+    GLOBAL_WATCHDOG,
+    RecompileBudgetWarning,
+    RecompileWatchdog,
+    get_watchdog,
+)
+from paddle_tpu.observability.exporters import (  # noqa: F401
+    drain_trace_events,
+    start_metrics_server,
+    stop_metrics_server,
+    write_snapshot_jsonl,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "GLOBAL_METRICS",
+    "get_registry",
+    "metrics_enabled",
+    "CAUSE_FIRST_CALL",
+    "CAUSE_MODE_FLIP",
+    "CAUSE_NEW_SHAPE_DTYPE",
+    "GLOBAL_WATCHDOG",
+    "RecompileBudgetWarning",
+    "RecompileWatchdog",
+    "get_watchdog",
+    "drain_trace_events",
+    "start_metrics_server",
+    "stop_metrics_server",
+    "write_snapshot_jsonl",
+]

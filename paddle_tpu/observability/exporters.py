@@ -1,0 +1,138 @@
+"""Metrics exporters: Prometheus text over HTTP, and JSONL snapshots that the
+chrome-trace exporter links into its span stream.
+
+- :func:`start_metrics_server` serves ``GET /metrics`` (text exposition
+  0.0.4) on localhost. Opt-in: nothing listens unless it is called; with no
+  explicit port it reads ``FLAGS_metrics_port`` (0 = disabled).
+- :func:`write_snapshot_jsonl` appends one JSON line (walltime + the full
+  registry snapshot) to a file AND records a chrome-trace instant event
+  carrying the snapshot's path/seq; ``profiler.Profiler.export`` drains those
+  events into its ``traceEvents``, so a trace viewer shows exactly when each
+  metrics snapshot was taken relative to the recorded spans, and
+  ``load_profiler_result`` round-trips the link.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+
+from paddle_tpu.flags import GLOBAL_FLAGS
+
+from . import metrics as _metrics
+
+__all__ = [
+    "write_snapshot_jsonl",
+    "drain_trace_events",
+    "start_metrics_server",
+    "stop_metrics_server",
+]
+
+_trace_events: List[Dict[str, Any]] = []
+_trace_lock = threading.Lock()
+_snapshot_seq = itertools.count()
+# a server snapshotting every second with no profiler export draining must
+# not grow host memory: keep only the newest link events past this cap
+_MAX_TRACE_EVENTS = 4096
+
+
+def write_snapshot_jsonl(
+    path: str, registry: Optional[_metrics.MetricsRegistry] = None
+) -> Dict[str, Any]:
+    """Append one snapshot line to ``path``; returns the snapshot record.
+    ``ts_us`` uses the profiler's clock (perf_counter) so the linked instant
+    event lands on the same timeline as RecordEvent spans."""
+    reg = registry or _metrics.GLOBAL_METRICS
+    seq = next(_snapshot_seq)
+    ts_us = time.perf_counter() * 1e6
+    record = {
+        "seq": seq,
+        "ts_us": ts_us,
+        "walltime": time.time(),
+        "metrics": reg.snapshot(),
+    }
+    with open(path, "a") as f:
+        f.write(json.dumps(record) + "\n")
+    with _trace_lock:
+        _trace_events.append(
+            {
+                "name": "metrics_snapshot",
+                "ph": "i",
+                "s": "p",
+                "ts": ts_us,
+                "pid": os.getpid(),
+                "tid": threading.get_ident(),
+                "args": {"path": path, "seq": seq},
+            }
+        )
+        if len(_trace_events) > _MAX_TRACE_EVENTS:
+            del _trace_events[: -_MAX_TRACE_EVENTS]
+    return record
+
+
+def drain_trace_events() -> List[Dict[str, Any]]:
+    """Hand the buffered snapshot link events to the chrome-trace exporter."""
+    global _trace_events
+    with _trace_lock:
+        events, _trace_events = _trace_events, []
+    return events
+
+
+class _MetricsHandler(BaseHTTPRequestHandler):
+    def do_GET(self) -> None:  # noqa: N802 - http.server API
+        if self.path.split("?", 1)[0] != "/metrics":
+            self.send_error(404, "try /metrics")
+            return
+        body = _metrics.GLOBAL_METRICS.render_prometheus().encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/plain; version=0.0.4; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args: Any) -> None:  # silence per-request stderr
+        pass
+
+
+_server: Optional[ThreadingHTTPServer] = None
+_server_lock = threading.Lock()
+
+
+def start_metrics_server(port: Optional[int] = None) -> Optional[ThreadingHTTPServer]:
+    """Serve /metrics on 127.0.0.1. ``port=None`` reads ``FLAGS_metrics_port``
+    (<= 0 means disabled -> returns None); an explicit ``port=0`` binds an
+    ephemeral port (``server.server_address[1]`` has it). Idempotent."""
+    global _server
+    with _server_lock:
+        if _server is not None:
+            bound = _server.server_address[1]
+            if port not in (None, 0) and int(port) != bound:
+                raise RuntimeError(
+                    f"metrics server already bound to port {bound}; "
+                    f"stop_metrics_server() before requesting port {port}"
+                )
+            return _server
+        if port is None:
+            port = int(GLOBAL_FLAGS.get("metrics_port"))
+            if port <= 0:
+                return None
+        srv = ThreadingHTTPServer(("127.0.0.1", int(port)), _MetricsHandler)
+        srv.daemon_threads = True
+        t = threading.Thread(target=srv.serve_forever, daemon=True, name="metrics-http")
+        t.start()
+        _server = srv
+        return srv
+
+
+def stop_metrics_server() -> None:
+    global _server
+    with _server_lock:
+        if _server is not None:
+            _server.shutdown()
+            _server.server_close()
+            _server = None

@@ -1,0 +1,458 @@
+"""Process-global runtime metrics registry.
+
+TPU-native counterpart of the reference's observability substrate (SURVEY
+§5.1: the 179 exported runtime flags, ``DeviceMemoryStat`` peak/current
+accounting, host/device profiler): a typed registry of Counters, Gauges and
+Histograms that the serving engine, jit layer and collectives report through,
+rendered as Prometheus text exposition or JSONL snapshots
+(``observability.exporters``).
+
+Gating: every recording call checks a module-local cached copy of
+``FLAGS_enable_metrics`` (kept fresh by a flag-change listener), so with
+metrics off the hot-path cost is one list indexing — no registry lock, no
+dict lookup. Metric *definition* is always allowed; only recording is gated.
+
+Histograms use fixed log-scale buckets (``start * factor**i``), the shape
+that keeps decode-latency percentiles meaningful across four orders of
+magnitude without per-request allocation.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from paddle_tpu.flags import GLOBAL_FLAGS
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "GLOBAL_METRICS",
+    "get_registry",
+    "metrics_enabled",
+]
+
+# cached FLAGS_enable_metrics: plain list read on the hot path; the listener
+# keeps it in lockstep with set_flags / env seeding
+_ENABLED = [False]
+
+
+def _refresh_enabled(value: Any) -> None:
+    _ENABLED[0] = bool(value)
+
+
+GLOBAL_FLAGS.on_change("enable_metrics", _refresh_enabled)
+_ENABLED[0] = bool(GLOBAL_FLAGS.get("enable_metrics"))  # seeds FLAGS_ env var
+
+
+def metrics_enabled() -> bool:
+    """Current ``FLAGS_enable_metrics`` without touching the flag registry."""
+    return _ENABLED[0]
+
+
+def _fmt_value(v: float) -> str:
+    f = float(v)
+    return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+def _escape(v: str) -> str:
+    return v.replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+
+
+def _fmt_labels(names: Sequence[str], key: Sequence[str], extra: str = "") -> str:
+    parts = [f'{n}="{_escape(k)}"' for n, k in zip(names, key)]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class _Metric:
+    """Base: a named family of cells keyed by label-value tuples."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help_: str = "", labelnames: Sequence[str] = ()) -> None:
+        self.name = name
+        self.help = help_
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+        self._cells: Dict[Tuple[str, ...], Any] = {}
+
+    def _label_key(self, kv: Dict[str, Any]) -> Tuple[str, ...]:
+        if set(kv) != set(self.labelnames):
+            raise ValueError(
+                f"metric '{self.name}' takes labels {self.labelnames}, got {sorted(kv)}"
+            )
+        return tuple(str(kv[n]) for n in self.labelnames)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._cells.clear()
+
+    @staticmethod
+    def _copy_cell(cell: Any) -> Any:
+        return cell  # Counter cells are plain floats; mutable kinds override
+
+    def _sorted_cells(self) -> List[Tuple[Tuple[str, ...], Any]]:
+        # copy mutable cell state while holding the lock: a scrape/snapshot
+        # concurrent with recording must never see a half-applied update
+        # (e.g. a histogram bucket bumped but its count not yet)
+        with self._lock:
+            return sorted((k, self._copy_cell(c)) for k, c in self._cells.items())
+
+
+class _BoundCounter:
+    __slots__ = ("_m", "_key")
+
+    def __init__(self, m: "Counter", key: Tuple[str, ...]) -> None:
+        self._m, self._key = m, key
+
+    def inc(self, n: float = 1.0) -> None:
+        self._m._inc(self._key, n)
+
+
+class Counter(_Metric):
+    """Monotonic counter; float increments allowed (e.g. seconds totals)."""
+
+    kind = "counter"
+
+    def labels(self, **kv: Any) -> _BoundCounter:
+        return _BoundCounter(self, self._label_key(kv))
+
+    def inc(self, n: float = 1.0) -> None:
+        self._inc((), n)
+
+    def _inc(self, key: Tuple[str, ...], n: float) -> None:
+        if n < 0:
+            # validate before the enabled gate so a buggy call site fails in
+            # metrics-off test runs, not first in a metrics-on production serve
+            raise ValueError(f"counter '{self.name}' cannot decrease (inc {n})")
+        if not _ENABLED[0]:
+            return
+        with self._lock:
+            self._cells[key] = self._cells.get(key, 0.0) + n
+
+    def value(self, **kv: Any) -> float:
+        key = self._label_key(kv)
+        with self._lock:
+            return float(self._cells.get(key, 0.0))
+
+    def total(self) -> float:
+        with self._lock:
+            return float(sum(self._cells.values()))
+
+    def _render(self, lines: List[str]) -> None:
+        for key, v in self._sorted_cells():
+            lines.append(f"{self.name}{_fmt_labels(self.labelnames, key)} {_fmt_value(v)}")
+
+    def _snapshot_values(self) -> List[Dict[str, Any]]:
+        return [
+            {"labels": dict(zip(self.labelnames, key)), "value": v}
+            for key, v in self._sorted_cells()
+        ]
+
+
+class _BoundGauge:
+    __slots__ = ("_m", "_key")
+
+    def __init__(self, m: "Gauge", key: Tuple[str, ...]) -> None:
+        self._m, self._key = m, key
+
+    def set(self, v: float) -> None:
+        self._m._set(self._key, v)
+
+    def inc(self, n: float = 1.0) -> None:
+        self._m._add(self._key, n)
+
+    def dec(self, n: float = 1.0) -> None:
+        self._m._add(self._key, -n)
+
+
+class Gauge(_Metric):
+    """Point-in-time value; also tracks the high-water mark since reset
+    (the ``DeviceMemoryStat`` peak/current pattern, stats.h:126)."""
+
+    kind = "gauge"
+
+    @staticmethod
+    def _copy_cell(cell: Any) -> Any:
+        return dict(cell)
+
+    def labels(self, **kv: Any) -> _BoundGauge:
+        return _BoundGauge(self, self._label_key(kv))
+
+    def set(self, v: float) -> None:
+        self._set((), v)
+
+    def inc(self, n: float = 1.0) -> None:
+        self._add((), n)
+
+    def dec(self, n: float = 1.0) -> None:
+        self._add((), -n)
+
+    def _set(self, key: Tuple[str, ...], v: float) -> None:
+        if not _ENABLED[0]:
+            return
+        v = float(v)
+        with self._lock:
+            cell = self._cells.setdefault(key, {"value": 0.0, "max": v})
+            cell["value"] = v
+            cell["max"] = max(cell["max"], v)
+
+    def _add(self, key: Tuple[str, ...], n: float) -> None:
+        if not _ENABLED[0]:
+            return
+        with self._lock:
+            cell = self._cells.setdefault(key, {"value": 0.0, "max": 0.0})
+            cell["value"] += float(n)
+            cell["max"] = max(cell["max"], cell["value"])
+
+    def value(self, **kv: Any) -> float:
+        key = self._label_key(kv)
+        with self._lock:
+            cell = self._cells.get(key)
+            return float(cell["value"]) if cell else 0.0
+
+    def high_water(self, **kv: Any) -> float:
+        key = self._label_key(kv)
+        with self._lock:
+            cell = self._cells.get(key)
+            return float(cell["max"]) if cell else 0.0
+
+    def _render(self, lines: List[str]) -> None:
+        for key, cell in self._sorted_cells():
+            lines.append(
+                f"{self.name}{_fmt_labels(self.labelnames, key)} {_fmt_value(cell['value'])}"
+            )
+
+    def _snapshot_values(self) -> List[Dict[str, Any]]:
+        return [
+            {"labels": dict(zip(self.labelnames, key)), "value": cell["value"], "max": cell["max"]}
+            for key, cell in self._sorted_cells()
+        ]
+
+
+class _BoundHistogram:
+    __slots__ = ("_m", "_key")
+
+    def __init__(self, m: "Histogram", key: Tuple[str, ...]) -> None:
+        self._m, self._key = m, key
+
+    def observe(self, v: float) -> None:
+        self._m._observe(self._key, v)
+
+
+class Histogram(_Metric):
+    """Fixed log-scale buckets: upper bounds ``start * factor**i`` for
+    ``i < count``, plus +Inf overflow. Percentiles via linear interpolation
+    inside the winning bucket (``histogram_quantile`` semantics)."""
+
+    kind = "histogram"
+
+    def __init__(
+        self,
+        name: str,
+        help_: str = "",
+        labelnames: Sequence[str] = (),
+        start: float = 1e-4,
+        factor: float = 2.0,
+        count: int = 26,
+    ) -> None:
+        super().__init__(name, help_, labelnames)
+        if start <= 0 or factor <= 1 or count < 1:
+            raise ValueError(f"bad log-scale bucket spec ({start}, {factor}, {count})")
+        self.bucket_spec: Tuple[float, float, int] = (float(start), float(factor), int(count))
+        self.bounds: Tuple[float, ...] = tuple(start * factor**i for i in range(count))
+
+    def _new_cell(self) -> Dict[str, Any]:
+        return {"counts": [0] * (len(self.bounds) + 1), "sum": 0.0, "count": 0}
+
+    @staticmethod
+    def _copy_cell(cell: Dict[str, Any]) -> Dict[str, Any]:
+        return {"counts": list(cell["counts"]), "sum": cell["sum"], "count": cell["count"]}
+
+    def labels(self, **kv: Any) -> _BoundHistogram:
+        return _BoundHistogram(self, self._label_key(kv))
+
+    def observe(self, v: float) -> None:
+        self._observe((), v)
+
+    def _observe(self, key: Tuple[str, ...], v: float) -> None:
+        if not _ENABLED[0]:
+            return
+        v = float(v)
+        i = bisect.bisect_left(self.bounds, v)  # first bound >= v (le semantics)
+        with self._lock:
+            cell = self._cells.get(key)
+            if cell is None:
+                cell = self._cells[key] = self._new_cell()
+            cell["counts"][i] += 1
+            cell["sum"] += v
+            cell["count"] += 1
+
+    def _cell(self, kv: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        key = self._label_key(kv)
+        with self._lock:
+            cell = self._cells.get(key)
+            return self._copy_cell(cell) if cell is not None else None
+
+    def count(self, **kv: Any) -> int:
+        cell = self._cell(kv)
+        return int(cell["count"]) if cell else 0
+
+    def sum(self, **kv: Any) -> float:
+        cell = self._cell(kv)
+        return float(cell["sum"]) if cell else 0.0
+
+    def bucket_counts(self, **kv: Any) -> List[int]:
+        cell = self._cell(kv)
+        return list(cell["counts"]) if cell else [0] * (len(self.bounds) + 1)
+
+    def quantile(self, q: float, **kv: Any) -> float:
+        """Estimate the q-quantile (0..1). Empty histogram -> 0.0; mass in
+        the +Inf bucket resolves to the largest finite bound."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"q must be in [0, 1], got {q}")
+        cell = self._cell(kv)
+        if cell is None or cell["count"] == 0:
+            return 0.0
+        target = q * cell["count"]
+        cum = 0.0
+        for i, c in enumerate(cell["counts"]):
+            prev = cum
+            cum += c
+            if cum >= target and c > 0:
+                if i >= len(self.bounds):
+                    return self.bounds[-1]
+                lo = 0.0 if i == 0 else self.bounds[i - 1]
+                hi = self.bounds[i]
+                return lo + (hi - lo) * (target - prev) / c
+        return self.bounds[-1]
+
+    def _render(self, lines: List[str]) -> None:
+        for key, cell in self._sorted_cells():
+            cum = 0
+            for bound, c in zip(self.bounds, cell["counts"]):
+                cum += c
+                le = _fmt_labels(self.labelnames, key, extra=f'le="{_fmt_value(bound)}"')
+                lines.append(f"{self.name}_bucket{le} {cum}")
+            le = _fmt_labels(self.labelnames, key, extra='le="+Inf"')
+            lines.append(f"{self.name}_bucket{le} {cell['count']}")
+            base = _fmt_labels(self.labelnames, key)
+            lines.append(f"{self.name}_sum{base} {_fmt_value(cell['sum'])}")
+            lines.append(f"{self.name}_count{base} {cell['count']}")
+
+    def _snapshot_values(self) -> List[Dict[str, Any]]:
+        out = []
+        for key, cell in self._sorted_cells():
+            cum, buckets = 0, {}
+            for bound, c in zip(self.bounds, cell["counts"]):
+                cum += c
+                buckets[_fmt_value(bound)] = cum
+            buckets["+Inf"] = cell["count"]
+            out.append(
+                {
+                    "labels": dict(zip(self.labelnames, key)),
+                    "count": cell["count"],
+                    "sum": cell["sum"],
+                    "buckets": buckets,
+                }
+            )
+        return out
+
+
+class MetricsRegistry:
+    """Thread-safe get-or-create registry of metric families."""
+
+    def __init__(self) -> None:
+        self._metrics: Dict[str, _Metric] = {}
+        self._lock = threading.RLock()
+
+    def _get_or_create(self, cls: type, name: str, help_: str, labelnames: Sequence[str], **kw: Any) -> Any:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if not isinstance(m, cls) or m.labelnames != tuple(labelnames):
+                    raise ValueError(
+                        f"metric '{name}' already registered as {m.kind} with "
+                        f"labels {m.labelnames}"
+                    )
+                return m
+            m = cls(name, help_, labelnames, **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help_: str = "", labelnames: Sequence[str] = ()) -> Counter:
+        return self._get_or_create(Counter, name, help_, labelnames)
+
+    def gauge(self, name: str, help_: str = "", labelnames: Sequence[str] = ()) -> Gauge:
+        return self._get_or_create(Gauge, name, help_, labelnames)
+
+    def histogram(
+        self,
+        name: str,
+        help_: str = "",
+        labelnames: Sequence[str] = (),
+        start: float = 1e-4,
+        factor: float = 2.0,
+        count: int = 26,
+    ) -> Histogram:
+        h = self._get_or_create(
+            Histogram, name, help_, labelnames, start=start, factor=factor, count=count
+        )
+        spec = (float(start), float(factor), int(count))
+        if h.bucket_spec != spec:
+            raise ValueError(
+                f"histogram '{name}' already registered with buckets "
+                f"{h.bucket_spec}, requested {spec}"
+            )
+        return h
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._metrics)
+
+    def reset(self) -> None:
+        """Zero every metric's cells; definitions survive (tests, bench)."""
+        with self._lock:
+            for m in self._metrics.values():
+                m.reset()
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-serializable view of every non-empty metric family."""
+        out: Dict[str, Any] = {}
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            values = m._snapshot_values()
+            if values:
+                out[m.name] = {"type": m.kind, "help": m.help, "values": values}
+        return out
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        lines: List[str] = []
+        with self._lock:
+            metrics = [self._metrics[n] for n in sorted(self._metrics)]
+        for m in metrics:
+            if not m._cells:
+                continue
+            if m.help:
+                lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            m._render(lines)
+        return "\n".join(lines) + "\n" if lines else ""
+
+
+GLOBAL_METRICS = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    return GLOBAL_METRICS

@@ -1,0 +1,134 @@
+"""Recompile watchdog: compile-count honesty for every traced entry point.
+
+A TPU serving number is only trustworthy when retraces are measured, not
+assumed (the Ragged Paged Attention point, PAPERS.md): one surprise retrace
+mid-serve costs seconds and silently turns a latency benchmark into a compile
+benchmark. The watchdog counts every compilation with cause attribution:
+
+- ``first_call``      — the function's first trace (expected, free of blame);
+- ``new_shape_dtype`` — a new input shape/dtype bucket forced a retrace;
+- ``mode_flip``       — train()/eval() flipped on a reachable Layer, baking a
+                        different dropout/batch-norm program.
+
+Feeders: ``jit/api.py`` (StaticFunction cache misses, with cause derived
+from the cache key) and the serving engine's two jitted entry points.
+Counting is ALWAYS on — a compile costs seconds, so recording one is never
+overhead and retrace warnings must fire in production even with metrics off —
+but the ``jit_compiles_total`` metric it feeds respects
+``FLAGS_enable_metrics`` like every other recording.
+
+``FLAGS_max_compiles_per_fn`` budgets RE-compiles: only compiles past a
+function's ``first_call`` traces count against it (N engine instances sharing
+a fn name can't trip it); when exceeded, a ``RecompileBudgetWarning`` fires
+with the cause breakdown (0 disables).
+"""
+
+from __future__ import annotations
+
+import threading
+import warnings
+from typing import Any, Dict, Optional
+
+from paddle_tpu.flags import GLOBAL_FLAGS
+
+from . import metrics as _metrics
+
+__all__ = [
+    "CAUSE_FIRST_CALL",
+    "CAUSE_NEW_SHAPE_DTYPE",
+    "CAUSE_MODE_FLIP",
+    "RecompileBudgetWarning",
+    "RecompileWatchdog",
+    "GLOBAL_WATCHDOG",
+    "get_watchdog",
+]
+
+CAUSE_FIRST_CALL = "first_call"
+CAUSE_NEW_SHAPE_DTYPE = "new_shape_dtype"
+CAUSE_MODE_FLIP = "mode_flip"
+
+_MAX_SIGNATURES = 32  # per-fn cap so a retrace storm can't grow host memory
+
+
+class RecompileBudgetWarning(UserWarning):
+    """One traced function blew through ``FLAGS_max_compiles_per_fn``."""
+
+
+class RecompileWatchdog:
+    """Thread-safe per-function compile ledger with cause attribution."""
+
+    def __init__(self, registry: Optional[_metrics.MetricsRegistry] = None) -> None:
+        self._lock = threading.Lock()
+        self._fns: Dict[str, Dict[str, Any]] = {}
+        reg = registry or _metrics.GLOBAL_METRICS
+        self._counter = reg.counter(
+            "jit_compiles_total",
+            "Compilations recorded by the recompile watchdog.",
+            labelnames=("fn", "cause"),
+        )
+
+    def record_compile(
+        self, fn: str, signature: Any = None, cause: str = CAUSE_NEW_SHAPE_DTYPE
+    ) -> int:
+        """Record one compilation of ``fn``; returns its total compile count.
+        Called once per actual trace (cache miss), never per call."""
+        with self._lock:
+            rec = self._fns.setdefault(
+                fn, {"count": 0, "causes": {}, "signatures": []}
+            )
+            rec["count"] += 1
+            rec["causes"][cause] = rec["causes"].get(cause, 0) + 1
+            if signature is not None and len(rec["signatures"]) < _MAX_SIGNATURES:
+                sig = signature if isinstance(signature, str) else repr(signature)
+                sig = sig[:200]
+                if sig not in rec["signatures"]:
+                    rec["signatures"].append(sig)
+            count = rec["count"]
+            causes = dict(rec["causes"])
+        self._counter.labels(fn=fn, cause=cause).inc()
+        budget = GLOBAL_FLAGS.get("max_compiles_per_fn")
+        # budget counts RE-compiles: first_call traces are expected once per
+        # instance (several engines / Layer instances legitimately share one
+        # fn name here), so they can never trip the retrace warning
+        recompiles = count - causes.get(CAUSE_FIRST_CALL, 0)
+        if budget and recompiles > budget:
+            warnings.warn(
+                f"recompile watchdog: '{fn}' recompiled {recompiles} times "
+                f"past its first trace ({count} compiles total, "
+                f"FLAGS_max_compiles_per_fn={budget}); causes: {causes} — "
+                f"check for unbucketed input shapes or train/eval flips",
+                RecompileBudgetWarning,
+                stacklevel=3,
+            )
+        return count
+
+    def counts(self) -> Dict[str, int]:
+        with self._lock:
+            return {fn: rec["count"] for fn, rec in self._fns.items()}
+
+    def total(self) -> int:
+        with self._lock:
+            return sum(rec["count"] for rec in self._fns.values())
+
+    def report(self) -> Dict[str, Dict[str, Any]]:
+        """Deep-copied ledger: {fn: {count, causes, signatures}}."""
+        with self._lock:
+            return {
+                fn: {
+                    "count": rec["count"],
+                    "causes": dict(rec["causes"]),
+                    "signatures": list(rec["signatures"]),
+                }
+                for fn, rec in self._fns.items()
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._fns.clear()
+
+
+GLOBAL_WATCHDOG = RecompileWatchdog()
+
+
+def get_watchdog() -> RecompileWatchdog:
+    return GLOBAL_WATCHDOG

@@ -264,6 +264,15 @@ class Profiler:
 
     def export(self, path: str, format: str = "json") -> None:  # noqa: A002
         events = self._events + _recorder.drain()
+        try:
+            # metrics snapshots taken via observability.write_snapshot_jsonl
+            # appear as instant events on the same (perf_counter) timeline,
+            # linking each snapshot file/seq into the span stream
+            from paddle_tpu.observability.exporters import drain_trace_events
+
+            events = events + drain_trace_events()
+        except Exception:
+            pass
         with open(path, "w") as f:
             json.dump({"traceEvents": events}, f)
 

@@ -130,7 +130,7 @@ class TestRankingCorrelation:
         }
         predicted = [estimate_step_time(c, tcfg)["step_time_s"] for c in trial_cfgs]
 
-        def measure(cfg) -> float:
+        def build_timer(cfg):
             paddle.seed(0)
             gcfg = GPTConfig(
                 vocab_size=VOCAB, hidden_size=64, num_layers=4, num_heads=4,
@@ -161,15 +161,27 @@ class TestRankingCorrelation:
             ids = paddle.to_tensor(rng.integers(0, VOCAB, (mbs, SEQ)).astype(np.int32))
             for _ in range(2 * acc):  # warmup/compile
                 micro(m, opt, ids, ids)
-            t0 = time.perf_counter()
-            steps = 3
-            for _ in range(steps):
-                for _ in range(acc):  # one dispatched program per microbatch
-                    loss = micro(m, opt, ids, ids)
-            float(loss)
-            return (time.perf_counter() - t0) / steps
+            steps = 12 // acc  # equal dispatch count per timed block for every cfg
 
-        measured = [measure(c) for c in trial_cfgs]
+            def timed_step() -> float:
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    for _ in range(acc):  # one dispatched program per microbatch
+                        loss = micro(m, opt, ids, ids)
+                float(loss)
+                return (time.perf_counter() - t0) / steps
+
+            return timed_step
+
+        # Compile everything first, then time round-robin with min-over-passes:
+        # sequential per-config timing lets runtime drift (allocator/thread-pool
+        # warmup, a transient load spike on a shared 2-core box) land entirely
+        # on one config and invert the ranking the assertion checks.
+        timers = [build_timer(c) for c in trial_cfgs]
+        measured = [float("inf")] * len(timers)
+        for _ in range(3):
+            for i, timed_step in enumerate(timers):
+                measured[i] = min(measured[i], timed_step())
         rho = validate_ranking(predicted, measured)
         assert rho >= 0.5, (
             f"cost-model ranking does not track measurements: rho={rho} "

@@ -278,6 +278,121 @@ class TestIntakeValidation:
             eng.add_request(np.zeros((2,), np.int32), max_new_tokens=0)
 
 
+class TestEngineMetrics:
+    """The observability acceptance test: metrics report exactly 2 compiles
+    for a staggered mixed workload, TTFT/decode histograms are populated,
+    pool gauges match ``pool_stats()`` exactly after every admit/evict, and
+    recording is a no-op with metrics disabled."""
+
+    def _flag(self):
+        return paddle.get_flags(["FLAGS_enable_metrics"])["FLAGS_enable_metrics"]
+
+    def _assert_gauges_match(self, reg, eng):
+        s = eng.pool_stats()
+        assert reg.get("engine_kv_blocks_allocated").value() == s["allocated"]
+        assert reg.get("engine_kv_blocks_free").value() == s["free"]
+        assert reg.get("engine_kv_pool_utilization").value() == pytest.approx(
+            s["allocated"] / s["total"]
+        )
+        assert reg.get("engine_queue_depth").value() == len(eng._waiting)
+        assert reg.get("engine_active_slots").value() == sum(
+            r is not None for r in eng._slot_req
+        )
+
+    def test_staggered_workload_metrics_and_watchdog(self):
+        from paddle_tpu import observability as obs
+
+        prior = self._flag()
+        obs.GLOBAL_METRICS.reset()
+        obs.GLOBAL_WATCHDOG.reset()
+        paddle.set_flags({"FLAGS_enable_metrics": True})
+        try:
+            m, cfg = _model(seed=11)
+            rng = np.random.default_rng(11)
+            eng = ContinuousBatchingEngine(
+                m, max_slots=2, block_size=4, prompt_bucket=16
+            )
+            reg = obs.GLOBAL_METRICS
+            # staggered: 5 requests through 2 slots, budgets 2..6 so some
+            # finish early and free their slot mid-flight
+            specs = [(5, 4), (7, 2), (3, 6), (6, 3), (2, 5)]
+            for n, t in specs:
+                eng.add_request(
+                    rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32),
+                    max_new_tokens=t,
+                )
+            assert reg.get("engine_queue_depth").value() == 5
+            done = []
+            while eng.has_work():
+                done += eng.step()
+                self._assert_gauges_match(reg, eng)  # exact after every boundary
+            assert len(done) == 5
+
+            # histograms populated: one TTFT per admit, one latency per step
+            assert reg.get("engine_ttft_seconds").count() == 5
+            assert reg.get("engine_ttft_seconds").sum() > 0
+            assert (
+                reg.get("engine_decode_step_seconds").count()
+                == eng.stats["steps"]
+                > 0
+            )
+            assert reg.get("engine_requests_admitted_total").value() == 5
+            assert reg.get("engine_requests_finished_total").total() == 5
+            assert reg.get("engine_requests_finished_total").value(reason="length") == 5
+            assert reg.get("engine_slots_evicted_total").value() == 5
+            assert reg.get("engine_kv_pool_utilization").high_water() > 0
+            assert reg.get("engine_kv_blocks_free").value() == eng.num_blocks
+
+            # the watchdog saw exactly the engine's two compiled signatures
+            rep = {
+                k: v
+                for k, v in obs.GLOBAL_WATCHDOG.report().items()
+                if k.startswith("ContinuousBatchingEngine.")
+            }
+            assert set(rep) == {
+                "ContinuousBatchingEngine.prefill",
+                "ContinuousBatchingEngine.decode",
+            }
+            assert all(r["count"] == 1 for r in rep.values())
+            assert rep["ContinuousBatchingEngine.prefill"]["signatures"] == ["ids[1,16]"]
+            assert rep["ContinuousBatchingEngine.decode"]["signatures"] == ["toks[2]"]
+            assert all(r["causes"] == {"first_call": 1} for r in rep.values())
+            # ... and the gated metric counter agrees: exactly 2 compiles
+            c = reg.get("jit_compiles_total")
+            assert c.value(fn="ContinuousBatchingEngine.prefill", cause="first_call") == 1
+            assert c.value(fn="ContinuousBatchingEngine.decode", cause="first_call") == 1
+            assert c.total() == 2
+        finally:
+            paddle.set_flags({"FLAGS_enable_metrics": prior})
+
+    def test_disabled_recording_is_noop(self):
+        from paddle_tpu import observability as obs
+
+        prior = self._flag()
+        paddle.set_flags({"FLAGS_enable_metrics": False})
+        obs.GLOBAL_METRICS.reset()
+        obs.GLOBAL_WATCHDOG.reset()
+        try:
+            m, cfg = _model(seed=12)
+            rng = np.random.default_rng(12)
+            eng = ContinuousBatchingEngine(m, max_slots=2, block_size=4, prompt_bucket=8)
+            eng.add_request(
+                rng.integers(0, cfg.vocab_size, (4,)).astype(np.int32),
+                max_new_tokens=3,
+            )
+            eng.run()
+            # nothing recorded anywhere in the registry
+            assert obs.GLOBAL_METRICS.snapshot() == {}
+            # the watchdog's own ledger stays honest even with metrics off —
+            # compile counting is not hot-path recording
+            assert obs.GLOBAL_WATCHDOG.counts() == {
+                "ContinuousBatchingEngine.prefill": 1,
+                "ContinuousBatchingEngine.decode": 1,
+            }
+        finally:
+            paddle.set_flags({"FLAGS_enable_metrics": prior})
+
+
 def test_step_returns_finished_exactly_once():
     """Finished requests are handed back only by the step() (or run()) call
     during which they finish — the engine retains no reference, so a

@@ -1,0 +1,368 @@
+"""Observability layer tests: metrics registry (golden Prometheus rendering,
+log-scale histogram bucket math), flag gating (disabled recording is a no-op,
+env-var seeding at first read), exporters (HTTP endpoint, JSONL snapshots),
+and the recompile watchdog (cause attribution through jit, budget warning).
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.flags import GLOBAL_FLAGS, FlagRegistry
+from paddle_tpu.observability.metrics import MetricsRegistry
+
+
+@pytest.fixture
+def metrics_on():
+    """Enable metrics for one test, reset shared state, restore after."""
+    prior = paddle.get_flags(["FLAGS_enable_metrics"])["FLAGS_enable_metrics"]
+    obs.GLOBAL_METRICS.reset()
+    obs.GLOBAL_WATCHDOG.reset()
+    paddle.set_flags({"FLAGS_enable_metrics": True})
+    yield
+    paddle.set_flags({"FLAGS_enable_metrics": prior})
+
+
+@pytest.fixture
+def metrics_off():
+    prior = paddle.get_flags(["FLAGS_enable_metrics"])["FLAGS_enable_metrics"]
+    paddle.set_flags({"FLAGS_enable_metrics": False})
+    yield
+    paddle.set_flags({"FLAGS_enable_metrics": prior})
+
+
+class TestHistogramBuckets:
+    def test_log_scale_bounds(self, metrics_on):
+        reg = MetricsRegistry()
+        h = reg.histogram("h", start=1e-3, factor=4.0, count=5)
+        assert h.bounds == (1e-3, 4e-3, 16e-3, 64e-3, 256e-3)
+
+    def test_cumulative_counts_sum_count(self, metrics_on):
+        reg = MetricsRegistry()
+        h = reg.histogram("h", start=1.0, factor=2.0, count=4)  # le 1,2,4,8
+        for v in (0.5, 1.0, 3.0, 10.0):
+            h.observe(v)
+        # raw per-bucket (le semantics: 1.0 lands in the le=1 bucket)
+        assert h.bucket_counts() == [2, 0, 1, 0, 1]
+        assert h.count() == 4
+        assert h.sum() == pytest.approx(14.5)
+
+    def test_quantile_interpolation(self, metrics_on):
+        reg = MetricsRegistry()
+        h = reg.histogram("h", start=1.0, factor=2.0, count=4)
+        for v in (0.5, 1.0, 3.0, 10.0):
+            h.observe(v)
+        # q=0.5 -> target 2 falls exactly at the le=1 bucket's upper edge
+        assert h.quantile(0.5) == pytest.approx(1.0)
+        # q=0.75 -> target 3: bucket (2,4], one obs -> upper edge
+        assert h.quantile(0.75) == pytest.approx(4.0)
+        # overflow mass resolves to the largest finite bound
+        assert h.quantile(1.0) == pytest.approx(8.0)
+        assert reg.histogram("empty").quantile(0.9) == 0.0
+
+    def test_get_or_create_rejects_kind_mismatch(self, metrics_on):
+        reg = MetricsRegistry()
+        reg.counter("m")
+        with pytest.raises(ValueError, match="already registered"):
+            reg.gauge("m")
+
+
+class TestPrometheusGolden:
+    def test_text_exposition_format(self, metrics_on):
+        reg = MetricsRegistry()
+        c = reg.counter("requests_total", "Total requests.", labelnames=("code",))
+        c.labels(code="200").inc(3)
+        c.labels(code="500").inc()
+        g = reg.gauge("queue_depth", "Queue depth.")
+        g.set(7)
+        h = reg.histogram("latency_seconds", "Latency.", start=1.0, factor=2.0, count=4)
+        for v in (0.5, 1.0, 3.0, 10.0):
+            h.observe(v)
+        expected = (
+            "# HELP latency_seconds Latency.\n"
+            "# TYPE latency_seconds histogram\n"
+            'latency_seconds_bucket{le="1"} 2\n'
+            'latency_seconds_bucket{le="2"} 2\n'
+            'latency_seconds_bucket{le="4"} 3\n'
+            'latency_seconds_bucket{le="8"} 3\n'
+            'latency_seconds_bucket{le="+Inf"} 4\n'
+            "latency_seconds_sum 14.5\n"
+            "latency_seconds_count 4\n"
+            "# HELP queue_depth Queue depth.\n"
+            "# TYPE queue_depth gauge\n"
+            "queue_depth 7\n"
+            "# HELP requests_total Total requests.\n"
+            "# TYPE requests_total counter\n"
+            'requests_total{code="200"} 3\n'
+            'requests_total{code="500"} 1\n'
+        )
+        assert reg.render_prometheus() == expected
+
+    def test_label_escaping(self, metrics_on):
+        reg = MetricsRegistry()
+        reg.counter("c", labelnames=("p",)).labels(p='a"b\\c').inc()
+        assert r'c{p="a\"b\\c"} 1' in reg.render_prometheus()
+
+    def test_gauge_high_water(self, metrics_on):
+        reg = MetricsRegistry()
+        g = reg.gauge("util")
+        for v in (0.25, 0.875, 0.5):
+            g.set(v)
+        assert g.value() == 0.5
+        assert g.high_water() == 0.875
+
+
+class TestFlagGating:
+    def test_disabled_recording_is_noop(self, metrics_off):
+        reg = MetricsRegistry()
+        c = reg.counter("c")
+        g = reg.gauge("g")
+        h = reg.histogram("h")
+        c.inc(5)
+        g.set(3)
+        h.observe(1.0)
+        assert not obs.metrics_enabled()
+        assert c.value() == 0.0 and g.value() == 0.0 and h.count() == 0
+        assert reg.snapshot() == {}
+        assert reg.render_prometheus() == ""
+
+    def test_toggle_updates_cached_gate(self, metrics_off):
+        reg = MetricsRegistry()
+        c = reg.counter("c")
+        paddle.set_flags({"FLAGS_enable_metrics": True})
+        assert obs.metrics_enabled()
+        c.inc()
+        paddle.set_flags({"FLAGS_enable_metrics": False})
+        c.inc()
+        assert c.value() == 1.0
+
+    def test_new_flags_are_defined(self):
+        flags = paddle.get_flags(
+            ["FLAGS_enable_metrics", "FLAGS_metrics_port", "FLAGS_max_compiles_per_fn"]
+        )
+        assert isinstance(flags["FLAGS_enable_metrics"], bool)
+        assert flags["FLAGS_metrics_port"] == 0
+        assert flags["FLAGS_max_compiles_per_fn"] == 16
+
+
+class TestEnvSeeding:
+    """FLAGS_<name> env vars seed flag values at FIRST read."""
+
+    def test_env_seeds_global_registry_flag(self, monkeypatch):
+        name = "obs_env_seed_probe"
+        monkeypatch.setenv(f"FLAGS_{name}", "17")
+        GLOBAL_FLAGS.define(name, int, 3, "env-seeding test probe")
+        try:
+            assert GLOBAL_FLAGS.get(name) == 17
+        finally:
+            GLOBAL_FLAGS._flags.pop(name, None)
+
+    def test_env_seeds_each_new_flag_type(self, monkeypatch):
+        reg = FlagRegistry()
+        reg.define("enable_metrics", bool, False, "")
+        reg.define("metrics_port", int, 0, "")
+        reg.define("max_compiles_per_fn", int, 16, "")
+        monkeypatch.setenv("FLAGS_enable_metrics", "true")
+        monkeypatch.setenv("FLAGS_metrics_port", "9090")
+        monkeypatch.setenv("FLAGS_max_compiles_per_fn", "4")
+        assert reg.get("enable_metrics") is True
+        assert reg.get("metrics_port") == 9090
+        assert reg.get("max_compiles_per_fn") == 4
+
+    def test_explicit_set_beats_env(self, monkeypatch):
+        reg = FlagRegistry()
+        reg.define("metrics_port", int, 0, "")
+        reg.set("metrics_port", 7070)
+        monkeypatch.setenv("FLAGS_metrics_port", "9090")
+        assert reg.get("metrics_port") == 7070  # env only applies at FIRST read
+
+    def test_on_change_fires_for_set_and_env_seed(self, monkeypatch):
+        reg = FlagRegistry()
+        reg.define("a", int, 0, "")
+        reg.define("b", int, 0, "")
+        seen = []
+        reg.on_change("a", seen.append)
+        reg.on_change("b", seen.append)
+        reg.set("a", 5)
+        monkeypatch.setenv("FLAGS_b", "7")
+        reg.get("b")
+        assert seen == [5, 7]
+
+
+class TestExporters:
+    def test_http_endpoint_serves_prometheus_text(self, metrics_on):
+        obs.GLOBAL_METRICS.counter("http_probe_total", "probe").inc(2)
+        srv = obs.start_metrics_server(port=0)  # ephemeral port
+        try:
+            port = srv.server_address[1]
+            with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=5) as r:
+                assert r.status == 200
+                assert "text/plain" in r.headers["Content-Type"]
+                body = r.read().decode()
+            assert "http_probe_total 2" in body
+            assert "# TYPE http_probe_total counter" in body
+            # only /metrics is the exposition endpoint
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz", timeout=5)
+            assert ei.value.code == 404
+        finally:
+            obs.stop_metrics_server()
+
+    def test_server_disabled_when_flag_unset(self):
+        prior = paddle.get_flags(["FLAGS_metrics_port"])["FLAGS_metrics_port"]
+        paddle.set_flags({"FLAGS_metrics_port": 0})
+        try:
+            assert obs.start_metrics_server() is None
+        finally:
+            paddle.set_flags({"FLAGS_metrics_port": prior})
+
+    def test_jsonl_snapshots_and_trace_link_events(self, metrics_on, tmp_path):
+        obs.drain_trace_events()  # clear leftovers from other tests
+        obs.GLOBAL_METRICS.counter("snap_probe_total").inc(3)
+        path = str(tmp_path / "metrics.jsonl")
+        rec1 = obs.write_snapshot_jsonl(path)
+        obs.GLOBAL_METRICS.counter("snap_probe_total").inc()
+        rec2 = obs.write_snapshot_jsonl(path)
+        lines = open(path).read().splitlines()
+        assert len(lines) == 2
+        parsed = [json.loads(l) for l in lines]
+        assert parsed[0]["seq"] == rec1["seq"]
+        assert parsed[1]["seq"] == rec2["seq"] == rec1["seq"] + 1
+        vals = [p["metrics"]["snap_probe_total"]["values"][0]["value"] for p in parsed]
+        assert vals == [3.0, 4.0]
+        events = obs.drain_trace_events()
+        assert [e["name"] for e in events] == ["metrics_snapshot"] * 2
+        assert events[0]["ph"] == "i"
+        assert events[0]["args"] == {"path": path, "seq": rec1["seq"]}
+        assert obs.drain_trace_events() == []  # drained exactly once
+
+
+class TestRecompileWatchdog:
+    def test_ledger_and_budget_warning(self, metrics_on):
+        wd = obs.RecompileWatchdog(registry=MetricsRegistry())
+        prior = paddle.get_flags(["FLAGS_max_compiles_per_fn"])["FLAGS_max_compiles_per_fn"]
+        paddle.set_flags({"FLAGS_max_compiles_per_fn": 2})
+        try:
+            wd.record_compile("f", signature="[2,4]", cause=obs.CAUSE_FIRST_CALL)
+            wd.record_compile("f", signature="[3,4]", cause=obs.CAUSE_NEW_SHAPE_DTYPE)
+            wd.record_compile("f", signature="[5,4]", cause=obs.CAUSE_NEW_SHAPE_DTYPE)
+            # budget counts RE-compiles: 2 so far, within budget 2
+            with pytest.warns(obs.RecompileBudgetWarning, match="'f' recompiled 3 times"):
+                wd.record_compile("f", signature="[7,4]", cause=obs.CAUSE_NEW_SHAPE_DTYPE)
+            rep = wd.report()["f"]
+            assert rep["count"] == 4
+            assert rep["causes"] == {"first_call": 1, "new_shape_dtype": 3}
+            assert rep["signatures"] == ["[2,4]", "[3,4]", "[5,4]", "[7,4]"]
+            assert wd.total() == 4
+        finally:
+            paddle.set_flags({"FLAGS_max_compiles_per_fn": prior})
+
+    def test_first_call_compiles_never_trip_budget(self, metrics_on):
+        """Many engine/Layer instances share one fn name; their expected
+        once-per-instance first traces must not fire the retrace warning."""
+        import warnings
+
+        wd = obs.RecompileWatchdog(registry=MetricsRegistry())
+        prior = paddle.get_flags(["FLAGS_max_compiles_per_fn"])["FLAGS_max_compiles_per_fn"]
+        paddle.set_flags({"FLAGS_max_compiles_per_fn": 2})
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("error", obs.RecompileBudgetWarning)
+                for _ in range(20):
+                    wd.record_compile("Engine.prefill", cause=obs.CAUSE_FIRST_CALL)
+            assert wd.counts()["Engine.prefill"] == 20
+        finally:
+            paddle.set_flags({"FLAGS_max_compiles_per_fn": prior})
+
+    def test_budget_zero_disables_warning(self, metrics_on):
+        wd = obs.RecompileWatchdog(registry=MetricsRegistry())
+        prior = paddle.get_flags(["FLAGS_max_compiles_per_fn"])["FLAGS_max_compiles_per_fn"]
+        paddle.set_flags({"FLAGS_max_compiles_per_fn": 0})
+        try:
+            import warnings
+
+            with warnings.catch_warnings():
+                warnings.simplefilter("error", obs.RecompileBudgetWarning)
+                for i in range(50):
+                    wd.record_compile("f", cause=obs.CAUSE_NEW_SHAPE_DTYPE)
+        finally:
+            paddle.set_flags({"FLAGS_max_compiles_per_fn": prior})
+
+    def test_jit_cause_attribution(self, metrics_on):
+        """StaticFunction cache misses feed the watchdog with the right
+        causes: first trace, a new input-shape bucket, a train/eval flip."""
+        import paddle_tpu.nn as nn
+
+        paddle.seed(0)
+        model = nn.Linear(4, 4)
+
+        @paddle.jit.to_static
+        def f(model, x):
+            return model(x)
+
+        model.train()
+        f(model, paddle.randn([2, 4]))  # first_call
+        f(model, paddle.randn([3, 4]))  # new_shape_dtype
+        model.eval()
+        f(model, paddle.randn([3, 4]))  # mode_flip
+        f(model, paddle.randn([3, 4]))  # cache hit: no new compile
+        rep = obs.GLOBAL_WATCHDOG.report()
+        key = [k for k in rep if k.endswith(".f") or k == "f"]
+        assert len(key) == 1, rep
+        rec = rep[key[0]]
+        assert rec["count"] == 3
+        assert rec["causes"] == {
+            "first_call": 1,
+            "new_shape_dtype": 1,
+            "mode_flip": 1,
+        }
+        # the gated metric counter saw the same three compiles
+        c = obs.GLOBAL_METRICS.get("jit_compiles_total")
+        assert c.value(fn=key[0], cause="mode_flip") == 1
+        assert sum(
+            v["value"]
+            for v in c._snapshot_values()
+            if v["labels"]["fn"] == key[0]
+        ) == 3
+
+    def test_graph_break_is_not_counted_as_compile(self, metrics_on):
+        """A full_graph=False trace that graph-breaks to eager never produced
+        a compiled program — the watchdog must not count it."""
+
+        @paddle.jit.to_static(full_graph=False)
+        def g(x):
+            if float(x.sum()) > 0:  # concretization -> graph break
+                return x + 1
+            return x - 1
+
+        with pytest.warns(UserWarning, match="graph break"):
+            g(paddle.ones([2]))
+        g(paddle.ones([2]))  # guard-cache hit: eager again
+        assert not any(k == "g" or k.endswith(".g") for k in obs.GLOBAL_WATCHDOG.counts())
+
+
+class TestCollectiveCounters:
+    def test_single_process_collectives_counted(self, metrics_on):
+        import paddle_tpu.distributed as dist
+
+        t = paddle.ones([4])
+        dist.all_reduce(t)
+        dist.all_reduce(t)
+        dist.broadcast(t, src=0)
+        calls = obs.GLOBAL_METRICS.get("collective_calls_total")
+        assert calls.value(op="all_reduce") == 2
+        assert calls.value(op="broadcast") == 1
+        secs = obs.GLOBAL_METRICS.get("collective_seconds_total")
+        assert secs.value(op="all_reduce") >= 0.0
+
+    def test_disabled_collectives_not_counted(self, metrics_off):
+        import paddle_tpu.distributed as dist
+
+        obs.GLOBAL_METRICS.reset()
+        t = paddle.ones([4])
+        dist.all_reduce(t)
+        calls = obs.GLOBAL_METRICS.get("collective_calls_total")
+        assert calls.value(op="all_reduce") == 0

@@ -65,6 +65,47 @@ class TestProfiler:
         assert "visible" in names and "hidden" not in names
 
 
+class TestMetricsSnapshotLink:
+    def test_chrome_export_roundtrips_metrics_snapshots(self, tmp_path):
+        """Snapshots written via observability.write_snapshot_jsonl appear as
+        instant events in the chrome trace, round-tripped through
+        load_profiler_result alongside the RecordEvent spans."""
+        import paddle_tpu as paddle
+        from paddle_tpu import observability as obs
+
+        prior = paddle.get_flags(["FLAGS_enable_metrics"])["FLAGS_enable_metrics"]
+        paddle.set_flags({"FLAGS_enable_metrics": True})
+        obs.drain_trace_events()  # leftovers from other tests
+        try:
+            obs.GLOBAL_METRICS.reset()
+            obs.GLOBAL_METRICS.counter("roundtrip_probe_total").inc(2)
+            snap_path = str(tmp_path / "metrics.jsonl")
+            p = Profiler()
+            p.start()
+            with RecordEvent("span_a"):
+                rec1 = obs.write_snapshot_jsonl(snap_path)
+            rec2 = obs.write_snapshot_jsonl(snap_path)
+            p.stop()
+            out = str(tmp_path / "trace.json")
+            p.export(out)
+
+            data = profiler.load_profiler_result(out)
+            names = [e["name"] for e in data["traceEvents"]]
+            assert "span_a" in names
+            snaps = [e for e in data["traceEvents"] if e["name"] == "metrics_snapshot"]
+            assert [e["args"]["seq"] for e in snaps] == [rec1["seq"], rec2["seq"]]
+            assert all(e["ph"] == "i" and e["args"]["path"] == snap_path for e in snaps)
+            # the linked JSONL file carries the full registry snapshot
+            lines = open(snap_path).read().splitlines()
+            assert len(lines) == 2
+            parsed = json.loads(lines[0])
+            assert parsed["seq"] == rec1["seq"]
+            probe = parsed["metrics"]["roundtrip_probe_total"]["values"][0]
+            assert probe["value"] == 2.0
+        finally:
+            paddle.set_flags({"FLAGS_enable_metrics": prior})
+
+
 class TestBenchmarkTimer:
     def test_throughput(self):
         bm = benchmark()
