@@ -271,9 +271,9 @@ def main() -> None:
         # only lazily — register them before set_flags can see them.
         import paddle_tpu.kernels.autotune  # noqa: F401
 
-        os.environ.setdefault("PADDLE_TPU_AUTOTUNE_VERBOSE", "1")
         paddle.set_flags(
             {
+                "FLAGS_kernel_autotune_verbose": True,
                 "FLAGS_use_kernel_autotune": True,
                 # committed cache file: re-runs (and retries) skip the sweep
                 "FLAGS_kernel_autotune_cache": AUTOTUNE_CACHE,

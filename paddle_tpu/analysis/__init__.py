@@ -1,0 +1,47 @@
+"""Static analysis for the framework's own invariants.
+
+AST-based lint (no imports of the analyzed code) with pluggable checkers,
+stable codes, inline ``# analysis: disable=XX123 <reason>`` suppressions and
+text/JSON reporters. The tier-1 suite runs the whole-package analysis
+(``tests/test_static_analysis.py``), so every checker is a merge gate.
+
+Checker families:
+
+- **TS** trace-safety — host side effects inside ``@to_static``/``jax.jit``
+  traced functions (:mod:`.checkers.trace_safety`);
+- **PK** Pallas purity — impure kernel bodies / BlockSpec index maps
+  (:mod:`.checkers.pallas_purity`);
+- **FD** flag discipline — unresolvable flag strings, un-cached registry
+  reads in hot-path loops (:mod:`.checkers.flag_discipline`);
+- **EH** exception hygiene — bare/silent/unannotated broad excepts
+  (:mod:`.checkers.exception_hygiene`).
+
+CLI: ``python -m paddle_tpu.analysis [--format json] paddle_tpu/`` — exits
+non-zero on any unsuppressed violation.
+"""
+
+from paddle_tpu.analysis.checkers import CHECKER_CLASSES, all_checkers, all_codes  # noqa: F401
+from paddle_tpu.analysis.core import (  # noqa: F401
+    Checker,
+    FileContext,
+    ProjectContext,
+    Violation,
+    analyze_paths,
+    analyze_source,
+)
+from paddle_tpu.analysis.reporters import render_json, render_text, summarize  # noqa: F401
+
+__all__ = [
+    "Checker",
+    "FileContext",
+    "ProjectContext",
+    "Violation",
+    "analyze_paths",
+    "analyze_source",
+    "all_checkers",
+    "all_codes",
+    "CHECKER_CLASSES",
+    "render_json",
+    "render_text",
+    "summarize",
+]

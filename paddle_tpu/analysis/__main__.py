@@ -1,0 +1,61 @@
+"""CLI: ``python -m paddle_tpu.analysis [--format text|json] paths...``
+
+Exit status 0 when every violation is suppressed (with a reason), 1 when any
+unsuppressed violation remains, 2 on usage errors — so the same invocation
+works as a pre-commit hook and as the tier-1 gate."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from paddle_tpu.analysis.checkers import all_codes
+from paddle_tpu.analysis.core import analyze_paths
+from paddle_tpu.analysis.reporters import render_json, render_text
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.analysis",
+        description="AST static analysis: trace-safety (TS), Pallas purity (PK), "
+        "flag discipline (FD), exception hygiene (EH).",
+    )
+    ap.add_argument("paths", nargs="*", help="files or directories to analyze")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument(
+        "--select",
+        help="comma-separated code prefixes to run (e.g. TS,EH401); default all",
+    )
+    ap.add_argument(
+        "--show-suppressed", action="store_true",
+        help="include suppressed violations in text output",
+    )
+    ap.add_argument(
+        "--list-checkers", action="store_true", help="print codes and exit"
+    )
+    args = ap.parse_args(argv)
+
+    if args.list_checkers:
+        for code, desc in sorted(all_codes().items()):
+            print(f"{code}  {desc}")
+        return 0
+    if not args.paths:
+        ap.print_usage(sys.stderr)
+        return 2
+
+    select = [s.strip() for s in args.select.split(",")] if args.select else None
+    try:
+        violations = analyze_paths(args.paths, select=select)
+    except FileNotFoundError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+    if args.format == "json":
+        print(render_json(violations))
+    else:
+        print(render_text(violations, show_suppressed=args.show_suppressed))
+    return 1 if any(not v.suppressed for v in violations) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,40 @@
+"""Checker registry. Adding a checker = subclass :class:`Checker` in a module
+here and list it in :data:`CHECKER_CLASSES`; codes must be unique across the
+suite (enforced at import by :func:`all_checkers`)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Type
+
+from paddle_tpu.analysis.checkers.exception_hygiene import ExceptionHygieneChecker
+from paddle_tpu.analysis.checkers.flag_discipline import FlagDisciplineChecker
+from paddle_tpu.analysis.checkers.pallas_purity import PallasPurityChecker
+from paddle_tpu.analysis.checkers.trace_safety import TraceSafetyChecker
+from paddle_tpu.analysis.core import Checker
+
+__all__ = ["CHECKER_CLASSES", "all_checkers", "all_codes"]
+
+CHECKER_CLASSES: List[Type[Checker]] = [
+    TraceSafetyChecker,
+    PallasPurityChecker,
+    FlagDisciplineChecker,
+    ExceptionHygieneChecker,
+]
+
+
+def all_checkers() -> List[Checker]:
+    checkers = [cls() for cls in CHECKER_CLASSES]
+    seen: Dict[str, str] = {}
+    for c in checkers:
+        for code in c.codes:
+            if code in seen:
+                raise ValueError(f"checker code {code} defined by both {seen[code]} and {c.name}")
+            seen[code] = c.name
+    return checkers
+
+
+def all_codes() -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for c in all_checkers():
+        out.update(c.codes)
+    return out

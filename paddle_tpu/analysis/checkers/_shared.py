@@ -1,0 +1,95 @@
+"""AST helpers shared by the checker suite."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+# names whose attribute calls reach the observability layer (metrics registry
+# or recompile watchdog) — host-side state no traced/kernel body may touch
+OBSERVABILITY_ROOTS = {"GLOBAL_METRICS", "GLOBAL_WATCHDOG"}
+OBSERVABILITY_CALLS = {
+    "get_registry",
+    "get_watchdog",
+    "metrics_enabled",
+    "record_compile",
+    "write_snapshot_jsonl",
+    "start_metrics_server",
+    "drain_trace_events",
+}
+
+
+def attr_root(node: ast.AST) -> Optional[str]:
+    """Leftmost Name of an attribute chain: ``a.b.c`` -> ``a``."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def attr_chain(node: ast.AST) -> Optional[str]:
+    """Dotted form of a Name/Attribute chain, or None for anything else."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def is_os_environ(node: ast.AST) -> bool:
+    return attr_chain(node) in ("os.environ", "environ")
+
+
+def const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def bound_names(fn: ast.AST) -> Set[str]:
+    """Names bound inside a function: parameters plus every Store/for/with/
+    comprehension target — used to separate closure/global reads from locals."""
+    names: Set[str] = set()
+    if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        a = fn.args
+        for arg in (*a.posonlyargs, *a.args, *a.kwonlyargs):
+            names.add(arg.arg)
+        if a.vararg:
+            names.add(a.vararg.arg)
+        if a.kwarg:
+            names.add(a.kwarg.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            names.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            names.add(node.name)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                names.add((alias.asname or alias.name).split(".")[0])
+    return names
+
+
+def body_walk(fn: ast.AST) -> Iterable[ast.AST]:
+    """Walk a function's body (decorators/defaults of the function itself are
+    not part of what executes when it runs)."""
+    if isinstance(fn, ast.Lambda):
+        yield from ast.walk(fn.body)
+        return
+    for stmt in getattr(fn, "body", []):
+        yield from ast.walk(stmt)
+
+
+def func_params(fn: ast.AST) -> Set[str]:
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        return set()
+    a = fn.args
+    names = {arg.arg for arg in (*a.posonlyargs, *a.args, *a.kwonlyargs)}
+    if a.vararg:
+        names.add(a.vararg.arg)
+    if a.kwarg:
+        names.add(a.kwarg.arg)
+    return names

@@ -1,0 +1,117 @@
+"""EH — exception hygiene checker.
+
+Broad exception handlers are how a framework converts hard faults into
+silent wrong answers (a Pallas lowering error swallowed into a "fallback"
+that never fires again, a store outage read as "worker healthy"). The rules:
+
+- EH401  bare ``except:`` — never allowed (it also catches KeyboardInterrupt
+         and SystemExit);
+- EH402  ``except Exception:`` (or BaseException, or a tuple containing one)
+         whose body is only ``pass``/``...`` — a silent swallower; either
+         handle/log it or suppress with a stated reason;
+- EH403  broad ``except`` with no reason comment — every broad catch must
+         state why breadth is correct, either on the handler line itself or
+         as a comment-only line opening the handler body (both idioms are
+         established in this codebase).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List
+
+from paddle_tpu.analysis.core import Checker, FileContext, Violation
+
+_BROAD = {"Exception", "BaseException"}
+
+# lint-silencing tags are not reasons: a comment consisting only of these
+# carries no information about WHY breadth is correct
+_TAG_RES = (
+    re.compile(r"noqa(?::\s*[A-Z]+\d*(?:\s*,\s*[A-Z]+\d*)*)?"),
+    re.compile(r"type:\s*ignore(?:\[[^\]]*\])?"),
+    re.compile(r"pragma:\s*no\s*cover"),
+    re.compile(r"analysis:\s*disable=[A-Z0-9, ]+"),
+)
+
+
+def _states_reason(line: str) -> bool:
+    if "#" not in line:
+        return False
+    comment = line.split("#", 1)[1].replace("#", " ")
+    for tag in _TAG_RES:
+        comment = tag.sub(" ", comment)
+    return bool(re.search(r"[A-Za-z]", comment))
+
+
+def _is_broad(type_node: ast.AST) -> bool:
+    if isinstance(type_node, ast.Name):
+        return type_node.id in _BROAD
+    if isinstance(type_node, ast.Tuple):
+        return any(_is_broad(e) for e in type_node.elts)
+    return False
+
+
+def _is_silent(body: List[ast.stmt]) -> bool:
+    for stmt in body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if (
+            isinstance(stmt, ast.Expr)
+            and isinstance(stmt.value, ast.Constant)
+            and stmt.value.value is Ellipsis
+        ):
+            continue
+        return False
+    return True
+
+
+class ExceptionHygieneChecker(Checker):
+    name = "exception-hygiene"
+    codes = {
+        "EH401": "bare except",
+        "EH402": "broad except silently swallowing (body is only pass)",
+        "EH403": "broad except without a reason comment (handler line or body-opening comment)",
+    }
+
+    def run(self, ctx: FileContext) -> List[Violation]:
+        out: List[Violation] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            loc = (ctx.path, node.lineno, node.col_offset)
+            if node.type is None:
+                out.append(
+                    Violation(*loc, "EH401",
+                              "bare except: catches KeyboardInterrupt/SystemExit; "
+                              "name the exception type")
+                )
+                continue
+            if not _is_broad(node.type):
+                continue
+            if _is_silent(node.body):
+                out.append(
+                    Violation(*loc, "EH402",
+                              "broad except with silent pass body swallows every "
+                              "error; handle/log it, narrow the type, or suppress "
+                              "with a stated reason")
+                )
+            elif not self._has_reason_comment(ctx, node):
+                out.append(
+                    Violation(*loc, "EH403",
+                              "broad except without a reason comment; state why "
+                              "catching Exception is correct (on this line or a "
+                              "comment line opening the body), or narrow the type")
+                )
+        return out
+
+    def _has_reason_comment(self, ctx: FileContext, node: ast.ExceptHandler) -> bool:
+        if _states_reason(ctx.lines[node.lineno - 1]):
+            return True
+        # comment-only lines between the handler line and its first statement
+        first = node.body[0].lineno if node.body else node.lineno + 1
+        for idx in range(node.lineno, min(first - 1, len(ctx.lines))):
+            stripped = ctx.lines[idx].lstrip()
+            if stripped.startswith("#") and _states_reason(stripped):
+                return True
+        return False

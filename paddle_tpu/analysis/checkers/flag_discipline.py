@@ -1,0 +1,134 @@
+"""FD — flag discipline checker.
+
+The flag registry (``paddle_tpu/flags.py``) is stringly typed: a misspelled
+name in ``GLOBAL_FLAGS.get("...")`` or a stale ``FLAGS_<name>`` env reference
+fails only when that line finally runs (or worse, an env var silently stops
+doing anything). FD301 resolves every statically-visible flag string against
+the project's defined-flag universe (flags.py definitions plus every
+``define_flag(...)`` in the analyzed file set).
+
+FD302 enforces the hot-path idiom established by the observability layer:
+``registry.get()`` takes the registry lock, so a flag read inside a loop in a
+hot-path module (kernels/inference/core/observability/jit) must instead use a
+module-local cached by an ``on_change`` listener (see
+``observability/metrics.py``'s ``_ENABLED`` for the pattern).
+
+Codes:
+
+- FD301  flag string does not resolve to a defined flag
+- FD302  registry flag read inside a loop in a hot-path module
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Tuple
+
+from paddle_tpu.analysis.checkers._shared import attr_chain, const_str
+from paddle_tpu.analysis.core import Checker, FileContext, Violation
+
+_LOOPS = (ast.For, ast.AsyncFor, ast.While, ast.ListComp, ast.SetComp,
+          ast.DictComp, ast.GeneratorExp)
+_ENV_GETTERS = {"os.environ.get", "environ.get", "os.getenv"}
+
+
+def _registry_accessor(call: ast.Call) -> Optional[str]:
+    """'get'/'set' when the call is ``GLOBAL_FLAGS.get/set(...)``."""
+    fn = call.func
+    if (
+        isinstance(fn, ast.Attribute)
+        and fn.attr in ("get", "set")
+        and isinstance(fn.value, ast.Name)
+        and fn.value.id == "GLOBAL_FLAGS"
+    ):
+        return fn.attr
+    return None
+
+
+def _flag_strings(call: ast.Call) -> Iterable[Tuple[str, str]]:
+    """Yield (flag_name, how) for every statically-resolvable flag string the
+    call references. FLAGS_ prefixes are stripped for env-var forms."""
+    chain = attr_chain(call.func) or ""
+    # match get_flags/set_flags by trailing name so the public attribute-
+    # qualified spellings (paddle.set_flags, paddle_tpu.get_flags) are
+    # resolved too, not just bare-name imports
+    name = chain.split(".")[-1] if chain else None
+    if _registry_accessor(call) and call.args:
+        s = const_str(call.args[0])
+        if s is not None:
+            yield s, f"GLOBAL_FLAGS.{call.func.attr}()"  # type: ignore[union-attr]
+    elif name == "get_flags" and call.args:
+        arg = call.args[0]
+        items = arg.elts if isinstance(arg, (ast.List, ast.Tuple)) else [arg]
+        for item in items:
+            s = const_str(item)
+            if s is not None:
+                yield s.removeprefix("FLAGS_"), "get_flags()"
+    elif name == "set_flags" and call.args and isinstance(call.args[0], ast.Dict):
+        for k in call.args[0].keys:
+            s = const_str(k) if k is not None else None
+            if s is not None:
+                yield s.removeprefix("FLAGS_"), "set_flags()"
+    elif chain in _ENV_GETTERS or chain.endswith(".setenv") or chain.endswith(".delenv"):
+        if call.args:
+            s = const_str(call.args[0])
+            if s is not None and s.startswith("FLAGS_"):
+                yield s.removeprefix("FLAGS_"), f"env reference '{s}'"
+
+
+class FlagDisciplineChecker(Checker):
+    name = "flag-discipline"
+    codes = {
+        "FD301": "flag string does not resolve to a defined flag",
+        "FD302": "registry flag read inside a loop in a hot-path module",
+    }
+
+    def run(self, ctx: FileContext) -> List[Violation]:
+        known = ctx.project.known_flags
+        out: List[Violation] = []
+        for node in ast.walk(ctx.tree):
+            # env subscripts: os.environ["FLAGS_x"]
+            if (
+                isinstance(node, ast.Subscript)
+                and attr_chain(node.value) in ("os.environ", "environ")
+            ):
+                s = const_str(node.slice)
+                if s is not None and s.startswith("FLAGS_"):
+                    flag = s.removeprefix("FLAGS_")
+                    if flag not in known:
+                        out.append(self._fd301(ctx, node, flag, f"env subscript '{s}'"))
+            if not isinstance(node, ast.Call):
+                continue
+            for flag, how in _flag_strings(node):
+                if flag not in known:
+                    out.append(self._fd301(ctx, node, flag, how))
+            if ctx.hot_path and self._is_loop_read(node, ctx):
+                out.append(
+                    Violation(
+                        ctx.path, node.lineno, node.col_offset, "FD302",
+                        "flag registry read inside a loop in a hot-path module; "
+                        "cache the value in a local via an on_change listener "
+                        "(see observability/metrics.py)",
+                    )
+                )
+        return out
+
+    def _fd301(self, ctx: FileContext, node: ast.AST, flag: str, how: str) -> Violation:
+        return Violation(
+            ctx.path, node.lineno, node.col_offset, "FD301",
+            f"{how} references undefined flag '{flag}'; define it via "
+            "define_flag()/flags.py or fix the name",
+        )
+
+    def _is_loop_read(self, node: ast.Call, ctx: FileContext) -> bool:
+        is_read = _registry_accessor(node) == "get" or (
+            isinstance(node.func, ast.Name) and node.func.id == "get_flags"
+        )
+        if not is_read:
+            return False
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, _LOOPS):
+                return True
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                return False
+        return False

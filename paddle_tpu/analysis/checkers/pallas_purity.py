@@ -1,0 +1,206 @@
+"""PK — Pallas purity checker.
+
+Kernel bodies and BlockSpec index maps handed to ``pl.pallas_call`` are
+staged onto the TPU by Mosaic: any host state they touch is read once at
+lowering and frozen into the compiled kernel. The ragged paged-attention
+guarantees (zero-cost padding via the clamped index map + ``pl.when`` compute
+skip) hold only while these functions stay pure functions of their refs and
+grid indices.
+
+Kernel discovery is two-pronged and documented rather than clever:
+
+1. resolved — the first argument of every ``pallas_call(...)`` (a function
+   name, a ``functools.partial(kernel, ...)``, possibly through one local
+   ``kernel = partial(...)`` assignment, or an inline lambda), plus the index
+   map of every ``BlockSpec(...)`` in the file (second positional argument or
+   ``index_map=`` keyword);
+2. convention — any function whose name ends in ``_kernel`` (this codebase's
+   naming rule; kernels that reach ``pallas_call`` through a helper parameter,
+   as in ``kernels/fused.py``, are only caught this way).
+
+Codes:
+
+- PK201  flag read inside a kernel body / index map
+- PK202  metrics-registry / watchdog call inside a kernel body / index map
+- PK203  kernel body / index map closes over mutable module state
+- PK204  host I/O (print/open/os.environ/time) inside a kernel body / index map
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from paddle_tpu.analysis.checkers._shared import (
+    OBSERVABILITY_CALLS,
+    OBSERVABILITY_ROOTS,
+    attr_chain,
+    attr_root,
+    body_walk,
+    bound_names,
+    is_os_environ,
+)
+from paddle_tpu.analysis.core import Checker, FileContext, Violation
+
+_FLAG_CALLS = {"get_flags", "set_flags", "define_flag"}
+
+
+def _mutable_module_globals(tree: ast.Module) -> Set[str]:
+    """Module-level bindings a pure kernel must not read: plain assignments to
+    non-constant values whose name is not an ALL_CAPS constant. Imports,
+    function/class defs and literal constants are exempt."""
+    out: Set[str] = set()
+    for stmt in tree.body:
+        targets: List[ast.expr] = []
+        value: Optional[ast.expr] = None
+        if isinstance(stmt, ast.Assign):
+            targets, value = stmt.targets, stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets, value = [stmt.target], stmt.value
+        if value is None or isinstance(value, ast.Constant):
+            continue
+        if isinstance(value, ast.UnaryOp) and isinstance(value.operand, ast.Constant):
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name) and not t.id.strip("_").isupper():
+                out.add(t.id)
+    return out
+
+
+class _KernelCollector(ast.NodeVisitor):
+    def __init__(self) -> None:
+        self.defs: Dict[str, List[ast.AST]] = {}
+        self.kernels: Dict[int, Tuple[ast.AST, str]] = {}  # id -> (node, role)
+        self._pending: List[Tuple[str, str]] = []  # (name, role)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self.defs.setdefault(node.name, []).append(node)
+        if node.name.endswith("_kernel"):
+            self.kernels[id(node)] = (node, "kernel body")
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    def _partial_target(self, call: ast.Call) -> Optional[str]:
+        if attr_chain(call.func) in ("functools.partial", "partial") and call.args:
+            if isinstance(call.args[0], ast.Name):
+                return call.args[0].id
+        return None
+
+    def _resolve_kernel_arg(self, arg: ast.AST, scope: Optional[ast.AST]) -> None:
+        if isinstance(arg, ast.Lambda):
+            self.kernels[id(arg)] = (arg, "kernel body")
+        elif isinstance(arg, ast.Call):
+            name = self._partial_target(arg)
+            if name:
+                self._pending.append((name, "kernel body"))
+        elif isinstance(arg, ast.Name):
+            # follow one `k = functools.partial(fn, ...)` hop in the enclosing
+            # function before falling back to a def of the same name
+            target = arg.id
+            if scope is not None:
+                for node in ast.walk(scope):
+                    if (
+                        isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Call)
+                        and any(
+                            isinstance(t, ast.Name) and t.id == target
+                            for t in node.targets
+                        )
+                    ):
+                        name = self._partial_target(node.value)
+                        if name:
+                            target = name
+            self._pending.append((target, "kernel body"))
+
+    def collect(self, ctx: FileContext) -> List[Tuple[ast.AST, str]]:
+        self.visit(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func) or ""
+            if chain.split(".")[-1] == "pallas_call" and node.args:
+                scope = next(
+                    (
+                        a
+                        for a in ctx.ancestors(node)
+                        if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    ),
+                    None,
+                )
+                self._resolve_kernel_arg(node.args[0], scope)
+            elif chain.split(".")[-1] == "BlockSpec":
+                imap: Optional[ast.AST] = None
+                if len(node.args) >= 2:
+                    imap = node.args[1]
+                for kw in node.keywords:
+                    if kw.arg == "index_map":
+                        imap = kw.value
+                if isinstance(imap, ast.Lambda):
+                    self.kernels[id(imap)] = (imap, "index map")
+                elif isinstance(imap, ast.Name):
+                    self._pending.append((imap.id, "index map"))
+        for name, role in self._pending:
+            for fn in self.defs.get(name, ()):
+                self.kernels.setdefault(id(fn), (fn, role))
+        return list(self.kernels.values())
+
+
+class PallasPurityChecker(Checker):
+    name = "pallas-purity"
+    codes = {
+        "PK201": "flag read inside a Pallas kernel/index map",
+        "PK202": "metrics/watchdog call inside a Pallas kernel/index map",
+        "PK203": "Pallas kernel/index map closes over mutable module state",
+        "PK204": "host I/O inside a Pallas kernel/index map",
+    }
+
+    def run(self, ctx: FileContext) -> List[Violation]:
+        mutables = _mutable_module_globals(ctx.tree)
+        out: List[Violation] = []
+        for fn, role in _KernelCollector().collect(ctx):
+            label = getattr(fn, "name", "<lambda>")
+            local = bound_names(fn)
+            for node in body_walk(fn):
+                v = self._check_node(node, local, mutables, role, label)
+                if v is not None:
+                    code, msg = v
+                    out.append(
+                        Violation(ctx.path, node.lineno, node.col_offset, code, msg)
+                    )
+        return out
+
+    def _check_node(
+        self,
+        node: ast.AST,
+        local: Set[str],
+        mutables: Set[str],
+        role: str,
+        label: str,
+    ):
+        where = f"in {role} '{label}'"
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            if node.id == "GLOBAL_FLAGS":
+                return "PK201", f"flag registry reference {where}: kernels must not read flags"
+            if node.id in mutables and node.id not in local:
+                return "PK203", (
+                    f"'{node.id}' {where} closes over mutable module state; "
+                    "pass it as a kernel argument or bake it via functools.partial"
+                )
+        if is_os_environ(node) and not isinstance(node, ast.Call):
+            return "PK204", f"os.environ access {where}"
+        if not isinstance(node, ast.Call):
+            return None
+        chain = attr_chain(node.func)
+        root = attr_root(node.func)
+        if isinstance(node.func, ast.Name) and node.func.id in _FLAG_CALLS:
+            return "PK201", f"{node.func.id}() {where}: kernels must not touch flags"
+        if root in OBSERVABILITY_ROOTS or (
+            isinstance(node.func, ast.Name) and node.func.id in OBSERVABILITY_CALLS
+        ):
+            return "PK202", f"observability call {where}"
+        if isinstance(node.func, ast.Name) and node.func.id in ("print", "open"):
+            return "PK204", f"{node.func.id}() {where}"
+        if root == "time" and isinstance(node.func, ast.Attribute):
+            return "PK204", f"{chain}() {where}"
+        return None

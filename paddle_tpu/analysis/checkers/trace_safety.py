@@ -1,0 +1,169 @@
+"""TS — trace-safety checker.
+
+A function whose body is captured by tracing (``@to_static`` / ``@jax.jit``
+decorated, or passed to ``jax.jit(...)`` / ``to_static(...)``) executes its
+Python exactly once per compile, not once per call. Host side effects inside
+such a body are therefore bugs of the recorded-at-trace-time class this
+codebase has already been bitten by (PR 2's "record compiles only after the
+trace succeeds" rule): they fire on compiles, not calls, and silently stop
+firing when the compile cache hits.
+
+Codes:
+
+- TS101  ``print(...)`` inside a traced function
+- TS102  ``time.*`` call inside a traced function
+- TS103  ``os.environ`` / ``os.getenv`` access inside a traced function
+- TS104  metrics-registry / recompile-watchdog call inside a traced function
+- TS105  ``float()/int()/bool()/.item()/.numpy()/.tolist()`` on a traced
+         function's parameter (forces device sync / breaks the trace)
+- TS106  ``global`` declaration inside a traced function (trace-time global
+         mutation)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from paddle_tpu.analysis.checkers._shared import (
+    OBSERVABILITY_CALLS,
+    OBSERVABILITY_ROOTS,
+    attr_chain,
+    attr_root,
+    body_walk,
+    func_params,
+    is_os_environ,
+)
+from paddle_tpu.analysis.core import Checker, FileContext, Violation
+
+_JIT_CHAINS = {"jax.jit", "to_static", "jit.to_static", "paddle_tpu.jit.to_static"}
+_SYNC_ATTRS = {"item", "numpy", "tolist"}
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    chain = attr_chain(dec.func if isinstance(dec, ast.Call) else dec)
+    return chain in _JIT_CHAINS
+
+
+class _TracedFunctions(ast.NodeVisitor):
+    """Collect every function whose body runs under trace: decorated defs,
+    plus defs/lambdas/methods handed to ``jax.jit`` / ``to_static`` calls."""
+
+    def __init__(self) -> None:
+        self.by_name: Dict[str, List[ast.AST]] = {}
+        self.methods: Dict[str, List[ast.AST]] = {}
+        self.traced: Dict[int, ast.AST] = {}
+        self._pending_names: Set[str] = set()
+        self._pending_methods: Set[str] = set()
+
+    def _record_def(self, node: ast.AST) -> None:
+        self.by_name.setdefault(node.name, []).append(node)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._record_def(node)
+        if any(_is_jit_decorator(d) for d in node.decorator_list):
+            self.traced[id(node)] = node
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.methods.setdefault(item.name, []).append(item)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        chain = attr_chain(node.func)
+        if chain in _JIT_CHAINS and node.args:
+            target = node.args[0]
+            if isinstance(target, ast.Lambda):
+                self.traced[id(target)] = target
+            elif isinstance(target, ast.Name):
+                self._pending_names.add(target.id)
+            elif isinstance(target, ast.Attribute) and isinstance(target.value, ast.Name):
+                if target.value.id == "self":
+                    self._pending_methods.add(target.attr)
+        self.generic_visit(node)
+
+    def resolve(self, tree: ast.Module) -> List[ast.AST]:
+        self.visit(tree)
+        for name in self._pending_names:
+            for fn in self.by_name.get(name, ()):
+                self.traced[id(fn)] = fn
+        for name in self._pending_methods:
+            for fn in self.methods.get(name, ()):
+                self.traced[id(fn)] = fn
+        return list(self.traced.values())
+
+
+class TraceSafetyChecker(Checker):
+    name = "trace-safety"
+    codes = {
+        "TS101": "print() inside a traced function",
+        "TS102": "time.* call inside a traced function",
+        "TS103": "os.environ access inside a traced function",
+        "TS104": "metrics/watchdog call inside a traced function",
+        "TS105": "host materialization of a traced function's parameter",
+        "TS106": "global declaration inside a traced function",
+    }
+
+    def run(self, ctx: FileContext) -> List[Violation]:
+        out: List[Violation] = []
+        for fn in _TracedFunctions().resolve(ctx.tree):
+            label = getattr(fn, "name", "<lambda>")
+            params = func_params(fn)
+            for node in body_walk(fn):
+                v = self._check_node(node, params, label)
+                if v is not None:
+                    code, msg = v
+                    out.append(
+                        Violation(ctx.path, node.lineno, node.col_offset, code, msg)
+                    )
+        return out
+
+    def _check_node(self, node: ast.AST, params: Set[str], label: str):
+        where = f"in traced function '{label}'"
+        if isinstance(node, ast.Global):
+            return "TS106", f"global declaration {where}: trace-time global mutation"
+        if is_os_environ(node) and not isinstance(node, ast.Call):
+            return "TS103", f"os.environ access {where}: read once at trace, then baked"
+        if not isinstance(node, ast.Call):
+            return None
+        chain = attr_chain(node.func)
+        root = attr_root(node.func)
+        if chain == "print" or (isinstance(node.func, ast.Name) and node.func.id == "print"):
+            return "TS101", f"print() {where}: fires per compile, not per call"
+        if root == "time" and isinstance(node.func, ast.Attribute):
+            return "TS102", f"{chain}() {where}: measures trace time, not run time"
+        if chain in ("os.getenv", "os.putenv"):
+            return "TS103", f"{chain}() {where}: read once at trace, then baked"
+        if root in OBSERVABILITY_ROOTS or (
+            isinstance(node.func, ast.Name) and node.func.id in OBSERVABILITY_CALLS
+        ):
+            return "TS104", (
+                f"observability call {where}: record at the jit call site, "
+                "after the trace succeeds"
+            )
+        if (
+            isinstance(node.func, ast.Name)
+            and node.func.id in ("float", "int", "bool")
+            and len(node.args) == 1
+            and isinstance(node.args[0], ast.Name)
+            and node.args[0].id in params
+        ):
+            return "TS105", (
+                f"{node.func.id}() on parameter '{node.args[0].id}' {where}: "
+                "concretizes a tracer"
+            )
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _SYNC_ATTRS
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id in params
+        ):
+            return "TS105", (
+                f".{node.func.attr}() on parameter '{node.func.value.id}' {where}: "
+                "concretizes a tracer"
+            )
+        return None

@@ -1,0 +1,319 @@
+"""Visitor core of the static-analysis framework.
+
+Framework-invariant AST lint: everything here operates on ``ast`` trees and
+raw source lines only — no imports of the modules under analysis, so the
+analyzer can run on a checkout where ``jax`` is broken or absent, and the
+same pass works on any Python codebase that adopts the checker conventions.
+
+Pieces:
+
+- :class:`Violation` — one finding, with a stable code (``TS101``), location,
+  and suppression state;
+- :class:`Checker` — pluggable checker base; concrete checkers live in
+  :mod:`paddle_tpu.analysis.checkers` and register via ``all_checkers()``;
+- inline suppressions — ``# analysis: disable=TS101 <reason>`` on the
+  violating line (or an immediately preceding comment-only line) suppresses
+  that code there; a suppression **must** carry a reason string, otherwise
+  the violation stays live and is additionally marked as reason-less;
+- :func:`analyze_paths` / :func:`analyze_source` — drivers that parse files,
+  build the cross-file :class:`ProjectContext` (the defined-flag universe for
+  the FD checkers), run every checker, and resolve suppressions.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+__all__ = [
+    "Violation",
+    "Checker",
+    "FileContext",
+    "ProjectContext",
+    "analyze_paths",
+    "analyze_source",
+    "iter_python_files",
+    "parent_map",
+]
+
+# modules whose code runs per-op / per-step / per-token: flag reads inside
+# loops here must go through an on_change-cached local (FD302)
+HOT_PATH_DIR_NAMES = ("kernels", "inference", "core", "observability", "jit")
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*analysis:\s*disable=([A-Z]{2,3}\d{3}(?:\s*,\s*[A-Z]{2,3}\d{3})*)"
+    r"(?:[ \t]+(\S.*?))?\s*$"
+)
+
+
+@dataclass
+class Violation:
+    """One finding. ``suppressed`` is resolved by the driver, not checkers."""
+
+    path: str
+    line: int
+    col: int
+    code: str
+    message: str
+    suppressed: bool = False
+    reason: Optional[str] = None  # the suppression's stated reason, if any
+
+    def format(self) -> str:
+        tag = f" [suppressed: {self.reason}]" if self.suppressed else ""
+        return f"{self.path}:{self.line}:{self.col}: {self.code} {self.message}{tag}"
+
+
+@dataclass
+class ProjectContext:
+    """Cross-file facts shared by all checkers in one run."""
+
+    # every flag name registered via flags.py / define_flag across the run's
+    # file set (plus the always-scanned canonical flags.py)
+    known_flags: Set[str] = field(default_factory=set)
+
+
+@dataclass
+class FileContext:
+    path: str
+    lines: List[str]
+    tree: ast.Module
+    project: ProjectContext
+    hot_path: bool
+    # child -> parent links for the whole tree (ancestor queries: loop
+    # enclosure for FD302, class resolution for jax.jit(self._method))
+    parents: Dict[ast.AST, ast.AST] = field(default_factory=dict)
+
+    def ancestors(self, node: ast.AST) -> Iterable[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self.parents.get(cur)
+
+
+class Checker:
+    """Base class. Subclasses set ``name`` and ``codes`` (code -> one-line
+    description) and implement :meth:`run` returning violations with
+    ``suppressed`` left False — the driver resolves suppressions."""
+
+    name: str = "base"
+    codes: Dict[str, str] = {}
+
+    def run(self, ctx: FileContext) -> List[Violation]:
+        raise NotImplementedError
+
+
+def parent_map(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def _suppressions_for_line(lines: Sequence[str], lineno: int) -> List[Tuple[Set[str], str]]:
+    """All (codes, reason) directives governing ``lineno`` (1-based): an
+    ``# analysis: disable=`` comment on the line itself and/or on an
+    immediately preceding comment-only line. Reasons may be empty — the
+    caller decides what that means."""
+    out: List[Tuple[Set[str], str]] = []
+    for idx in (lineno - 1, lineno - 2):
+        if 0 <= idx < len(lines):
+            if idx == lineno - 2 and not lines[idx].lstrip().startswith("#"):
+                continue
+            m = _SUPPRESS_RE.search(lines[idx])
+            if m:
+                codes = {c.strip() for c in m.group(1).split(",")}
+                out.append((codes, (m.group(2) or "").strip()))
+    return out
+
+
+def _resolve_suppressions(violations: List[Violation], lines: Sequence[str]) -> None:
+    for v in violations:
+        matches = [
+            reason
+            for codes, reason in _suppressions_for_line(lines, v.line)
+            if v.code in codes
+        ]
+        if not matches:
+            continue
+        reasons = [r for r in matches if r]
+        if reasons:
+            v.suppressed = True
+            v.reason = reasons[0]
+        else:
+            # a reason-less suppression does NOT suppress: the acceptance
+            # contract is "every suppression carries a reason"
+            v.message += " (suppression ignored: missing reason string)"
+
+
+def _is_hot_path(path: Path) -> bool:
+    return any(part in HOT_PATH_DIR_NAMES for part in path.parts)
+
+
+# -- defined-flag collection (FD checker universe) ---------------------------
+
+def _collect_flags_from_tree(tree: ast.Module) -> Set[str]:
+    """Flag names defined in one module: ``define_flag("name", ...)``,
+    ``GLOBAL_FLAGS.define("name", ...)``, and calls through a local alias of
+    ``GLOBAL_FLAGS.define`` (the ``d = GLOBAL_FLAGS.define`` idiom in
+    flags.py)."""
+    flags: Set[str] = set()
+    aliases: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Attribute):
+            val = node.value
+            if (
+                val.attr == "define"
+                and isinstance(val.value, ast.Name)
+                and val.value.id == "GLOBAL_FLAGS"
+            ):
+                aliases.update(t.id for t in node.targets if isinstance(t, ast.Name))
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        fn = node.func
+        named = isinstance(fn, ast.Name) and (fn.id == "define_flag" or fn.id in aliases)
+        attr = (
+            isinstance(fn, ast.Attribute)
+            and fn.attr == "define"
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id == "GLOBAL_FLAGS"
+        )
+        if (named or attr) and isinstance(node.args[0], ast.Constant) and isinstance(
+            node.args[0].value, str
+        ):
+            flags.add(node.args[0].value)
+    return flags
+
+
+def _canonical_flags_py() -> Optional[Path]:
+    p = Path(__file__).resolve().parents[1] / "flags.py"
+    return p if p.is_file() else None
+
+
+def build_project_context(
+    trees: Iterable[ast.Module], extra_flags: Iterable[str] = ()
+) -> ProjectContext:
+    ctx = ProjectContext()
+    ctx.known_flags.update(extra_flags)
+    canonical = _canonical_flags_py()
+    if canonical is not None:
+        try:
+            ctx.known_flags |= _collect_flags_from_tree(
+                ast.parse(canonical.read_text(encoding="utf-8"))
+            )
+        except SyntaxError:
+            pass  # a broken flags.py surfaces as its own parse error elsewhere
+    for tree in trees:
+        ctx.known_flags |= _collect_flags_from_tree(tree)
+    return ctx
+
+
+# -- drivers -----------------------------------------------------------------
+
+def iter_python_files(paths: Sequence[str]) -> List[Path]:
+    """Expand files/directories to .py files. A path that does not exist is a
+    hard error — a typo'd target must not turn the CI gate into a vacuous
+    zero-file pass."""
+    out: List[Path] = []
+    for p in paths:
+        path = Path(p)
+        if path.is_dir():
+            out.extend(sorted(path.rglob("*.py")))
+        elif path.is_file():
+            if path.suffix == ".py":
+                out.append(path)
+        else:
+            raise FileNotFoundError(f"no such file or directory: {p}")
+    if paths and not out:
+        # an existing-but-empty target (or a non-.py file) must not become a
+        # vacuous zero-file clean pass either
+        raise FileNotFoundError(
+            "no Python files found in: " + ", ".join(str(p) for p in paths)
+        )
+    return out
+
+
+def _default_checkers() -> List[Checker]:
+    from paddle_tpu.analysis.checkers import all_checkers
+
+    return all_checkers()
+
+
+def analyze_paths(
+    paths: Sequence[str],
+    checkers: Optional[Sequence[Checker]] = None,
+    select: Optional[Sequence[str]] = None,
+) -> List[Violation]:
+    """Analyze files/directories. ``select`` filters by checker-code prefix
+    (e.g. ``["TS", "EH401"]``). Unparseable files yield a single ``GEN001``."""
+    checkers = list(checkers) if checkers is not None else _default_checkers()
+    files = iter_python_files(paths)
+    parsed: List[Tuple[Path, str, ast.Module]] = []
+    violations: List[Violation] = []
+    for f in files:
+        src = f.read_text(encoding="utf-8", errors="replace")
+        try:
+            tree = ast.parse(src)
+        except SyntaxError as exc:
+            violations.append(
+                Violation(str(f), exc.lineno or 1, exc.offset or 0, "GEN001",
+                          f"file does not parse: {exc.msg}")
+            )
+            continue
+        parsed.append((f, src, tree))
+    project = build_project_context(tree for _, _, tree in parsed)
+    for f, src, tree in parsed:
+        violations.extend(
+            _run_checkers(tree, src, str(f), project, _is_hot_path(f), checkers, select)
+        )
+    return violations
+
+
+def analyze_source(
+    source: str,
+    path: str = "<snippet>",
+    checkers: Optional[Sequence[Checker]] = None,
+    select: Optional[Sequence[str]] = None,
+    known_flags: Optional[Iterable[str]] = None,
+    hot_path: bool = False,
+) -> List[Violation]:
+    """Analyze one in-memory snippet (the fixture-test entry point). When
+    ``known_flags`` is None the canonical flags.py plus the snippet's own
+    definitions form the universe."""
+    checkers = list(checkers) if checkers is not None else _default_checkers()
+    tree = ast.parse(source)
+    if known_flags is not None:
+        project = ProjectContext(known_flags=set(known_flags))
+        project.known_flags |= _collect_flags_from_tree(tree)
+    else:
+        project = build_project_context([tree])
+    return _run_checkers(tree, source, path, project, hot_path, checkers, select)
+
+
+def _run_checkers(
+    tree: ast.Module,
+    source: str,
+    path: str,
+    project: ProjectContext,
+    hot_path: bool,
+    checkers: Sequence[Checker],
+    select: Optional[Sequence[str]],
+) -> List[Violation]:
+    lines = source.splitlines()
+    ctx = FileContext(
+        path=path, lines=lines, tree=tree, project=project,
+        hot_path=hot_path, parents=parent_map(tree),
+    )
+    violations: List[Violation] = []
+    for checker in checkers:
+        found = checker.run(ctx)
+        if select is not None:
+            found = [v for v in found if any(v.code.startswith(s) for s in select)]
+        violations.extend(found)
+    _resolve_suppressions(violations, lines)
+    violations.sort(key=lambda v: (v.path, v.line, v.col, v.code))
+    return violations

@@ -1,0 +1,58 @@
+"""Violation reporters: human text and machine JSON.
+
+Both render the same violation list; the JSON form is what CI and the tier-1
+gate consume (``python -m paddle_tpu.analysis --format json ...``)."""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Sequence
+
+from paddle_tpu.analysis.core import Violation
+
+__all__ = ["render_text", "render_json", "summarize"]
+
+
+def summarize(violations: Sequence[Violation]) -> Dict[str, int]:
+    live = [v for v in violations if not v.suppressed]
+    per_code: Dict[str, int] = {}
+    for v in live:
+        per_code[v.code] = per_code.get(v.code, 0) + 1
+    return {
+        "total": len(violations),
+        "unsuppressed": len(live),
+        "suppressed": len(violations) - len(live),
+        **{f"code:{c}": n for c, n in sorted(per_code.items())},
+    }
+
+
+def render_text(violations: Sequence[Violation], show_suppressed: bool = False) -> str:
+    shown = [v for v in violations if show_suppressed or not v.suppressed]
+    lines: List[str] = [v.format() for v in shown]
+    s = summarize(violations)
+    lines.append(
+        f"{s['unsuppressed']} unsuppressed violation(s), "
+        f"{s['suppressed']} suppressed"
+    )
+    return "\n".join(lines)
+
+
+def render_json(violations: Sequence[Violation]) -> str:
+    return json.dumps(
+        {
+            "violations": [
+                {
+                    "path": v.path,
+                    "line": v.line,
+                    "col": v.col,
+                    "code": v.code,
+                    "message": v.message,
+                    "suppressed": v.suppressed,
+                    "reason": v.reason,
+                }
+                for v in violations
+            ],
+            "summary": summarize(violations),
+        },
+        indent=1,
+    )

@@ -24,6 +24,26 @@ import numpy as np
 from paddle_tpu.core import autograd as _ag
 from paddle_tpu.flags import GLOBAL_FLAGS
 
+# FLAGS_check_nan_inf / _level cached in plain lists kept fresh by on_change
+# listeners (the observability/metrics.py idiom, enforced as FD302): the scan
+# gate runs on every op dispatch and must not take the registry lock.
+_NAN_CHECK = [False]
+_NAN_LEVEL = [0]
+
+
+def _refresh_nan_check(value: Any) -> None:
+    _NAN_CHECK[0] = bool(value)
+
+
+def _refresh_nan_level(value: Any) -> None:
+    _NAN_LEVEL[0] = int(value)
+
+
+GLOBAL_FLAGS.on_change("check_nan_inf", _refresh_nan_check)
+GLOBAL_FLAGS.on_change("check_nan_inf_level", _refresh_nan_level)
+_NAN_CHECK[0] = bool(GLOBAL_FLAGS.get("check_nan_inf"))  # seeds FLAGS_ env var
+_NAN_LEVEL[0] = int(GLOBAL_FLAGS.get("check_nan_inf_level"))
+
 
 def _is_tensor(x: Any) -> bool:
     from paddle_tpu.core.tensor import Tensor
@@ -40,7 +60,7 @@ def _check_nan_inf(name: str, arrays: Sequence[Any]) -> None:
         if hasattr(a, "dtype") and jnp.issubdtype(jnp.dtype(a.dtype), jnp.inexact):
             finite = bool(jnp.all(jnp.isfinite(a)))
             if not finite:
-                level = GLOBAL_FLAGS.get("check_nan_inf_level")
+                level = _NAN_LEVEL[0]
                 msg = f"NaN/Inf detected in output of op '{name}'"
                 if level == 0:
                     raise FloatingPointError(msg)
@@ -114,7 +134,7 @@ def _wrap_outputs(name: str, raw_out: Any, node: Optional[_ag.GradNode]) -> Any:
     from paddle_tpu.core.tensor import Tensor
 
     flat_out, out_treedef = jax.tree_util.tree_flatten(raw_out)
-    if GLOBAL_FLAGS.get("check_nan_inf"):
+    if _NAN_CHECK[0]:
         _check_nan_inf(name, flat_out)
     if op_stats_hook is not None:
         op_stats_hook(name, flat_out)

@@ -341,7 +341,7 @@ class Tensor:
         grad_info = "" if self.stop_gradient else ", stop_gradient=False"
         try:
             value = np.array2string(self.numpy(), precision=6, separator=", ", threshold=64)
-        except Exception:
+        except Exception:  # repr must never raise: traced/donated/deleted buffers
             value = "<traced>"
         return (
             f"Tensor(shape={self.shape}, dtype={jnp.dtype(self.dtype).name}{grad_info},\n"

@@ -254,6 +254,7 @@ def _run_trial_in_subprocess(
             code = 1
             try:
                 conn.send(("err", f"{type(exc).__name__}: {exc}"[:200]))
+            # analysis: disable=EH402 forked child is dying; the parent reads a closed pipe as a crash
             except Exception:  # noqa: BLE001
                 pass
         conn.close()

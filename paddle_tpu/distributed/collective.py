@@ -209,11 +209,11 @@ def _in_parallel_trace() -> bool:
         from jax._src.core import get_axis_env  # jax>=0.5 internal; fallback below
 
         return bool(get_axis_env().axis_sizes)
-    except Exception:
+    except Exception:  # jax-internal API; moved across versions — try the older one
         try:
             frame = jax.core.unsafe_get_axis_names()  # type: ignore[attr-defined]
             return bool(frame)
-        except Exception:
+        except Exception:  # neither internal exists: treat as "not in a mapped trace"
             return False
 
 

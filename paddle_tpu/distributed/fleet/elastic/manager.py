@@ -76,7 +76,7 @@ class ElasticManager:
     def _read_gen(self) -> int:
         try:
             return int(self._store.get("elastic/generation").decode())
-        except Exception:
+        except Exception:  # no generation published yet (fresh store) / store down
             return 0
 
     def _beat_key(self, rank: int) -> str:
@@ -104,8 +104,8 @@ class ElasticManager:
         while not self._stop.wait(self.ttl / 3.0):
             try:
                 self._beat()
-            except Exception:
-                return  # store gone: the manager will see the lease expire
+            except Exception:  # store gone: stop beating, manager sees lease expire
+                return
 
     def stop(self) -> None:
         self._stop.set()
@@ -129,6 +129,7 @@ class ElasticManager:
         def hook(dump: Dict[str, Any]) -> None:
             try:
                 self.report_fault(f"hang in {dump.get('section')}")
+            # analysis: disable=EH402 best-effort fault mark from a watchdog thread; the store may be gone with the job
             except Exception:  # noqa: BLE001 - store may be gone too
                 pass
 
@@ -137,7 +138,7 @@ class ElasticManager:
     def _faulted(self, r: int) -> bool:
         try:
             return bool(self._store.get(self._fault_key(r)))
-        except Exception:
+        except Exception:  # missing key / store error both mean "no fault mark"
             return False
 
     # -- manager side -------------------------------------------------------
@@ -149,7 +150,7 @@ class ElasticManager:
                 raw = self._store.get(self._beat_key(r))
                 if now - float(raw.decode()) > self.ttl:
                     continue
-            except Exception:
+            except Exception:  # no beat key / unparsable beat: rank is not alive
                 continue
             # fault lookup only for fresh-beat ranks (halves store traffic in
             # the all-healthy case; dead ranks need no fault check)
@@ -211,6 +212,6 @@ class ElasticManager:
         try:
             gen = int(store.get("elastic/generation").decode())
             world = [int(r) for r in store.get("elastic/world").decode().split(",") if r]
-        except Exception:
+        except Exception:  # topology not published (yet): caller falls back to static launch
             return None
         return {"generation": gen, "world_size": len(world), "members": world}

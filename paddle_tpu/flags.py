@@ -66,7 +66,21 @@ class FlagRegistry:
             flag.env_read = True
             env = os.environ.get(f"FLAGS_{flag.name}")
             if env is not None:
-                flag.value = self._coerce(flag, env)
+                try:
+                    flag.value = self._coerce(flag, env)
+                except (TypeError, ValueError) as exc:
+                    # un-mark so the error re-fires on EVERY read: if the first
+                    # get() happens inside someone's broad except, the flag
+                    # must not silently serve its default forever after
+                    flag.env_read = False
+                    # env seeding happens at the first get() of the flag, which
+                    # can be deep inside unrelated code — name the flag and the
+                    # env var so the malformed value is findable
+                    raise ValueError(
+                        f"invalid value {env!r} in environment variable "
+                        f"FLAGS_{flag.name} for flag '{flag.name}' "
+                        f"(expected {flag.type.__name__})"
+                    ) from exc
                 self._notify(flag)
 
     def get(self, name: str) -> Any:
@@ -83,7 +97,13 @@ class FlagRegistry:
                 raise KeyError(f"unknown flag '{name}'")
             flag = self._flags[name]
             flag.env_read = True
-            flag.value = self._coerce(flag, value)
+            try:
+                flag.value = self._coerce(flag, value)
+            except (TypeError, ValueError) as exc:
+                raise ValueError(
+                    f"invalid value {value!r} for flag '{name}' "
+                    f"(expected {flag.type.__name__})"
+                ) from exc
             self._notify(flag)
 
     def names(self) -> List[str]:

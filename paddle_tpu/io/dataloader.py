@@ -142,7 +142,7 @@ class DataLoader:
             try:
                 for batch in self._iter_batches():
                     q.put(batch)
-            except BaseException as e:  # noqa: BLE001
+            except BaseException as e:  # noqa: BLE001 - ferried to the consumer thread, re-raised there
                 error_box.append(e)
             finally:
                 q.put(sentinel)
@@ -192,5 +192,6 @@ class DataLoader:
         if pool is not None:
             try:
                 pool.shutdown()
+            # analysis: disable=EH402 __del__ during interpreter teardown; queues/processes may be half-destroyed
             except Exception:  # noqa: BLE001
                 pass

@@ -344,7 +344,7 @@ class WorkerPool:
         for _ in self._procs:
             try:
                 self._task_q.put(_SHUTDOWN)
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 - queue already closed; survivors are terminated below
                 break
         for p in self._procs:
             p.join(timeout=2.0)
@@ -360,6 +360,7 @@ class WorkerPool:
             if kind == "__shm__":
                 try:
                     _tree_from_shm(payload)
+                # analysis: disable=EH402 drain is best-effort; the segment may already be unlinked by its consumer
                 except Exception:  # noqa: BLE001
                     pass
         for q in (self._task_q, self._result_q):
@@ -368,6 +369,7 @@ class WorkerPool:
         if self._ring is not None:
             try:
                 self._ring.close()
+            # analysis: disable=EH402 shutdown path; ring segment may already be unlinked by the OS or a dead worker
             except Exception:  # noqa: BLE001
                 pass
             self._ring = None

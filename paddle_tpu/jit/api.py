@@ -294,7 +294,7 @@ class StaticFunction:
                 stacklevel=2,
             )
             return self._fn(*args, **kwargs)
-        except BaseException:
+        except BaseException:  # any first-exec failure must uncache; see below
             if cache_miss:
                 # the first execution failed past the trace-break net (XLA
                 # runtime error, data-dependent check): drop the entry so a

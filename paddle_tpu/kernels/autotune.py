@@ -25,11 +25,49 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import jax
 
 from paddle_tpu.flags import GLOBAL_FLAGS, define_flag
+from paddle_tpu.observability import get_registry
 
 define_flag("use_kernel_autotune", bool, False, "Time Pallas block-size candidates at first use per shape.")
 define_flag("kernel_autotune_cache", str, "", "Optional JSON file persisting autotune picks across processes.")
+define_flag("kernel_autotune_verbose", bool, False, "Echo autotune pick lines at INFO on stderr (replaces the old PADDLE_TPU_AUTOTUNE_VERBOSE env print).")
 
 _logger = logging.getLogger("paddle_tpu.kernels.autotune")
+_picks_total = get_registry().counter(
+    "paddle_tpu_autotune_picks_total",
+    "Autotune sweeps completed (a config timed, picked and cached), by kernel.",
+    labelnames=("kernel",),
+)
+_verbose_state: List[Any] = []  # [handler, prior logger level] while installed
+
+
+def _sync_verbose_logging(enabled: bool) -> None:
+    """Opt-in stderr echo of pick lines (FLAGS_kernel_autotune_verbose): the
+    observability-layer replacement for the old raw print. Driven by an
+    on_change listener (registered below), so flipping the flag off removes
+    the handler and restores the module logger's prior level immediately —
+    not only when the next uncached sweep happens to run."""
+    if enabled and not _verbose_state:
+        import sys
+
+        h = logging.StreamHandler(sys.stderr)
+        h.setFormatter(logging.Formatter("%(message)s"))
+        _verbose_state[:] = [h, _logger.level]
+        _logger.addHandler(h)
+        if _logger.getEffectiveLevel() > logging.INFO:
+            _logger.setLevel(logging.INFO)
+    elif not enabled and _verbose_state:
+        h, prior = _verbose_state
+        _logger.removeHandler(h)
+        _logger.setLevel(prior)
+        _verbose_state.clear()
+
+
+def _refresh_verbose(value: Any) -> None:
+    _sync_verbose_logging(bool(value))
+
+
+GLOBAL_FLAGS.on_change("kernel_autotune_verbose", _refresh_verbose)
+_sync_verbose_logging(bool(GLOBAL_FLAGS.get("kernel_autotune_verbose")))  # seeds env
 
 __all__ = ["autotune", "AutotuneCache", "cache"]
 
@@ -71,7 +109,7 @@ class AutotuneCache:
                 }
                 with open(path, "w") as f:
                     json.dump(serial, f, indent=1)
-            except Exception as exc:  # noqa: BLE001
+            except Exception as exc:  # noqa: BLE001 - persistence is best-effort; in-process cache still holds the pick
                 _logger.warning("autotune cache %s not writable: %s", path, exc)
 
     def clear(self) -> None:
@@ -109,7 +147,7 @@ def autotune(
     try:
         if jax.default_backend() != "tpu":
             return default
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001 - no backend initialised: tuning is TPU-only
         return default
     hit = cache.get(kernel, key)
     if hit is not None:
@@ -132,6 +170,7 @@ def autotune(
     if best is None:
         best = default
     cache.put(kernel, key, best)
+    _picks_total.labels(kernel=kernel).inc()
     _logger.info(
         "autotune %s key=%s picked %s (%.3fms) over %s",
         kernel,
@@ -140,13 +179,4 @@ def autotune(
         best_t * 1e3 if best_t < float("inf") else -1.0,
         [(c, round(t * 1e3, 3)) for c, t in results],
     )
-    if os.environ.get("PADDLE_TPU_AUTOTUNE_VERBOSE"):
-        import sys
-
-        print(
-            f"autotune: {kernel} {key} -> {best} "
-            f"({[(c, round(t * 1e3, 3)) for c, t in results]})",
-            file=sys.stderr,
-            flush=True,
-        )
     return best

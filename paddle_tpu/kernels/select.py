@@ -28,7 +28,7 @@ def pallas_enabled(flag: str) -> bool:
         return False
     try:
         return jax.default_backend() == "tpu"
-    except Exception:
+    except Exception:  # no backend initialised / plugin init failed: not a TPU
         return False
 
 

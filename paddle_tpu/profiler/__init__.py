@@ -60,7 +60,7 @@ class _HostEventRecorder:
 
             # build=False: never compile C++ during `import paddle_tpu`
             self._native = load_native(build=False)
-        except Exception:
+        except Exception:  # native extension optional: pure-python recorder suffices
             self._native = None
 
     @property
@@ -122,7 +122,7 @@ class RecordEvent:
 
             self._jax_ann = jax.profiler.TraceAnnotation(self.name)
             self._jax_ann.__enter__()
-        except Exception:
+        except Exception:  # device annotation is best-effort; host span still recorded
             self._jax_ann = None
 
     def end(self) -> None:
@@ -223,7 +223,7 @@ class Profiler:
 
                 reset_max_memory_allocated()
                 self.memory_at_start = memory_allocated()
-            except Exception:
+            except Exception:  # allocator stats unavailable on this backend
                 self.memory_at_start = 0
 
     def stop(self) -> None:
@@ -236,7 +236,7 @@ class Profiler:
             # process-wide peak (still useful, never destructive)
             self.peak_memory_allocated = max_memory_allocated()
             self.memory_at_stop = memory_allocated()
-        except Exception:
+        except Exception:  # allocator stats unavailable on this backend
             self.peak_memory_allocated = 0
             self.memory_at_stop = 0
         if self._on_trace_ready is not None:
@@ -271,7 +271,7 @@ class Profiler:
             from paddle_tpu.observability.exporters import drain_trace_events
 
             events = events + drain_trace_events()
-        except Exception:
+        except ImportError:  # exporters unavailable mid-teardown: spans still export
             pass
         with open(path, "w") as f:
             json.dump({"traceEvents": events}, f)

@@ -66,8 +66,17 @@ class TestTCPStoreNative:
             # each rank waits for the OTHER rank's key (cross-process block)
             other = store.get(f"rank{{1 - rank}}")
             assert other == f"payload-{{1 - rank}}".encode(), other
-            n = store.add("arrived", 1)
             store.wait("rank0")
+            # the arrival barrier is each rank's LAST store op, and the master
+            # (rank 0) exits only after seeing both arrivals: otherwise rank 0
+            # can finish and take the server down while rank 1's final request
+            # is still in flight (flaked under full-suite load)
+            n = store.add("arrived", 1)
+            if rank == 0:
+                for _ in range(500):
+                    if store.add("arrived", 0) >= 2:
+                        break
+                    time.sleep(0.05)
             print(f"rank {{rank}} ok n={{n}}")
             """
         )

@@ -1,0 +1,513 @@
+"""Static-analysis framework tests: per-checker fixtures (positive AND
+negative per code), suppression semantics, reporters, CLI exit codes, and the
+tier-1 gate — the whole-package self-run must come back with zero
+unsuppressed violations."""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from paddle_tpu.analysis import (
+    all_checkers,
+    all_codes,
+    analyze_paths,
+    analyze_source,
+    render_json,
+    render_text,
+    summarize,
+)
+
+REPO = Path(__file__).resolve().parents[1]
+PKG = REPO / "paddle_tpu"
+
+
+def codes(src, **kw):
+    return sorted(v.code for v in analyze_source(src, **kw) if not v.suppressed)
+
+
+# -- TS: trace-safety --------------------------------------------------------
+
+def test_ts101_print_in_jitted_function():
+    assert "TS101" in codes(
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    print(x)\n"
+        "    return x\n"
+    )
+
+
+def test_ts101_negative_print_outside_trace():
+    assert codes("def f(x):\n    print(x)\n    return x\n") == []
+
+
+def test_ts101_function_passed_to_jax_jit():
+    assert "TS101" in codes(
+        "import jax\n"
+        "def g(x):\n"
+        "    print(x)\n"
+        "    return x\n"
+        "h = jax.jit(g, donate_argnums=(0,))\n"
+    )
+
+
+def test_ts101_method_passed_to_jax_jit_via_self():
+    assert "TS101" in codes(
+        "import jax\n"
+        "class Engine:\n"
+        "    def __init__(self):\n"
+        "        self._fn = jax.jit(self._impl)\n"
+        "    def _impl(self, x):\n"
+        "        print(x)\n"
+        "        return x\n"
+    )
+
+
+def test_ts102_time_call():
+    src = (
+        "import time\n"
+        "from paddle_tpu.jit import to_static\n"
+        "@to_static\n"
+        "def step(x):\n"
+        "    t0 = time.perf_counter()\n"
+        "    return x, t0\n"
+    )
+    assert "TS102" in codes(src)
+    assert codes(src.replace("time.perf_counter()", "x + 1")) == []
+
+
+def test_ts103_environ():
+    assert "TS103" in codes(
+        "import jax, os\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    if os.environ.get('DEBUG'):\n"
+        "        return x\n"
+        "    return x + 1\n"
+    )
+    # reading the environment OUTSIDE the traced body is fine
+    assert codes(
+        "import jax, os\n"
+        "dbg = os.environ.get('DEBUG')\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return x\n"
+    ) == []
+
+
+def test_ts104_metrics_in_traced_body():
+    assert "TS104" in codes(
+        "import jax\n"
+        "from paddle_tpu.observability import GLOBAL_METRICS\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    GLOBAL_METRICS.counter('c').inc()\n"
+        "    return x\n"
+    )
+    assert "TS104" in codes(
+        "import jax\n"
+        "from paddle_tpu.observability import get_registry\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    get_registry().counter('c').inc()\n"
+        "    return x\n"
+    )
+
+
+def test_ts104_negative_metrics_at_call_site():
+    assert codes(
+        "import jax\n"
+        "from paddle_tpu.observability import GLOBAL_METRICS\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return x\n"
+        "def serve(x):\n"
+        "    y = f(x)\n"
+        "    GLOBAL_METRICS.counter('c').inc()\n"
+        "    return y\n"
+    ) == []
+
+
+def test_ts105_param_materialization():
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return float(x)\n"
+    )
+    assert "TS105" in codes(src)
+    assert "TS105" in codes(src.replace("float(x)", "x.item()"))
+    # float() of a non-parameter local is not flagged
+    assert codes(src.replace("float(x)", "float(1.5) + x")) == []
+
+
+def test_ts106_global_mutation():
+    assert "TS106" in codes(
+        "import jax\n"
+        "_n = 0\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    global _n\n"
+        "    _n += 1\n"
+        "    return x\n"
+    )
+    assert codes(
+        "_n = 0\n"
+        "def f(x):\n"
+        "    global _n\n"
+        "    _n += 1\n"
+        "    return x\n"
+    ) == []
+
+
+# -- PK: Pallas purity -------------------------------------------------------
+
+def test_pk201_flag_read_in_kernel():
+    assert "PK201" in codes(
+        "from paddle_tpu.flags import GLOBAL_FLAGS\n"
+        "def _add_kernel(x_ref, o_ref):\n"
+        "    if GLOBAL_FLAGS.get('benchmark'):\n"
+        "        o_ref[...] = x_ref[...]\n"
+    )
+
+
+def test_pk202_metrics_in_kernel():
+    assert "PK202" in codes(
+        "from paddle_tpu.observability import GLOBAL_METRICS\n"
+        "def _add_kernel(x_ref, o_ref):\n"
+        "    GLOBAL_METRICS.counter('c').inc()\n"
+        "    o_ref[...] = x_ref[...]\n"
+    )
+
+
+def test_pk203_mutable_global_closure():
+    src = (
+        "_seen = {}\n"
+        "NEG_INF = -1e30\n"
+        "def _add_kernel(x_ref, o_ref):\n"
+        "    o_ref[...] = x_ref[...] + len(_seen) + NEG_INF\n"
+    )
+    got = codes(src)
+    assert "PK203" in got
+    # ALL_CAPS literal constants are allowed
+    assert got.count("PK203") == 1
+
+
+def test_pk203_negative_partial_bakes_state():
+    assert codes(
+        "import functools\n"
+        "def _add_kernel(x_ref, o_ref, *, n):\n"
+        "    o_ref[...] = x_ref[...] + n\n"
+        "kernel = functools.partial(_add_kernel, n=3)\n"
+    ) == []
+
+
+def test_pk204_print_in_kernel_resolved_through_partial():
+    # resolution path: pallas_call(k) where k = functools.partial(body, ...)
+    assert "PK204" in codes(
+        "import functools\n"
+        "from jax.experimental import pallas as pl\n"
+        "def body(x_ref, o_ref, *, n):\n"
+        "    print('tracing')\n"
+        "    o_ref[...] = x_ref[...]\n"
+        "def run(x):\n"
+        "    k = functools.partial(body, n=1)\n"
+        "    return pl.pallas_call(k, out_shape=x)(x)\n"
+    )
+
+
+def test_pk204_index_map_lambda():
+    assert "PK204" in codes(
+        "import time\n"
+        "from jax.experimental import pallas as pl\n"
+        "spec = pl.BlockSpec((8, 8), lambda i, j: (i, int(time.time())))\n"
+    )
+    assert codes(
+        "from jax.experimental import pallas as pl\n"
+        "spec = pl.BlockSpec((8, 8), lambda i, j: (i, j))\n"
+    ) == []
+
+
+# -- FD: flag discipline -----------------------------------------------------
+
+def test_fd301_undefined_flag():
+    assert codes(
+        "from paddle_tpu.flags import GLOBAL_FLAGS\n"
+        "v = GLOBAL_FLAGS.get('definitely_not_a_flag')\n"
+    ) == ["FD301"]
+    # canonical flags.py names resolve
+    assert codes(
+        "from paddle_tpu.flags import GLOBAL_FLAGS\n"
+        "v = GLOBAL_FLAGS.get('benchmark')\n"
+    ) == []
+
+
+def test_fd301_env_and_setters():
+    assert codes("import os\nv = os.environ.get('FLAGS_nope')\n") == ["FD301"]
+    assert codes("import os\nv = os.environ['FLAGS_benchmark']\n") == []
+    assert codes("from paddle_tpu.flags import set_flags\nset_flags({'FLAGS_typo_flag': 1})\n") == ["FD301"]
+    assert codes("from paddle_tpu.flags import get_flags\nget_flags(['benchmark', 'gone_flag'])\n") == ["FD301"]
+    # the public attribute-qualified spellings resolve too
+    assert codes("import paddle_tpu as paddle\npaddle.set_flags({'FLAGS_typo_flag': 1})\n") == ["FD301"]
+    assert codes("import paddle_tpu as paddle\npaddle.set_flags({'FLAGS_benchmark': True})\n") == []
+
+
+def test_fd301_define_in_same_run_resolves():
+    assert codes(
+        "from paddle_tpu.flags import GLOBAL_FLAGS, define_flag\n"
+        "define_flag('my_new_flag', bool, False)\n"
+        "v = GLOBAL_FLAGS.get('my_new_flag')\n"
+    ) == []
+
+
+def test_fd302_loop_read_in_hot_path():
+    src = (
+        "from paddle_tpu.flags import GLOBAL_FLAGS\n"
+        "def scan(items):\n"
+        "    for it in items:\n"
+        "        if GLOBAL_FLAGS.get('benchmark'):\n"
+        "            it.sync()\n"
+    )
+    assert codes(src, hot_path=True) == ["FD302"]
+    assert codes(src, hot_path=False) == []
+    hoisted = (
+        "from paddle_tpu.flags import GLOBAL_FLAGS\n"
+        "def scan(items):\n"
+        "    bench = GLOBAL_FLAGS.get('benchmark')\n"
+        "    for it in items:\n"
+        "        if bench:\n"
+        "            it.sync()\n"
+    )
+    assert codes(hoisted, hot_path=True) == []
+
+
+# -- EH: exception hygiene ---------------------------------------------------
+
+def test_eh401_bare_except():
+    assert codes("try:\n    f()\nexcept:\n    g()\n") == ["EH401"]
+    assert codes("try:\n    f()\nexcept ValueError:\n    g()\n") == []
+
+
+def test_eh402_silent_swallow():
+    assert "EH402" in codes("try:\n    f()\nexcept Exception:\n    pass\n")
+    # logging the failure is not silent
+    assert codes(
+        "import logging\n"
+        "try:\n"
+        "    f()\n"
+        "except Exception:  # tolerable: best-effort hook\n"
+        "    logging.getLogger(__name__).warning('f failed')\n"
+    ) == []
+
+
+def test_eh403_lint_tags_are_not_reasons():
+    # a bare noqa / type: ignore / pragma tag says nothing about WHY breadth
+    # is correct — it must not satisfy EH403
+    assert codes("try:\n    f()\nexcept Exception:  # noqa: BLE001\n    y = 0\n") == ["EH403"]
+    assert codes("try:\n    f()\nexcept Exception:  # type: ignore[misc]\n    y = 0\n") == ["EH403"]
+    # a tag FOLLOWED by prose is fine
+    assert codes(
+        "try:\n    f()\nexcept Exception:  # noqa: BLE001 - fallback covers it\n    y = 0\n"
+    ) == []
+
+
+def test_eh403_broad_except_needs_reason():
+    assert codes("try:\n    f()\nexcept Exception as exc:\n    y = 0\n") == ["EH403"]
+    assert codes("try:\n    f()\nexcept Exception as exc:  # fallback below\n    y = 0\n") == []
+    # comment-only line opening the body also counts (repo idiom)
+    assert codes(
+        "try:\n"
+        "    f()\n"
+        "except Exception as exc:\n"
+        "    # fallback: the retry path below re-raises on second failure\n"
+        "    y = 0\n"
+    ) == []
+
+
+# -- suppressions ------------------------------------------------------------
+
+def test_suppression_with_reason():
+    vs = analyze_source(
+        "try:\n"
+        "    f()\n"
+        "except:  # analysis: disable=EH401 exercised by fixture\n"
+        "    g()\n"
+    )
+    assert len(vs) == 1 and vs[0].suppressed and vs[0].reason == "exercised by fixture"
+
+
+def test_suppression_on_preceding_comment_line():
+    vs = analyze_source(
+        "try:\n"
+        "    f()\n"
+        "# analysis: disable=EH401 fixture wants it suppressed\n"
+        "except:\n"
+        "    g()\n"
+    )
+    assert [v.suppressed for v in vs] == [True]
+
+
+def test_suppression_without_reason_does_not_suppress():
+    vs = analyze_source(
+        "try:\n"
+        "    f()\n"
+        "except:  # analysis: disable=EH401\n"
+        "    g()\n"
+    )
+    assert len(vs) == 1 and not vs[0].suppressed
+    assert "missing reason" in vs[0].message
+
+
+def test_suppression_wrong_code_does_not_suppress():
+    vs = analyze_source(
+        "try:\n"
+        "    f()\n"
+        "except:  # analysis: disable=TS101 not the right code\n"
+        "    g()\n"
+    )
+    assert len(vs) == 1 and not vs[0].suppressed
+
+
+def test_suppression_preceding_line_wins_over_unrelated_inline_disable():
+    # an inline disable for a DIFFERENT code must not mask a valid
+    # suppression sitting on the preceding comment line
+    vs = analyze_source(
+        "try:\n"
+        "    f()\n"
+        "# analysis: disable=EH401 fixture suppresses the bare except\n"
+        "except:  # analysis: disable=TS101 unrelated code\n"
+        "    g()\n"
+    )
+    assert [v.suppressed for v in vs] == [True]
+    assert vs[0].reason == "fixture suppresses the bare except"
+
+
+def test_suppression_multiple_codes():
+    vs = analyze_source(
+        "try:\n"
+        "    f()\n"
+        "except:  # analysis: disable=TS101,EH401 fixture covers both\n"
+        "    g()\n"
+    )
+    assert [v.suppressed for v in vs] == [True]
+
+
+# -- reporters + registry ----------------------------------------------------
+
+def test_reporters_and_summary():
+    vs = analyze_source("try:\n    f()\nexcept:\n    pass\n")
+    data = json.loads(render_json(vs))
+    assert data["summary"]["unsuppressed"] == len(vs) >= 1
+    assert {v["code"] for v in data["violations"]} >= {"EH401"}
+    text = render_text(vs)
+    assert "EH401" in text and "unsuppressed" in text
+
+
+def test_checker_codes_unique_and_documented():
+    table = all_codes()
+    assert {"TS101", "PK201", "FD301", "EH401"} <= set(table)
+    for checker in all_checkers():
+        for code, desc in checker.codes.items():
+            assert desc, code
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def _run_cli(args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.analysis", *args],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300,
+    )
+
+
+def test_cli_exit_codes(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("try:\n    f()\nexcept:\n    pass\n")
+    good = tmp_path / "good.py"
+    good.write_text("def f():\n    return 1\n")
+    r = _run_cli([str(bad)])
+    assert r.returncode == 1 and "EH401" in r.stdout
+    r = _run_cli(["--format", "json", str(good)])
+    assert r.returncode == 0
+    assert json.loads(r.stdout)["summary"]["unsuppressed"] == 0
+
+
+def test_cli_missing_path_is_a_usage_error(tmp_path):
+    # a typo'd target must not become a vacuous zero-file clean pass
+    r = _run_cli([str(tmp_path / "no_such_dir")])
+    assert r.returncode == 2 and "no such file" in r.stderr
+    # ... and neither must an existing directory holding no Python files
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    r = _run_cli([str(empty)])
+    assert r.returncode == 2 and "no Python files" in r.stderr
+
+
+def test_autotune_verbose_handler_follows_the_flag():
+    import logging
+
+    import paddle_tpu as paddle
+    from paddle_tpu.kernels.autotune import _logger, _verbose_state
+
+    prior = _logger.level
+    try:
+        paddle.set_flags({"FLAGS_kernel_autotune_verbose": True})
+        assert _verbose_state and _verbose_state[0] in _logger.handlers
+        paddle.set_flags({"FLAGS_kernel_autotune_verbose": False})
+        assert not _verbose_state
+        assert not any(isinstance(h, logging.StreamHandler) for h in _logger.handlers)
+        assert _logger.level == prior
+    finally:
+        paddle.set_flags({"FLAGS_kernel_autotune_verbose": False})
+        _logger.setLevel(prior)
+
+
+# -- the tier-1 gate: the package must analyze clean -------------------------
+
+def test_whole_package_clean():
+    vs = analyze_paths([str(PKG)])
+    live = [v for v in vs if not v.suppressed]
+    assert live == [], "unsuppressed violations:\n" + "\n".join(v.format() for v in live)
+    # acceptance: every suppression carries a reason string
+    for v in vs:
+        if v.suppressed:
+            assert v.reason, v.format()
+
+
+def test_cli_whole_package_gate():
+    r = _run_cli(["--format", "json", "paddle_tpu/"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    data = json.loads(r.stdout)
+    assert data["summary"]["unsuppressed"] == 0
+
+
+# -- flags satellite: env-coercion failures name the flag --------------------
+
+def test_env_coercion_error_names_flag_and_env_var(monkeypatch):
+    from paddle_tpu.flags import FlagRegistry
+
+    reg = FlagRegistry()
+    reg.define("scan_depth", int, 4)
+    monkeypatch.setenv("FLAGS_scan_depth", "not-an-int")
+    with pytest.raises(ValueError) as ei:
+        reg.get("scan_depth")
+    msg = str(ei.value)
+    assert "FLAGS_scan_depth" in msg and "scan_depth" in msg and "int" in msg
+    # the error re-fires on every read — a first get() swallowed by someone's
+    # broad except must not leave the flag silently serving its default
+    with pytest.raises(ValueError):
+        reg.get("scan_depth")
+
+
+def test_set_coercion_error_names_flag():
+    from paddle_tpu.flags import FlagRegistry
+
+    reg = FlagRegistry()
+    reg.define("scan_depth", int, 4)
+    with pytest.raises(ValueError, match="scan_depth"):
+        reg.set("scan_depth", "nope")
