@@ -122,6 +122,7 @@ def _define_builtin_flags() -> None:
     d("use_pallas_attention", bool, True, "Use Pallas flash-attention kernels on TPU when applicable.")
     d("use_pallas_fused", bool, True, "Use Pallas fused rms_norm/rope kernels on TPU when applicable.")
     d("use_pallas_paged_attention", bool, True, "Use the Pallas block-table flash-decode kernel on TPU.")
+    d("use_fused_loss", bool, True, "Fuse the lm-head matmul with softmax cross-entropy at model training-loss sites (vocab-chunked, never materializes [B,S,V] logits; Pallas on TPU, lax.scan reference elsewhere). Models return (loss, None) on this path.")
     d("benchmark", bool, False, "Block on every op (sync dispatch) for timing.")
     d("log_memory_stats", bool, False, "Log live/peak device memory stats per allocation event.")
     d("allocator_strategy", str, "xla", "Allocator backing; on TPU the XLA/PJRT allocator owns HBM.")

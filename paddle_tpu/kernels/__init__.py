@@ -1,12 +1,14 @@
 """Pallas TPU kernels — the hot ops where hand-scheduling beats XLA fusion
 (SURVEY §7 stage 8): flash attention + FlashMask sparse-mask variant, fused
-rms_norm and rotary embedding.
+rms_norm and rotary embedding, and the fused linear+cross-entropy loss head.
 
 Every kernel has an ``interpret=`` flag so numerics are testable on the CPU
 backend; production selection happens in the ``paddle_tpu.nn.functional`` /
-``paddle_tpu.incubate`` wrappers via ``FLAGS_use_pallas_attention``.
+``paddle_tpu.incubate`` wrappers via ``FLAGS_use_pallas_attention`` /
+``FLAGS_use_fused_loss``.
 """
 
 from paddle_tpu.kernels.flash_attention import flash_attention_pallas  # noqa: F401
 from paddle_tpu.kernels.flashmask import flashmask_attention_pallas  # noqa: F401
 from paddle_tpu.kernels.fused import fused_rms_norm_pallas, fused_rope_pallas  # noqa: F401
+from paddle_tpu.kernels.fused_loss import fused_linear_cross_entropy  # noqa: F401

@@ -1,0 +1,506 @@
+"""Fused linear + softmax-cross-entropy loss head, vocab-chunked.
+
+The training loss head is the single largest bandwidth sink in a causal-LM
+step: ``lm_head`` materializes ``[B·S, V]`` logits, the fp32 upcast copies
+them, and ``log_softmax`` allocates a third buffer — at the bench config
+(16k tokens, 32k vocab) that is ~2 GB of pure HBM traffic per copy, dwarfing
+any single matmul. This module computes ``cross_entropy(x @ Wᵀ, labels)``
+without ever materializing ``[N, V]`` in any dtype, in the style of flash
+attention's online softmax:
+
+- **forward** streams vocab blocks of ``x @ W_blockᵀ`` through VMEM keeping a
+  per-token online max/sum (fp32) plus the target-class logit (gathered per
+  block; ``ignore_index`` rows simply never match), then finishes with
+  ``loss = logsumexp - target_logit`` reduced exactly like
+  ``F.cross_entropy`` (mean over non-ignored tokens, ``max(count, 1)``);
+- **backward** recomputes each block's logits from the saved logsumexp and
+  emits ``(softmax - onehot) * dloss`` block-wise, accumulating ``dX`` (row
+  blocks) and ``dW`` (vocab blocks) in two Pallas kernels — the flash-attn-2
+  dq/dkv split, so each output is only ever revisited on consecutive grid
+  steps;
+- a ``lax.scan``-over-vocab-chunks reference with the SAME custom-VJP
+  decomposition (pure jnp) runs on CPU / in tier-1 / as the fallback, so the
+  numerics are pinned off-TPU. (Differentiating *through* a scan would stash
+  every chunk's logits — exactly the ``[N, V]`` buffer this kernel exists to
+  avoid — hence the custom VJP on both paths.)
+
+Weight layouts: ``vocab_major=False`` is ``nn.Linear`` 's ``[H, V]``
+(untied lm_head); ``vocab_major=True`` is the embedding's ``[V, H]``
+(tied lm_head, the ``matmul(out, embed.weight, transpose_y=True)`` branch).
+Both fuse without a transpose — only BlockSpec index maps and dot dims
+change.
+
+Selection: ``FLAGS_use_fused_loss`` + TPU backend picks the Pallas kernels
+(vocab/row block sizes autotuned per shape, ``kernels/autotune.py``); any
+Pallas failure falls back to the scan reference through
+``kernels.select.warn_fallback`` (counted in
+``paddle_tpu_kernel_fallbacks_total``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from paddle_tpu.kernels.select import _CompilerParams, pallas_enabled, warn_fallback
+
+__all__ = ["fused_linear_cross_entropy"]
+
+NEG_INF = -1e30
+_REF_BLOCK = 512  # scan-reference vocab chunk; any value works, numerics-pinning only
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+# --------------------------------------------------------------------------
+# shared custom-VJP shell: epilogue (reduction) + per-row grad coefficient
+# --------------------------------------------------------------------------
+
+
+def _build_core(engine_fwd, engine_bwd, ignore_index, reduction):
+    """Wrap a (fwd, bwd) engine pair in the custom VJP both paths share.
+
+    Engine contract (all row-count-N arrays are 1-D f32 unless noted):
+    ``engine_fwd(x2, wp, lab) -> (lse, target_logit)`` and
+    ``engine_bwd(x2, wp, lab, lse, gcoef) -> (dx, dw)`` with ``dx`` in
+    ``x2.dtype`` ``[N, H]`` and ``dw`` in ``wp``'s dtype and layout. The
+    shell owns the reduction semantics (identical to ``F.cross_entropy``)
+    and the ``ignore_index`` masking, so the Pallas and scan paths cannot
+    drift apart on them.
+    """
+
+    def _loss(per, valid):
+        if reduction == "mean":
+            denom = jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+            return jnp.sum(per) / denom
+        if reduction == "sum":
+            return jnp.sum(per)
+        return per
+
+    @jax.custom_vjp
+    def core(x2, wp, lab):
+        lse, tl = engine_fwd(x2, wp, lab)
+        valid = lab != ignore_index
+        return _loss(jnp.where(valid, lse - tl, 0.0), valid)
+
+    def core_fwd(x2, wp, lab):
+        lse, tl = engine_fwd(x2, wp, lab)
+        valid = lab != ignore_index
+        loss = _loss(jnp.where(valid, lse - tl, 0.0), valid)
+        # residuals: inputs + the [N] logsumexp only — never [N, V]
+        return loss, (x2, wp, lab, lse)
+
+    def core_bwd(res, g):
+        x2, wp, lab, lse = res
+        valid = lab != ignore_index
+        g = g.astype(jnp.float32)
+        if reduction == "mean":
+            denom = jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+            g_row = (g / denom) * jnp.ones_like(lse)
+        elif reduction == "sum":
+            g_row = g * jnp.ones_like(lse)
+        else:
+            g_row = g  # [N] cotangent for reduction="none"
+        gcoef = jnp.where(valid, g_row, 0.0)
+        dx, dw = engine_bwd(x2, wp, lab, lse, gcoef)
+        # integer labels carry no gradient (float0 cotangent)
+        return dx, dw, np.zeros(lab.shape, jax.dtypes.float0)
+
+    core.defvjp(core_fwd, core_bwd)
+    return core
+
+
+# --------------------------------------------------------------------------
+# lax.scan reference engine (CPU / tier-1 / fallback)
+# --------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _make_ref_core(v, h, blk, ignore_index, reduction):
+    """Pure-jnp engines over vocab-major padded weights ``[nv*blk, H]``."""
+    nv = (v + blk - 1) // blk
+
+    def engine_fwd(x2, wp, lab):
+        wb = wp.reshape(nv, blk, h)
+        cols0 = jnp.arange(blk)
+        n = x2.shape[0]
+
+        def step(carry, inp):
+            m, l, tl = carry
+            wj, j = inp
+            logits = jax.lax.dot_general(
+                x2, wj, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )  # [N, blk]
+            cols = j * blk + cols0
+            logits = jnp.where((cols < v)[None, :], logits, NEG_INF)
+            m_new = jnp.maximum(m, logits.max(axis=-1))
+            l_new = l * jnp.exp(m - m_new) + jnp.exp(logits - m_new[:, None]).sum(axis=-1)
+            tl_new = tl + jnp.where(cols[None, :] == lab[:, None], logits, 0.0).sum(axis=-1)
+            return (m_new, l_new, tl_new), None
+
+        init = (
+            jnp.full((n,), NEG_INF, jnp.float32),
+            jnp.zeros((n,), jnp.float32),
+            jnp.zeros((n,), jnp.float32),
+        )
+        (m, l, tl), _ = jax.lax.scan(step, init, (wb, jnp.arange(nv)))
+        return m + jnp.log(l), tl
+
+    def engine_bwd(x2, wp, lab, lse, gcoef):
+        wb = wp.reshape(nv, blk, h)
+        cols0 = jnp.arange(blk)
+
+        def step(dx, inp):
+            wj, j = inp
+            logits = jax.lax.dot_general(
+                x2, wj, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            cols = j * blk + cols0
+            p = jnp.exp(logits - lse[:, None])
+            p = jnp.where((cols < v)[None, :], p, 0.0)  # zero-padded W rows: kill exp(-lse)
+            onehot = (cols[None, :] == lab[:, None]).astype(jnp.float32)
+            d = ((p - onehot) * gcoef[:, None]).astype(x2.dtype)
+            dx = dx + jax.lax.dot_general(
+                d, wj, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            dwj = jax.lax.dot_general(
+                d, x2, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            return dx, dwj.astype(wp.dtype)
+
+        dx, dwb = jax.lax.scan(
+            step, jnp.zeros((x2.shape[0], h), jnp.float32), (wb, jnp.arange(nv))
+        )
+        return dx.astype(x2.dtype), dwb.reshape(nv * blk, h)
+
+    return _build_core(engine_fwd, engine_bwd, ignore_index, reduction)
+
+
+def _reference_path(x2, w, lab, *, v, h, ignore_index, reduction, vocab_major):
+    # canonicalize to vocab-major [V, H] + zero-pad the ragged tail; both ops
+    # sit OUTSIDE the custom VJP so their transposes run in reverse for dW
+    wc = w if vocab_major else jnp.swapaxes(w, 0, 1)
+    vp = _round_up(v, _REF_BLOCK)
+    wp = jnp.pad(wc, ((0, vp - v), (0, 0))) if vp > v else wc
+    core = _make_ref_core(v, h, _REF_BLOCK, ignore_index, reduction)
+    return core(x2, wp, lab)
+
+
+# --------------------------------------------------------------------------
+# Pallas kernels
+# --------------------------------------------------------------------------
+
+
+def _flxent_fwd_kernel(x_ref, w_ref, lab_ref, m_ref, l_ref, tl_ref, *, v, blk_v, vocab_major):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref[...], NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref[...])
+        tl_ref[...] = jnp.zeros_like(tl_ref[...])
+
+    x = x_ref[...]  # [blk_rows, H] native dtype — bf16 MXU, fp32 accumulation
+    w = w_ref[...]
+    if vocab_major:  # w [blk_v, H]
+        logits = jax.lax.dot_general(
+            x, w, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+    else:  # w [H, blk_v]
+        logits = jax.lax.dot_general(
+            x, w, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+    cols = j * blk_v + jax.lax.broadcasted_iota(jnp.int32, (1, blk_v), 1)
+    logits = jnp.where(cols < v, logits, NEG_INF)
+    m = m_ref[...]  # [blk_rows, 1]
+    m_new = jnp.maximum(m, logits.max(axis=-1, keepdims=True))
+    alpha = jnp.exp(m - m_new)
+    l_ref[...] = l_ref[...] * alpha + jnp.sum(jnp.exp(logits - m_new), axis=-1, keepdims=True)
+    m_ref[...] = m_new
+    # target-class logit: ignore_index (< 0) never matches a column
+    tl_ref[...] += jnp.sum(jnp.where(cols == lab_ref[...], logits, 0.0), axis=-1, keepdims=True)
+
+
+def _flxent_block_d(x_ref, w_ref, lab_ref, lse_ref, gc_ref, j, *, v, blk_v, vocab_major):
+    """Recompute one block's ``(softmax - onehot) * gcoef`` from the saved
+    logsumexp — shared by the dX and dW kernels."""
+    x = x_ref[...]
+    w = w_ref[...]
+    if vocab_major:
+        logits = jax.lax.dot_general(
+            x, w, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+    else:
+        logits = jax.lax.dot_general(
+            x, w, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+    cols = j * blk_v + jax.lax.broadcasted_iota(jnp.int32, (1, blk_v), 1)
+    p = jnp.exp(logits - lse_ref[...])
+    p = jnp.where(cols < v, p, 0.0)  # zero-padded W rows: kill exp(-lse)
+    onehot = (cols == lab_ref[...]).astype(jnp.float32)
+    return ((p - onehot) * gc_ref[...]).astype(x.dtype)
+
+
+def _flxent_dx_kernel(x_ref, w_ref, lab_ref, lse_ref, gc_ref, dx_ref, *, v, blk_v, vocab_major):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        dx_ref[...] = jnp.zeros_like(dx_ref[...])
+
+    d = _flxent_block_d(
+        x_ref, w_ref, lab_ref, lse_ref, gc_ref, j, v=v, blk_v=blk_v, vocab_major=vocab_major
+    )
+    w = w_ref[...]
+    if vocab_major:  # d [br, bv] @ w [bv, H]
+        dx_ref[...] += jax.lax.dot_general(
+            d, w, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+    else:  # d [br, bv] @ w [H, bv]ᵀ
+        dx_ref[...] += jax.lax.dot_general(
+            d, w, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+
+def _flxent_dw_kernel(x_ref, w_ref, lab_ref, lse_ref, gc_ref, dw_ref, *, v, blk_v, vocab_major):
+    j = pl.program_id(0)  # vocab block (outer, parallel)
+    i = pl.program_id(1)  # row block (inner, sequential accumulation)
+
+    @pl.when(i == 0)
+    def _init():
+        dw_ref[...] = jnp.zeros_like(dw_ref[...])
+
+    d = _flxent_block_d(
+        x_ref, w_ref, lab_ref, lse_ref, gc_ref, j, v=v, blk_v=blk_v, vocab_major=vocab_major
+    )
+    x = x_ref[...]
+    if vocab_major:  # dᵀ [bv, br] @ x [br, H]
+        dw_ref[...] += jax.lax.dot_general(
+            d, x, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+    else:  # xᵀ [H, br] @ d [br, bv]
+        dw_ref[...] += jax.lax.dot_general(
+            x, d, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+
+@functools.lru_cache(maxsize=None)
+def _make_pallas_core(
+    n_pad, v, vp, h, blk_rows, blk_v, vocab_major, interpret, ignore_index, reduction
+):
+    nr = n_pad // blk_rows
+    nv = vp // blk_v
+    row_spec = pl.BlockSpec((blk_rows, h), lambda i, j: (i, 0))
+    col_spec = pl.BlockSpec((blk_rows, 1), lambda i, j: (i, 0))  # lab/lse/gc/m/l/tl
+    if vocab_major:
+        w_spec = pl.BlockSpec((blk_v, h), lambda i, j: (j, 0))
+    else:
+        w_spec = pl.BlockSpec((h, blk_v), lambda i, j: (0, j))
+
+    def engine_fwd(x2, wp, lab):
+        m, l, tl = pl.pallas_call(
+            functools.partial(
+                _flxent_fwd_kernel, v=v, blk_v=blk_v, vocab_major=vocab_major
+            ),
+            grid=(nr, nv),
+            # row blocks are independent (megacore-splittable); the vocab dim
+            # accumulates the online softmax state and MUST run sequentially
+            compiler_params=_CompilerParams(dimension_semantics=("parallel", "arbitrary")),
+            in_specs=[row_spec, w_spec, col_spec],
+            out_specs=[col_spec, col_spec, col_spec],
+            out_shape=[jax.ShapeDtypeStruct((n_pad, 1), jnp.float32)] * 3,
+            interpret=interpret,
+        )(x2, wp, lab.reshape(n_pad, 1))
+        return (m + jnp.log(l))[:, 0], tl[:, 0]
+
+    def engine_bwd(x2, wp, lab, lse, gcoef):
+        lab2 = lab.reshape(n_pad, 1)
+        lse2 = lse.reshape(n_pad, 1)
+        gc2 = gcoef.reshape(n_pad, 1)
+        kw = dict(v=v, blk_v=blk_v, vocab_major=vocab_major)
+        dx = pl.pallas_call(
+            functools.partial(_flxent_dx_kernel, **kw),
+            grid=(nr, nv),
+            compiler_params=_CompilerParams(dimension_semantics=("parallel", "arbitrary")),
+            in_specs=[row_spec, w_spec, col_spec, col_spec, col_spec],
+            out_specs=row_spec,
+            out_shape=jax.ShapeDtypeStruct((n_pad, h), jnp.float32),
+            interpret=interpret,
+        )(x2, wp, lab2, lse2, gc2)
+        # dW: transposed grid so its accumulation dim (rows) is innermost —
+        # an output block may only be revisited on consecutive grid steps
+        if vocab_major:
+            dw_spec = pl.BlockSpec((blk_v, h), lambda j, i: (j, 0))
+            dw_shape = jax.ShapeDtypeStruct((vp, h), jnp.float32)
+        else:
+            dw_spec = pl.BlockSpec((h, blk_v), lambda j, i: (0, j))
+            dw_shape = jax.ShapeDtypeStruct((h, vp), jnp.float32)
+        dw = pl.pallas_call(
+            functools.partial(_flxent_dw_kernel, **kw),
+            grid=(nv, nr),
+            compiler_params=_CompilerParams(dimension_semantics=("parallel", "arbitrary")),
+            in_specs=[
+                pl.BlockSpec((blk_rows, h), lambda j, i: (i, 0)),
+                pl.BlockSpec((blk_v, h), lambda j, i: (j, 0))
+                if vocab_major
+                else pl.BlockSpec((h, blk_v), lambda j, i: (0, j)),
+                pl.BlockSpec((blk_rows, 1), lambda j, i: (i, 0)),
+                pl.BlockSpec((blk_rows, 1), lambda j, i: (i, 0)),
+                pl.BlockSpec((blk_rows, 1), lambda j, i: (i, 0)),
+            ],
+            out_specs=dw_spec,
+            out_shape=dw_shape,
+            interpret=interpret,
+        )(x2, wp, lab2, lse2, gc2)
+        return dx.astype(x2.dtype), dw.astype(wp.dtype)
+
+    return _build_core(engine_fwd, engine_bwd, ignore_index, reduction)
+
+
+def _pallas_path(x2, w, lab, *, v, h, ignore_index, reduction, vocab_major, interpret, block):
+    n = x2.shape[0]
+    blk_rows, blk_v = block
+    blk_rows = min(blk_rows, _round_up(n, 16))  # small batches: one row block
+    n_pad = _round_up(n, blk_rows)
+    vp = _round_up(v, blk_v)
+    # padding / layout prep sits OUTSIDE the custom VJP: its transpose rules
+    # slice dX and dW back to the caller's shapes automatically
+    x2p = jnp.pad(x2, ((0, n_pad - n), (0, 0))) if n_pad > n else x2
+    labp = (
+        jnp.pad(lab, (0, n_pad - n), constant_values=ignore_index) if n_pad > n else lab
+    )
+    if vp > v:
+        wp = jnp.pad(w, ((0, vp - v), (0, 0)) if vocab_major else ((0, 0), (0, vp - v)))
+    else:
+        wp = w
+    core = _make_pallas_core(
+        n_pad, v, vp, h, blk_rows, blk_v, vocab_major, interpret, ignore_index, reduction
+    )
+    loss = core(x2p, wp, labp)
+    if reduction == "none":
+        loss = loss[:n]
+    return loss
+
+
+# --------------------------------------------------------------------------
+# block-size autotuning + public entry
+# --------------------------------------------------------------------------
+
+
+def _default_block(h: int, itemsize: int) -> Tuple[int, int]:
+    # dW kernel VMEM budget: x + w blocks (native dtype) + fp32 dw block;
+    # larger hidden sizes need smaller blocks — pick the largest tier that
+    # fits the same budget the autotune candidate filter enforces
+    for cfg in ((512, 512), (256, 256), (128, 128)):
+        if _vmem_ok(cfg[0], cfg[1], h, itemsize):
+            return cfg
+    return (128, 128)
+
+
+def _vmem_ok(blk_rows: int, blk_v: int, h: int, itemsize: int) -> bool:
+    resident = (
+        blk_rows * h * itemsize  # x block
+        + blk_v * h * itemsize  # w block
+        + blk_rows * blk_v * 4  # logits
+        + blk_v * h * 4  # fp32 dw accumulator (the fattest kernel's extra)
+    )
+    return resident <= 12 * 1024 * 1024
+
+
+def _autotune_fused_loss(n, v, h, dtype, vocab_major, interpret):
+    """Benchmark-pick (row-block, vocab-block) for this loss-head shape
+    (reference ``auto_tune_base.h:48``); defaults when tuning is off."""
+    from paddle_tpu.kernels.autotune import autotune
+
+    itemsize = jnp.dtype(dtype).itemsize
+    key = (n, v, h, str(dtype), vocab_major)
+    candidates = [
+        (br, bv)
+        for br in (256, 512, 1024)
+        for bv in (256, 512, 1024)
+        if _vmem_ok(br, bv, h, itemsize)
+    ]
+
+    def build(cfg):
+        xz = jnp.zeros((n, h), dtype)
+        wz = jnp.zeros((v, h) if vocab_major else (h, v), dtype)
+        labz = jnp.zeros((n,), jnp.int32)
+
+        def run():
+            loss, vjp_fn = jax.vjp(
+                lambda a, b: _pallas_path(
+                    a, b, labz, v=v, h=h, ignore_index=-100, reduction="mean",
+                    vocab_major=vocab_major, interpret=interpret, block=cfg,
+                ),
+                xz, wz,
+            )
+            return vjp_fn(jnp.ones_like(loss))  # fwd + bwd: the training cost
+
+        return run
+
+    return autotune(
+        "fused_linear_xent", key, candidates, build, default=_default_block(h, itemsize)
+    )
+
+
+def fused_linear_cross_entropy(
+    x: jax.Array,
+    weight: jax.Array,
+    labels: jax.Array,
+    ignore_index: int = -100,
+    reduction: str = "mean",
+    vocab_major: bool = False,
+    interpret: bool = False,
+    block: Optional[Tuple[int, int]] = None,
+) -> jax.Array:
+    """``cross_entropy(x @ Wᵀ, labels)`` without materializing ``[N, V]``.
+
+    ``x`` ``[..., H]``; ``weight`` ``[H, V]`` (``nn.Linear``) or ``[V, H]``
+    with ``vocab_major=True`` (tied embedding); ``labels`` ``[...]`` int.
+    Differentiable in ``x`` and ``weight`` (custom VJP; the backward
+    recomputes block logits from the saved logsumexp). Loss is fp32;
+    reduction semantics match ``F.cross_entropy`` (mean divides by
+    ``max(#non-ignored, 1)``). ``interpret=True`` forces the Pallas path in
+    interpreter mode (tests); ``block`` overrides the autotuned
+    ``(row_block, vocab_block)``.
+    """
+    if reduction not in ("mean", "sum", "none"):
+        raise ValueError(f"unsupported reduction {reduction!r}")
+    lead = x.shape[:-1]
+    h = x.shape[-1]
+    v = weight.shape[0] if vocab_major else weight.shape[1]
+    n = 1
+    for s in lead:
+        n *= int(s)
+    x2 = x.reshape(n, h)
+    lab = labels.reshape(n).astype(jnp.int32)
+
+    loss = None
+    # pre-trace applicability: lane-aligned hidden (see kernels/select.py)
+    if bool(interpret) or (pallas_enabled("use_fused_loss") and h % 128 == 0):
+        blk = tuple(block) if block is not None else _autotune_fused_loss(
+            n, v, h, x.dtype, vocab_major, bool(interpret)
+        )
+        try:
+            loss = _pallas_path(
+                x2, weight, lab, v=v, h=h, ignore_index=int(ignore_index),
+                reduction=reduction, vocab_major=bool(vocab_major),
+                interpret=bool(interpret), block=blk,
+            )
+        except Exception as exc:  # Mosaic lowering / unsupported shape: XLA path covers it
+            warn_fallback("fused_linear_cross_entropy", exc)
+    if loss is None:
+        loss = _reference_path(
+            x2, weight, lab, v=v, h=h, ignore_index=int(ignore_index),
+            reduction=reduction, vocab_major=bool(vocab_major),
+        )
+    if reduction == "none":
+        return loss.reshape(lead)
+    return loss

@@ -13,6 +13,7 @@ import jax
 from jax.experimental.pallas import tpu as pltpu
 
 from paddle_tpu.flags import GLOBAL_FLAGS
+from paddle_tpu.observability import get_registry
 
 # named TPUCompilerParams before jax 0.5 — the one shared shim every kernel
 # module imports (keep version dances out of the kernels themselves)
@@ -20,6 +21,11 @@ _CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerPar
 
 _logger = logging.getLogger("paddle_tpu.kernels")
 _warned: set = set()
+_fallbacks_total = get_registry().counter(
+    "paddle_tpu_kernel_fallbacks_total",
+    "Pallas kernel failures that degraded to the XLA fallback path, by kernel.",
+    labelnames=("kernel",),
+)
 
 
 def pallas_enabled(flag: str) -> bool:
@@ -33,8 +39,10 @@ def pallas_enabled(flag: str) -> bool:
 
 
 def warn_fallback(kernel: str, exc: Exception) -> None:
-    """One-time warning when a Pallas kernel fails and the XLA path is used —
-    silent permanent degradation is worse than one log line."""
+    """Counted (every occurrence) + warned (once) when a Pallas kernel fails
+    and the XLA path is used — silent permanent degradation is worse than one
+    log line, and the counter makes the degradation scrapeable."""
+    _fallbacks_total.labels(kernel=kernel).inc()
     if kernel not in _warned:
         _warned.add(kernel)
         _logger.warning("Pallas kernel %s failed (%s); using XLA fallback", kernel, exc)

@@ -140,7 +140,13 @@ class ErnieModel(nn.Layer):
         position_ids: Optional[Tensor] = None,
         attention_mask: Optional[Tensor] = None,
         task_type_ids: Optional[Tensor] = None,
+        labels: Optional[Tensor] = None,
     ) -> Tuple[Tensor, Tensor]:
+        """Returns ``(sequence_output, pooled_output)``. With ``labels``
+        (masked-LM pretraining; ``-100`` = unmasked/ignored) the first
+        element is instead the MLM **loss** over the tied word-embedding
+        head — fused vocab-chunk-wise when ``FLAGS_use_fused_loss`` is on,
+        so ``[B, S, V]`` prediction scores are never materialized."""
         mask = None
         if attention_mask is not None:
             # [B, S] padding mask → additive [B, 1, 1, S]
@@ -150,6 +156,19 @@ class ErnieModel(nn.Layer):
         for layer in self.encoder:
             h = layer(h, mask)
         pooled = paddle_tpu.tanh(self.pooler(h[:, 0]))
+        if labels is not None:
+            from paddle_tpu.flags import GLOBAL_FLAGS
+
+            w = self.embeddings.word_embeddings.weight
+            if GLOBAL_FLAGS.get("use_fused_loss"):
+                loss = F.fused_linear_cross_entropy(
+                    h, w, labels, ignore_index=-100, reduction="mean",
+                    weight_vocab_major=True,
+                )
+            else:
+                scores = paddle_tpu.matmul(h, w, transpose_y=True)
+                loss = F.cross_entropy(scores, labels, ignore_index=-100, reduction="mean")
+            return loss, pooled
         return h, pooled
 
 

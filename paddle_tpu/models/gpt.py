@@ -136,9 +136,30 @@ class GPTForPretraining(nn.Layer):
         super().__init__()
         self.gpt = GPTModel(config)
 
-    def forward(self, input_ids: Tensor, position_ids: Optional[Tensor] = None) -> Tensor:
+    def forward(
+        self,
+        input_ids: Tensor,
+        position_ids: Optional[Tensor] = None,
+        labels: Optional[Tensor] = None,
+    ) -> Any:
+        """Without ``labels``: ``[B, S, V]`` logits (unchanged). With
+        ``labels``: ``(loss, None)`` on the fused lm-head+cross-entropy path
+        (``FLAGS_use_fused_loss``, tied embedding fuses vocab-major) — logits
+        are never materialized — else ``(loss, logits)``."""
         h = self.gpt(input_ids, position_ids)
         w = self.gpt.embeddings.word_embeddings.weight
+        if labels is not None:
+            from paddle_tpu.flags import GLOBAL_FLAGS
+
+            if GLOBAL_FLAGS.get("use_fused_loss"):
+                loss = F.fused_linear_cross_entropy(
+                    h, w, labels, ignore_index=-100, reduction="mean",
+                    weight_vocab_major=True,
+                )
+                return loss, None
+            logits = paddle_tpu.matmul(h, w, transpose_y=True)
+            loss = F.cross_entropy(logits, labels, ignore_index=-100, reduction="mean")
+            return loss, logits
         return paddle_tpu.matmul(h, w, transpose_y=True)
 
 

@@ -26,6 +26,7 @@ import paddle_tpu
 import paddle_tpu.nn as nn
 import paddle_tpu.nn.functional as F
 from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.flags import GLOBAL_FLAGS
 from paddle_tpu.generation import GenerationMixin
 from paddle_tpu.incubate.nn.functional import fused_rotary_position_embedding
 from paddle_tpu.ops.creation import arange
@@ -330,20 +331,43 @@ class LlamaForCausalLM(nn.Layer, GenerationMixin):
         use_cache: bool = False,
         cache_position: Optional[Tensor] = None,
     ) -> Any:
+        """Causal-LM forward.
+
+        Training contract: with ``labels`` given, the return is
+        ``(loss, logits_or_None)``. When ``FLAGS_use_fused_loss`` is on (the
+        default) the lm-head matmul is fused into a vocab-chunked
+        cross-entropy (``F.fused_linear_cross_entropy``) and the second
+        element is **None** — full ``[B, S, V]`` logits are never
+        materialized, so returning them would pin the very buffer the fused
+        path exists to eliminate across ``backward()``. Callers that need
+        training-time logits must set ``FLAGS_use_fused_loss=False``.
+        Without ``labels`` the return is ``logits`` (plus caches when
+        ``use_cache``), unchanged.
+        """
         out = self.llama(
             input_ids, startend_row_indices, past_key_values, use_cache, cache_position
         )
         caches = None
         if use_cache:
             out, caches = out
+        if labels is not None and GLOBAL_FLAGS.get("use_fused_loss"):
+            if self.lm_head is not None:
+                loss = F.fused_linear_cross_entropy(
+                    out, self.lm_head.weight, labels, ignore_index=-100, reduction="mean"
+                )
+            else:
+                loss = F.fused_linear_cross_entropy(
+                    out, self.llama.embed_tokens.weight, labels,
+                    ignore_index=-100, reduction="mean", weight_vocab_major=True,
+                )
+            return loss, None
         if self.lm_head is not None:
             logits = self.lm_head(out)
         else:
             logits = paddle_tpu.matmul(out, self.llama.embed_tokens.weight, transpose_y=True)
         if labels is not None:
-            loss = F.cross_entropy(
-                logits.astype("float32"), labels, ignore_index=-100, reduction="mean"
-            )
+            # F.cross_entropy upcasts to fp32 internally (stable logsumexp)
+            loss = F.cross_entropy(logits, labels, ignore_index=-100, reduction="mean")
             return loss, logits
         if use_cache:
             return logits, caches

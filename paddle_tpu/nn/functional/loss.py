@@ -11,6 +11,7 @@ from paddle_tpu.ops.registry import defop
 
 __all__ = [
     "cross_entropy",
+    "fused_linear_cross_entropy",
     "softmax_with_cross_entropy",
     "nll_loss",
     "mse_loss",
@@ -53,6 +54,11 @@ def cross_entropy(
     """Softmax cross entropy (reference ``cross_entropy_with_softmax`` kernel +
     ``python/paddle/nn/functional/loss.py`` cross_entropy)."""
     logits = input
+    if jnp.issubdtype(logits.dtype, jnp.floating) and jnp.finfo(logits.dtype).bits < 32:
+        # fp32 logsumexp accumulation for half-precision callers: the upcast
+        # fuses into the jitted log_softmax instead of forcing call sites to
+        # pre-materialize (and pin across backward) an fp32 [.., V] copy
+        logits = logits.astype(jnp.float32)
     if use_softmax:
         logp = jax.nn.log_softmax(logits, axis=axis)
     else:
@@ -90,6 +96,37 @@ def cross_entropy(
             denom = jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
         return jnp.sum(loss) / denom
     return _reduce(loss, reduction)
+
+
+@defop("fused_linear_cross_entropy_fn", tensor_method=None)
+def fused_linear_cross_entropy(
+    input,  # noqa: A002
+    weight,
+    label,
+    ignore_index=-100,
+    reduction="mean",
+    weight_vocab_major=False,
+):
+    """Fused lm-head + softmax cross entropy: ``cross_entropy(input @ Wᵀ,
+    label)`` computed vocab-chunk-wise so the ``[.., V]`` logits are never
+    materialized in any dtype (forward keeps an online fp32 logsumexp + the
+    target-class logit; backward recomputes block logits — see
+    ``kernels/fused_loss.py``). ``weight`` is ``[H, V]`` (``nn.Linear``
+    layout) or ``[V, H]`` with ``weight_vocab_major=True`` (tied-embedding
+    lm-head). Loss is fp32; ``ignore_index`` / ``reduction`` semantics match
+    :func:`cross_entropy`. Pallas on TPU (``FLAGS_use_fused_loss``), a
+    ``lax.scan`` reference with the same custom-VJP decomposition elsewhere.
+    """
+    from paddle_tpu.kernels.fused_loss import fused_linear_cross_entropy as _fused
+
+    return _fused(
+        input,
+        weight,
+        label,
+        ignore_index=ignore_index,
+        reduction=reduction,
+        vocab_major=weight_vocab_major,
+    )
 
 
 def softmax_with_cross_entropy(
