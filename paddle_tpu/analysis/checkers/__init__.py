@@ -9,6 +9,7 @@ from typing import Dict, List, Type
 from paddle_tpu.analysis.checkers.exception_hygiene import ExceptionHygieneChecker
 from paddle_tpu.analysis.checkers.flag_discipline import FlagDisciplineChecker
 from paddle_tpu.analysis.checkers.pallas_purity import PallasPurityChecker
+from paddle_tpu.analysis.checkers.robustness import RobustnessChecker
 from paddle_tpu.analysis.checkers.trace_safety import TraceSafetyChecker
 from paddle_tpu.analysis.core import Checker
 
@@ -19,6 +20,7 @@ CHECKER_CLASSES: List[Type[Checker]] = [
     PallasPurityChecker,
     FlagDisciplineChecker,
     ExceptionHygieneChecker,
+    RobustnessChecker,
 ]
 
 

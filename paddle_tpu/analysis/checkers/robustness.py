@@ -1,0 +1,83 @@
+"""RB — robustness checker.
+
+``os._exit`` kills the process without running ``finally`` blocks, atexit
+hooks, or buffered-IO flush. The fault-tolerance layer depends on orderly
+unwinding: a checkpoint save interrupted by ``os._exit`` skips its atomic
+commit, and a serving process exiting this way drops finished requests that
+were awaiting delivery. The only sanctioned users are:
+
+- ``distributed/watchdog.py`` — the reference CommTaskManager contract is
+  dump-then-abort; a hung collective cannot be cancelled from Python, so a
+  normal exit would block forever;
+- ``distributed/launch/`` — the launcher's process-group teardown, where the
+  children being killed are the ones being relaunched.
+
+- RB501  ``os._exit`` call outside those locations (including through an
+         ``import os as X`` alias or ``from os import _exit``).
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import PurePath
+from typing import List, Set
+
+from paddle_tpu.analysis.core import Checker, FileContext, Violation
+
+_ALLOWED_FILE_SUFFIX = ("distributed", "watchdog.py")
+_ALLOWED_DIR = ("distributed", "launch")
+
+
+def _is_allowed_path(path: str) -> bool:
+    parts = PurePath(path).parts
+    if len(parts) >= 2 and parts[-2:] == _ALLOWED_FILE_SUFFIX:
+        return True
+    for i in range(len(parts) - 1):
+        if parts[i : i + 2] == _ALLOWED_DIR:
+            return True
+    return False
+
+
+class RobustnessChecker(Checker):
+    name = "robustness"
+    codes = {
+        "RB501": "os._exit outside distributed/watchdog.py or distributed/launch/ "
+                 "(bypasses checkpoint flush and finished-request delivery)",
+    }
+
+    def run(self, ctx: FileContext) -> List[Violation]:
+        if _is_allowed_path(ctx.path):
+            return []
+        os_aliases: Set[str] = {"os"}
+        exit_names: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "os":
+                        os_aliases.add(a.asname or "os")
+            elif isinstance(node, ast.ImportFrom) and node.module == "os":
+                for a in node.names:
+                    if a.name == "_exit":
+                        exit_names.add(a.asname or "_exit")
+        out: List[Violation] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            hit = (
+                isinstance(fn, ast.Attribute)
+                and fn.attr == "_exit"
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id in os_aliases
+            ) or (isinstance(fn, ast.Name) and fn.id in exit_names)
+            if hit:
+                out.append(
+                    Violation(
+                        ctx.path, node.lineno, node.col_offset, "RB501",
+                        "os._exit skips finally/atexit/IO flush — it bypasses "
+                        "checkpoint commit and finished-request delivery; only "
+                        "the watchdog abort path (distributed/watchdog.py) and "
+                        "the launcher (distributed/launch/) may call it",
+                    )
+                )
+        return out

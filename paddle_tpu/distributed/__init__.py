@@ -55,4 +55,6 @@ from paddle_tpu.distributed.parallel import (  # noqa: F401
     is_initialized,
 )
 from paddle_tpu.distributed.placements import Partial, Placement, Replicate, Shard  # noqa: F401
+from paddle_tpu.distributed.resilient import resilient_train_loop  # noqa: F401
 from paddle_tpu.distributed.store import Store, TCPStore  # noqa: F401
+from paddle_tpu.distributed.watchdog import CommWatchdog, WatchdogTimeout  # noqa: F401

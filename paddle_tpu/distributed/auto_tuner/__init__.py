@@ -258,6 +258,7 @@ def _run_trial_in_subprocess(
             except Exception:  # noqa: BLE001
                 pass
         conn.close()
+        # analysis: disable=RB501 forked trial child owns no checkpoints or requests; the parent reads the pipe, and running jax teardown in the fork would deadlock
         _os._exit(code)  # skip atexit/jax teardown in the fork
 
     proc = ctx.Process(target=child, args=(send, cfg), daemon=True)

@@ -1,13 +1,20 @@
-"""Distributed checkpoint: sharded save + cross-mesh reshard-on-load.
+"""Distributed checkpoint: sharded save + cross-mesh reshard-on-load,
+crash-consistent (atomic writes, content-hashed manifests, managed
+retention/validation via :class:`CheckpointManager`).
 
 Reference: ``python/paddle/distributed/checkpoint/`` —
 ``save_state_dict.py:145``, ``load_state_dict.py:467``, ``metadata.py``.
 """
 
 from paddle_tpu.distributed.checkpoint.load_state_dict import load_state_dict  # noqa: F401
+from paddle_tpu.distributed.checkpoint.manager import (  # noqa: F401
+    CheckpointManager,
+    CheckpointRecord,
+)
 from paddle_tpu.distributed.checkpoint.metadata import (  # noqa: F401
     LocalTensorIndex,
     LocalTensorMetadata,
     Metadata,
+    file_sha256,
 )
 from paddle_tpu.distributed.checkpoint.save_state_dict import save_state_dict  # noqa: F401

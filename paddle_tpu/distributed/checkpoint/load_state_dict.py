@@ -22,7 +22,7 @@ import jax
 import numpy as np
 
 from paddle_tpu.core.tensor import Tensor
-from paddle_tpu.distributed.checkpoint.metadata import Metadata
+from paddle_tpu.distributed.checkpoint.metadata import Metadata, file_sha256
 
 __all__ = ["load_state_dict"]
 
@@ -35,6 +35,28 @@ def _read_metadata(path: str) -> List[Metadata]:
     if not metas:
         raise FileNotFoundError(f"no *.metadata manifest under {path}")
     return metas
+
+
+def _verify_hashes(path: str, metas: List[Metadata]) -> None:
+    """Check every manifest-referenced data file against its recorded content
+    hash (manifests from before the hash field simply have none). A mismatch
+    means a torn/corrupt write — loading it would silently serve garbage."""
+    for meta in metas:
+        for fname, digest in getattr(meta, "file_hashes", {}).items():
+            fp = os.path.join(path, fname)
+            if not os.path.isfile(fp):
+                raise FileNotFoundError(
+                    f"checkpoint payload {fname} referenced by the manifest "
+                    f"is missing under {path} (incomplete save?)"
+                )
+            actual = file_sha256(fp)
+            if actual != digest:
+                raise ValueError(
+                    f"checkpoint payload {fname} failed its content hash "
+                    f"({actual[:12]}… != manifest {digest[:12]}…) — torn or "
+                    "corrupt write; use CheckpointManager.latest_valid() to "
+                    "fall back to the last good checkpoint"
+                )
 
 
 def _assemble(name: str, metas: List[Metadata], payloads: Dict[str, Any]) -> np.ndarray:
@@ -89,6 +111,7 @@ def load_state_dict(
     """Fill ``state_dict``'s tensors in place from the checkpoint at ``path``,
     resharding to each target tensor's current placements."""
     metas = _read_metadata(path)
+    _verify_hashes(path, metas)
     npz_files = [np.load(f) for f in glob.glob(os.path.join(path, "*.distcp.npz"))]
     try:
         payloads = {}

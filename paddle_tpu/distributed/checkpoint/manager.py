@@ -1,0 +1,247 @@
+"""Crash-consistent checkpoint manager: retention, validation, resume.
+
+Reference intent: the fork's elastic stack relaunches a failed job and
+resumes "from the checkpoint" — which only works if the checkpoint a crash
+left behind is *loadable or detectably bad*, never silently torn. This
+manager owns a directory of step-numbered checkpoints:
+
+- :meth:`save` writes into a hidden staging directory and atomically
+  ``os.replace``\\ s it into place, so a crash mid-save can never produce a
+  half-checkpoint under a committed name;
+- :meth:`latest_valid` walks checkpoints newest-first and returns the first
+  whose manifest parses AND whose every data file matches its recorded
+  content hash — torn/corrupt checkpoints are counted
+  (``checkpoints_skipped_torn_total``) and skipped;
+- retention keeps the newest ``keep`` checkpoints (older ones are deleted
+  only after a save commits, so the invariant "at least one good checkpoint"
+  survives a crash at any instant);
+- non-array state (an optimizer's LR-scheduler dict, step counters, user
+  ``extra``) rides in a JSON sidecar so one :meth:`restore` rebuilds the
+  whole training state.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+from typing import Any, Dict, List, NamedTuple, Optional
+
+from paddle_tpu.distributed.checkpoint.load_state_dict import (
+    _read_metadata,
+    load_state_dict,
+)
+from paddle_tpu.distributed.checkpoint.metadata import file_sha256
+from paddle_tpu.distributed.checkpoint.save_state_dict import save_state_dict
+from paddle_tpu.observability import metrics as _obs
+
+__all__ = ["CheckpointManager", "CheckpointRecord"]
+
+_SIDECAR = "extra_state.json"
+_STEP_RE = re.compile(r"^step_(\d{8})$")
+
+_saved_total = _obs.GLOBAL_METRICS.counter(
+    "checkpoints_saved_total", "Checkpoints committed by CheckpointManager.save."
+)
+_skipped_torn_total = _obs.GLOBAL_METRICS.counter(
+    "checkpoints_skipped_torn_total",
+    "Checkpoints skipped by latest_valid() as torn/corrupt "
+    "(unreadable manifest, missing payload, or content-hash mismatch).",
+)
+
+
+class CheckpointRecord(NamedTuple):
+    step: int
+    path: str
+
+
+def _is_jsonable(v: Any) -> bool:
+    return isinstance(v, (dict, list, tuple, str, bool)) or v is None
+
+
+class CheckpointManager:
+    """Manage ``root/step_XXXXXXXX`` checkpoint directories.
+
+    ``state_dict`` values that are tensors/arrays (anything with ``.shape``)
+    or plain numbers go through the sharded array writer; dict/list/str/bool/
+    None values go to the JSON sidecar and come back natively from
+    :meth:`restore` — so ``{**model_state, **optimizer.state_dict()}`` (which
+    mixes tensors, ints and an LR-scheduler dict) round-trips whole.
+    """
+
+    def __init__(self, root: str, keep: int = 3) -> None:
+        if keep < 1:
+            raise ValueError(f"keep must be >= 1, got {keep}")
+        self.root = str(root)
+        self.keep = int(keep)
+        os.makedirs(self.root, exist_ok=True)
+
+    # -- paths ---------------------------------------------------------------
+    def _dir(self, step: int) -> str:
+        return os.path.join(self.root, f"step_{int(step):08d}")
+
+    def steps(self) -> List[int]:
+        """Committed checkpoint steps, ascending (validity not checked)."""
+        out = []
+        for name in os.listdir(self.root):
+            m = _STEP_RE.match(name)
+            if m and os.path.isdir(os.path.join(self.root, name)):
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    # -- save ----------------------------------------------------------------
+    def save(
+        self,
+        state_dict: Dict[str, Any],
+        step: int,
+        extra: Optional[Dict[str, Any]] = None,
+    ) -> str:
+        """Write one checkpoint for ``step``; returns its committed path.
+
+        The whole checkpoint is staged under ``.staging_step_XXXXXXXX`` and
+        renamed into place in one ``os.replace`` — an abort at ANY point
+        (including an injected ``checkpoint.write`` fault) leaves no
+        committed directory, so ``latest_valid()`` still sees the previous
+        checkpoint."""
+        arrays: Dict[str, Any] = {}
+        sidecar_state: Dict[str, Any] = {}
+        for k, v in state_dict.items():
+            if _is_jsonable(v):
+                sidecar_state[k] = v
+            else:
+                arrays[k] = v  # Tensor / ndarray / scalar — save_state_dict's job
+        staging = os.path.join(self.root, f".staging_step_{int(step):08d}")
+        shutil.rmtree(staging, ignore_errors=True)
+        try:
+            save_state_dict(arrays, staging)
+            sidecar = {
+                "step": int(step),
+                "extra": dict(extra or {}),
+                "state": sidecar_state,
+            }
+            payload = json.dumps(sidecar).encode()
+            tmp = os.path.join(staging, _SIDECAR + ".tmp")
+            with open(tmp, "wb") as f:
+                f.write(payload)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, os.path.join(staging, _SIDECAR))
+            final = self._dir(step)
+            trash = None
+            if os.path.exists(final):
+                # re-save of the same step (a relaunch redoing it): move the
+                # old committed checkpoint aside FIRST — os.replace cannot
+                # land on a non-empty dir, and rmtree-before-replace would
+                # open a crash window with NEITHER checkpoint on disk
+                trash = os.path.join(self.root, f".trash_step_{int(step):08d}")
+                shutil.rmtree(trash, ignore_errors=True)
+                os.replace(final, trash)
+            try:
+                os.replace(staging, final)
+            except BaseException:
+                # commit rename failed: put the old checkpoint back so the
+                # step is never left with neither version on disk
+                if trash is not None:
+                    os.replace(trash, final)
+                raise
+            if trash is not None:
+                shutil.rmtree(trash, ignore_errors=True)
+        except BaseException:
+            # any abort (incl. KeyboardInterrupt / injected fault) must drop
+            # the staging dir so no half-written checkpoint can ever commit
+            shutil.rmtree(staging, ignore_errors=True)
+            raise
+        _saved_total.inc()
+        self._retain()
+        return final
+
+    def _retain(self) -> None:
+        for step in self.steps()[: -self.keep]:
+            shutil.rmtree(self._dir(step), ignore_errors=True)
+
+    # -- validate / find -----------------------------------------------------
+    def validate(self, step: int) -> bool:
+        """True iff ``step``'s checkpoint is complete and uncorrupted: the
+        manifest parses, every referenced payload exists and matches its
+        content hash, and the sidecar (when present) parses."""
+        path = self._dir(step)
+        try:
+            metas = _read_metadata(path)
+        except Exception:  # unreadable/missing/torn manifest IS the detected condition
+            return False
+        for meta in metas:
+            hashes = getattr(meta, "file_hashes", {})
+            for fname in set(meta.storage_metadata.values()):
+                fp = os.path.join(path, fname + ".npz")
+                if not os.path.isfile(fp):
+                    return False
+                digest = hashes.get(fname + ".npz")
+                if digest is not None and file_sha256(fp) != digest:
+                    return False
+        sidecar = os.path.join(path, _SIDECAR)
+        if os.path.exists(sidecar):
+            try:
+                with open(sidecar, "r", encoding="utf-8") as f:
+                    json.load(f)
+            except (OSError, ValueError):  # torn sidecar: checkpoint unusable
+                return False
+        return True
+
+    def latest_valid(self) -> Optional[CheckpointRecord]:
+        """Newest checkpoint that passes :meth:`validate`; torn ones are
+        counted and skipped. None when no valid checkpoint exists."""
+        for step in reversed(self.steps()):
+            if self.validate(step):
+                return CheckpointRecord(step, self._dir(step))
+            _skipped_torn_total.inc()
+        return None
+
+    # -- restore -------------------------------------------------------------
+    def manifest_keys(self, step: int) -> List[str]:
+        """Every state key stored at ``step`` (arrays + sidecar)."""
+        path = self._dir(step)
+        keys = set()
+        for meta in _read_metadata(path):
+            keys.update(meta.state_dict_metadata)
+        keys.update(self._read_sidecar(path)["state"])
+        return sorted(keys)
+
+    def _read_sidecar(self, path: str) -> Dict[str, Any]:
+        sidecar = os.path.join(path, _SIDECAR)
+        if not os.path.exists(sidecar):
+            return {"step": -1, "extra": {}, "state": {}}
+        with open(sidecar, "r", encoding="utf-8") as f:
+            return json.load(f)
+
+    def restore(
+        self, state_dict: Dict[str, Any], step: Optional[int] = None
+    ) -> Dict[str, Any]:
+        """Fill ``state_dict`` from checkpoint ``step`` (default: latest
+        valid). Tensor values are filled in place (resharded to their current
+        placements); plain-array and sidecar entries are replaced in the
+        dict. Returns ``{"step": saved_step, "extra": {...}}``."""
+        if step is None:
+            rec = self.latest_valid()
+            if rec is None:
+                raise FileNotFoundError(f"no valid checkpoint under {self.root}")
+            step = rec.step
+        path = self._dir(step)
+        sidecar = self._read_sidecar(path)
+        saved_arrays = set()
+        for meta in _read_metadata(path):
+            saved_arrays.update(meta.state_dict_metadata)
+        # only keys the checkpoint actually holds are restored: a target key
+        # born after this checkpoint (e.g. an optimizer accumulator created
+        # by a later step) keeps its current value instead of KeyError-ing
+        # the whole resume
+        array_target = {
+            k: v for k, v in state_dict.items()
+            if k in saved_arrays and k not in sidecar["state"]
+        }
+        if array_target:
+            load_state_dict(array_target, path)
+            state_dict.update(array_target)
+        for k, v in sidecar["state"].items():
+            state_dict[k] = v
+        return {"step": int(sidecar["step"]), "extra": dict(sidecar["extra"])}

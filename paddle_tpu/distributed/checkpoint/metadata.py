@@ -4,12 +4,27 @@ Reference: ``python/paddle/distributed/checkpoint/metadata.py`` —
 ``LocalTensorMetadata`` (offsets + lengths of one shard in the global
 tensor), ``LocalTensorIndex`` (which file holds it), ``Metadata`` (the global
 manifest written once by the coordinator).
+
+Crash consistency: the manifest also carries a content hash for every data
+file it references (``file_hashes``), written AFTER the data file was
+atomically committed — a torn or corrupt payload is detectable instead of
+silently loadable, and ``CheckpointManager.latest_valid()`` skips it.
 """
 
 from __future__ import annotations
 
+import hashlib
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
+
+
+def file_sha256(path: str) -> str:
+    """Streaming sha256 of one file (the manifest's content-hash function)."""
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
 
 
 @dataclass(frozen=True)
@@ -38,3 +53,7 @@ class Metadata:
     storage_metadata: Dict[LocalTensorIndex, str] = field(default_factory=dict)
     global_shapes: Dict[str, Tuple[int, ...]] = field(default_factory=dict)
     flat_mapping: Dict[str, str] = field(default_factory=dict)
+    # data filename (as written, e.g. "0_0.distcp.npz") -> sha256 hex digest;
+    # read with getattr(..., "file_hashes", {}) — manifests pickled before
+    # this field existed unpickle without it
+    file_hashes: Dict[str, str] = field(default_factory=dict)

@@ -26,9 +26,23 @@ from paddle_tpu.distributed.checkpoint.metadata import (
     LocalTensorIndex,
     LocalTensorMetadata,
     Metadata,
+    file_sha256,
 )
+from paddle_tpu.testing.faults import fault_point
 
 __all__ = ["save_state_dict"]
+
+
+def _atomic_write(path: str, writer) -> None:
+    """Write via a sibling tmp file + ``os.replace``: readers never observe a
+    half-written file, and a crash mid-write leaves the old file (or nothing)
+    instead of a torn one that pickle/npz would happily half-load."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        writer(f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
 
 
 def _to_array(v: Any):
@@ -62,8 +76,10 @@ def save_state_dict(
         # shards with fresh ones
         import glob as _glob
 
-        for stale in _glob.glob(os.path.join(path, "*.distcp.npz")) + _glob.glob(
-            os.path.join(path, "*.metadata")
+        for stale in (
+            _glob.glob(os.path.join(path, "*.distcp.npz"))
+            + _glob.glob(os.path.join(path, "*.metadata"))
+            + _glob.glob(os.path.join(path, "*.tmp"))  # crashed-save leftovers
         ):
             os.remove(stale)
     meta = Metadata()
@@ -102,8 +118,17 @@ def save_state_dict(
             shards_payload[f"{name}@{off}"] = data
         meta.state_dict_metadata[name] = entries
 
-    np.savez(os.path.join(path, fname + ".npz"), **shards_payload)
+    # crash-consistent commit order: (1) data file atomically, (2) hash of
+    # the committed bytes into the manifest, (3) manifest atomically — a
+    # fault anywhere leaves either no manifest (checkpoint invisible) or a
+    # manifest whose hashes expose any missing/torn data file
+    fault_point("checkpoint.write")
+    payload_path = os.path.join(path, fname + ".npz")
+    _atomic_write(payload_path, lambda f: np.savez(f, **shards_payload))
+    meta.file_hashes[fname + ".npz"] = file_sha256(payload_path)
     # every process writes its own manifest piece; rank 0's name is canonical.
     # single-host (the common test path): one manifest with everything.
-    with open(os.path.join(path, f"{rank}.metadata"), "wb") as f:
-        pickle.dump(meta, f)
+    fault_point("checkpoint.write")
+    _atomic_write(
+        os.path.join(path, f"{rank}.metadata"), lambda f: pickle.dump(meta, f)
+    )

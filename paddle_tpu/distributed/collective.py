@@ -22,6 +22,7 @@ import numpy as np
 
 from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.observability import metrics as _obs
+from paddle_tpu.testing.faults import fault_point as _fault_point
 
 _coll_calls = _obs.GLOBAL_METRICS.counter(
     "collective_calls_total",
@@ -37,12 +38,15 @@ _coll_seconds = _obs.GLOBAL_METRICS.counter(
 
 
 def _instrumented(fn):
-    """Wrap one collective with call/time counters. With metrics off the
-    wrapper is a single cached-bool check — safe on trace-time hot paths."""
+    """Wrap one collective with call/time counters and a fault-injection
+    site (``collective.<op>``). With metrics off and no fault plan installed
+    the wrapper is two cached-bool checks — safe on trace-time hot paths."""
     op = fn.__name__
+    fault_site = f"collective.{op}"
 
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):
+        _fault_point(fault_site)
         if not _obs.metrics_enabled():
             return fn(*args, **kwargs)
         t0 = time.perf_counter()

@@ -1,0 +1,141 @@
+"""Resilient training loop: CommWatchdog + crash-consistent checkpoint resume.
+
+Reference shape: the fork's ``CommTaskManager`` (detect → dump → abort →
+relaunch) plus its elastic manager's relaunch-with-checkpoint contract. Two
+failure regimes compose here:
+
+- **in-process recoverable** — a step raises (backend error, watchdog-raised
+  ``WatchdogTimeout``, injected fault): restore the last *valid* checkpoint
+  (``CheckpointManager.latest_valid()`` skips torn ones) and resume from the
+  step after it, up to ``max_failures`` times;
+- **process-fatal** — a true hang: the ``CommWatchdog`` section around each
+  step dumps diagnostics and (when ``abort=True``) exits so the launcher /
+  elastic layer relaunches the process — on the next life this same loop
+  finds the checkpoint and resumes.
+
+The loop checkpoints ``state_dict`` (plus the optimizer's state and the step
+counter) every ``save_every`` steps through :class:`CheckpointManager`, whose
+atomic-commit discipline guarantees the resume source is never a torn file.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple, Type
+
+from paddle_tpu.distributed.checkpoint.manager import CheckpointManager
+from paddle_tpu.distributed.watchdog import CommWatchdog, WatchdogTimeout
+
+__all__ = ["resilient_train_loop"]
+
+_OPT_PREFIX = "optim::"
+
+
+def _full_state(state_dict: Dict[str, Any], optimizer: Any) -> Dict[str, Any]:
+    sd = dict(state_dict)
+    if optimizer is not None:
+        for k, v in optimizer.state_dict().items():
+            sd[_OPT_PREFIX + k] = v
+    return sd
+
+
+def _restore(
+    manager: CheckpointManager,
+    state_dict: Dict[str, Any],
+    optimizer: Any,
+    step: int,
+) -> Dict[str, Any]:
+    target = _full_state(state_dict, optimizer)
+    for k in manager.manifest_keys(step):
+        # placeholders for checkpoint keys the live objects don't hold yet
+        # (e.g. optimizer accumulators on a fresh relaunch): restore returns
+        # them as host arrays / native values
+        target.setdefault(k, None)
+    info = manager.restore(target, step=step)
+    for k, v in target.items():
+        if not k.startswith(_OPT_PREFIX):
+            # Tensor entries were filled in place (v is state_dict[k]);
+            # plain entries were replaced — write the restored value back
+            state_dict[k] = v
+    if optimizer is not None:
+        optimizer.set_state_dict(
+            {k[len(_OPT_PREFIX):]: v for k, v in target.items()
+             if k.startswith(_OPT_PREFIX)}
+        )
+    return info
+
+
+def resilient_train_loop(
+    step_fn: Callable[[int], Any],
+    state_dict: Dict[str, Any],
+    num_steps: int,
+    manager: CheckpointManager,
+    optimizer: Any = None,
+    watchdog: Optional[CommWatchdog] = None,
+    save_every: int = 1,
+    max_failures: int = 3,
+    recover_on: Tuple[Type[BaseException], ...] = (
+        WatchdogTimeout,
+        RuntimeError,  # covers XlaRuntimeError + injected faults
+        MemoryError,
+        OSError,
+    ),
+) -> Dict[str, Any]:
+    """Run ``step_fn(step)`` for steps ``0..num_steps-1`` with checkpointing
+    and resume-on-failure.
+
+    On entry, an existing valid checkpoint (e.g. from a previous life of
+    this process) is restored and the loop starts after it. Each completed
+    step is checkpointed every ``save_every`` steps; a ``recover_on``
+    exception restores the last valid checkpoint and resumes from the step
+    after it (or retries from the initial state when nothing was saved yet).
+    More than ``max_failures`` recoveries re-raises — a persistent fault
+    must escalate to the launcher, not loop forever.
+
+    Returns a summary: ``{"start_step", "failures", "resumes": [...],
+    "completed": num_steps}``.
+    """
+    resumes = []
+    failures = 0
+    start = 0
+    rec = manager.latest_valid()
+    if rec is not None:
+        info = _restore(manager, state_dict, optimizer, rec.step)
+        start = info["step"] + 1
+    step = start
+    while step < num_steps:
+        try:
+            if watchdog is not None:
+                with watchdog.section(f"train_step_{step}"):
+                    step_fn(step)
+            else:
+                step_fn(step)
+            # the save participates in the same recovery policy: a transient
+            # disk failure mid-save (its staging discipline left both the
+            # live state and the previous checkpoint intact) consumes a
+            # failure budget slot and resumes, instead of killing the run
+            if save_every and step % save_every == 0:
+                manager.save(_full_state(state_dict, optimizer), step)
+        except recover_on as exc:
+            failures += 1
+            if failures > max_failures:
+                raise
+            rec = manager.latest_valid()
+            resumes.append(
+                {
+                    "failed_step": step,
+                    "error": f"{type(exc).__name__}: {exc}"[:200],
+                    "resumed_from": rec.step if rec is not None else None,
+                }
+            )
+            if rec is not None:
+                info = _restore(manager, state_dict, optimizer, rec.step)
+                step = info["step"] + 1
+            # no checkpoint yet: retry the same step from the live state
+            continue
+        step += 1
+    return {
+        "start_step": start,
+        "completed": int(num_steps),
+        "failures": failures,
+        "resumes": resumes,
+    }

@@ -54,6 +54,9 @@ class CommWatchdog:
         self.on_timeout = on_timeout
         self.abort = abort
         self.completed: Deque[Dict[str, Any]] = deque(maxlen=history)
+        # the most recent timeout dump, exposed so a resilient loop (or a
+        # test) can assert on WHAT fired without scraping stderr
+        self.last_dump: Optional[Dict[str, Any]] = None
         self._lock = threading.Lock()
         self._seq = 0
 
@@ -71,22 +74,34 @@ class CommWatchdog:
             "thread_stacks": stacks,
         }
 
+    def _write_stderr(self, dump: Dict[str, Any]) -> None:
+        sys.stderr.write(
+            f"[CommWatchdog] section '{dump['section']}' exceeded "
+            f"{self.timeout}s — probable collective hang. Recent sections: "
+            f"{[s['section'] for s in dump['recent_sections']]}\n"
+        )
+        for tid, st in dump["thread_stacks"].items():
+            sys.stderr.write(f"--- thread {tid} ---\n{''.join(st)}\n")
+        sys.stderr.flush()
+
     def _fire(self, name: str, started: float, done: threading.Event) -> None:
         if done.wait(self.timeout):
             return
         dump = self._dump(name, started)
+        self.last_dump = dump
         try:
-            if self.on_timeout is not None:
-                self.on_timeout(dump)
-            else:
-                sys.stderr.write(
-                    f"[CommWatchdog] section '{name}' exceeded {self.timeout}s — "
-                    f"probable collective hang. Recent sections: "
-                    f"{[s['section'] for s in dump['recent_sections']]}\n"
-                )
-                for tid, st in dump["thread_stacks"].items():
-                    sys.stderr.write(f"--- thread {tid} ---\n{''.join(st)}\n")
-                sys.stderr.flush()
+            try:
+                if self.on_timeout is not None:
+                    self.on_timeout(dump)
+                else:
+                    self._write_stderr(dump)
+            except Exception:
+                # a buggy user handler must not suppress the abort path's
+                # diagnostics — dump the handler's own failure, then fall
+                # back to the default stderr dump so the hang evidence
+                # reaches the logs before any abort
+                traceback.print_exc(file=sys.stderr)
+                self._write_stderr(dump)
         finally:
             if self.abort:
                 # the hung collective cannot be cancelled from Python — abort
@@ -130,5 +145,9 @@ class _Section:
                     "seq": self._wd._seq,
                     "duration_s": time.monotonic() - self._started,
                     "ok": exc_type is None,
+                    # WHAT failed, not just that it did: lets a resilient
+                    # loop / test distinguish a WatchdogTimeout from an OOM
+                    # without racing stderr
+                    "exc_type": exc_type.__name__ if exc_type is not None else None,
                 }
             )

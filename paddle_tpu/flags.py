@@ -140,6 +140,9 @@ def _define_builtin_flags() -> None:
     d("enable_metrics", bool, False, "Record runtime metrics (counters/gauges/histograms) into the global registry; off = every recording call is a no-op.")
     d("metrics_port", int, 0, "Serve Prometheus text exposition on this localhost port via observability.start_metrics_server(); 0 disables the endpoint.")
     d("max_compiles_per_fn", int, 16, "Recompile-watchdog budget: warn when one traced function RE-compiles (compiles past its first_call traces) more than this many times; 0 disables the warning.")
+    # fault-tolerance layer (registered here so env seeding works before the
+    # paddle_tpu.testing.faults import runs; empty = injection fully off)
+    d("fault_inject_plan", str, "", "Deterministic fault-injection plan: 'site:call_index:ExceptionName' entries joined by ';' (see testing/faults.py). Empty disables injection; fault sites then cost one cached-bool read.")
 
 
 _define_builtin_flags()

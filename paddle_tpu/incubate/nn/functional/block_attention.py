@@ -25,6 +25,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from paddle_tpu.testing.faults import fault_point as _fault_point
+
 __all__ = [
     "BlockKVCache",
     "block_multihead_attention",
@@ -85,6 +87,7 @@ class BlockKVCache:
     # -- allocator ----------------------------------------------------------
     def allocate(self, seq_id: int, num_tokens: int) -> None:
         """Ensure ``seq_id`` has blocks for ``num_tokens`` more tokens."""
+        _fault_point("block_pool.allocate")
         table = self._tables.setdefault(seq_id, [])
         cur = self._lens.get(seq_id, 0)
         need_blocks = -(-(cur + num_tokens) // self.block_size)

@@ -27,6 +27,13 @@ The block allocator is host-side Python (it runs between steps, not inside
 the program), reusing ``BlockKVCache``'s accounting; admission reserves a
 request's worst-case block need up front so a mid-flight decode step can
 never hit pool exhaustion.
+
+Fault tolerance: because every request's prompt and generated tokens live on
+the host (``InferenceRequest``), a dispatch failure that consumed the
+donated KV buffers is recoverable — ``step()`` retries with backoff through
+``recover()``, which rebuilds the pools and replays every live slot from
+host truth through the SAME two compiled programs (see README "Fault
+tolerance"). Only exhausted retries mark the engine permanently failed.
 """
 
 from __future__ import annotations
@@ -46,6 +53,7 @@ from paddle_tpu.observability.recompile import (
     CAUSE_NEW_SHAPE_DTYPE,
     GLOBAL_WATCHDOG,
 )
+from paddle_tpu.testing.faults import InjectedFault, fault_point
 
 __all__ = ["ContinuousBatchingEngine", "InferenceRequest"]
 
@@ -91,6 +99,16 @@ def _engine_metrics() -> Dict[str, Any]:
         "blocks_reserved": reg.gauge(
             "engine_kv_blocks_reserved",
             "Worst-case blocks reserved by live sequences (admission guarantee).",
+        ),
+        "recoveries": reg.counter(
+            "engine_recoveries_total",
+            "Step recoveries: KV buffers reallocated and live requests "
+            "replayed after a dispatch failure consumed the donated caches.",
+        ),
+        "replayed": reg.counter(
+            "engine_requests_replayed_total",
+            "Live requests re-prefilled and replayed from host-side truth "
+            "during a recovery.",
         ),
         "util": reg.gauge(
             "engine_kv_pool_utilization",
@@ -142,6 +160,8 @@ class ContinuousBatchingEngine:
         num_blocks: Optional[int] = None,
         prompt_bucket: int = 32,
         max_model_len: Optional[int] = None,
+        max_recoveries: int = 2,
+        recovery_backoff: float = 0.05,
     ) -> None:
         from paddle_tpu.incubate.nn.functional import BlockKVCache
 
@@ -170,6 +190,10 @@ class ContinuousBatchingEngine:
         hd = cfg.hidden_size // cfg.num_attention_heads
         self._num_layers = cfg.num_hidden_layers
         dtype = next(iter(model.parameters())).dtype
+        # cache geometry, kept so recover() can rebuild identical buffers
+        # (identical shapes/dtypes -> the compiled programs are reused)
+        self._kvh, self._hd, self._cache_dtype = kvh, hd, dtype
+        self._cache_shape = (self.num_blocks, kvh, self.block_size, hd)
         # host-side allocator/accounting only; the device pool lives below
         self._mgr = BlockKVCache(
             self.num_blocks, self.block_size, kvh, hd,
@@ -178,9 +202,8 @@ class ContinuousBatchingEngine:
         # ONE global paged pool shared by every layer's sequences would alias
         # writes across layers — each layer owns its [NB, KVH, BS, D] pair,
         # all indexed by the SAME block tables (the reference layout).
-        shape = (self.num_blocks, kvh, self.block_size, hd)
         self._caches = [
-            (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+            (jnp.zeros(self._cache_shape, dtype), jnp.zeros(self._cache_shape, dtype))
             for _ in range(self._num_layers)
         ]
 
@@ -194,15 +217,28 @@ class ContinuousBatchingEngine:
         self._ids = itertools.count()
 
         self._named = list(model.named_parameters())
-        self.stats = {"prefill_traces": 0, "decode_traces": 0, "steps": 0, "admitted": 0}
+        self.stats = {
+            "prefill_traces": 0, "decode_traces": 0, "steps": 0,
+            "admitted": 0, "recoveries": 0,
+        }
         self._metrics = _engine_metrics()
         self._update_pool_gauges()
         # On donating backends (TPU) a step that fails AFTER dispatch has
         # already consumed the donated cache buffers: allocator accounting is
-        # rolled back, but the KV contents are unrecoverable — the engine
-        # marks itself broken and refuses further use rather than serving
-        # garbage. On CPU (no donation) failed steps are safely retryable.
+        # rolled back, but the KV contents are unrecoverable. step() then
+        # runs recover() — reallocate the pools and replay every live slot
+        # from host-side truth — up to ``max_recoveries`` times (exponential
+        # ``recovery_backoff`` between attempts) before marking the engine
+        # PERMANENTLY failed. On CPU (no donation) a failed step leaves the
+        # buffers intact and is safely retryable by the caller, so no
+        # recovery runs. ``_broken`` means permanently failed only.
         self._broken = False
+        self.max_recoveries = int(max_recoveries)
+        self.recovery_backoff = float(recovery_backoff)
+        # finished requests awaiting delivery: survives a failed attempt so
+        # a request that finished at prefill before the decode dispatch died
+        # is still delivered exactly once by the step() that succeeds
+        self._pending_done: List[InferenceRequest] = []
         # per-engine "first successful compile recorded" markers: the watchdog
         # attributes each engine instance's initial trace as first_call
         self._prefill_recorded = False
@@ -256,8 +292,10 @@ class ContinuousBatchingEngine:
     def _check_usable(self) -> None:
         if self._broken:
             raise RuntimeError(
-                "engine KV state was lost (a failed step consumed its donated "
-                "cache buffers); build a new ContinuousBatchingEngine"
+                "engine KV state was lost and recovery is exhausted (failed "
+                "steps consumed the donated cache buffers "
+                f"{self.max_recoveries + 1} times); build a new "
+                "ContinuousBatchingEngine"
             )
 
     # -- request intake ------------------------------------------------------
@@ -269,7 +307,10 @@ class ContinuousBatchingEngine:
     ) -> int:
         """Queue one prompt; returns the request id. Raises on prompts that
         can never fit the configured bucket/model length (failing loudly at
-        intake beats wedging the scheduler)."""
+        intake beats wedging the scheduler). Intake stays open while the
+        engine is mid-recovery — recovery is an engine-internal condition,
+        not a caller error, so the request simply queues; only a PERMANENTLY
+        failed engine (recovery exhausted) hard-rejects."""
         self._check_usable()
         prompt = np.asarray(
             prompt_ids._data if hasattr(prompt_ids, "_data") else prompt_ids,
@@ -390,17 +431,19 @@ class ContinuousBatchingEngine:
         ids[0, :plen] = req.prompt
         traces_before = self.stats["prefill_traces"]
         try:
+            fault_point("engine.prefill")
             tok, self._caches = self._prefill_fn(
                 self._param_arrays(), self._caches, jnp.asarray(ids), table,
                 jnp.asarray([plen], jnp.int32),
             )
         except BaseException:
             # undo the allocation so a transient device failure leaves the
-            # pool accounting exactly as before this admit
+            # pool accounting exactly as before this admit; whether the
+            # failure is recoverable (buffers lost -> recover + retry) or
+            # permanent is decided by step()'s retry loop
             self._mgr.free(slot)
             self._reserved[slot] = 0
             self._waiting.appendleft(req)  # keeps FIFO order for a retry
-            self._broken = self._broken or self._buffers_lost()
             raise
         if self.stats["prefill_traces"] > traces_before:
             # recorded HERE, after the jit call returned: a trace that died
@@ -449,13 +492,68 @@ class ContinuousBatchingEngine:
         active slots. Returns requests that finished during this step — the
         ONLY handback: the engine keeps no reference to finished requests
         (a step()-driven server never grows host memory), so a later run()
-        will not re-deliver them."""
+        will not re-deliver them.
+
+        Failure policy: a dispatch failure that left the cache buffers
+        intact (no donation consumed them) re-raises immediately with host
+        state rolled back — the caller may simply retry. A failure that
+        consumed the donated buffers (``_buffers_lost()``; an
+        :class:`InjectedFault` from a fault plan models exactly this) runs
+        :meth:`recover` and retries, up to ``max_recoveries`` times with
+        exponential backoff, then marks the engine permanently failed and
+        re-raises."""
         self._check_usable()
-        done: List[InferenceRequest] = []
-        self._admit_waiting(done)
+        attempt = 0
+        while True:
+            try:
+                self._step_attempt()
+                break
+            except BaseException as exc:
+                # broad on purpose: ANY dispatch failure must be classified
+                # (recoverable buffers-lost vs caller-retryable) — except an
+                # operator interrupt, which is never a recovery trigger and
+                # must propagate NOW, not after sleep+recover+retry; if it
+                # consumed donated buffers, the next step() call recovers
+                if isinstance(exc, (KeyboardInterrupt, SystemExit)):
+                    raise
+                # an injected dispatch fault models the donating-backend
+                # failure mode (buffers consumed by the aborted dispatch),
+                # so it takes the same recovery path on every backend
+                recoverable = self._buffers_lost() or isinstance(exc, InjectedFault)
+                if not recoverable or attempt >= self.max_recoveries:
+                    self._broken = recoverable
+                    raise
+                attempt += 1
+                time.sleep(self.recovery_backoff * (2 ** (attempt - 1)))
+                try:
+                    self.recover()
+                except BaseException:
+                    # a dispatch failure DURING recovery (device truly dead,
+                    # injected or real) leaves half-rebuilt KV — permanent
+                    self._broken = True
+                    raise
+        # deliver everything that finished during this (possibly retried)
+        # step exactly once — including prefill-finishers from an attempt
+        # whose decode dispatch later died
+        return self.drain_finished()
+
+    def drain_finished(self) -> List[InferenceRequest]:
+        """Hand back finished-but-undelivered requests. Normally step() is
+        the only delivery path; this exists for the salvage case — a step
+        whose delivery was preempted by an exception (including a PERMANENT
+        engine failure) leaves complete results the host already holds, and
+        they must be collectable rather than stranded. Usable on a broken
+        engine; exactly-once still holds (the buffer is drained)."""
+        out, self._pending_done = self._pending_done, []
+        return out
+
+    def _step_attempt(self) -> None:
+        """One admit+decode pass; finished requests land in
+        ``_pending_done`` (never lost to an exception mid-attempt)."""
+        self._admit_waiting(self._pending_done)
         active_slots = [i for i, r in enumerate(self._slot_req) if r is not None]
         if not active_slots:
-            return done
+            return
         for i in active_slots:
             self._mgr.allocate(i, 1)  # room for the token appended this step
         tables = jnp.asarray(self._mgr.block_table(range(self.max_slots)))
@@ -465,6 +563,7 @@ class ContinuousBatchingEngine:
         t0 = time.perf_counter()
         traces_before = self.stats["decode_traces"]
         try:
+            fault_point("engine.decode")
             nxt, self._caches = self._decode_fn(
                 self._param_arrays(), self._caches, jnp.asarray(self._last_tok),
                 tables, lens, jnp.asarray(active),
@@ -475,7 +574,6 @@ class ContinuousBatchingEngine:
             # invariant (_unreserved_free would over-report and over-admit)
             for i in active_slots:
                 self._mgr.truncate(i, int(self._ntok[i]))
-            self._broken = self._broken or self._buffers_lost()
             raise
         if self.stats["decode_traces"] > traces_before:
             # recorded HERE, after the jit call returned: a trace that died
@@ -504,9 +602,103 @@ class ContinuousBatchingEngine:
                 req.finish_reason = "length"
             if req.finished:
                 self._release(i, req)
-                done.append(req)
+                self._pending_done.append(req)
         self._update_pool_gauges()  # step appended one token per active slot
-        return done
+
+    def recover(self) -> None:
+        """Rebuild device KV state after a dispatch failure consumed the
+        donated cache buffers: reallocate the per-layer pools, reset the
+        block allocator, then re-prefill and replay every live slot from
+        host-side truth (``InferenceRequest`` holds the prompt and every
+        token generated so far). Request ids, emitted tokens, the waiting
+        queue and pending finished deliveries are all preserved.
+
+        The rebuilt buffers have identical shapes/dtypes, so BOTH compiled
+        programs are reused — a recovery must not add compiles (the
+        recompile watchdog still reports exactly 2 for this engine)."""
+        live = [(i, req) for i, req in enumerate(self._slot_req) if req is not None]
+        self._caches = [
+            (
+                jnp.zeros(self._cache_shape, self._cache_dtype),
+                jnp.zeros(self._cache_shape, self._cache_dtype),
+            )
+            for _ in range(self._num_layers)
+        ]
+        from paddle_tpu.incubate.nn.functional import BlockKVCache
+
+        self._mgr = BlockKVCache(
+            self.num_blocks, self.block_size, self._kvh, self._hd,
+            self.max_blocks_per_seq, dtype=self._cache_dtype,
+        )
+        self._ntok[:] = 0
+        self._last_tok[:] = 0
+        self._reserved[:] = 0
+        self.stats["recoveries"] += 1
+        self._metrics["recoveries"].inc()
+
+        # phase 1: re-prefill each live slot's prompt (the same [1, bucket]
+        # signature — compiled program reused; a retrace here would be a bug
+        # and is recorded so the 2-compile invariant test catches it)
+        for slot, req in live:
+            plen = req.prompt.size
+            self._mgr.allocate(slot, plen)
+            self._reserved[slot] = self._blocks_needed(req)
+            table = jnp.asarray(self._mgr.block_table([slot]))
+            ids = np.zeros((1, self.prompt_bucket), np.int32)
+            ids[0, :plen] = req.prompt
+            traces_before = self.stats["prefill_traces"]
+            _tok, self._caches = self._prefill_fn(
+                self._param_arrays(), self._caches, jnp.asarray(ids), table,
+                jnp.asarray([plen], jnp.int32),
+            )
+            if self.stats["prefill_traces"] > traces_before:
+                GLOBAL_WATCHDOG.record_compile(
+                    "ContinuousBatchingEngine.prefill",
+                    signature=f"ids[1,{self.prompt_bucket}]",
+                    cause=CAUSE_NEW_SHAPE_DTYPE,
+                )
+            self._ntok[slot] = plen
+            # the re-emitted first token is identical by determinism; host
+            # truth is authoritative either way (the request already holds it)
+            self._last_tok[slot] = req.generated[0]
+            self._metrics["replayed"].inc()
+
+        # phase 2: lockstep replay of already-generated tokens through the
+        # decode signature (one call per replay depth, every catching-up
+        # slot active) — the KV append is the effect we need; the re-emitted
+        # next tokens are discarded in favor of the recorded ones
+        max_replay = max((len(req.generated) - 1 for _, req in live), default=0)
+        for r in range(max_replay):
+            replay_slots = [i for i, req in live if len(req.generated) - 1 > r]
+            for i in replay_slots:
+                self._mgr.allocate(i, 1)
+            tables = jnp.asarray(self._mgr.block_table(range(self.max_slots)))
+            # SNAPSHOT the host-side vectors handed to the dispatch: replay
+            # never syncs (the emitted tokens are discarded), and jax's CPU
+            # backend zero-copies numpy inputs — mutating _ntok/_last_tok
+            # below while the async dispatch is still in flight would race
+            # the aliased buffers and corrupt the replayed KV. The normal
+            # step path is safe only because it syncs on nxt BEFORE mutating.
+            lens = jnp.asarray(self._ntok.copy())
+            toks = jnp.asarray(self._last_tok.copy())
+            active = np.zeros((self.max_slots,), bool)
+            active[replay_slots] = True
+            traces_before = self.stats["decode_traces"]
+            _nxt, self._caches = self._decode_fn(
+                self._param_arrays(), self._caches, toks, tables, lens,
+                jnp.asarray(active),
+            )
+            if self.stats["decode_traces"] > traces_before:
+                GLOBAL_WATCHDOG.record_compile(
+                    "ContinuousBatchingEngine.decode",
+                    signature=f"toks[{self.max_slots}]",
+                    cause=CAUSE_NEW_SHAPE_DTYPE,
+                )
+            for i in replay_slots:
+                req = self._slot_req[i]
+                self._ntok[i] += 1
+                self._last_tok[i] = req.generated[r + 1]
+        self._update_pool_gauges()
 
     def run(self) -> Dict[int, InferenceRequest]:
         """Drain the queue; returns {req_id: request} for everything that

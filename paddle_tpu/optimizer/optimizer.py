@@ -225,6 +225,13 @@ class Optimizer:
 
     def set_state_dict(self, state_dict: Dict[str, Any]) -> None:
         self._step_count = int(state_dict.get("_step_count", 0))
+        # the bias-correction time (t in m̂ = m/(1-β₁ᵗ)) lives in the
+        # device-side _step_buf, which must resume in lockstep with
+        # _step_count — leaving it at zero makes a restored Adam re-run
+        # warmup-sized steps and diverge from the uninterrupted trajectory
+        self._step_buf = (
+            jnp.asarray(self._step_count, jnp.int32) if self._step_count else None
+        )
         for p in self._parameters:
             prefix = f"{p.name}__"
             st = {}

@@ -1,0 +1,26 @@
+"""Testing substrate: deterministic fault injection.
+
+Robustness can only be tested if failures can be produced on demand —
+:mod:`.faults` is the seeded, site-based injector the engine-recovery,
+checkpoint and collective fault paths are pinned with.
+"""
+
+from paddle_tpu.testing.faults import (  # noqa: F401
+    FaultPlan,
+    FaultTrigger,
+    InjectedFault,
+    fault_point,
+    inject,
+    install_plan,
+    site_call_count,
+)
+
+__all__ = [
+    "FaultPlan",
+    "FaultTrigger",
+    "InjectedFault",
+    "fault_point",
+    "inject",
+    "install_plan",
+    "site_call_count",
+]

@@ -1,0 +1,248 @@
+"""Deterministic, site-based fault injection.
+
+The recovery paths this framework promises (engine step replay, torn-
+checkpoint skip, collective error handling) are unreachable in a healthy CI
+environment — this module makes failures reproducible on demand, the way the
+reference fork's ``CommTaskManager`` tests poke its detect→dump→abort path.
+
+Model: production code declares **fault sites** by calling
+:func:`fault_point("site.name")` at the exact dispatch boundaries a real
+fault would surface at (the engine's two jit call sites, every collective
+entry point's instrumented wrapper, checkpoint file writes, block-pool
+allocation). A :class:`FaultPlan` is a set of ``(site, call_index,
+exception)`` triggers: the ``call_index``-th call of ``site`` since the plan
+was installed raises ``exception`` — fully deterministic given a
+deterministic workload, and :meth:`FaultPlan.sample` derives a plan from a
+seed so randomized campaigns are replayable from the seed alone.
+
+Activation is either the :func:`inject` context manager (tests/bench) or the
+``FLAGS_fault_inject_plan`` flag / ``FLAGS_fault_inject_plan`` env var
+(whole-process campaigns, e.g. under the launcher). With no plan installed a
+fault site costs ONE cached-bool list read — the same flag-listener-cached
+gate pattern as the metrics layer, so sites are safe on hot paths.
+
+Every trigger that fires is counted in ``faults_injected_total`` (by site)
+through the global metrics registry.
+"""
+
+from __future__ import annotations
+
+import builtins
+import random
+import threading
+from contextlib import contextmanager
+from dataclasses import dataclass
+from typing import Dict, Iterable, Iterator, Optional, Sequence, Tuple, Type
+
+from paddle_tpu.flags import GLOBAL_FLAGS
+from paddle_tpu.observability import metrics as _obs
+
+__all__ = [
+    "FaultPlan",
+    "FaultTrigger",
+    "InjectedFault",
+    "fault_point",
+    "inject",
+    "install_plan",
+    "site_call_count",
+]
+
+
+class InjectedFault(RuntimeError):
+    """Default exception raised by a triggered fault site.
+
+    Distinguishable in ``except`` paths: recovery machinery (e.g. the
+    engine's step retry) treats an ``InjectedFault`` from a dispatch site
+    exactly like the donating-backend failure it models — a dispatch whose
+    buffers are gone — so the full recovery path runs on CPU CI too.
+    """
+
+
+@dataclass(frozen=True)
+class FaultTrigger:
+    """Fire ``exception`` on the ``call_index``-th call of ``site`` (0-based,
+    counted from plan installation)."""
+
+    site: str
+    call_index: int
+    exception: Type[BaseException] = InjectedFault
+
+    def spec(self) -> str:
+        return f"{self.site}:{self.call_index}:{self.exception.__name__}"
+
+
+def _resolve_exception(name: str) -> Type[BaseException]:
+    if name == "InjectedFault":
+        return InjectedFault
+    exc = getattr(builtins, name, None)
+    if isinstance(exc, type) and issubclass(exc, BaseException):
+        return exc
+    raise ValueError(
+        f"unknown exception type {name!r} in fault plan (builtins and "
+        f"'InjectedFault' are accepted)"
+    )
+
+
+class FaultPlan:
+    """An immutable set of :class:`FaultTrigger`\\ s."""
+
+    def __init__(self, triggers: Iterable[FaultTrigger] = ()) -> None:
+        self.triggers: Tuple[FaultTrigger, ...] = tuple(triggers)
+        for t in self.triggers:
+            if t.call_index < 0:
+                raise ValueError(f"negative call_index in trigger {t}")
+
+    def __bool__(self) -> bool:
+        return bool(self.triggers)
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, FaultPlan) and self.triggers == other.triggers
+
+    def __repr__(self) -> str:
+        return f"FaultPlan({list(self.triggers)!r})"
+
+    @classmethod
+    def single(
+        cls,
+        site: str,
+        call_index: int,
+        exception: Type[BaseException] = InjectedFault,
+    ) -> "FaultPlan":
+        return cls([FaultTrigger(site, call_index, exception)])
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        """Parse the ``FLAGS_fault_inject_plan`` format:
+        ``site:call_index:ExceptionName`` entries joined by ``;``
+        (e.g. ``"engine.decode:3:InjectedFault;collective.all_reduce:0:RuntimeError"``).
+        """
+        triggers = []
+        for entry in spec.split(";"):
+            entry = entry.strip()
+            if not entry:
+                continue
+            parts = entry.rsplit(":", 2)
+            if len(parts) != 3:
+                raise ValueError(
+                    f"bad fault-plan entry {entry!r} "
+                    "(expected site:call_index:ExceptionName)"
+                )
+            site, idx, exc = parts
+            triggers.append(FaultTrigger(site, int(idx), _resolve_exception(exc)))
+        return cls(triggers)
+
+    def spec(self) -> str:
+        """Serialize back to the flag format (round-trips through parse)."""
+        return ";".join(t.spec() for t in self.triggers)
+
+    @classmethod
+    def sample(
+        cls,
+        sites: Sequence[str],
+        n_faults: int,
+        seed: int,
+        max_call_index: int = 64,
+        exception: Type[BaseException] = InjectedFault,
+    ) -> "FaultPlan":
+        """Derive a plan from a seed: ``n_faults`` (site, call_index) picks
+        drawn with a private ``random.Random(seed)`` — the same seed always
+        yields the same plan, so a failing randomized campaign is replayable
+        from its seed alone."""
+        if not sites:
+            raise ValueError("sample() needs at least one site")
+        rng = random.Random(seed)
+        triggers = []
+        for _ in range(int(n_faults)):
+            triggers.append(
+                FaultTrigger(
+                    rng.choice(list(sites)),
+                    rng.randrange(int(max_call_index)),
+                    exception,
+                )
+            )
+        return cls(triggers)
+
+
+# -- runtime state ------------------------------------------------------------
+
+# cached "any plan installed" gate: one list read on the hot path (the same
+# pattern as metrics._ENABLED); everything else lives behind the lock
+_ACTIVE = [False]
+_LOCK = threading.Lock()
+_PLAN: Optional[FaultPlan] = None
+_COUNTS: Dict[str, int] = {}
+# (site, call_index) pairs already fired: each trigger fires at most once
+_FIRED: set = set()
+
+_injected_total = _obs.GLOBAL_METRICS.counter(
+    "faults_injected_total",
+    "Fault-plan triggers that fired, by site.",
+    labelnames=("site",),
+)
+
+
+def install_plan(plan: Optional[FaultPlan]) -> None:
+    """Install ``plan`` process-wide (None or an empty plan deactivates).
+    Installation resets every site's call counter, so ``call_index`` is
+    always relative to the moment the plan went live."""
+    global _PLAN
+    with _LOCK:
+        _PLAN = plan if plan else None
+        _COUNTS.clear()
+        _FIRED.clear()
+        _ACTIVE[0] = _PLAN is not None
+
+
+@contextmanager
+def inject(plan: FaultPlan) -> Iterator[FaultPlan]:
+    """Scoped installation: installs ``plan``, restores the previous plan
+    (usually none) on exit."""
+    with _LOCK:
+        prev = _PLAN
+    install_plan(plan)
+    try:
+        yield plan
+    finally:
+        install_plan(prev)
+
+
+def site_call_count(site: str) -> int:
+    """Calls of ``site`` observed since the current plan was installed."""
+    with _LOCK:
+        return _COUNTS.get(site, 0)
+
+
+def fault_point(site: str) -> None:
+    """Declare a fault site. No plan installed: one cached-bool read."""
+    if not _ACTIVE[0]:
+        return
+    _trip(site)
+
+
+def _trip(site: str) -> None:
+    with _LOCK:
+        plan = _PLAN
+        if plan is None:  # raced with a concurrent uninstall
+            return
+        idx = _COUNTS.get(site, 0)
+        _COUNTS[site] = idx + 1
+        exc_type = None
+        for t in plan.triggers:
+            if t.site == site and t.call_index == idx and (site, idx) not in _FIRED:
+                _FIRED.add((site, idx))
+                exc_type = t.exception
+                break
+    if exc_type is not None:
+        _injected_total.labels(site=site).inc()
+        raise exc_type(f"injected fault at site {site!r} (call #{idx})")
+
+
+# -- flag wiring --------------------------------------------------------------
+
+def _on_flag_change(value: str) -> None:
+    install_plan(FaultPlan.parse(value) if value else None)
+
+
+GLOBAL_FLAGS.on_change("fault_inject_plan", _on_flag_change)
+# seed from the env var / a value set before this import
+_on_flag_change(GLOBAL_FLAGS.get("fault_inject_plan"))

@@ -1,19 +1,26 @@
-"""Failure detection + elastic: comm watchdog hang dumps, TCPStore-lease
-membership, and launcher relaunch-on-failure with checkpoint resume.
+"""Failure detection + recovery: comm watchdog hang dumps, deterministic
+fault injection, engine step recovery with request replay, crash-consistent
+checkpoints, the resilient train loop, TCPStore-lease membership, and
+launcher relaunch-on-failure with checkpoint resume.
 
 Reference parity: ``paddle/phi/core/distributed/comm_task_manager.h:37``
-(watchdog), ``fleet/elastic/manager.py:128-251`` (membership + relaunch).
+(watchdog detect→dump→abort), ``fleet/elastic/manager.py:128-251``
+(membership + relaunch); the recovery layer is PR 6's fault-tolerance
+tentpole (see README "Fault tolerance").
 """
 
+import glob
 import os
 import subprocess
 import sys
 import textwrap
 import time
 
+import numpy as np
 import pytest
 
-from paddle_tpu.distributed.watchdog import CommWatchdog
+from paddle_tpu.distributed.watchdog import CommWatchdog, WatchdogTimeout
+from paddle_tpu.testing import faults
 from paddle_tpu_native.loader import load_native
 from paddle_tpu_native.store import TCPStore
 
@@ -55,6 +62,44 @@ class TestCommWatchdog:
             with wd.section("boom"):
                 raise RuntimeError("x")
         assert wd.completed[-1]["ok"] is False
+
+    def test_history_records_exception_type(self):
+        """WHAT failed, not just that it did — resilient_train_loop and
+        tests assert on the type without racing stderr."""
+        wd = CommWatchdog(timeout=5.0)
+        with pytest.raises(WatchdogTimeout):
+            with wd.section("hung"):
+                raise WatchdogTimeout("simulated")
+        assert wd.completed[-1]["exc_type"] == "WatchdogTimeout"
+        with wd.section("fine"):
+            pass
+        assert wd.completed[-1]["exc_type"] is None
+
+    def test_last_dump_exposed(self):
+        wd = CommWatchdog(timeout=0.2, on_timeout=lambda d: None)
+        assert wd.last_dump is None
+        with wd.section("slow"):
+            time.sleep(0.5)
+        assert wd.last_dump is not None
+        assert wd.last_dump["section"] == "slow"
+        assert wd.last_dump["thread_stacks"]
+
+    def test_buggy_on_timeout_handler_cannot_suppress_diagnostics(self, capfd):
+        """A handler that raises must not swallow the dump: the default
+        stderr diagnostics still run (the abort path's evidence) and
+        last_dump is still recorded."""
+
+        def bad_handler(dump):
+            raise ValueError("buggy handler")
+
+        wd = CommWatchdog(timeout=0.2, on_timeout=bad_handler)
+        with wd.section("slow"):
+            time.sleep(0.5)
+        time.sleep(0.1)  # let the watchdog thread finish its dump
+        assert wd.last_dump is not None and wd.last_dump["section"] == "slow"
+        err = capfd.readouterr().err
+        assert "buggy handler" in err  # the handler's own failure is visible
+        assert "[CommWatchdog] section 'slow'" in err  # ... and so is the dump
 
 
 @pytest.mark.skipif(not native_available, reason="native lib not built")
@@ -272,3 +317,573 @@ class TestElasticScaling:
         w1.register()  # relaunch: clean fault state
         assert sorted(mgr.alive_workers()) == [0, 1]
         w1.stop()
+
+
+# -- PR 6 fault-tolerance layer ----------------------------------------------
+
+class TestFaultInjector:
+    """Deterministic, site-based injection (testing/faults.py)."""
+
+    def test_parse_spec_round_trip(self):
+        spec = "engine.decode:3:InjectedFault;collective.all_reduce:0:RuntimeError"
+        plan = faults.FaultPlan.parse(spec)
+        assert plan.spec() == spec
+        assert plan.triggers[0].exception is faults.InjectedFault
+        assert plan.triggers[1].exception is RuntimeError
+
+    def test_parse_rejects_garbage(self):
+        with pytest.raises(ValueError, match="fault-plan entry"):
+            faults.FaultPlan.parse("no-colons-here")
+        with pytest.raises(ValueError, match="unknown exception"):
+            faults.FaultPlan.parse("site:0:NotAnException")
+
+    def test_seeded_sample_is_deterministic(self):
+        a = faults.FaultPlan.sample(["s1", "s2", "s3"], n_faults=4, seed=7)
+        b = faults.FaultPlan.sample(["s1", "s2", "s3"], n_faults=4, seed=7)
+        assert a == b  # same seed -> same plan, replayable from the seed alone
+        c = faults.FaultPlan.sample(["s1", "s2", "s3"], n_faults=4, seed=8)
+        assert a != c
+
+    def test_same_plan_same_trigger(self):
+        """Same plan over the same deterministic workload fires at the SAME
+        call — the property every recovery test in this file leans on."""
+
+        def workload():
+            fired_at = None
+            for i in range(10):
+                try:
+                    faults.fault_point("det.site")
+                except faults.InjectedFault:
+                    fired_at = i
+            return fired_at
+
+        plan = faults.FaultPlan.single("det.site", 6)
+        with faults.inject(plan):
+            first = workload()
+        with faults.inject(plan):
+            second = workload()
+        assert first == second == 6
+
+    def test_trigger_fires_at_most_once(self):
+        plan = faults.FaultPlan.single("once.site", 0)
+        with faults.inject(plan):
+            with pytest.raises(faults.InjectedFault):
+                faults.fault_point("once.site")
+            for _ in range(5):
+                faults.fault_point("once.site")  # consumed: no re-fire
+
+    def test_inactive_is_noop_and_counts_reset_on_install(self):
+        faults.fault_point("never.registered")  # no plan: must be free & silent
+        plan = faults.FaultPlan.single("cnt.site", 99)
+        with faults.inject(plan):
+            faults.fault_point("cnt.site")
+            faults.fault_point("cnt.site")
+            assert faults.site_call_count("cnt.site") == 2
+        # plan uninstalled: counting stopped, state cleared
+        faults.fault_point("cnt.site")
+        with faults.inject(plan):
+            assert faults.site_call_count("cnt.site") == 0  # fresh install
+
+    def test_flag_activation_and_clear(self):
+        import paddle_tpu as paddle
+
+        try:
+            paddle.set_flags(
+                {"FLAGS_fault_inject_plan": "flag.site:1:MemoryError"}
+            )
+            faults.fault_point("flag.site")  # call 0: no trigger
+            with pytest.raises(MemoryError):
+                faults.fault_point("flag.site")  # call 1: boom
+        finally:
+            paddle.set_flags({"FLAGS_fault_inject_plan": ""})
+        faults.fault_point("flag.site")  # cleared: inert again
+
+    def test_injected_faults_counted(self):
+        import paddle_tpu as paddle
+        from paddle_tpu import observability as obs
+
+        prior = paddle.get_flags(["FLAGS_enable_metrics"])
+        paddle.set_flags({"FLAGS_enable_metrics": True})
+        obs.GLOBAL_METRICS.reset()
+        try:
+            with faults.inject(faults.FaultPlan.single("counted.site", 0)):
+                with pytest.raises(faults.InjectedFault):
+                    faults.fault_point("counted.site")
+            c = obs.GLOBAL_METRICS.get("faults_injected_total")
+            assert c.value(site="counted.site") == 1
+        finally:
+            paddle.set_flags(prior)
+
+
+class TestCollectiveSiteInjection:
+    """All 13 collective entry points are fault sites through the same
+    instrumented wrapper that feeds their metrics."""
+
+    def test_injection_raises_through_instrumented_wrapper(self):
+        import paddle_tpu as paddle
+        import paddle_tpu.distributed as dist
+
+        t = paddle.to_tensor(np.ones((2,), np.float32))
+        with faults.inject(faults.FaultPlan.single("collective.all_reduce", 0)):
+            with pytest.raises(faults.InjectedFault, match="collective.all_reduce"):
+                dist.all_reduce(t)
+        # consumed + uninstalled: the same call now goes through
+        dist.all_reduce(t)
+
+    def test_every_entry_point_is_a_site(self):
+        """The wrapper computes its site name from the wrapped fn — pin the
+        full 13-op surface so a new collective can't silently miss it."""
+        import paddle_tpu.distributed.collective as coll
+
+        expected = [
+            "all_reduce", "all_gather", "reduce", "reduce_scatter",
+            "broadcast", "scatter", "alltoall", "alltoall_single",
+            "ppermute", "send", "recv", "batch_isend_irecv", "barrier",
+        ]
+        for op in expected:
+            fn = getattr(coll, op)
+            assert hasattr(fn, "__wrapped__"), f"{op} is not instrumented"
+
+    def test_barrier_site_fires(self):
+        import paddle_tpu.distributed as dist
+
+        with faults.inject(faults.FaultPlan.single("collective.barrier", 0, RuntimeError)):
+            with pytest.raises(RuntimeError, match="collective.barrier"):
+                dist.barrier()
+
+
+def _tiny_engine(seed=0, **kw):
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import ContinuousBatchingEngine
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(seed)
+    cfg = LlamaConfig.tiny()
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("prompt_bucket", 16)
+    return m, cfg, ContinuousBatchingEngine(m, **kw)
+
+
+class TestEngineRecovery:
+    """The tentpole acceptance: a mid-workload decode fault is survived with
+    byte-identical tokens, exactly-once finished delivery, and the 2-compile
+    invariant intact."""
+
+    def _workload(self, cfg, rng, n=5):
+        specs = [(5, 6), (7, 4), (3, 9), (6, 2), (2, 7)][:n]
+        return [
+            (rng.integers(0, cfg.vocab_size, (p,)).astype(np.int32), t)
+            for p, t in specs
+        ]
+
+    def test_recovery_tokens_byte_identical_two_compiles(self):
+        from paddle_tpu import observability as obs
+        from paddle_tpu.inference import ContinuousBatchingEngine
+
+        m, cfg, eng_a = _tiny_engine(seed=20, max_slots=3)
+        rng = np.random.default_rng(20)
+        work = self._workload(cfg, rng)
+        rids_a = [eng_a.add_request(p, max_new_tokens=t) for p, t in work]
+        out_a = eng_a.run()
+        assert eng_a.stats["recoveries"] == 0
+
+        obs.GLOBAL_WATCHDOG.reset()
+        eng_b = ContinuousBatchingEngine(
+            m, max_slots=3, block_size=4, prompt_bucket=16
+        )
+        rids_b = [eng_b.add_request(p, max_new_tokens=t) for p, t in work]
+        with faults.inject(faults.FaultPlan.single("engine.decode", 3)):
+            out_b = eng_b.run()
+
+        assert eng_b.stats["recoveries"] == 1
+        for ra, rb in zip(rids_a, rids_b):
+            np.testing.assert_array_equal(
+                out_a[ra].tokens(), out_b[rb].tokens()
+            )
+            assert out_a[ra].finish_reason == out_b[rb].finish_reason
+        # the 2-compile invariant holds ACROSS a recovery: replay reuses
+        # both compiled programs (recompile watchdog is the honesty source)
+        rep = {
+            k: v["count"]
+            for k, v in obs.GLOBAL_WATCHDOG.report().items()
+            if k.startswith("ContinuousBatchingEngine.")
+        }
+        assert rep == {
+            "ContinuousBatchingEngine.prefill": 1,
+            "ContinuousBatchingEngine.decode": 1,
+        }
+        assert eng_b.stats["prefill_traces"] == 1
+        assert eng_b.stats["decode_traces"] == 1
+        assert eng_b.pool_stats()["free"] == eng_b.num_blocks
+
+    def test_prefill_fault_recovers_too(self):
+        m, cfg, eng = _tiny_engine(seed=21)
+        rng = np.random.default_rng(21)
+        rids = [
+            eng.add_request(
+                rng.integers(0, cfg.vocab_size, (4,)).astype(np.int32),
+                max_new_tokens=3,
+            )
+            for _ in range(3)
+        ]
+        # second prefill dispatch dies "consuming buffers": the first
+        # admitted request must be replayed and all three finish
+        with faults.inject(faults.FaultPlan.single("engine.prefill", 1)):
+            out = eng.run()
+        assert set(out) == set(rids)
+        assert eng.stats["recoveries"] == 1
+        assert all(len(r.generated) == 3 for r in out.values())
+
+    def test_finished_exactly_once_across_recovery(self):
+        m, cfg, eng = _tiny_engine(seed=22, max_slots=2)
+        rng = np.random.default_rng(22)
+        # mixed budgets so some requests finish before/around the fault
+        rids = [
+            eng.add_request(
+                rng.integers(0, cfg.vocab_size, (int(n),)).astype(np.int32),
+                max_new_tokens=int(t),
+            )
+            for n, t in [(4, 2), (6, 5), (3, 3), (5, 4), (2, 6)]
+        ]
+        delivered = []
+        with faults.inject(faults.FaultPlan.single("engine.decode", 2)):
+            while eng.has_work():
+                delivered += [r.req_id for r in eng.step()]
+        assert sorted(delivered) == sorted(rids)  # everyone, exactly once
+        assert len(set(delivered)) == len(delivered)
+        assert eng.run() == {}  # nothing retained, nothing re-delivered
+
+    def test_retries_exhausted_is_permanent_failure(self):
+        m, cfg, eng = _tiny_engine(seed=23, max_recoveries=1)
+        rng = np.random.default_rng(23)
+        eng.add_request(
+            rng.integers(0, cfg.vocab_size, (4,)).astype(np.int32),
+            max_new_tokens=4,
+        )
+        # faults on the original dispatch AND every retry: recovery exhausts
+        plan = faults.FaultPlan(
+            [faults.FaultTrigger("engine.decode", i) for i in range(8)]
+        )
+        with faults.inject(plan):
+            with pytest.raises(faults.InjectedFault):
+                eng.run()
+        # permanently failed: the hard RuntimeError contract
+        with pytest.raises(RuntimeError, match="build a new"):
+            eng.step()
+        with pytest.raises(RuntimeError, match="build a new"):
+            eng.add_request(np.zeros((2,), np.int32))
+
+    def test_intake_during_recovery_enqueues(self):
+        """Recovery is an engine-internal condition, not a caller error:
+        add_request mid-recovery queues the request instead of raising."""
+        m, cfg, eng = _tiny_engine(seed=24)
+        rng = np.random.default_rng(24)
+        r0 = eng.add_request(
+            rng.integers(0, cfg.vocab_size, (4,)).astype(np.int32),
+            max_new_tokens=4,
+        )
+        late_prompt = rng.integers(0, cfg.vocab_size, (5,)).astype(np.int32)
+        orig_recover = eng.recover
+        late = []
+
+        def recover_with_intake():
+            late.append(eng.add_request(late_prompt, max_new_tokens=2))
+            orig_recover()
+
+        eng.recover = recover_with_intake
+        with faults.inject(faults.FaultPlan.single("engine.decode", 1)):
+            out = eng.run()
+        assert late and set(out) == {r0, late[0]}
+        assert len(out[late[0]].generated) == 2
+
+
+class TestCrashConsistentCheckpoints:
+    """Atomic writes + content-hash manifests + managed retention."""
+
+    def _state(self, paddle, fill=1.0):
+        return {
+            "w": paddle.to_tensor(np.full((3, 2), fill, np.float32)),
+            "sched": {"last_epoch": 4},
+        }
+
+    def test_manifest_carries_hashes_and_load_verifies(self, tmp_path):
+        import paddle_tpu as paddle
+        from paddle_tpu.distributed.checkpoint import (
+            load_state_dict,
+            save_state_dict,
+        )
+        from paddle_tpu.distributed.checkpoint.load_state_dict import _read_metadata
+
+        path = str(tmp_path / "ckpt")
+        save_state_dict({"w": paddle.to_tensor(np.ones((4,), np.float32))}, path)
+        (meta,) = _read_metadata(path)
+        assert meta.file_hashes  # every payload hashed
+        # corrupt one byte -> load refuses instead of serving garbage
+        npz = glob.glob(os.path.join(path, "*.distcp.npz"))[0]
+        data = bytearray(open(npz, "rb").read())
+        data[len(data) // 2] ^= 0xFF
+        open(npz, "wb").write(bytes(data))
+        with pytest.raises(ValueError, match="content hash"):
+            load_state_dict(
+                {"w": paddle.to_tensor(np.zeros((4,), np.float32))}, path
+            )
+
+    def test_latest_valid_skips_torn_payload(self, tmp_path):
+        import paddle_tpu as paddle
+        from paddle_tpu import observability as obs
+        from paddle_tpu.distributed.checkpoint import CheckpointManager
+
+        prior = paddle.get_flags(["FLAGS_enable_metrics"])
+        paddle.set_flags({"FLAGS_enable_metrics": True})
+        obs.GLOBAL_METRICS.reset()
+        try:
+            mgr = CheckpointManager(str(tmp_path), keep=3)
+            mgr.save(self._state(paddle, 1.0), 0)
+            mgr.save(self._state(paddle, 2.0), 1)
+            npz = glob.glob(os.path.join(mgr._dir(1), "*.distcp.npz"))[0]
+            with open(npz, "r+b") as f:
+                f.truncate(os.path.getsize(npz) // 2)  # torn write
+            rec = mgr.latest_valid()
+            assert rec is not None and rec.step == 0
+            skipped = obs.GLOBAL_METRICS.get("checkpoints_skipped_torn_total")
+            assert skipped.value() == 1
+            # restoring from it serves step 0's values
+            target = self._state(paddle, 0.0)
+            info = mgr.restore(target, step=rec.step)
+            assert info["step"] == 0
+            np.testing.assert_array_equal(
+                np.asarray(target["w"].numpy()), np.full((3, 2), 1.0, np.float32)
+            )
+        finally:
+            paddle.set_flags(prior)
+
+    def test_mid_save_fault_leaves_previous_checkpoint(self, tmp_path):
+        import paddle_tpu as paddle
+        from paddle_tpu.distributed.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(str(tmp_path), keep=3)
+        mgr.save(self._state(paddle, 1.0), 0)
+        with faults.inject(faults.FaultPlan.single("checkpoint.write", 0, OSError)):
+            with pytest.raises(OSError):
+                mgr.save(self._state(paddle, 2.0), 1)
+        # the aborted save committed nothing: no step-1 dir, no staging litter
+        assert mgr.steps() == [0]
+        assert not glob.glob(os.path.join(str(tmp_path), ".staging*"))
+        rec = mgr.latest_valid()
+        assert rec is not None and rec.step == 0
+
+    def test_retention_keeps_last_k(self, tmp_path):
+        import paddle_tpu as paddle
+        from paddle_tpu.distributed.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(str(tmp_path), keep=2)
+        for s in range(4):
+            mgr.save(self._state(paddle, float(s)), s)
+        assert mgr.steps() == [2, 3]
+
+    def test_missing_manifest_is_invalid(self, tmp_path):
+        import paddle_tpu as paddle
+        from paddle_tpu.distributed.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(str(tmp_path), keep=3)
+        mgr.save(self._state(paddle, 1.0), 0)
+        for f in glob.glob(os.path.join(mgr._dir(0), "*.metadata")):
+            os.remove(f)
+        assert mgr.latest_valid() is None
+
+
+class TestResilientTrainLoop:
+    """CommWatchdog + checkpoint-resume composition: a WatchdogTimeout /
+    backend error resumes from the last good step instead of dying."""
+
+    def _build(self, paddle):
+        paddle.seed(0)
+        w = paddle.to_tensor(np.ones((4,), np.float32))
+        w.stop_gradient = False
+        # a stable name, as real Layer parameters have: the optimizer's
+        # accumulator checkpoint keys are name-derived, and resume across
+        # process lives needs them to match
+        w.name = "resilient_w"
+        opt = paddle.optimizer.AdamW(learning_rate=0.1, parameters=[w])
+
+        def step_fn_factory(fail_at=None):
+            tripped = []
+
+            def step_fn(step):
+                if fail_at is not None and step == fail_at and not tripped:
+                    tripped.append(step)
+                    raise WatchdogTimeout(f"simulated hang at step {step}")
+                loss = (w * w).sum()
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+
+            return step_fn
+
+        return w, opt, step_fn_factory
+
+    def test_resumes_from_last_good_step_bit_exact(self, tmp_path):
+        import paddle_tpu as paddle
+        from paddle_tpu.distributed import resilient_train_loop
+        from paddle_tpu.distributed.checkpoint import CheckpointManager
+
+        # fault-free reference
+        w0, opt0, mk0 = self._build(paddle)
+        m0 = CheckpointManager(str(tmp_path / "a"), keep=3)
+        s0 = resilient_train_loop(mk0(), {"w": w0}, 6, m0, optimizer=opt0)
+        assert s0["failures"] == 0
+        ref = np.asarray(w0.numpy()).copy()
+
+        # watchdog-wrapped run that "hangs" once at step 3
+        w1, opt1, mk1 = self._build(paddle)
+        m1 = CheckpointManager(str(tmp_path / "b"), keep=3)
+        wd = CommWatchdog(timeout=30.0)
+        s1 = resilient_train_loop(
+            mk1(fail_at=3), {"w": w1}, 6, m1, optimizer=opt1, watchdog=wd
+        )
+        assert s1["failures"] == 1
+        assert s1["resumes"][0]["failed_step"] == 3
+        assert s1["resumes"][0]["resumed_from"] == 2
+        np.testing.assert_array_equal(np.asarray(w1.numpy()), ref)
+        # the watchdog history names WHAT fired — no stderr scraping
+        bad = [e for e in wd.completed if e["exc_type"] == "WatchdogTimeout"]
+        assert bad and bad[0]["section"] == "train_step_3"
+
+    def test_resumes_across_process_lives(self, tmp_path):
+        """A second loop over the same manager (the relaunch scenario)
+        starts after the last checkpointed step, not from zero."""
+        import paddle_tpu as paddle
+        from paddle_tpu.distributed import resilient_train_loop
+        from paddle_tpu.distributed.checkpoint import CheckpointManager
+
+        w0, opt0, mk0 = self._build(paddle)
+        mgr = CheckpointManager(str(tmp_path), keep=3)
+        resilient_train_loop(mk0(), {"w": w0}, 3, mgr, optimizer=opt0)
+
+        w1, opt1, mk1 = self._build(paddle)  # fresh objects = fresh process
+        summary = resilient_train_loop(mk1(), {"w": w1}, 6, mgr, optimizer=opt1)
+        assert summary["start_step"] == 3  # resumed, not restarted
+
+        # equals a straight 6-step run
+        w2, opt2, mk2 = self._build(paddle)
+        m2 = CheckpointManager(str(tmp_path / "ref"), keep=3)
+        resilient_train_loop(mk2(), {"w": w2}, 6, m2, optimizer=opt2)
+        np.testing.assert_array_equal(np.asarray(w1.numpy()), np.asarray(w2.numpy()))
+
+    def test_persistent_fault_escalates(self, tmp_path):
+        import paddle_tpu as paddle
+        from paddle_tpu.distributed import resilient_train_loop
+        from paddle_tpu.distributed.checkpoint import CheckpointManager
+
+        w, opt, _ = self._build(paddle)
+
+        def always_fails(step):
+            raise RuntimeError("backend down")
+
+        mgr = CheckpointManager(str(tmp_path), keep=3)
+        with pytest.raises(RuntimeError, match="backend down"):
+            resilient_train_loop(
+                always_fails, {"w": w}, 4, mgr, optimizer=opt, max_failures=2
+            )
+
+
+class TestReviewHardening:
+    """Review fixes: interrupt transparency, salvage of undelivered results,
+    save failures inside the recovery budget, re-save atomicity."""
+
+    def test_keyboard_interrupt_is_never_a_recovery_trigger(self):
+        m, cfg, eng = _tiny_engine(seed=30)
+        rng = np.random.default_rng(30)
+        eng.add_request(
+            rng.integers(0, cfg.vocab_size, (4,)).astype(np.int32),
+            max_new_tokens=4,
+        )
+        eng._buffers_lost = lambda: True  # even with donated buffers gone
+
+        def interrupted(*a, **k):
+            raise KeyboardInterrupt()
+
+        eng._decode_fn = interrupted
+        with pytest.raises(KeyboardInterrupt):
+            eng.step()
+        # propagated directly: no recovery attempt consumed the interrupt,
+        # and the engine is not marked permanently failed by it
+        assert eng.stats["recoveries"] == 0
+        assert not eng._broken
+
+    def test_drain_finished_salvages_after_permanent_failure(self):
+        m, cfg, eng = _tiny_engine(seed=31, max_recoveries=0)
+        rng = np.random.default_rng(31)
+        # finishes AT PREFILL (max_new_tokens=1) during the same step whose
+        # decode dispatch then permanently fails
+        done_rid = eng.add_request(
+            rng.integers(0, cfg.vocab_size, (3,)).astype(np.int32),
+            max_new_tokens=1,
+        )
+        eng.add_request(
+            rng.integers(0, cfg.vocab_size, (4,)).astype(np.int32),
+            max_new_tokens=4,
+        )
+        with faults.inject(
+            faults.FaultPlan([faults.FaultTrigger("engine.decode", i) for i in range(4)])
+        ):
+            with pytest.raises(faults.InjectedFault):
+                eng.run()
+        with pytest.raises(RuntimeError, match="build a new"):
+            eng.step()
+        salvaged = eng.drain_finished()  # works even on a broken engine
+        assert [r.req_id for r in salvaged] == [done_rid]
+        assert salvaged[0].finished
+        assert eng.drain_finished() == []  # exactly-once: drained
+
+    def test_resilient_loop_survives_transient_save_failure(self, tmp_path):
+        import paddle_tpu as paddle
+        from paddle_tpu.distributed import resilient_train_loop
+        from paddle_tpu.distributed.checkpoint import CheckpointManager
+
+        paddle.seed(0)
+        w = paddle.to_tensor(np.ones((4,), np.float32))
+        w.stop_gradient = False
+        w.name = "resilient_w"
+        opt = paddle.optimizer.AdamW(learning_rate=0.1, parameters=[w])
+
+        def step_fn(step):
+            loss = (w * w).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+
+        mgr = CheckpointManager(str(tmp_path), keep=3)
+        # one checkpoint.write fault: the save of some step dies mid-write;
+        # the loop must count it against the budget and resume, not die
+        with faults.inject(
+            faults.FaultPlan.single("checkpoint.write", 5, OSError)
+        ):
+            summary = resilient_train_loop(
+                step_fn, {"w": w}, 5, mgr, optimizer=opt, max_failures=2
+            )
+        assert summary["failures"] == 1
+        assert mgr.latest_valid() is not None
+
+    def test_resave_same_step_survives_aborted_commit(self, tmp_path):
+        import paddle_tpu as paddle
+        from paddle_tpu.distributed.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(str(tmp_path), keep=3)
+        state = {"w": paddle.to_tensor(np.ones((2,), np.float32))}
+        mgr.save(state, 0)
+        # redoing the SAME step dies mid-write: the previously committed
+        # step-0 checkpoint must still be there and valid
+        with faults.inject(faults.FaultPlan.single("checkpoint.write", 0, OSError)):
+            with pytest.raises(OSError):
+                mgr.save(state, 0)
+        rec = mgr.latest_valid()
+        assert rec is not None and rec.step == 0
+        # ... and a successful redo replaces it cleanly
+        mgr.save(state, 0)
+        assert mgr.latest_valid().step == 0
+        assert not glob.glob(os.path.join(str(tmp_path), ".trash*"))
+        assert not glob.glob(os.path.join(str(tmp_path), ".staging*"))

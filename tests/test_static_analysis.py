@@ -328,6 +328,43 @@ def test_eh403_broad_except_needs_reason():
     ) == []
 
 
+# -- RB: robustness ----------------------------------------------------------
+
+def test_rb501_os_exit_flagged():
+    assert codes("import os\ndef f():\n    os._exit(1)\n") == ["RB501"]
+
+
+def test_rb501_through_import_alias():
+    assert codes("import os as _os\ndef f():\n    _os._exit(7)\n") == ["RB501"]
+    assert codes("from os import _exit\ndef f():\n    _exit(7)\n") == ["RB501"]
+    assert codes("from os import _exit as bail\ndef f():\n    bail(7)\n") == ["RB501"]
+
+
+def test_rb501_negative_sys_exit_and_other_exits():
+    assert codes("import sys\ndef f():\n    sys.exit(1)\n") == []
+    assert codes("import os\ndef f():\n    os.kill(1, 9)\n") == []
+
+
+def test_rb501_allowed_in_watchdog_and_launch():
+    src = "import os\ndef f():\n    os._exit(124)\n"
+    assert codes(src, path="paddle_tpu/distributed/watchdog.py") == []
+    assert codes(src, path="paddle_tpu/distributed/launch/main.py") == []
+    assert codes(src, path="paddle_tpu/distributed/launch/sub/mod.py") == []
+    # ... but NOT elsewhere under distributed/
+    assert codes(src, path="paddle_tpu/distributed/collective.py") == ["RB501"]
+
+
+def test_rb501_suppressible_with_reason():
+    vs = analyze_source(
+        "import os\n"
+        "def f():\n"
+        "    # analysis: disable=RB501 forked child owns no state to flush\n"
+        "    os._exit(1)\n"
+    )
+    assert [v.code for v in vs] == ["RB501"]
+    assert vs[0].suppressed and vs[0].reason
+
+
 # -- suppressions ------------------------------------------------------------
 
 def test_suppression_with_reason():
