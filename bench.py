@@ -400,6 +400,7 @@ def _main_timed(platform, paddle, cfg, batch, seq, steps, warmup) -> None:
         _bench_paged_decode(paddle, platform),
         _bench_engine_decode(paddle, platform),
         _bench_engine_fault_recovery(paddle, platform),
+        _bench_serving_goodput(paddle, platform),
     ]
     print(
         json.dumps(
@@ -808,6 +809,105 @@ def _bench_engine_fault_recovery(paddle, platform: str) -> dict:
         }
     except Exception as exc:  # noqa: BLE001 - secondary must never kill primary
         return {"metric": "engine_fault_recovery_tokens_per_sec", "error": f"{exc!r}"[:300]}
+    finally:
+        paddle.set_flags(prior)
+
+
+def _bench_serving_goodput(paddle, platform: str) -> dict:
+    """Open-loop overload bench (guarded): seeded Poisson arrivals at 2x the
+    calibrated sustainable rate, a tenant/priority mix with per-class SLOs,
+    through the full serving frontend (bounded intake, weighted fair
+    admission, deadlines, hysteresis shedding). Reports GOODPUT — tokens of
+    requests that finished inside their SLO — plus per-class SLO attainment
+    and the shed/deadline accounting, with the 2-compile honesty check: an
+    overload storm must be absorbed by scheduling, never by recompiling.
+    Seeded arrivals make reruns comparable (the arrival schedule, class mix
+    and prompt shapes all derive from the seeds below)."""
+    from paddle_tpu import observability as obs
+    from paddle_tpu.inference import ContinuousBatchingEngine
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import Priority, ServingConfig, ServingFrontend
+    from paddle_tpu.serving.loadgen import (
+        TrafficClass,
+        measure_sustainable_rate,
+        poisson_arrivals,
+        run_open_loop,
+    )
+
+    prior = paddle.get_flags(["FLAGS_enable_metrics"])
+    try:
+        if platform == "tpu":
+            cfg = LlamaConfig(
+                vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+                num_hidden_layers=8, num_attention_heads=16,
+                num_key_value_heads=16, max_position_embeddings=1024,
+            )
+            slots, bs, bucket, n_arrivals, calib = 8, 16, 128, 96, 16
+            plen, max_new, slo_s, max_queue = (16, 96), (16, 48), 8.0, 32
+        else:  # tiny CPU smoke: the same machinery with a small budget
+            cfg = LlamaConfig.tiny()
+            slots, bs, bucket, n_arrivals, calib = 2, 4, 16, 24, 6
+            plen, max_new, slo_s, max_queue = (3, 8), (3, 8), 2.0, 8
+
+        paddle.set_flags({"FLAGS_enable_metrics": True})
+        obs.GLOBAL_METRICS.reset()
+        obs.GLOBAL_WATCHDOG.reset()  # compile ledger counts THIS engine only
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg)
+        if platform == "tpu":
+            model = model.to(dtype="bfloat16")
+        model.eval()
+        engine = ContinuousBatchingEngine(
+            model, max_slots=slots, block_size=bs, prompt_bucket=bucket
+        )
+        frontend = ServingFrontend(engine, ServingConfig(max_queue=max_queue))
+        rate = measure_sustainable_rate(
+            frontend, calib, seed=7, prompt_len=plen, max_new_tokens=max_new,
+            vocab_size=cfg.vocab_size,
+        )
+        # calibration traffic must not pollute the overload window's counters
+        obs.GLOBAL_METRICS.reset()
+        mix = [
+            TrafficClass("chat", Priority.INTERACTIVE, 2.0, plen, max_new, slo_s),
+            TrafficClass("app", Priority.STANDARD, 2.0, plen, max_new, slo_s),
+            TrafficClass("batch", Priority.BEST_EFFORT, 1.0, plen, max_new, slo_s),
+        ]
+        arrivals = poisson_arrivals(
+            2.0 * rate, n_arrivals, mix, seed=8, vocab_size=cfg.vocab_size
+        )
+        report = run_open_loop(frontend, arrivals, max_wall_s=120.0)
+        reg = obs.GLOBAL_METRICS
+        shed = reg.get("serving_shed_total")
+        shed_by_reason = {
+            v["labels"]["reason"]: int(v["value"]) for v in shed._snapshot_values()
+        }
+        return {
+            "metric": "serving_goodput_tokens_per_sec",
+            "value": report["goodput_tokens_per_sec"],
+            "unit": "tokens/s",
+            "offered_rate_rps": round(2.0 * rate, 2),
+            "sustainable_rate_rps": round(rate, 2),
+            "arrivals": n_arrivals,
+            "slo_s": slo_s,
+            "slo_attainment": {
+                k: v["slo_attainment"] for k, v in report["per_class"].items()
+            },
+            "shed_total_by_reason": shed_by_reason,
+            "deadline_misses": int(
+                reg.get("serving_deadline_miss_total").total()
+            ),
+            "overload_level_peak": int(
+                reg.get("serving_overload_level").high_water()
+            ),
+            # honesty check: overload must add ZERO compiles past the two
+            # signatures calibration warmed up
+            "compiled_signatures": report["compiled_signatures_total"],
+            "compiles_during_overload": sum(
+                report["compiles_during_run"].values()
+            ),
+        }
+    except Exception as exc:  # noqa: BLE001 - secondary must never kill primary
+        return {"metric": "serving_goodput_tokens_per_sec", "error": f"{exc!r}"[:300]}
     finally:
         paddle.set_flags(prior)
 

@@ -132,6 +132,7 @@ from paddle_tpu import static  # noqa: F401
 from paddle_tpu import text  # noqa: F401
 from paddle_tpu import generation  # noqa: F401
 from paddle_tpu import inference  # noqa: F401
+from paddle_tpu import serving  # noqa: F401
 from paddle_tpu import sparse  # noqa: F401
 from paddle_tpu import incubate  # noqa: F401
 from paddle_tpu import quantization  # noqa: F401

@@ -14,7 +14,12 @@ Checker families:
 - **FD** flag discipline — unresolvable flag strings, un-cached registry
   reads in hot-path loops (:mod:`.checkers.flag_discipline`);
 - **EH** exception hygiene — bare/silent/unannotated broad excepts
-  (:mod:`.checkers.exception_hygiene`).
+  (:mod:`.checkers.exception_hygiene`);
+- **RB** robustness — ``os._exit`` outside the watchdog/launcher abort
+  paths (RB501), un-timed blocking waits (``Queue.get``/``Event.wait``/
+  ``Thread.join``/``socket.recv``) in the request-serving and collective
+  paths ``serving/``/``distributed/``/``inference/`` (RB502)
+  (:mod:`.checkers.robustness`).
 
 CLI: ``python -m paddle_tpu.analysis [--format json] paddle_tpu/`` — exits
 non-zero on any unsuppressed violation.

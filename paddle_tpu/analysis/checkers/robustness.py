@@ -1,10 +1,11 @@
 """RB — robustness checker.
 
-``os._exit`` kills the process without running ``finally`` blocks, atexit
-hooks, or buffered-IO flush. The fault-tolerance layer depends on orderly
-unwinding: a checkpoint save interrupted by ``os._exit`` skips its atomic
-commit, and a serving process exiting this way drops finished requests that
-were awaiting delivery. The only sanctioned users are:
+**RB501** — ``os._exit`` kills the process without running ``finally``
+blocks, atexit hooks, or buffered-IO flush. The fault-tolerance layer
+depends on orderly unwinding: a checkpoint save interrupted by ``os._exit``
+skips its atomic commit, and a serving process exiting this way drops
+finished requests that were awaiting delivery. The only sanctioned users
+are:
 
 - ``distributed/watchdog.py`` — the reference CommTaskManager contract is
   dump-then-abort; a hung collective cannot be cancelled from Python, so a
@@ -12,20 +13,55 @@ were awaiting delivery. The only sanctioned users are:
 - ``distributed/launch/`` — the launcher's process-group teardown, where the
   children being killed are the ones being relaunched.
 
-- RB501  ``os._exit`` call outside those locations (including through an
-         ``import os as X`` alias or ``from os import _exit``).
+**RB502** — an un-timed blocking wait is how a shed request wedges a worker
+forever: the serving layer's contract is that every request reaches a
+terminal state in bounded time, and one ``Queue.get()`` with no timeout on a
+stream whose producer died (engine permanently failed, request shed, client
+gone) parks the thread past any deadline the request carried. In the
+request-serving and collective paths (``serving/``, ``distributed/``,
+``inference/``), blocking waits must pass an explicit timeout. Detection is
+constructor-tracked, so ``dict.get`` / ``str.join`` / path joins are never
+confused for waits: a name (or ``self.<attr>``) assigned from
+``queue.Queue/SimpleQueue/LifoQueue/PriorityQueue``,
+``threading.Event/Condition``, ``threading.Thread`` or ``socket.socket`` is
+the receiver set, and on those receivers:
+
+- ``q.get()`` needs a ``timeout=`` kwarg or 2nd positional (``get(block,
+  timeout)``); ``get_nowait`` is always fine;
+- ``e.wait()`` / ``t.join()`` need a timeout kwarg or 1st positional;
+- ``s.recv()`` has no timeout parameter — the socket must have
+  ``settimeout(...)`` called on it somewhere in the same file.
+
+- RB501  ``os._exit`` call outside the sanctioned locations (including
+         through an ``import os as X`` alias or ``from os import _exit``).
+- RB502  un-timed blocking wait in ``serving/``/``distributed/``/
+         ``inference/`` on a tracked Queue/Event/Condition/Thread/socket.
 """
 
 from __future__ import annotations
 
 import ast
 from pathlib import PurePath
-from typing import List, Set
+from typing import Dict, List, Optional, Set
 
 from paddle_tpu.analysis.core import Checker, FileContext, Violation
 
 _ALLOWED_FILE_SUFFIX = ("distributed", "watchdog.py")
 _ALLOWED_DIR = ("distributed", "launch")
+
+# directories whose code serves requests / drives collectives: un-timed
+# waits here turn a shed request or a dead peer into a wedged worker
+_TIMED_WAIT_DIRS = ("serving", "distributed", "inference")
+
+# constructor -> receiver kind;   kind -> {method: min positional args that
+# make the call timed (timeout kwarg always counts)}
+_QUEUE_CTORS = {"Queue", "SimpleQueue", "LifoQueue", "PriorityQueue", "JoinableQueue"}
+_KIND_METHODS = {
+    "queue": {"get": 2},   # get(block, timeout)
+    "event": {"wait": 1},  # wait(timeout)
+    "thread": {"join": 1},  # join(timeout)
+    "socket": {"recv": None},  # no timeout param; needs settimeout() in file
+}
 
 
 def _is_allowed_path(path: str) -> bool:
@@ -38,16 +74,66 @@ def _is_allowed_path(path: str) -> bool:
     return False
 
 
+def _is_timed_wait_path(path: str) -> bool:
+    return any(part in _TIMED_WAIT_DIRS for part in PurePath(path).parts)
+
+
+def _receiver_key(node: ast.AST) -> Optional[str]:
+    """``name`` for ``name.m()``, ``self.attr`` for ``self.attr.m()``."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return f"self.{node.attr}"
+    return None
+
+
+def _ctor_kind(call: ast.expr, module_aliases: Dict[str, Set[str]],
+               from_imports: Dict[str, str]) -> Optional[str]:
+    """Classify a constructor call: Queue()/queue.Queue()/threading.Event()/
+    socket.socket() etc. -> receiver kind, else None."""
+    if not isinstance(call, ast.Call):
+        return None
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return from_imports.get(fn.id)
+    if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+        mod = fn.value.id
+        if mod in module_aliases["queue"] and fn.attr in _QUEUE_CTORS:
+            return "queue"
+        if mod in module_aliases["threading"]:
+            if fn.attr in ("Event", "Condition"):
+                return "event"
+            if fn.attr == "Thread":
+                return "thread"
+        if mod in module_aliases["socket"] and fn.attr == "socket":
+            return "socket"
+    return None
+
+
 class RobustnessChecker(Checker):
     name = "robustness"
     codes = {
         "RB501": "os._exit outside distributed/watchdog.py or distributed/launch/ "
                  "(bypasses checkpoint flush and finished-request delivery)",
+        "RB502": "blocking wait without an explicit timeout in serving/, "
+                 "distributed/ or inference/ (an un-timed wait is how a shed "
+                 "request wedges a worker forever)",
     }
 
     def run(self, ctx: FileContext) -> List[Violation]:
-        if _is_allowed_path(ctx.path):
-            return []
+        out: List[Violation] = []
+        if not _is_allowed_path(ctx.path):
+            out.extend(self._check_os_exit(ctx))
+        if _is_timed_wait_path(ctx.path):
+            out.extend(self._check_untimed_waits(ctx))
+        return out
+
+    # -- RB501 ---------------------------------------------------------------
+    def _check_os_exit(self, ctx: FileContext) -> List[Violation]:
         os_aliases: Set[str] = {"os"}
         exit_names: Set[str] = set()
         for node in ast.walk(ctx.tree):
@@ -80,4 +166,96 @@ class RobustnessChecker(Checker):
                         "the launcher (distributed/launch/) may call it",
                     )
                 )
+        return out
+
+    # -- RB502 ---------------------------------------------------------------
+    def _collect_receivers(self, ctx: FileContext) -> tuple:
+        """(receiver key -> kind, receivers with settimeout() called)."""
+        module_aliases: Dict[str, Set[str]] = {
+            "queue": set(), "threading": set(), "socket": set()
+        }
+        from_imports: Dict[str, str] = {}  # local ctor name -> kind
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name in module_aliases:
+                        module_aliases[a.name].add(a.asname or a.name)
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "queue":
+                    for a in node.names:
+                        if a.name in _QUEUE_CTORS:
+                            from_imports[a.asname or a.name] = "queue"
+                elif node.module == "threading":
+                    for a in node.names:
+                        if a.name in ("Event", "Condition"):
+                            from_imports[a.asname or a.name] = "event"
+                        elif a.name == "Thread":
+                            from_imports[a.asname or a.name] = "thread"
+                elif node.module == "socket":
+                    for a in node.names:
+                        if a.name == "socket":
+                            from_imports[a.asname or a.name] = "socket"
+        tracked: Dict[str, str] = {}
+        timed_sockets: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                # AnnAssign too: `self._q: Queue = Queue()` is the style the
+                # serving frontend itself uses — it must not be invisible
+                if node.value is None:
+                    continue
+                kind = _ctor_kind(node.value, module_aliases, from_imports)
+                if kind is not None:
+                    targets = (
+                        node.targets if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    for tgt in targets:
+                        key = _receiver_key(tgt)
+                        if key is not None:
+                            tracked[key] = kind
+            elif (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "settimeout"
+            ):
+                key = _receiver_key(node.func.value)
+                if key is not None:
+                    timed_sockets.add(key)
+        return tracked, timed_sockets
+
+    def _check_untimed_waits(self, ctx: FileContext) -> List[Violation]:
+        tracked, timed_sockets = self._collect_receivers(ctx)
+        if not tracked:
+            return []
+        out: List[Violation] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or not isinstance(node.func, ast.Attribute):
+                continue
+            method = node.func.attr
+            key = _receiver_key(node.func.value)
+            if key is None:
+                continue
+            kind = tracked.get(key)
+            if kind is None or method not in _KIND_METHODS.get(kind, ()):
+                continue
+            min_pos = _KIND_METHODS[kind][method]
+            if min_pos is None:  # socket.recv: timeout lives on the socket
+                if key in timed_sockets:
+                    continue
+            else:
+                has_kw = any(kw.arg == "timeout" for kw in node.keywords)
+                if has_kw or len(node.args) >= min_pos:
+                    continue
+            out.append(
+                Violation(
+                    ctx.path, node.lineno, node.col_offset, "RB502",
+                    f"blocking {key}.{method}() without an explicit timeout "
+                    "in a request-serving path: if the producer/peer dies "
+                    "(request shed, engine failed, client gone) this wait "
+                    "parks the worker forever — pass timeout= "
+                    + ("(or call settimeout() on the socket)"
+                       if kind == "socket" else "")
+                    + " and handle the expiry",
+                )
+            )
         return out

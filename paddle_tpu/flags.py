@@ -143,6 +143,9 @@ def _define_builtin_flags() -> None:
     # fault-tolerance layer (registered here so env seeding works before the
     # paddle_tpu.testing.faults import runs; empty = injection fully off)
     d("fault_inject_plan", str, "", "Deterministic fault-injection plan: 'site:call_index:ExceptionName' entries joined by ';' (see testing/faults.py). Empty disables injection; fault sites then cost one cached-bool read.")
+    # serving front end (paddle_tpu/serving/): same opt-in localhost pattern
+    # as metrics_port — nothing listens unless asked
+    d("serving_port", int, 0, "Serve the streaming generation HTTP endpoint (serving.start_serving_server) on this localhost port; 0 disables the endpoint.")
 
 
 _define_builtin_flags()

@@ -32,13 +32,29 @@ __all__ = [
     "create_predictor",
     "convert_to_mixed_precision",
     "PrecisionType",
+    "AdmissionPolicy",
     "ContinuousBatchingEngine",
+    "FIFOAdmission",
     "InferenceRequest",
+    "IntakeError",
+    "EmptyPromptError",
+    "InvalidTokenBudgetError",
+    "PromptTooLongError",
+    "RequestTooLongError",
+    "RequestUnservableError",
 ]
 
 from paddle_tpu.inference.engine import (  # noqa: E402
+    AdmissionPolicy,
     ContinuousBatchingEngine,
+    EmptyPromptError,
+    FIFOAdmission,
     InferenceRequest,
+    IntakeError,
+    InvalidTokenBudgetError,
+    PromptTooLongError,
+    RequestTooLongError,
+    RequestUnservableError,
 )
 
 

@@ -41,7 +41,7 @@ from __future__ import annotations
 import itertools
 import time
 from collections import deque
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -55,7 +55,48 @@ from paddle_tpu.observability.recompile import (
 )
 from paddle_tpu.testing.faults import InjectedFault, fault_point
 
-__all__ = ["ContinuousBatchingEngine", "InferenceRequest"]
+__all__ = [
+    "AdmissionPolicy",
+    "ContinuousBatchingEngine",
+    "EmptyPromptError",
+    "FIFOAdmission",
+    "InferenceRequest",
+    "IntakeError",
+    "InvalidTokenBudgetError",
+    "PromptTooLongError",
+    "RequestTooLongError",
+    "RequestUnservableError",
+]
+
+
+class IntakeError(ValueError):
+    """A request rejected at intake (validation), before any device work.
+
+    Subclasses ``ValueError`` for backward compatibility with callers that
+    ``except ValueError`` around :meth:`ContinuousBatchingEngine.add_request`;
+    the typed subclasses exist so a serving layer can map each failure to an
+    HTTP 4xx without string-matching the message."""
+
+
+class EmptyPromptError(IntakeError):
+    """The prompt has zero tokens."""
+
+
+class InvalidTokenBudgetError(IntakeError):
+    """``max_new_tokens`` is not a positive integer."""
+
+
+class PromptTooLongError(IntakeError):
+    """The prompt does not fit the configured ``prompt_bucket``."""
+
+
+class RequestTooLongError(IntakeError):
+    """prompt + ``max_new_tokens`` exceeds ``max_model_len``."""
+
+
+class RequestUnservableError(IntakeError):
+    """Worst-case KV demand exceeds the whole pool — no eviction can ever
+    make room, so the request would wedge the FIFO head forever."""
 
 
 def _engine_metrics() -> Dict[str, Any]:
@@ -118,7 +159,14 @@ def _engine_metrics() -> Dict[str, Any]:
 
 
 class InferenceRequest:
-    """One queued generation request and, after finishing, its result."""
+    """One queued generation request and, after finishing, its result.
+
+    ``priority`` / ``tenant`` / ``deadline`` are scheduling metadata consumed
+    by admission policies and the serving layer; the engine itself only acts
+    on ``deadline`` (an absolute ``time.perf_counter()`` instant): a request
+    whose deadline passes while queued is shed before its prefill runs, and
+    one that expires mid-decode is evicted with its blocks reclaimed —
+    ``finish_reason == "deadline"`` either way."""
 
     def __init__(
         self,
@@ -126,22 +174,69 @@ class InferenceRequest:
         prompt: np.ndarray,
         max_new_tokens: int,
         eos_token_id: Optional[int],
+        priority: int = 1,
+        tenant: str = "default",
+        deadline: Optional[float] = None,
     ) -> None:
         self.req_id = req_id
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
         self.eos_token_id = eos_token_id
+        self.priority = int(priority)
+        self.tenant = str(tenant)
+        self.deadline = None if deadline is None else float(deadline)
         self.generated: List[int] = []
-        self.finish_reason: Optional[str] = None  # "stop" | "length"
+        # "stop" | "length" | "deadline" | a cancel_request() reason
+        self.finish_reason: Optional[str] = None
         self.arrival_time = time.perf_counter()  # TTFT anchor
+        self.admit_time: Optional[float] = None  # None until prefill succeeded
 
     @property
     def finished(self) -> bool:
         return self.finish_reason is not None
 
+    def expired(self, now: Optional[float] = None) -> bool:
+        if self.deadline is None:
+            return False
+        return (time.perf_counter() if now is None else now) >= self.deadline
+
     def tokens(self) -> np.ndarray:
         """Prompt + generated tokens, the ``generate_paged`` layout."""
         return np.concatenate([self.prompt, np.asarray(self.generated, np.int32)])
+
+
+class AdmissionPolicy:
+    """Pluggable admission order for the engine's waiting queue.
+
+    :meth:`select` is called while a free slot exists; it returns the next
+    request to admit or None to stop admitting this boundary. Contract: the
+    returned request must be drawn from ``waiting`` and must satisfy
+    ``can_fit`` (the engine validates both — a buggy policy fails loudly
+    instead of corrupting the worst-case reservation invariant). Returning
+    None even though requests fit is allowed (e.g. a pacing policy)."""
+
+    def select(
+        self,
+        waiting: Sequence["InferenceRequest"],
+        can_fit: Callable[["InferenceRequest"], bool],
+    ) -> Optional["InferenceRequest"]:
+        raise NotImplementedError
+
+
+class FIFOAdmission(AdmissionPolicy):
+    """Strict arrival order with no head-of-line skipping: if the head does
+    not fit the pool's unreserved blocks, nothing is admitted — a large
+    request can never be starved by smaller ones arriving behind it. This is
+    the engine's historical default behavior."""
+
+    def select(
+        self,
+        waiting: Sequence["InferenceRequest"],
+        can_fit: Callable[["InferenceRequest"], bool],
+    ) -> Optional["InferenceRequest"]:
+        if waiting and can_fit(waiting[0]):
+            return waiting[0]
+        return None
 
 
 class ContinuousBatchingEngine:
@@ -162,6 +257,7 @@ class ContinuousBatchingEngine:
         max_model_len: Optional[int] = None,
         max_recoveries: int = 2,
         recovery_backoff: float = 0.05,
+        admission_policy: Optional[AdmissionPolicy] = None,
     ) -> None:
         from paddle_tpu.incubate.nn.functional import BlockKVCache
 
@@ -215,6 +311,7 @@ class ContinuousBatchingEngine:
         self._reserved = np.zeros((self.max_slots,), np.int64)  # admission worst case
         self._waiting: deque = deque()
         self._ids = itertools.count()
+        self._policy: AdmissionPolicy = admission_policy or FIFOAdmission()
 
         self._named = list(model.named_parameters())
         self.stats = {
@@ -299,51 +396,136 @@ class ContinuousBatchingEngine:
             )
 
     # -- request intake ------------------------------------------------------
-    def add_request(
-        self,
-        prompt_ids: Any,
-        max_new_tokens: int = 32,
-        eos_token_id: Optional[int] = None,
-    ) -> int:
-        """Queue one prompt; returns the request id. Raises on prompts that
-        can never fit the configured bucket/model length (failing loudly at
-        intake beats wedging the scheduler). Intake stays open while the
-        engine is mid-recovery — recovery is an engine-internal condition,
-        not a caller error, so the request simply queues; only a PERMANENTLY
-        failed engine (recovery exhausted) hard-rejects."""
-        self._check_usable()
+    def validate_request(self, prompt_ids: Any, max_new_tokens: int = 32) -> np.ndarray:
+        """Validate one prompt against the engine's static limits WITHOUT
+        queueing anything; returns the normalized ``int32`` prompt array.
+        Raises a typed :class:`IntakeError` subclass (all are ``ValueError``)
+        so a serving front end can map each failure to a 4xx status. Failing
+        loudly at intake beats wedging the scheduler."""
         prompt = np.asarray(
             prompt_ids._data if hasattr(prompt_ids, "_data") else prompt_ids,
             np.int32,
         ).reshape(-1)
         if prompt.size < 1:
-            raise ValueError("empty prompt")
+            raise EmptyPromptError("empty prompt")
         if max_new_tokens < 1:
-            raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+            raise InvalidTokenBudgetError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}"
+            )
         if prompt.size > self.prompt_bucket:
-            raise ValueError(
+            raise PromptTooLongError(
                 f"prompt ({prompt.size} tokens) exceeds prompt_bucket "
                 f"({self.prompt_bucket}); configure a larger bucket"
             )
         if prompt.size + max_new_tokens > self.max_model_len:
-            raise ValueError(
+            raise RequestTooLongError(
                 f"prompt ({prompt.size}) + max_new_tokens ({max_new_tokens}) "
                 f"exceeds max_model_len ({self.max_model_len})"
             )
-        req = InferenceRequest(next(self._ids), prompt, max_new_tokens, eos_token_id)
-        if self._blocks_needed(req) > self.num_blocks:
+        worst = prompt.size + max_new_tokens - 1
+        need = -(-worst // self.block_size)
+        if need > self.num_blocks:
             # a request no eviction can ever make room for would sit at the
             # FIFO head forever and busy-loop run()
-            raise ValueError(
-                f"request needs {self._blocks_needed(req)} KV blocks worst-case "
+            raise RequestUnservableError(
+                f"request needs {need} KV blocks worst-case "
                 f"but the pool only has {self.num_blocks}"
             )
+        return prompt
+
+    def make_request(
+        self,
+        prompt_ids: Any,
+        max_new_tokens: int = 32,
+        eos_token_id: Optional[int] = None,
+        priority: int = 1,
+        tenant: str = "default",
+        deadline: Optional[float] = None,
+    ) -> InferenceRequest:
+        """Validate and construct (but do not queue) one request — the seam
+        a serving layer uses to hold the handle it will stream from."""
+        self._check_usable()
+        prompt = self.validate_request(prompt_ids, max_new_tokens)
+        return InferenceRequest(
+            next(self._ids), prompt, max_new_tokens, eos_token_id,
+            priority=priority, tenant=tenant, deadline=deadline,
+        )
+
+    def enqueue(self, req: InferenceRequest) -> int:
+        """Queue a request built by :meth:`make_request`; returns its id.
+        Intake stays open while the engine is mid-recovery — recovery is an
+        engine-internal condition, not a caller error, so the request simply
+        queues; only a PERMANENTLY failed engine (recovery exhausted)
+        hard-rejects."""
+        self._check_usable()
         self._waiting.append(req)
         self._update_pool_gauges()  # queue depth changed
         return req.req_id
 
+    def add_request(
+        self,
+        prompt_ids: Any,
+        max_new_tokens: int = 32,
+        eos_token_id: Optional[int] = None,
+        priority: int = 1,
+        tenant: str = "default",
+        deadline: Optional[float] = None,
+    ) -> int:
+        """Queue one prompt; returns the request id. Raises a typed
+        :class:`IntakeError` on prompts that can never be served (see
+        :meth:`validate_request`)."""
+        return self.enqueue(
+            self.make_request(
+                prompt_ids, max_new_tokens, eos_token_id,
+                priority=priority, tenant=tenant, deadline=deadline,
+            )
+        )
+
     def has_work(self) -> bool:
         return bool(self._waiting) or any(r is not None for r in self._slot_req)
+
+    @property
+    def broken(self) -> bool:
+        """True once recovery is exhausted and the engine is PERMANENTLY
+        failed (a transient, caller-retryable step failure does not set
+        this — see :meth:`step`)."""
+        return self._broken
+
+    def queue_depth(self) -> int:
+        """Requests waiting for a slot (what the queue-depth gauge exports)."""
+        return len(self._waiting)
+
+    def live_requests(self) -> List[InferenceRequest]:
+        """Requests currently holding a slot (mid-decode), slot order."""
+        return [r for r in self._slot_req if r is not None]
+
+    def set_admission_policy(self, policy: AdmissionPolicy) -> None:
+        """Swap the admission policy (takes effect at the next boundary)."""
+        self._policy = policy
+
+    def cancel_request(
+        self, req_id: int, reason: str = "cancelled"
+    ) -> Optional[InferenceRequest]:
+        """Targeted eviction: remove ``req_id`` wherever it lives. A queued
+        request is dropped before its prefill ever runs; a mid-decode one is
+        evicted from its slot with its KV blocks reclaimed immediately. The
+        request (``finish_reason = reason``) is returned to THIS caller and
+        will NOT also be delivered by step() — exactly-once holds with the
+        cancel return value as the one delivery. Returns None when the id is
+        unknown (already finished and delivered, or never queued)."""
+        for req in self._waiting:
+            if req.req_id == req_id:
+                self._waiting.remove(req)
+                req.finish_reason = reason
+                self._metrics["finished"].labels(reason=reason).inc()
+                self._update_pool_gauges()
+                return req
+        for slot, req in enumerate(self._slot_req):
+            if req is not None and req.req_id == req_id:
+                req.finish_reason = reason
+                self._release(slot, req)
+                return req
+        return None
 
     # -- compiled programs (each traces exactly ONCE per engine) -------------
     def _param_arrays(self) -> List[Any]:
@@ -409,15 +591,48 @@ class ContinuousBatchingEngine:
         worst = req.prompt.size + req.max_new_tokens - 1
         return -(-worst // self.block_size)
 
+    def _can_fit(self, req: InferenceRequest) -> bool:
+        return self._unreserved_free() >= self._blocks_needed(req)
+
+    def _shed_expired_queued(self, done: List[InferenceRequest]) -> None:
+        """Shed queued requests whose deadline already passed — BEFORE any
+        prefill is spent on them. They are delivered through the same step()
+        return path as normal finishes, ``finish_reason == "deadline"``."""
+        if not self._waiting:
+            return
+        now = time.perf_counter()
+        expired = [r for r in self._waiting if r.expired(now)]
+        for req in expired:
+            self._waiting.remove(req)
+            req.finish_reason = "deadline"
+            self._metrics["finished"].labels(reason="deadline").inc()
+            done.append(req)
+        if expired:
+            self._update_pool_gauges()  # queue depth changed
+
     def _admit_waiting(self, done: List[InferenceRequest]) -> None:
+        self._shed_expired_queued(done)
         while self._waiting:
-            req = self._waiting[0]
             free_slots = [i for i, r in enumerate(self._slot_req) if r is None]
             if not free_slots:
                 return
-            if self._unreserved_free() < self._blocks_needed(req):
-                return  # FIFO: no head-of-line skipping, keeps latency fair
-            self._waiting.popleft()
+            req = self._policy.select(tuple(self._waiting), self._can_fit)
+            if req is None:
+                return
+            # a buggy policy must fail loudly, not corrupt the worst-case
+            # reservation invariant the pool depends on
+            if req not in self._waiting:
+                raise RuntimeError(
+                    f"admission policy {type(self._policy).__name__} selected "
+                    "a request that is not in the waiting queue"
+                )
+            if not self._can_fit(req):
+                raise RuntimeError(
+                    f"admission policy {type(self._policy).__name__} selected "
+                    f"request {req.req_id} needing {self._blocks_needed(req)} "
+                    f"blocks with only {self._unreserved_free()} unreserved"
+                )
+            self._waiting.remove(req)
             self._admit(req, free_slots[0])
             if req.finished:  # finished at prefill (eos / max_new_tokens == 1)
                 done.append(req)
@@ -459,8 +674,9 @@ class ContinuousBatchingEngine:
             self._prefill_recorded = True
         self.stats["admitted"] += 1
         tok = int(tok)  # device sync: the first token exists past this line
+        req.admit_time = time.perf_counter()
         self._metrics["admitted"].inc()
-        self._metrics["ttft"].observe(time.perf_counter() - req.arrival_time)
+        self._metrics["ttft"].observe(req.admit_time - req.arrival_time)
         req.generated.append(tok)
         if req.eos_token_id is not None and tok == req.eos_token_id:
             req.finish_reason = "stop"
@@ -550,6 +766,15 @@ class ContinuousBatchingEngine:
     def _step_attempt(self) -> None:
         """One admit+decode pass; finished requests land in
         ``_pending_done`` (never lost to an exception mid-attempt)."""
+        # mid-decode deadline expiry FIRST: evict before paying for another
+        # step of this slot's compute, so the freed slot/blocks are available
+        # to the admit pass below in the same boundary
+        now = time.perf_counter()
+        for i, req in enumerate(self._slot_req):
+            if req is not None and req.expired(now):
+                req.finish_reason = "deadline"
+                self._release(i, req)
+                self._pending_done.append(req)
         self._admit_waiting(self._pending_done)
         active_slots = [i for i, r in enumerate(self._slot_req) if r is not None]
         if not active_slots:

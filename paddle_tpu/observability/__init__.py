@@ -17,7 +17,9 @@ Three pieces, one substrate every perf/robustness PR reports through:
 Instrumented call sites: ``inference/engine.py`` (TTFT, decode-step latency,
 queue depth, admits/evicts/finished, KV-pool gauges), ``jit/api.py``
 (StaticFunction cache misses feed the watchdog), ``distributed/collective.py``
-(per-op call/time counters).
+(per-op call/time counters), and the serving front end (:mod:`.serving`
+families: shed/deadline/goodput counters, per-priority queue-wait and TTFT
+histograms, overload-level gauge).
 """
 
 from paddle_tpu.observability.metrics import (  # noqa: F401
@@ -44,8 +46,16 @@ from paddle_tpu.observability.exporters import (  # noqa: F401
     stop_metrics_server,
     write_snapshot_jsonl,
 )
+from paddle_tpu.observability.serving import (  # noqa: F401
+    PRIORITY_NAMES,
+    priority_name,
+    serving_metrics,
+)
 
 __all__ = [
+    "PRIORITY_NAMES",
+    "priority_name",
+    "serving_metrics",
     "Counter",
     "Gauge",
     "Histogram",

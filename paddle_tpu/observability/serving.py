@@ -1,0 +1,108 @@
+"""Serving-layer metric families (SLO/overload observability).
+
+The serving front end (`paddle_tpu/serving/`) reports through these; they
+live here so the whole metric surface — engine, collectives, jit, serving —
+is defined against ONE registry with one naming convention, and so exporters
+and dashboards can discover them without importing the serving stack.
+
+Conventions:
+
+- ``priority`` label values are the class names (``interactive`` /
+  ``standard`` / ``best_effort``; unknown numeric classes render as their
+  number) so a Prometheus query never needs the enum;
+- every shed path — bounded-queue rejection, overload rejection, deadline
+  expiry at either lifecycle stage, client disconnect, engine failure —
+  accounts into ``serving_shed_total{reason}``: the sum over reasons equals
+  the number of requests that entered the frontend (or tried to) and did not
+  finish normally. Deadline sheds ALSO count into
+  ``serving_deadline_miss_total{stage}`` with the lifecycle stage
+  (``queued`` vs ``decode``) the deadline caught them in.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from paddle_tpu.observability import metrics as _metrics
+
+__all__ = ["PRIORITY_NAMES", "priority_name", "serving_metrics"]
+
+# canonical priority classes (lower = more important); the serving layer
+# re-exports these as Priority.INTERACTIVE / STANDARD / BEST_EFFORT
+PRIORITY_NAMES: Dict[int, str] = {0: "interactive", 1: "standard", 2: "best_effort"}
+
+
+def priority_name(priority: int) -> str:
+    return PRIORITY_NAMES.get(int(priority), str(int(priority)))
+
+
+def serving_metrics() -> Dict[str, Any]:
+    """Get-or-create the serving metric families (process-global, like the
+    engine's `_engine_metrics`)."""
+    reg = _metrics.GLOBAL_METRICS
+    return {
+        "requests": reg.counter(
+            "serving_requests_total",
+            "Requests accepted by the serving frontend, by tenant and priority.",
+            labelnames=("tenant", "priority"),
+        ),
+        "shed": reg.counter(
+            "serving_shed_total",
+            "Requests shed instead of served, by reason (queue_full / overload "
+            "/ deadline_queued / deadline_decode / client_disconnect / "
+            "stream_timeout / engine_failure / cancelled).",
+            labelnames=("reason",),
+        ),
+        "deadline_miss": reg.counter(
+            "serving_deadline_miss_total",
+            "Requests whose deadline expired, by the lifecycle stage the "
+            "expiry caught them in (queued: shed before prefill; decode: "
+            "evicted mid-generation, blocks reclaimed).",
+            labelnames=("stage",),
+        ),
+        "degraded": reg.counter(
+            "serving_degraded_total",
+            "Graceful-degradation actions taken under pressure, by action "
+            "(clamp_max_new_tokens).",
+            labelnames=("action",),
+        ),
+        "queue_wait": reg.histogram(
+            "serving_queue_wait_seconds",
+            "Time from frontend accept to engine admission (prefill start), "
+            "per priority class.",
+            labelnames=("priority",),
+        ),
+        "ttft": reg.histogram(
+            "serving_ttft_seconds",
+            "Time from frontend accept to the first streamed token, per "
+            "priority class.",
+            labelnames=("priority",),
+        ),
+        "tokens": reg.counter(
+            "serving_tokens_total",
+            "Tokens streamed to clients, per priority class.",
+            labelnames=("priority",),
+        ),
+        "goodput": reg.counter(
+            "serving_goodput_tokens_total",
+            "Tokens of requests that finished normally INSIDE their SLO "
+            "deadline (the metric an overloaded deployment lives on), per "
+            "priority class.",
+            labelnames=("priority",),
+        ),
+        "queue_depth": reg.gauge(
+            "serving_queue_depth",
+            "Requests waiting in the frontend's bounded intake queue.",
+        ),
+        "level": reg.gauge(
+            "serving_overload_level",
+            "Overload controller state: 0 normal, 1 degraded (best-effort "
+            "budgets clamped), 2 shedding (low-priority intake rejected). "
+            "High-water mark tracked since reset.",
+        ),
+        "responses": reg.counter(
+            "serving_http_responses_total",
+            "HTTP responses by status code (200/400/404/429/500).",
+            labelnames=("code",),
+        ),
+    }

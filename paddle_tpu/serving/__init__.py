@@ -1,0 +1,62 @@
+"""SLO-aware serving layer over the continuous-batching engine.
+
+The host-side policy stack between clients and ``engine.step()``:
+
+- :mod:`.frontend` — :class:`ServingFrontend`: bounded intake, per-request
+  deadlines/TTLs, priority classes with weighted per-tenant fair admission,
+  hysteresis load shedding and graceful degradation;
+- :mod:`.scheduler` — :class:`WeightedFairPolicy`, the stride scheduler
+  installed as the engine's admission policy;
+- :mod:`.http` — the streaming localhost HTTP endpoint
+  (``start_serving_server``, ``FLAGS_serving_port``);
+- :mod:`.loadgen` — the open-loop Poisson arrival harness behind bench.py's
+  ``serving_goodput`` record and the overload acceptance tests;
+- :mod:`.errors` — :class:`Overloaded` (429) and the re-exported typed
+  :class:`IntakeError` taxonomy (4xx).
+
+See README "Serving & SLOs" for thresholds, status mapping and flags.
+"""
+
+from paddle_tpu.serving.errors import (  # noqa: F401
+    EmptyPromptError,
+    IntakeError,
+    InvalidTokenBudgetError,
+    Overloaded,
+    PromptTooLongError,
+    RequestTooLongError,
+    RequestUnservableError,
+    ServingError,
+)
+from paddle_tpu.serving.frontend import (  # noqa: F401
+    Hysteresis,
+    OverloadController,
+    Priority,
+    ServingConfig,
+    ServingFrontend,
+    ServingRequest,
+)
+from paddle_tpu.serving.http import (  # noqa: F401
+    start_serving_server,
+    stop_serving_server,
+)
+from paddle_tpu.serving.scheduler import WeightedFairPolicy  # noqa: F401
+
+__all__ = [
+    "EmptyPromptError",
+    "Hysteresis",
+    "IntakeError",
+    "InvalidTokenBudgetError",
+    "Overloaded",
+    "OverloadController",
+    "Priority",
+    "PromptTooLongError",
+    "RequestTooLongError",
+    "RequestUnservableError",
+    "ServingConfig",
+    "ServingError",
+    "ServingFrontend",
+    "ServingRequest",
+    "WeightedFairPolicy",
+    "start_serving_server",
+    "stop_serving_server",
+]

@@ -1,0 +1,49 @@
+"""Serving-layer error taxonomy.
+
+Intake *validation* failures are the engine's typed :class:`IntakeError`
+subclasses (re-exported here) — they mean the request itself is malformed
+and map to HTTP 4xx. :class:`Overloaded` means the request was fine but the
+system is shedding load — HTTP 429 with a ``Retry-After`` hint; the client
+should back off and retry, not fix anything.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.inference.engine import (  # noqa: F401  (re-export for HTTP mapping)
+    EmptyPromptError,
+    IntakeError,
+    InvalidTokenBudgetError,
+    PromptTooLongError,
+    RequestTooLongError,
+    RequestUnservableError,
+)
+
+__all__ = [
+    "Overloaded",
+    "ServingError",
+    "IntakeError",
+    "EmptyPromptError",
+    "InvalidTokenBudgetError",
+    "PromptTooLongError",
+    "RequestTooLongError",
+    "RequestUnservableError",
+]
+
+
+class ServingError(RuntimeError):
+    """Base class for serving-frontend errors that are NOT intake validation."""
+
+
+class Overloaded(ServingError):
+    """The frontend refused intake to protect itself (bounded queue full, or
+    the overload controller is shedding this priority class).
+
+    ``retry_after`` is the backoff hint in seconds (also sent as the HTTP
+    ``Retry-After`` header); ``reason`` is the shed-accounting label
+    (``queue_full`` / ``overload``) the same request was counted under in
+    ``serving_shed_total``."""
+
+    def __init__(self, message: str, retry_after: float = 1.0, reason: str = "overload") -> None:
+        super().__init__(message)
+        self.retry_after = float(retry_after)
+        self.reason = str(reason)

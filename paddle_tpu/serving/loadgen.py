@@ -1,0 +1,265 @@
+"""Open-loop overload bench harness: seeded Poisson arrivals, goodput/SLO
+reporting.
+
+Open-loop means arrivals do NOT wait for completions — the generator submits
+on its own clock, exactly like independent users do, so when the offered
+rate exceeds capacity the backlog grows and the frontend's admission /
+shedding / deadline machinery is what is actually being measured (a
+closed-loop bench self-throttles and can never produce this regime; the
+offline tokens/s bench never exercises admit/evict/finished at all).
+
+Everything is derived from one seed: inter-arrival gaps (exponential at the
+offered rate), the tenant/priority class of each arrival (weighted mix), and
+prompt/budget sizes — reruns are comparable and a failing campaign replays
+from its seed.
+
+:func:`run_open_loop` drives the frontend inline (no pump thread): each
+iteration submits every arrival whose scheduled time has come, then pumps
+once. The report carries the numbers a deployment lives on — goodput
+(tokens of requests that finished inside their SLO), per-class SLO
+attainment, shed/deadline counts by reason — plus the 2-compile honesty
+check via the recompile watchdog.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from paddle_tpu.observability.recompile import GLOBAL_WATCHDOG
+from paddle_tpu.observability.serving import priority_name
+from paddle_tpu.serving.errors import IntakeError, Overloaded
+from paddle_tpu.serving.frontend import Priority, ServingFrontend, ServingRequest
+
+__all__ = ["TrafficClass", "Arrival", "poisson_arrivals", "run_open_loop",
+           "measure_sustainable_rate"]
+
+
+@dataclass(frozen=True)
+class TrafficClass:
+    """One slice of the offered mix. ``share`` values are relative weights
+    (normalized across the mix); ``slo_s`` becomes each request's TTL —
+    finishing past it is an SLO miss, shedding at it is deadline enforcement."""
+
+    tenant: str = "default"
+    priority: int = Priority.STANDARD
+    share: float = 1.0
+    prompt_len: tuple = (4, 12)  # inclusive range drawn per request
+    max_new_tokens: tuple = (4, 16)
+    slo_s: Optional[float] = None
+
+
+@dataclass
+class Arrival:
+    t: float  # seconds from bench start
+    cls: TrafficClass
+    prompt: np.ndarray
+    max_new_tokens: int
+
+
+def poisson_arrivals(
+    rate_rps: float,
+    n: int,
+    mix: Sequence[TrafficClass],
+    seed: int,
+    vocab_size: int = 1000,
+) -> List[Arrival]:
+    """``n`` arrivals with Exp(1/rate) inter-arrival gaps; class, prompt and
+    budget all drawn from the same seeded generator."""
+    if rate_rps <= 0:
+        raise ValueError(f"rate_rps must be > 0, got {rate_rps}")
+    if not mix:
+        raise ValueError("traffic mix must not be empty")
+    rng = np.random.default_rng(seed)
+    shares = np.asarray([c.share for c in mix], np.float64)
+    shares = shares / shares.sum()
+    out: List[Arrival] = []
+    t = 0.0
+    for _ in range(int(n)):
+        t += float(rng.exponential(1.0 / rate_rps))
+        cls = mix[int(rng.choice(len(mix), p=shares))]
+        plen = int(rng.integers(cls.prompt_len[0], cls.prompt_len[1] + 1))
+        out.append(
+            Arrival(
+                t=t,
+                cls=cls,
+                prompt=rng.integers(0, vocab_size, (plen,)).astype(np.int32),
+                max_new_tokens=int(
+                    rng.integers(cls.max_new_tokens[0], cls.max_new_tokens[1] + 1)
+                ),
+            )
+        )
+    return out
+
+
+@dataclass
+class _ClassStats:
+    offered: int = 0
+    accepted: int = 0
+    rejected: int = 0  # Overloaded at intake
+    ok_in_slo: int = 0
+    ok_late: int = 0
+    shed: int = 0  # accepted then shed (deadline/cancel/engine failure)
+    goodput_tokens: int = 0
+    tokens: int = 0
+
+
+def run_open_loop(
+    frontend: ServingFrontend,
+    arrivals: Sequence[Arrival],
+    max_wall_s: float = 120.0,
+    on_iteration=None,
+) -> Dict[str, Any]:
+    """Replay ``arrivals`` against ``frontend`` (driven inline) and report.
+    ``on_iteration(frontend)``, when given, runs after every pump — the
+    overload test uses it to assert bounded-queue/accounting invariants
+    while the storm is live."""
+    watchdog_before = {
+        fn: rec["count"]
+        for fn, rec in GLOBAL_WATCHDOG.report().items()
+        if fn.startswith("ContinuousBatchingEngine.")
+    }
+    stats: Dict[str, _ClassStats] = {}
+    live: List[ServingRequest] = []
+    finished: List[ServingRequest] = []
+
+    def _cls_key(cls: TrafficClass) -> str:
+        return f"{cls.tenant}/{priority_name(cls.priority)}"
+
+    pending = list(arrivals)
+    pending.reverse()  # pop() from the back == chronological order
+    start = time.perf_counter()
+    while pending or frontend.engine.has_work() or live:
+        now = time.perf_counter() - start
+        if now > max_wall_s:
+            break
+        while pending and pending[-1].t <= now:
+            a = pending.pop()
+            st = stats.setdefault(_cls_key(a.cls), _ClassStats())
+            st.offered += 1
+            try:
+                handle = frontend.submit(
+                    a.prompt,
+                    max_new_tokens=a.max_new_tokens,
+                    priority=a.cls.priority,
+                    tenant=a.cls.tenant,
+                    ttl_s=a.cls.slo_s,
+                )
+            except Overloaded:
+                st.rejected += 1
+                continue
+            except IntakeError:
+                st.rejected += 1
+                continue
+            st.accepted += 1
+            handle._cls_key = _cls_key(a.cls)  # bench-local annotation
+            live.append(handle)
+        for handle in frontend.pump():
+            if handle in live:  # ignore leftovers from a prior (calibration) run
+                live.remove(handle)
+                finished.append(handle)
+        if on_iteration is not None:
+            on_iteration(frontend)
+
+    wall = time.perf_counter() - start
+    for handle in finished:
+        st = stats[handle._cls_key]
+        ntok = len(handle.inner.generated)
+        st.tokens += ntok
+        if handle.outcome == "ok":
+            if handle.met_deadline:
+                st.ok_in_slo += 1
+                st.goodput_tokens += ntok
+            else:
+                st.ok_late += 1
+        else:
+            st.shed += 1
+
+    watchdog_after = {
+        fn: rec["count"]
+        for fn, rec in GLOBAL_WATCHDOG.report().items()
+        if fn.startswith("ContinuousBatchingEngine.")
+    }
+    per_class = {}
+    for key, st in sorted(stats.items()):
+        per_class[key] = {
+            "offered": st.offered,
+            "accepted": st.accepted,
+            "rejected_at_intake": st.rejected,
+            "finished_in_slo": st.ok_in_slo,
+            "finished_late": st.ok_late,
+            "shed_after_accept": st.shed,
+            "tokens": st.tokens,
+            "goodput_tokens": st.goodput_tokens,
+            # SLO attainment over EVERYTHING offered: a rejected or shed
+            # request is an SLO failure, not a statistical no-show
+            "slo_attainment": round(st.ok_in_slo / st.offered, 4) if st.offered else 0.0,
+        }
+    total_goodput = sum(st.goodput_tokens for st in stats.values())
+    total_tokens = sum(st.tokens for st in stats.values())
+    return {
+        "wall_s": round(wall, 3),
+        "arrivals": len(arrivals),
+        "undelivered_arrivals": len(pending) + len(live),  # hit max_wall_s
+        "goodput_tokens_per_sec": round(total_goodput / wall, 2) if wall else 0.0,
+        "tokens_per_sec": round(total_tokens / wall, 2) if wall else 0.0,
+        "per_class": per_class,
+        "compiles_during_run": {
+            fn: watchdog_after.get(fn, 0) - watchdog_before.get(fn, 0)
+            for fn in set(watchdog_before) | set(watchdog_after)
+        },
+        "compiled_signatures_total": sum(watchdog_after.values()),
+    }
+
+
+def measure_sustainable_rate(
+    frontend: ServingFrontend,
+    n_requests: int,
+    seed: int,
+    prompt_len: tuple = (4, 12),
+    max_new_tokens: tuple = (4, 16),
+    vocab_size: int = 1000,
+) -> float:
+    """Closed-loop calibration: run ``n_requests`` through the engine with
+    the queue kept fed and return the completion rate (requests/sec). An
+    open-loop bench offering ``2 *`` this rate is guaranteed into overload.
+    A two-request warmup runs (and completes) before the timer starts, so
+    both engine signatures are compiled outside the measured window — the
+    rate reflects steady-state capacity and the overload run that follows
+    adds no compiles of its own."""
+    rng = np.random.default_rng(seed)
+    n = int(n_requests)
+    warm = [
+        frontend.submit(
+            rng.integers(0, vocab_size, (int(prompt_len[0]),)).astype(np.int32),
+            max_new_tokens=int(max_new_tokens[0]),
+            priority=Priority.STANDARD,
+        )
+        for _ in range(2)
+    ]
+    while not all(h.finished for h in warm):
+        frontend.pump()
+    t0 = time.perf_counter()
+    submitted = done = 0
+    while done < n:
+        while submitted < n:
+            try:
+                # same INCLUSIVE ranges as poisson_arrivals: calibration must
+                # price the same per-request work as the storm it calibrates
+                plen = int(rng.integers(prompt_len[0], prompt_len[1] + 1))
+                frontend.submit(
+                    rng.integers(0, vocab_size, (plen,)).astype(np.int32),
+                    max_new_tokens=int(
+                        rng.integers(max_new_tokens[0], max_new_tokens[1] + 1)
+                    ),
+                    priority=Priority.STANDARD,
+                )
+            except Overloaded:
+                break  # bounded intake: drain a little, then keep feeding
+            submitted += 1
+        done += len(frontend.pump())
+    dt = time.perf_counter() - t0
+    return n / dt if dt > 0 else float("inf")

@@ -1,0 +1,101 @@
+"""Weighted fair admission: priority classes + per-tenant fairness.
+
+Replaces the engine's raw FIFO ``_admit_waiting`` order through the
+:class:`~paddle_tpu.inference.engine.AdmissionPolicy` hook. Two layers:
+
+- **across priority classes** — stride scheduling: class ``p`` with weight
+  ``w_p`` holds a virtual "pass" that advances by ``1/w_p`` per admission,
+  and the class with the smallest pass is served next. Over a sustained
+  backlog each class's admission share converges to ``w_p / Σw`` — strict
+  enough that interactive traffic keeps flowing under overload, but unlike
+  strict priority a starving best-effort class still advances (its pass
+  falls behind and eventually wins a turn);
+- **within a class, across tenants** — round-robin keyed on the last tenant
+  served, so one chatty tenant cannot monopolize its class; within a tenant,
+  arrival order (oldest first).
+
+No head-of-line capacity skipping: if the fair-share winner does not fit the
+pool's unreserved blocks, admission stops for this boundary (same
+no-starvation guarantee as the engine's FIFO default — a large request is
+never indefinitely bypassed by smaller ones).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Sequence
+
+from paddle_tpu.inference.engine import AdmissionPolicy, InferenceRequest
+
+__all__ = ["WeightedFairPolicy", "DEFAULT_WEIGHTS"]
+
+# priority class -> stride weight (higher weight = larger admission share);
+# keys are the Priority.* constants (0 interactive / 1 standard / 2 best_effort)
+DEFAULT_WEIGHTS: Dict[int, float] = {0: 4.0, 1: 2.0, 2: 1.0}
+
+
+class WeightedFairPolicy(AdmissionPolicy):
+    def __init__(self, weights: Optional[Dict[int, float]] = None) -> None:
+        self.weights = dict(DEFAULT_WEIGHTS if weights is None else weights)
+        for p, w in self.weights.items():
+            if w <= 0:
+                raise ValueError(f"priority {p} weight must be > 0, got {w}")
+        self._pass: Dict[int, float] = {}  # priority -> stride pass value
+        self._contending: set = set()  # classes waiting at the last select()
+        self._last_tenant: Dict[int, str] = {}  # priority -> last tenant served
+
+    def _weight(self, priority: int) -> float:
+        return self.weights.get(priority, 1.0)
+
+    def select(
+        self,
+        waiting: Sequence[InferenceRequest],
+        can_fit: Callable[[InferenceRequest], bool],
+    ) -> Optional[InferenceRequest]:
+        if not waiting:
+            return None
+        by_prio: Dict[int, list] = {}
+        for req in waiting:
+            by_prio.setdefault(req.priority, []).append(req)
+
+        # a class joining (or REjoining after idle) starts at the incumbents'
+        # minimum pass — it must not burst through a backlog's worth of
+        # "missed" turns it was never contending for. Only newly-arrived
+        # classes are clamped: a continuously-contending class keeps the low
+        # pass it legitimately earned (clamping incumbents would erase the
+        # fair-share advantage the stride exists to grant). Incumbent = was
+        # waiting at the previous select() AND still is.
+        incumbents = self._contending & set(by_prio)
+        if incumbents:
+            floor = min(self._pass.get(p, 0.0) for p in incumbents)
+        else:
+            # everything drained and the mix restarts fresh: stale credit
+            # from a past regime must not decide the new one
+            self._pass.clear()
+            floor = 0.0
+        for p in by_prio:
+            if p not in incumbents:
+                self._pass[p] = max(self._pass.get(p, floor), floor)
+            else:
+                self._pass.setdefault(p, floor)
+        self._contending = set(by_prio)
+
+        # smallest pass wins; ties break toward the more important class
+        prio = min(by_prio, key=lambda p: (self._pass[p], p))
+
+        # round-robin across the class's tenants, starting after the tenant
+        # served last time; within a tenant, arrival (waiting) order
+        tenants = sorted({r.tenant for r in by_prio[prio]})
+        last = self._last_tenant.get(prio)
+        if last is not None and last in tenants:
+            i = tenants.index(last) + 1
+            tenants = tenants[i:] + tenants[:i]
+        elif last is not None:
+            tenants = sorted(tenants, key=lambda t: (t <= last, t))
+        tenant = tenants[0]
+        req = next(r for r in by_prio[prio] if r.tenant == tenant)
+
+        if not can_fit(req):
+            return None  # no capacity skipping: wait for blocks to free up
+        self._pass[prio] += 1.0 / self._weight(prio)
+        self._last_tenant[prio] = tenant
+        return req

@@ -6,6 +6,7 @@ checkpoint and collective fault paths are pinned with.
 """
 
 from paddle_tpu.testing.faults import (  # noqa: F401
+    KNOWN_SITES,
     FaultPlan,
     FaultTrigger,
     InjectedFault,
@@ -16,6 +17,7 @@ from paddle_tpu.testing.faults import (  # noqa: F401
 )
 
 __all__ = [
+    "KNOWN_SITES",
     "FaultPlan",
     "FaultTrigger",
     "InjectedFault",

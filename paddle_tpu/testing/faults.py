@@ -9,7 +9,11 @@ Model: production code declares **fault sites** by calling
 :func:`fault_point("site.name")` at the exact dispatch boundaries a real
 fault would surface at (the engine's two jit call sites, every collective
 entry point's instrumented wrapper, checkpoint file writes, block-pool
-allocation). A :class:`FaultPlan` is a set of ``(site, call_index,
+allocation, and the serving frontend's intake/respond seams —
+``serving.intake`` fires inside ``ServingFrontend.submit`` before any
+validation, ``serving.respond`` fires before each streamed HTTP chunk so
+overload × fault interplay, e.g. a respond failure mid-shed-storm, is
+reproducible). A :class:`FaultPlan` is a set of ``(site, call_index,
 exception)`` triggers: the ``call_index``-th call of ``site`` since the plan
 was installed raises ``exception`` — fully deterministic given a
 deterministic workload, and :meth:`FaultPlan.sample` derives a plan from a
@@ -41,11 +45,25 @@ __all__ = [
     "FaultPlan",
     "FaultTrigger",
     "InjectedFault",
+    "KNOWN_SITES",
     "fault_point",
     "inject",
     "install_plan",
     "site_call_count",
 ]
+
+# Canonical fault-site names (the ``fault_point`` call sites across the
+# package), for ``FaultPlan.sample(KNOWN_SITES, ...)`` campaigns. Collective
+# sites are one per instrumented entry point (``collective.<op>``); only the
+# stable, always-present ones are listed here.
+KNOWN_SITES = (
+    "engine.prefill",
+    "engine.decode",
+    "checkpoint.write",
+    "block_pool.allocate",
+    "serving.intake",
+    "serving.respond",
+)
 
 
 class InjectedFault(RuntimeError):

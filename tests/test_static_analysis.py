@@ -365,6 +365,97 @@ def test_rb501_suppressible_with_reason():
     assert vs[0].suppressed and vs[0].reason
 
 
+# -- RB502: un-timed blocking waits in request-serving paths ------------------
+
+SERVING = "paddle_tpu/serving/worker.py"
+
+
+def test_rb502_untimed_queue_get_flagged():
+    src = "import queue\nq = queue.Queue()\nitem = q.get()\n"
+    assert codes(src, path=SERVING) == ["RB502"]
+    # from-import constructor form
+    src = "from queue import Queue\nq = Queue()\nitem = q.get()\n"
+    assert codes(src, path=SERVING) == ["RB502"]
+
+
+def test_rb502_timed_queue_get_ok():
+    assert codes(
+        "import queue\nq = queue.Queue()\nitem = q.get(timeout=5)\n", path=SERVING
+    ) == []
+    # positional form get(block, timeout) and get_nowait are both fine
+    assert codes(
+        "import queue\nq = queue.Queue()\nitem = q.get(True, 5)\n", path=SERVING
+    ) == []
+    assert codes(
+        "import queue\nq = queue.Queue()\nitem = q.get_nowait()\n", path=SERVING
+    ) == []
+
+
+def test_rb502_dict_get_and_str_join_not_confused_for_waits():
+    # constructor tracking: untracked receivers never match
+    assert codes("d = {}\nv = d.get('k')\n", path=SERVING) == []
+    assert codes("s = ','.join(['a'])\n", path=SERVING) == []
+    assert codes("import os\np = os.path.join('a', 'b')\n", path=SERVING) == []
+
+
+def test_rb502_annotated_assignment_receivers_are_tracked():
+    # `self._q: Queue = Queue()` is an AnnAssign — the exact construction
+    # style the serving frontend uses; it must not be invisible
+    src = (
+        "from queue import Queue\n"
+        "class H:\n"
+        "    def __init__(self):\n"
+        "        self._q: Queue = Queue()\n"
+        "    def take(self):\n"
+        "        return self._q.get()\n"
+    )
+    assert codes(src, path=SERVING) == ["RB502"]
+    assert codes(src.replace(".get()", ".get(timeout=1)"), path=SERVING) == []
+
+
+def test_rb502_event_wait_and_thread_join():
+    src = (
+        "import threading\n"
+        "class A:\n"
+        "    def __init__(self):\n"
+        "        self._done = threading.Event()\n"
+        "        self._t = threading.Thread(target=print)\n"
+        "    def finish(self):\n"
+        "        self._done.wait()\n"
+        "        self._t.join()\n"
+    )
+    assert codes(src, path="paddle_tpu/inference/x.py") == ["RB502", "RB502"]
+    timed = src.replace(".wait()", ".wait(timeout=2)").replace(".join()", ".join(5)")
+    assert codes(timed, path="paddle_tpu/inference/x.py") == []
+
+
+def test_rb502_socket_recv_needs_settimeout():
+    src = "import socket\ns = socket.socket()\ndata = s.recv(1024)\n"
+    assert codes(src, path="paddle_tpu/distributed/x.py") == ["RB502"]
+    timed = "import socket\ns = socket.socket()\ns.settimeout(3)\ndata = s.recv(1024)\n"
+    assert codes(timed, path="paddle_tpu/distributed/x.py") == []
+
+
+def test_rb502_only_in_request_serving_dirs():
+    src = "import queue\nq = queue.Queue()\nitem = q.get()\n"
+    assert codes(src, path="paddle_tpu/models/x.py") == []
+    assert codes(src, path="paddle_tpu/kernels/x.py") == []
+    for gated in ("serving", "distributed", "inference"):
+        assert codes(src, path=f"paddle_tpu/{gated}/x.py") == ["RB502"]
+
+
+def test_rb502_suppressible_with_reason():
+    vs = analyze_source(
+        "import queue\n"
+        "q = queue.Queue()\n"
+        "# analysis: disable=RB502 shutdown path; producer provably alive\n"
+        "item = q.get()\n",
+        path=SERVING,
+    )
+    assert [v.code for v in vs] == ["RB502"]
+    assert vs[0].suppressed and vs[0].reason
+
+
 # -- suppressions ------------------------------------------------------------
 
 def test_suppression_with_reason():
