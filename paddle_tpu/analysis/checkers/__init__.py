@@ -8,6 +8,7 @@ from typing import Dict, List, Type
 
 from paddle_tpu.analysis.checkers.exception_hygiene import ExceptionHygieneChecker
 from paddle_tpu.analysis.checkers.flag_discipline import FlagDisciplineChecker
+from paddle_tpu.analysis.checkers.observability import ObservabilityChecker
 from paddle_tpu.analysis.checkers.pallas_purity import PallasPurityChecker
 from paddle_tpu.analysis.checkers.robustness import RobustnessChecker
 from paddle_tpu.analysis.checkers.trace_safety import TraceSafetyChecker
@@ -21,6 +22,7 @@ CHECKER_CLASSES: List[Type[Checker]] = [
     FlagDisciplineChecker,
     ExceptionHygieneChecker,
     RobustnessChecker,
+    ObservabilityChecker,
 ]
 
 

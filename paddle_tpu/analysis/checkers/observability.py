@@ -1,0 +1,128 @@
+"""OB — observability-discipline checker.
+
+**OB601** — the tracing/flight-recorder surface has exactly two safe shapes,
+and this check pins both:
+
+1. a live span may only be opened as a context manager. ``tracer.span(...)``
+   returns an armed :class:`~paddle_tpu.observability.tracing.Span` whose
+   recording happens in ``__exit__`` — assigned to a variable or called
+   bare, the span is never closed, never reaches the bounded store, and the
+   leak is silent (the trace just has a hole where the phase should be).
+   The retroactive forms (``add_span``/``add_event``) take explicit
+   timestamps and need no ``with``;
+2. span opens AND flight-recorder event emission belong in host code only.
+   Inside a ``@jax.jit``/``@to_static`` body they fire per COMPILE, not per
+   call (the recorded-at-trace-time bug class TS104 pins for metrics), and
+   inside a Pallas kernel/index map they are host I/O from device code.
+   Emit at the jit call site, after the dispatch returns — exactly how the
+   engine emits its decode-step spans.
+
+Detection is receiver-shaped, so ordinary ``.span``/``.record`` methods on
+unrelated objects are never confused for tracer calls:
+
+- a span open is ``<recv>.span(...)`` where the receiver's last component
+  names a tracer (contains ``tracer``, any case: ``tracer``, ``_tracer``,
+  ``GLOBAL_TRACER``, ``self._tracer``) or is a ``get_tracer()`` call;
+- flight-recorder emission is ``record_event(...)`` (any receiver or bare —
+  the module-level shorthand) or ``<recv>.record(...)`` where the
+  receiver's last component contains ``flight`` or ``recorder``.
+
+- OB601  tracer span opened outside ``with``, or tracer/flight-recorder
+         emission inside a traced (``@jax.jit``/``to_static``) function or
+         Pallas kernel body / index map.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from paddle_tpu.analysis.checkers._shared import attr_chain, body_walk
+from paddle_tpu.analysis.checkers.pallas_purity import _KernelCollector
+from paddle_tpu.analysis.checkers.trace_safety import _TracedFunctions
+from paddle_tpu.analysis.core import Checker, FileContext, Violation
+
+
+def _last_component(chain: Optional[str]) -> str:
+    return chain.rsplit(".", 1)[-1].lower() if chain else ""
+
+
+def _is_tracer_span_open(node: ast.Call) -> bool:
+    fn = node.func
+    if not isinstance(fn, ast.Attribute) or fn.attr != "span":
+        return False
+    recv = fn.value
+    if isinstance(recv, ast.Call):
+        # get_tracer().span(...)
+        return _last_component(attr_chain(recv.func)) == "get_tracer"
+    return "tracer" in _last_component(attr_chain(recv))
+
+
+def _is_flight_emit(node: ast.Call) -> bool:
+    fn = node.func
+    if isinstance(fn, ast.Name) and fn.id == "record_event":
+        return True
+    if isinstance(fn, ast.Attribute):
+        if fn.attr == "record_event":
+            return True
+        if fn.attr == "record":
+            last = _last_component(attr_chain(fn.value))
+            return "flight" in last or "recorder" in last
+    return False
+
+
+class ObservabilityChecker(Checker):
+    name = "observability-discipline"
+    codes = {
+        "OB601": "tracer span opened outside a with statement (silent leak), "
+                 "or tracer/flight-recorder emission inside a traced "
+                 "function or Pallas kernel (fires per compile, not per "
+                 "call)",
+    }
+
+    def run(self, ctx: FileContext) -> List[Violation]:
+        device_nodes: Dict[int, Tuple[str, str]] = {}  # node id -> (kind, label)
+        for fn in _TracedFunctions().resolve(ctx.tree):
+            label = getattr(fn, "name", "<lambda>")
+            for node in body_walk(fn):
+                device_nodes.setdefault(id(node), ("traced function", label))
+        for fn, role in _KernelCollector().collect(ctx):
+            label = getattr(fn, "name", "<lambda>")
+            for node in body_walk(fn):
+                device_nodes.setdefault(id(node), (f"Pallas {role}", label))
+
+        out: List[Violation] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            span_open = _is_tracer_span_open(node)
+            flight_emit = _is_flight_emit(node)
+            if not span_open and not flight_emit:
+                continue
+            hit = device_nodes.get(id(node))
+            if hit is not None:
+                kind, label = hit
+                what = "tracer span open" if span_open else "flight-recorder emission"
+                out.append(
+                    Violation(
+                        ctx.path, node.lineno, node.col_offset, "OB601",
+                        f"{what} inside {kind} '{label}': fires per compile, "
+                        "not per call — emit at the jit call site after the "
+                        "dispatch returns",
+                    )
+                )
+                continue
+            if span_open and not isinstance(
+                ctx.parents.get(node), ast.withitem
+            ):
+                out.append(
+                    Violation(
+                        ctx.path, node.lineno, node.col_offset, "OB601",
+                        "tracer span opened outside a with statement: the "
+                        "span records in __exit__, so this one is never "
+                        "closed and silently leaks — use "
+                        "'with tracer.span(...) as sp:' (or add_span for "
+                        "retroactive timestamps)",
+                    )
+                )
+        return out

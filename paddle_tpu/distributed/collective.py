@@ -22,6 +22,7 @@ import numpy as np
 
 from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.observability import metrics as _obs
+from paddle_tpu.observability import tracing as _tracing
 from paddle_tpu.testing.faults import fault_point as _fault_point
 
 _coll_calls = _obs.GLOBAL_METRICS.counter(
@@ -38,23 +39,34 @@ _coll_seconds = _obs.GLOBAL_METRICS.counter(
 
 
 def _instrumented(fn):
-    """Wrap one collective with call/time counters and a fault-injection
-    site (``collective.<op>``). With metrics off and no fault plan installed
-    the wrapper is two cached-bool checks — safe on trace-time hot paths."""
+    """Wrap one collective with call/time counters, a fault-injection site
+    (``collective.<op>``) and a tracer span (trace time under jit; eager
+    dispatch time otherwise). With metrics and tracing off and no fault
+    plan installed the wrapper is three cached-bool checks — safe on
+    trace-time hot paths."""
     op = fn.__name__
     fault_site = f"collective.{op}"
+    span_name = f"collective.{op}"
 
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):
         _fault_point(fault_site)
-        if not _obs.metrics_enabled():
+        # full-rate tracing only: a collective carries no request context
+        # to sample against, so at a partial rate these spans would flood
+        # the bounded ring and evict the sampled request trees
+        traced = _tracing.tracing_full()
+        if not _obs.metrics_enabled() and not traced:
             return fn(*args, **kwargs)
         t0 = time.perf_counter()
         try:
             return fn(*args, **kwargs)
         finally:
-            _coll_calls.labels(op=op).inc()
-            _coll_seconds.labels(op=op).inc(time.perf_counter() - t0)
+            t1 = time.perf_counter()
+            if _obs.metrics_enabled():
+                _coll_calls.labels(op=op).inc()
+                _coll_seconds.labels(op=op).inc(t1 - t0)
+            if traced:
+                _tracing.GLOBAL_TRACER.add_span(span_name, start_s=t0, end_s=t1)
 
     return wrapper
 

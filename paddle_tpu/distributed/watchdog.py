@@ -90,6 +90,25 @@ class CommWatchdog:
         dump = self._dump(name, started)
         self.last_dump = dump
         try:
+            # the always-on flight recorder gets a black-box line + a
+            # postmortem dump file BEFORE any handler/abort runs; both are
+            # best-effort by contract (safe_dump swallows its own failures)
+            from paddle_tpu.observability import flight_recorder as _flight
+
+            _flight.record_event(
+                "watchdog_timeout", section=name,
+                elapsed_s=round(dump["elapsed_s"], 3), timeout_s=self.timeout,
+            )
+            _flight.safe_dump(
+                "comm_watchdog_timeout",
+                extra={"section": name, "elapsed_s": dump["elapsed_s"],
+                       "recent_sections": [
+                           s["section"] for s in dump["recent_sections"]]},
+            )
+        # analysis: disable=EH402 best-effort black box: a broken observability import must never block the dump/abort path; the stderr dump below is the evidence of record
+        except Exception:
+            pass
+        try:
             try:
                 if self.on_timeout is not None:
                     self.on_timeout(dump)

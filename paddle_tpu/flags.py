@@ -138,6 +138,11 @@ def _define_builtin_flags() -> None:
     # SURVEY §5.1); registered here so env seeding works before the
     # paddle_tpu.observability import runs
     d("enable_metrics", bool, False, "Record runtime metrics (counters/gauges/histograms) into the global registry; off = every recording call is a no-op.")
+    d("trace_sample_rate", float, 0.0, "Head-sampling probability (0..1) for per-request distributed tracing (observability.tracing). 0 disables tracing entirely — every trace call site then costs one cached-bool read.")
+    d("trace_seed", int, 0, "Seed for the global tracer's id/sampling RNG: the same seed + request sequence reproduces the same sampling decisions and span ids.")
+    d("trace_buffer_size", int, 4096, "Capacity of the tracer's bounded in-process span store (newest spans win); read when a Tracer is constructed.")
+    d("flight_recorder_size", int, 1024, "Ring capacity of the always-on flight recorder: how many recent structured events the black box retains for postmortem dumps.")
+    d("flight_recorder_dir", str, "", "Directory for automatic flight-recorder dumps (engine permanent failure, watchdog timeout, pump death); empty = the system temp dir.")
     d("metrics_port", int, 0, "Serve Prometheus text exposition on this localhost port via observability.start_metrics_server(); 0 disables the endpoint.")
     d("max_compiles_per_fn", int, 16, "Recompile-watchdog budget: warn when one traced function RE-compiles (compiles past its first_call traces) more than this many times; 0 disables the warning.")
     # fault-tolerance layer (registered here so env seeding works before the

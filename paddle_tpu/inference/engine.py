@@ -47,7 +47,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from paddle_tpu.observability import flight_recorder as _flight
 from paddle_tpu.observability import metrics as _obs
+from paddle_tpu.observability import tracing as _tracing
 from paddle_tpu.observability.recompile import (
     CAUSE_FIRST_CALL,
     CAUSE_NEW_SHAPE_DTYPE,
@@ -190,6 +192,18 @@ class InferenceRequest:
         self.finish_reason: Optional[str] = None
         self.arrival_time = time.perf_counter()  # TTFT anchor
         self.admit_time: Optional[float] = None  # None until prefill succeeded
+        # lifecycle timestamps the tracing layer turns into phase spans at
+        # terminal time (plain floats — kept regardless of sampling)
+        self.prefill_start: Optional[float] = None
+        self.finish_wall: Optional[float] = None
+        # sampled trace context (observability.tracing.TraceContext) set by
+        # the serving frontend; None = this request is not traced
+        self.trace: Optional[Any] = None
+        # decode attribution: in a continuous batch a request's decode time
+        # is its share of the batched steps it rode — accumulated only while
+        # tracing is enabled (one cached-bool read per STEP, not per request)
+        self.decode_steps = 0
+        self.decode_share_s = 0.0
 
     @property
     def finished(self) -> bool:
@@ -517,6 +531,10 @@ class ContinuousBatchingEngine:
             if req.req_id == req_id:
                 self._waiting.remove(req)
                 req.finish_reason = reason
+                req.finish_wall = time.perf_counter()
+                _flight.record_event(
+                    "shed_queued", req_id=req.req_id, reason=reason
+                )
                 self._metrics["finished"].labels(reason=reason).inc()
                 self._update_pool_gauges()
                 return req
@@ -605,6 +623,10 @@ class ContinuousBatchingEngine:
         for req in expired:
             self._waiting.remove(req)
             req.finish_reason = "deadline"
+            req.finish_wall = now
+            _flight.record_event(
+                "shed_queued", req_id=req.req_id, reason="deadline"
+            )
             self._metrics["finished"].labels(reason="deadline").inc()
             done.append(req)
         if expired:
@@ -645,6 +667,7 @@ class ContinuousBatchingEngine:
         ids = np.zeros((1, self.prompt_bucket), np.int32)
         ids[0, :plen] = req.prompt
         traces_before = self.stats["prefill_traces"]
+        req.prefill_start = time.perf_counter()
         try:
             fault_point("engine.prefill")
             tok, self._caches = self._prefill_fn(
@@ -675,6 +698,11 @@ class ContinuousBatchingEngine:
         self.stats["admitted"] += 1
         tok = int(tok)  # device sync: the first token exists past this line
         req.admit_time = time.perf_counter()
+        # black box: ids and sizes only, never prompt content
+        _flight.record_event(
+            "admit", req_id=req.req_id, slot=slot, prompt_len=int(plen),
+            queue_depth=len(self._waiting),
+        )
         self._metrics["admitted"].inc()
         self._metrics["ttft"].observe(req.admit_time - req.arrival_time)
         req.generated.append(tok)
@@ -699,6 +727,12 @@ class ContinuousBatchingEngine:
         self._slot_req[slot] = None
         self._ntok[slot] = 0
         self._last_tok[slot] = 0
+        req.finish_wall = time.perf_counter()
+        _flight.record_event(
+            "evict", req_id=req.req_id, slot=slot,
+            reason=req.finish_reason or "unknown",
+            n_generated=len(req.generated),
+        )
         self._metrics["evicted"].inc()
         self._metrics["finished"].labels(reason=req.finish_reason or "unknown").inc()
         self._update_pool_gauges()
@@ -738,20 +772,43 @@ class ContinuousBatchingEngine:
                 recoverable = self._buffers_lost() or isinstance(exc, InjectedFault)
                 if not recoverable or attempt >= self.max_recoveries:
                     self._broken = recoverable
+                    if self._broken:
+                        self._dump_black_box(exc)
                     raise
                 attempt += 1
                 time.sleep(self.recovery_backoff * (2 ** (attempt - 1)))
                 try:
                     self.recover()
-                except BaseException:
+                except BaseException as rexc:
                     # a dispatch failure DURING recovery (device truly dead,
                     # injected or real) leaves half-rebuilt KV — permanent
                     self._broken = True
+                    self._dump_black_box(rexc)
                     raise
         # deliver everything that finished during this (possibly retried)
         # step exactly once — including prefill-finishers from an attempt
         # whose decode dispatch later died
         return self.drain_finished()
+
+    def _dump_black_box(self, exc: BaseException) -> None:
+        """The engine just became PERMANENTLY failed: write the flight
+        recorder's recent-event ring to disk so the postmortem has a
+        timeline. safe_dump never raises — the original exception is what
+        the caller must see."""
+        _flight.record_event(
+            "engine_permanent_failure",
+            error=f"{type(exc).__name__}: {exc}"[:200],
+            live=sum(r is not None for r in self._slot_req),
+            queued=len(self._waiting),
+        )
+        _flight.safe_dump(
+            "engine_permanent_failure",
+            extra={
+                "error": f"{type(exc).__name__}: {exc}"[:200],
+                "stats": dict(self.stats),
+                "pool": self.pool_stats(),
+            },
+        )
 
     def drain_finished(self) -> List[InferenceRequest]:
         """Hand back finished-but-undelivered requests. Normally step() is
@@ -814,7 +871,32 @@ class ContinuousBatchingEngine:
             self._decode_recorded = True
         self.stats["steps"] += 1
         nxt = np.asarray(nxt)  # device sync: the step's tokens are real here
-        self._metrics["step"].observe(time.perf_counter() - t0)
+        t1 = time.perf_counter()
+        self._metrics["step"].observe(t1 - t0)
+        if _tracing.tracing_enabled():
+            # per-request decode time in a continuous batch is a SHARE of
+            # the batched step it rode; accumulate the even split on every
+            # active request, and emit one batch-step span (annotated with
+            # slot membership) when any rider is sampled
+            share = (t1 - t0) / len(active_slots)
+            membership: Dict[str, int] = {}
+            any_sampled = False
+            for i in active_slots:
+                req = self._slot_req[i]
+                req.decode_steps += 1
+                req.decode_share_s += share
+                membership[str(i)] = req.req_id
+                if req.trace is not None and req.trace.sampled:
+                    any_sampled = True
+            if any_sampled:
+                _tracing.GLOBAL_TRACER.add_span(
+                    "engine.decode_step", start_s=t0, end_s=t1,
+                    attrs={
+                        "slot_req_ids": membership,
+                        "n_active": len(active_slots),
+                        "share_s": round(share, 9),
+                    },
+                )
         for i in active_slots:
             req = self._slot_req[i]
             tok = int(nxt[i])
@@ -842,6 +924,11 @@ class ContinuousBatchingEngine:
         programs are reused — a recovery must not add compiles (the
         recompile watchdog still reports exactly 2 for this engine)."""
         live = [(i, req) for i, req in enumerate(self._slot_req) if req is not None]
+        t_recover = time.perf_counter()
+        _flight.record_event(
+            "recovery", live=len(live), queued=len(self._waiting),
+            recoveries=self.stats["recoveries"] + 1,
+        )
         self._caches = [
             (
                 jnp.zeros(self._cache_shape, self._cache_dtype),
@@ -923,6 +1010,11 @@ class ContinuousBatchingEngine:
                 req = self._slot_req[i]
                 self._ntok[i] += 1
                 self._last_tok[i] = req.generated[r + 1]
+        if _tracing.tracing_enabled():
+            _tracing.GLOBAL_TRACER.add_span(
+                "engine.recover", start_s=t_recover, end_s=time.perf_counter(),
+                attrs={"replayed_slots": len(live), "replay_depth": max_replay},
+            )
         self._update_pool_gauges()
 
     def run(self) -> Dict[int, InferenceRequest]:

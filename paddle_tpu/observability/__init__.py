@@ -12,7 +12,16 @@ Three pieces, one substrate every perf/robustness PR reports through:
   stream;
 - a recompile watchdog (:mod:`.recompile`): compile counts with cause
   attribution (new shape/dtype vs. train/eval flip vs. first call) and a
-  ``FLAGS_max_compiles_per_fn`` budget warning.
+  ``FLAGS_max_compiles_per_fn`` budget warning;
+- a per-request distributed tracer (:mod:`.tracing`): span trees
+  (trace/span/parent ids, traceparent propagation) with seeded head
+  sampling via ``FLAGS_trace_sample_rate``, zero-cost when off, bounded
+  span store, chrome-trace + JSONL export merged by ``profiler.export``;
+- an always-on flight recorder (:mod:`.flight_recorder`): lock-cheap ring
+  of recent structured events (admits/evicts/recoveries/compiles/faults/
+  overload transitions), dumped automatically — redacted — on engine
+  permanent failure, watchdog timeout and pump-thread death; read dumps
+  with ``python -m paddle_tpu.observability.dump``.
 
 Instrumented call sites: ``inference/engine.py`` (TTFT, decode-step latency,
 queue depth, admits/evicts/finished, KV-pool gauges), ``jit/api.py``
@@ -22,6 +31,24 @@ families: shed/deadline/goodput counters, per-priority queue-wait and TTFT
 histograms, overload-level gauge).
 """
 
+from paddle_tpu.observability.flight_recorder import (  # noqa: F401
+    FlightRecorder,
+    GLOBAL_FLIGHT_RECORDER,
+    get_flight_recorder,
+    record_event,
+    safe_dump,
+)
+from paddle_tpu.observability.tracing import (  # noqa: F401
+    GLOBAL_TRACER,
+    Span,
+    TraceContext,
+    Tracer,
+    format_traceparent,
+    get_tracer,
+    parse_traceparent,
+    tracing_enabled,
+    tracing_full,
+)
 from paddle_tpu.observability.metrics import (  # noqa: F401
     Counter,
     Gauge,
@@ -53,6 +80,20 @@ from paddle_tpu.observability.serving import (  # noqa: F401
 )
 
 __all__ = [
+    "FlightRecorder",
+    "GLOBAL_FLIGHT_RECORDER",
+    "get_flight_recorder",
+    "record_event",
+    "safe_dump",
+    "GLOBAL_TRACER",
+    "Span",
+    "TraceContext",
+    "Tracer",
+    "format_traceparent",
+    "get_tracer",
+    "parse_traceparent",
+    "tracing_enabled",
+    "tracing_full",
     "PRIORITY_NAMES",
     "priority_name",
     "serving_metrics",

@@ -1,0 +1,181 @@
+"""CLI: ``python -m paddle_tpu.observability.dump [--to-chrome OUT] file``
+
+Postmortem reader for the observability artifacts:
+
+- a **flight-recorder dump** (``flightrec_*.json``, schema
+  ``paddle_tpu.flight_recorder/v1``) is pretty-printed as a timeline —
+  reason, dump walltime, then one line per event with its offset from the
+  newest event;
+- a **span JSONL** (``Tracer.export_jsonl`` output) is summarized per
+  trace, or converted to a chrome-trace JSON with ``--to-chrome OUT``
+  (load it in ``chrome://tracing`` / Perfetto).
+
+Exit status: 0 on success, 2 on a missing, empty or corrupt file — the
+same no-vacuous-pass discipline as the analyzer CLI: a typo'd path in a
+postmortem script must fail loudly, never print an empty timeline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+from paddle_tpu.observability.flight_recorder import DUMP_SCHEMA
+from paddle_tpu.observability.tracing import Tracer
+
+
+def _load(path: str) -> Any:
+    """Classify + parse: a flight dump (one JSON object with our schema), a
+    span JSONL (one record per line), else ValueError."""
+    with open(path) as f:
+        text = f.read()
+    if not text.strip():
+        raise ValueError("file is empty")
+    try:
+        obj = json.loads(text)
+        if isinstance(obj, dict) and obj.get("schema") == DUMP_SCHEMA:
+            return ("flight", obj)
+        if isinstance(obj, dict) and "events" in obj and "reason" in obj:
+            return ("flight", obj)
+    except ValueError:
+        pass  # not a single JSON document — try JSONL below
+    records: List[Dict[str, Any]] = []
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError as exc:
+            raise ValueError(f"line {lineno} is not valid JSON: {exc}") from exc
+        if not isinstance(rec, dict) or "name" not in rec or "ts_us" not in rec:
+            raise ValueError(
+                f"line {lineno} is not a span record (need 'name' and 'ts_us')"
+            )
+        records.append(rec)
+    if not records:
+        raise ValueError("no span records found")
+    return ("spans", records)
+
+
+def _print_flight(dump: Dict[str, Any]) -> None:
+    events = dump.get("events", [])
+    print(f"flight-recorder dump — reason: {dump.get('reason', '?')}")
+    print(
+        f"pid {dump.get('pid', '?')}, walltime {dump.get('walltime', '?')}, "
+        f"{len(events)} events"
+    )
+    extra = dump.get("extra") or {}
+    if extra:
+        print(f"extra: {json.dumps(extra, default=str)}")
+    if not events:
+        print("(empty ring)")
+        return
+    newest = max(float(e.get("ts_us", 0.0)) for e in events)
+    print(f"{'t-rel':>10}  {'kind':<24} fields")
+    for e in events:
+        rel = (float(e.get("ts_us", 0.0)) - newest) / 1e6
+        fields = {
+            k: v
+            for k, v in e.items()
+            if k not in ("seq", "ts_us", "walltime", "kind")
+        }
+        print(
+            f"{rel:>+9.3f}s  {str(e.get('kind', '?')):<24} "
+            f"{json.dumps(fields, default=str)}"
+        )
+
+
+def _print_spans(records: List[Dict[str, Any]]) -> None:
+    spans = [r for r in records if r.get("kind", "span") == "span"]
+    events = [r for r in records if r.get("kind") == "event"]
+    traces: Dict[str, List[Dict[str, Any]]] = {}
+    for s in spans:
+        traces.setdefault(str(s.get("trace_id")), []).append(s)
+    print(f"{len(spans)} spans, {len(events)} events, {len(traces)} traces")
+    for tid, group in traces.items():
+        group.sort(key=lambda s: s["ts_us"])
+        print(f"trace {tid}:")
+        by_id = {s.get("span_id"): s for s in group}
+        for s in group:
+            depth = 0
+            cur = s
+            seen = set()  # a corrupt cyclic parent chain must not hang us
+            while (
+                cur is not None
+                and cur.get("parent_id") in by_id
+                and id(cur) not in seen
+            ):
+                seen.add(id(cur))
+                depth += 1
+                cur = by_id[cur["parent_id"]]
+            dur_ms = float(s.get("dur_us", 0.0)) / 1e3
+            print(
+                f"  {'  ' * depth}{s['name']}  {dur_ms:.3f} ms"
+                f"  [{s.get('status', 'ok')}]"
+            )
+
+
+def _to_chrome(records: List[Dict[str, Any]], out: str) -> int:
+    events = []
+    for rec in records:
+        rec = dict(rec)
+        rec.setdefault("kind", "span")
+        rec.setdefault("dur_us", 0.0)
+        rec.setdefault("attrs", {})
+        events.append(Tracer._to_chrome(rec))
+    with open(out, "w") as f:
+        json.dump({"traceEvents": events}, f)
+    return len(events)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.observability.dump",
+        description="Pretty-print a flight-recorder dump, or summarize / "
+        "convert a tracer span JSONL.",
+    )
+    ap.add_argument("path", help="flight-recorder dump (.json) or span JSONL")
+    ap.add_argument(
+        "--to-chrome",
+        metavar="OUT",
+        help="convert a span JSONL to a chrome-trace JSON file",
+    )
+    args = ap.parse_args(argv)
+
+    if not os.path.isfile(args.path):
+        print(f"error: no such file: {args.path}", file=sys.stderr)
+        return 2
+    try:
+        which, payload = _load(args.path)
+    except (ValueError, OSError) as exc:
+        print(f"error: cannot read {args.path}: {exc}", file=sys.stderr)
+        return 2
+
+    if args.to_chrome:
+        if which == "flight":
+            # a flight dump converts too: events become instant marks
+            records = [
+                {"kind": "event", "name": e.get("kind", "?"),
+                 "ts_us": e.get("ts_us", 0.0),
+                 "attrs": {k: v for k, v in e.items()
+                           if k not in ("kind", "ts_us")}}
+                for e in payload.get("events", [])
+            ]
+        else:
+            records = payload
+        n = _to_chrome(records, args.to_chrome)
+        print(f"wrote {n} traceEvents to {args.to_chrome}")
+        return 0
+
+    if which == "flight":
+        _print_flight(payload)
+    else:
+        _print_spans(payload)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

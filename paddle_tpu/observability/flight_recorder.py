@@ -1,0 +1,213 @@
+"""Always-on flight recorder: the serving stack's black box.
+
+When a run dies permanently — engine recovery exhausted, a collective hung
+past the ``CommWatchdog`` timeout, the serving pump thread crashed — the
+aggregate metrics say only that it died. This ring buffer records what the
+engine was *doing* in the seconds before: admits, evicts/finishes,
+recoveries, compiles, fault injections, overload-level transitions. On any
+of the three permanent-failure seams the buffer is dumped to a JSON file
+automatically, so every postmortem starts with a timeline instead of a
+shrug (the reference fork's ``CommTaskManager`` dump-on-timeout discipline,
+generalized to the whole serving stack).
+
+Design constraints, in order:
+
+- **always on** — unlike metrics/tracing there is no flag gate: a black box
+  that must be enabled before the crash is not a black box. Recording is
+  therefore lock-free cheap: one ``deque.append`` (atomic under the GIL) of
+  a small dict; the ring (``FLAGS_flight_recorder_size``) bounds memory
+  forever;
+- **redacted** — dumps must be shippable to a bug report: prompt content
+  never enters an event, and :func:`_redact` scrubs denylisted keys
+  (``prompt``/``tokens``/...) from events AND caller-supplied extras as a
+  second line of defense, replacing values with a length-only marker;
+- **dump must never kill the dumper** — :meth:`FlightRecorder.safe_dump`
+  swallows everything (including the ``tracing.export`` fault site it
+  declares, so CI proves the property); the engine step path and the pump
+  thread only ever call the safe form. Dump files are written
+  tmp+``os.replace`` so a crash mid-dump leaves no torn file.
+
+Read a dump with ``python -m paddle_tpu.observability.dump <file>``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import tempfile
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from paddle_tpu.flags import GLOBAL_FLAGS
+
+__all__ = [
+    "DUMP_SCHEMA",
+    "FlightRecorder",
+    "GLOBAL_FLIGHT_RECORDER",
+    "get_flight_recorder",
+    "record_event",
+    "safe_dump",
+]
+
+DUMP_SCHEMA = "paddle_tpu.flight_recorder/v1"
+
+# keys whose values may carry user content: scrubbed from every dumped event
+# (events are written to never include these; the dump redacts regardless)
+_REDACT_KEYS = frozenset(
+    {"prompt", "prompt_ids", "tokens", "generated", "token_ids", "text", "ids"}
+)
+
+
+def _redact(obj: Any) -> Any:
+    """Deep-copy ``obj`` with denylisted keys replaced by a length-only
+    marker — a dump can prove HOW MUCH was there without leaking WHAT."""
+    if isinstance(obj, dict):
+        out = {}
+        for k, v in obj.items():
+            if isinstance(k, str) and k.lower() in _REDACT_KEYS:
+                try:
+                    n = len(v)  # type: ignore[arg-type]
+                except TypeError:
+                    n = 1
+                out[k] = f"<redacted:{n}>"
+            else:
+                out[k] = _redact(v)
+        return out
+    if isinstance(obj, (list, tuple)):
+        return [_redact(v) for v in obj]
+    return obj
+
+
+class FlightRecorder:
+    """Bounded ring of recent structured events + crash-consistent dumps."""
+
+    def __init__(self, capacity: Optional[int] = None) -> None:
+        cap = int(
+            GLOBAL_FLAGS.get("flight_recorder_size") if capacity is None else capacity
+        )
+        if cap < 1:
+            raise ValueError(f"flight recorder capacity must be >= 1, got {cap}")
+        self._events: deque = deque(maxlen=cap)
+        self._seq = itertools.count()
+        self._dump_seq = itertools.count()
+        self._lock = threading.Lock()  # dumps only; record() never takes it
+
+    def record(self, kind: str, **fields: Any) -> None:
+        """Record one event. Lock-free (deque.append is atomic), always on —
+        this is the per-admit/per-evict cost, so it stays one small dict
+        build + one append. Callers must not pass prompt content."""
+        self._events.append(
+            {
+                "seq": next(self._seq),
+                "ts_us": time.perf_counter() * 1e6,
+                "walltime": time.time(),
+                "kind": kind,
+                **fields,
+            }
+        )
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        # record() is deliberately lock-free, so copy defensively: a
+        # concurrent append can invalidate the copy's iterator mid-flight
+        # (RuntimeError: deque mutated during iteration), and the dump
+        # seams fire exactly while other threads are still recording —
+        # losing the postmortem to that race would defeat the black box
+        for _ in range(8):
+            try:
+                return list(self._events)
+            except RuntimeError:  # ring churned mid-copy: retry
+                continue
+        # ring still churning after retries: index-copy what's reachable
+        out: List[Dict[str, Any]] = []
+        for i in range(len(self._events)):
+            try:
+                out.append(self._events[i])
+            except IndexError:  # shrunk under us (clear()): take what we have
+                break
+        return out
+
+    def clear(self) -> None:
+        self._events.clear()
+
+    def _default_dir(self) -> str:
+        configured = str(GLOBAL_FLAGS.get("flight_recorder_dir"))
+        return configured or os.path.join(
+            tempfile.gettempdir(), "paddle_tpu_flightrec"
+        )
+
+    def dump(
+        self,
+        reason: str,
+        path: Optional[str] = None,
+        extra: Optional[Dict[str, Any]] = None,
+    ) -> str:
+        """Write the redacted ring to a JSON file; returns the path. With no
+        explicit path: ``FLAGS_flight_recorder_dir`` (or the system temp
+        dir) / ``flightrec_<pid>_<n>_<reason>.json``. Atomic via
+        tmp+``os.replace``. Declares the ``tracing.export`` fault site —
+        failure seams call :meth:`safe_dump` instead."""
+        from paddle_tpu.testing.faults import fault_point  # lazy: import cycle
+
+        fault_point("tracing.export")
+        with self._lock:
+            n = next(self._dump_seq)
+            if path is None:
+                d = self._default_dir()
+                os.makedirs(d, exist_ok=True)
+                safe_reason = "".join(
+                    c if c.isalnum() or c in "-_" else "_" for c in reason
+                )[:64]
+                path = os.path.join(
+                    d, f"flightrec_{os.getpid()}_{n}_{safe_reason}.json"
+                )
+            payload = {
+                "schema": DUMP_SCHEMA,
+                "reason": reason,
+                "pid": os.getpid(),
+                "walltime": time.time(),
+                "extra": _redact(dict(extra) if extra else {}),
+                "events": [_redact(e) for e in self.snapshot()],
+            }
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(payload, f, indent=1, default=str)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        return path
+
+    def safe_dump(
+        self,
+        reason: str,
+        path: Optional[str] = None,
+        extra: Optional[Dict[str, Any]] = None,
+    ) -> Optional[str]:
+        """Dump that never raises (None on failure) — the ONLY form the
+        engine step path, pump thread and watchdog may call: the black box
+        must never take down the path whose death it is documenting."""
+        try:
+            return self.dump(reason, path=path, extra=extra)
+        except Exception:  # dump is best-effort by contract on failure seams
+            return None
+
+
+GLOBAL_FLIGHT_RECORDER = FlightRecorder()
+
+
+def get_flight_recorder() -> FlightRecorder:
+    return GLOBAL_FLIGHT_RECORDER
+
+
+def record_event(kind: str, **fields: Any) -> None:
+    """Record into the process-global recorder (the module-level shorthand
+    every instrumented call site uses)."""
+    GLOBAL_FLIGHT_RECORDER.record(kind, **fields)
+
+
+def safe_dump(
+    reason: str, path: Optional[str] = None, extra: Optional[Dict[str, Any]] = None
+) -> Optional[str]:
+    return GLOBAL_FLIGHT_RECORDER.safe_dump(reason, path=path, extra=extra)

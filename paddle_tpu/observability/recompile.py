@@ -31,7 +31,9 @@ from typing import Any, Dict, Optional
 
 from paddle_tpu.flags import GLOBAL_FLAGS
 
+from . import flight_recorder as _flight
 from . import metrics as _metrics
+from . import tracing as _tracing
 
 __all__ = [
     "CAUSE_FIRST_CALL",
@@ -86,6 +88,15 @@ class RecompileWatchdog:
             count = rec["count"]
             causes = dict(rec["causes"])
         self._counter.labels(fn=fn, cause=cause).inc()
+        # a compile costs seconds: always worth a flight-recorder line (the
+        # black box's postmortem shows compiles near the failure), and a
+        # trace instant when tracing is on (a compile mid-serve explains a
+        # latency cliff no span arithmetic can)
+        _flight.record_event("compile", fn=fn, cause=cause, count=count)
+        if _tracing.tracing_enabled():
+            _tracing.GLOBAL_TRACER.add_event(
+                "jit.compile", attrs={"fn": fn, "cause": cause, "count": count}
+            )
         budget = GLOBAL_FLAGS.get("max_compiles_per_fn")
         # budget counts RE-compiles: first_call traces are expected once per
         # instance (several engines / Layer instances legitimately share one

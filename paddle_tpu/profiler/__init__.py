@@ -273,6 +273,15 @@ class Profiler:
             events = events + drain_trace_events()
         except ImportError:  # exporters unavailable mid-teardown: spans still export
             pass
+        try:
+            # request/engine spans from the distributed tracer land on the
+            # same perf_counter timeline as RecordEvent spans, so one chrome
+            # trace shows a request's phases against the recorded host spans
+            from paddle_tpu.observability.tracing import GLOBAL_TRACER
+
+            events = events + GLOBAL_TRACER.drain_chrome_events()
+        except ImportError:  # tracing unavailable mid-teardown: spans still export
+            pass
         with open(path, "w") as f:
             json.dump({"traceEvents": events}, f)
 

@@ -47,6 +47,8 @@ from queue import Empty, Queue
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 from paddle_tpu.inference.engine import ContinuousBatchingEngine, InferenceRequest
+from paddle_tpu.observability import flight_recorder as _flight
+from paddle_tpu.observability import tracing as _tracing
 from paddle_tpu.observability.serving import priority_name, serving_metrics
 from paddle_tpu.serving.errors import Overloaded
 from paddle_tpu.serving.scheduler import DEFAULT_WEIGHTS, WeightedFairPolicy
@@ -191,6 +193,10 @@ class ServingRequest:
         self.id = inner.req_id
         self.priority = inner.priority
         self.tenant = inner.tenant
+        # distributed-tracing context for this request's span tree; set by
+        # submit() when tracing is enabled (None otherwise). Kept even when
+        # unsampled so the trace id still propagates downstream.
+        self.trace_ctx: Optional[_tracing.TraceContext] = None
         self.submit_time = submit_time
         self.requested_max_new_tokens = int(requested_max_new)
         self.degraded = requested_max_new != inner.max_new_tokens
@@ -205,6 +211,14 @@ class ServingRequest:
     @property
     def finished(self) -> bool:
         return self._done.is_set()
+
+    @property
+    def traceparent(self) -> Optional[str]:
+        """Outgoing ``traceparent`` header value for this request's root
+        span (None when tracing was off at submit)."""
+        if self.trace_ctx is None:
+            return None
+        return _tracing.format_traceparent(self.trace_ctx)
 
     @property
     def met_deadline(self) -> bool:
@@ -297,20 +311,44 @@ class ServingFrontend:
         priority: int = Priority.STANDARD,
         tenant: str = "default",
         ttl_s: Optional[float] = None,
+        traceparent: Optional[str] = None,
     ) -> ServingRequest:
         """Accept one request. Raises a typed
         :class:`~paddle_tpu.inference.engine.IntakeError` (→ 4xx) on
         malformed input, :class:`Overloaded` (→ 429) when shedding, and
-        ``RuntimeError`` if the engine is permanently failed."""
+        ``RuntimeError`` if the engine is permanently failed.
+
+        ``traceparent`` (the W3C-style header) continues an upstream trace;
+        with tracing enabled and no header, seeded head sampling against
+        ``FLAGS_trace_sample_rate`` decides. With the rate at 0 the entire
+        tracing surface of this call is ONE cached-bool read."""
         fault_point("serving.intake")
         priority = int(priority)
         now = time.perf_counter()
+        trace_ctx = None
+        if _tracing.tracing_enabled():
+            trace_ctx = _tracing.GLOBAL_TRACER.start_trace(traceparent)
         with self._lock:
             if self._failed is not None:
                 raise RuntimeError(
                     f"serving frontend stopped: {self._failed}; build a new engine"
                 )
-            self._shed_gate(priority)
+            try:
+                self._shed_gate(priority)
+            except Overloaded as exc:
+                # a sampled request rejected at intake still gets a terminal
+                # root span — a trace must never just vanish at the door
+                if trace_ctx is not None and trace_ctx.sampled:
+                    _tracing.GLOBAL_TRACER.add_span(
+                        "request", trace_id=trace_ctx.trace_id,
+                        span_id=trace_ctx.span_id, parent_id=trace_ctx.parent_id,
+                        start_s=now, end_s=time.perf_counter(),
+                        attrs={"outcome": exc.reason,
+                               "priority": priority_name(priority),
+                               "tenant": tenant},
+                        status=f"shed:{exc.reason}",
+                    )
+                raise
             effective_max_new = self._degrade_gate(priority, int(max_new_tokens))
             ttl = self.config.default_ttl_s if ttl_s is None else ttl_s
             deadline = None if ttl is None else now + float(ttl)
@@ -321,6 +359,9 @@ class ServingFrontend:
             handle = ServingRequest(
                 inner, now, int(max_new_tokens), self.config.default_wait_s
             )
+            handle.trace_ctx = trace_ctx
+            if trace_ctx is not None and trace_ctx.sampled:
+                inner.trace = trace_ctx  # engine-side spans attach here
             self.engine.enqueue(inner)
             self._live[inner.req_id] = handle
             self._metrics["requests"].labels(
@@ -399,8 +440,10 @@ class ServingFrontend:
                 # live for pump() to finalize through step()'s delivery
             handle = self._live.pop(req_id)
             self._count_shed(reason)
-            handle._push_new(time.perf_counter())  # flush tokens produced so far
-            handle._finalize(reason, time.perf_counter())
+            now = time.perf_counter()
+            handle._push_new(now)  # flush tokens produced so far
+            handle._finalize(reason, now)
+            self._emit_trace(handle, now)
             self._update_gauges()
             return True
 
@@ -441,6 +484,11 @@ class ServingFrontend:
         first = handle.first_token_time is None
         pushed = handle._push_new(now)
         if pushed:
+            ctx = handle.trace_ctx
+            if ctx is not None and ctx.sampled:
+                _tracing.GLOBAL_TRACER.add_event(
+                    "stream_chunk", ctx=ctx, attrs={"tokens": pushed}
+                )
             pr = priority_name(handle.priority)
             self._metrics["tokens"].labels(priority=pr).inc(pushed)
             if first:
@@ -469,7 +517,64 @@ class ServingFrontend:
             handle._finalize(outcome, now)
         else:  # cancel_request reasons arriving via step() are already counted
             handle._finalize(reason or "unknown", now)
+        self._emit_trace(handle, now)
         return handle
+
+    def _emit_trace(self, handle: ServingRequest, now: float) -> None:
+        """Emit the request's span tree at terminal time, built from the
+        lifecycle timestamps the engine/frontend recorded along the way.
+        The phases tile [submit, terminal] contiguously — queue_wait →
+        (prefill → decode, when admitted) → stream_out — so their durations
+        sum to the request's observed end-to-end latency, and every span is
+        parented to the root. No-op unless this request was sampled."""
+        ctx = handle.trace_ctx
+        if ctx is None or not ctx.sampled:
+            return
+        t = _tracing.GLOBAL_TRACER
+        inner = handle.inner
+        tid, root = ctx.trace_id, ctx.span_id
+        sub = handle.submit_time
+        pstart, admit = inner.prefill_start, inner.admit_time
+        fin = inner.finish_wall if inner.finish_wall is not None else now
+        admitted = pstart is not None and admit is not None
+        q_end = pstart if admitted else fin
+        t.add_span(
+            "request.queue_wait", trace_id=tid, parent_id=root,
+            start_s=sub, end_s=q_end,
+        )
+        if admitted:
+            t.add_span(
+                "request.prefill", trace_id=tid, parent_id=root,
+                start_s=pstart, end_s=admit,
+                attrs={"prompt_len": int(inner.prompt.size)},
+            )
+            t.add_span(
+                "request.decode", trace_id=tid, parent_id=root,
+                start_s=admit, end_s=fin,
+                attrs={
+                    "decode_steps": inner.decode_steps,
+                    # batched share: this request's even split of every
+                    # decode step it rode (see engine.decode_step spans)
+                    "batched_share_s": round(inner.decode_share_s, 6),
+                },
+            )
+        t.add_span(
+            "request.stream_out", trace_id=tid, parent_id=root,
+            start_s=fin, end_s=now, attrs={"tokens": handle._n_pushed},
+        )
+        t.add_span(
+            "request", trace_id=tid, span_id=root, parent_id=ctx.parent_id,
+            start_s=sub, end_s=now,
+            attrs={
+                "req_id": handle.id,
+                "priority": priority_name(handle.priority),
+                "tenant": handle.tenant,
+                "outcome": handle.outcome,
+                "finish_reason": inner.finish_reason,
+                "n_generated": len(inner.generated),
+            },
+            status="ok" if handle.outcome == "ok" else f"shed:{handle.outcome}",
+        )
 
     def _ttft_p99(self) -> float:
         if not self._ttfts:
@@ -481,7 +586,17 @@ class ServingFrontend:
         stats = self.engine.pool_stats()
         util = stats["allocated"] / stats["total"] if stats["total"] else 0.0
         queue_frac = self.engine.queue_depth() / self.config.max_queue
-        return self.controller.update(queue_frac, util, self._ttft_p99())
+        prev = self.controller.level
+        level = self.controller.update(queue_frac, util, self._ttft_p99())
+        if level != prev:
+            # overload transitions are rare and postmortem-critical: the
+            # black box shows what pressure looked like before a death
+            _flight.record_event(
+                "overload_level",
+                **{"from": _LEVEL_NAMES[prev], "to": _LEVEL_NAMES[level],
+                   "queue_frac": round(queue_frac, 4), "util": round(util, 4)},
+            )
+        return level
 
     def _update_gauges(self) -> None:
         self._metrics["queue_depth"].set(self.engine.queue_depth())
@@ -525,6 +640,13 @@ class ServingFrontend:
         now = time.perf_counter()
         with self._lock:
             self._failed = why
+            # the pump thread is dying: black-box line + postmortem dump
+            # (safe_dump never raises — failing every stream still happens)
+            _flight.record_event(
+                "pump_death", why=why[:200], live=len(self._live),
+                queue_depth=self.engine.queue_depth(),
+            )
+            _flight.safe_dump("serving_pump_death", extra={"why": why[:200]})
             # salvage results the engine already finished but never delivered
             salvaged = {r.req_id for r in self.engine.drain_finished()}
             for rid, handle in list(self._live.items()):
@@ -534,6 +656,7 @@ class ServingFrontend:
                 else:
                     self._count_shed("engine_failure")
                     handle._finalize("engine_failure", now)
+                    self._emit_trace(handle, now)
                 del self._live[rid]
             self._update_gauges()
 

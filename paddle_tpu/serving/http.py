@@ -16,6 +16,12 @@ Routes:
 - ``GET /healthz`` — the frontend's :meth:`snapshot` (overload level, queue
   depth, pool utilization).
 
+Tracing: a ``traceparent`` request header (W3C shape, see
+``observability.tracing``) continues the caller's trace through this hop;
+the response carries a ``traceparent`` header naming the request's root
+span so the client can link its own spans. With ``FLAGS_trace_sample_rate``
+at 0 the header is ignored at the cost of one cached-bool read.
+
 Status mapping: malformed body / intake validation → **400** (typed
 ``IntakeError``, no message string-matching), unknown route → **404**,
 shedding → **429** with a ``Retry-After`` header from the
@@ -135,6 +141,9 @@ class _ServingHandler(BaseHTTPRequestHandler):
         except _BadRequest as exc:
             self._send_json(400, {"error": str(exc)})
             return
+        # distributed tracing: continue the caller's trace when the header
+        # is present (malformed headers are ignored, never a 4xx)
+        kwargs["traceparent"] = self.headers.get("traceparent")
         try:
             handle = self.frontend.submit(**kwargs)
         except Overloaded as exc:
@@ -162,6 +171,9 @@ class _ServingHandler(BaseHTTPRequestHandler):
         self.send_response(200)
         self.send_header("Content-Type", "application/x-ndjson")
         self.send_header("Cache-Control", "no-store")
+        if handle.traceparent:
+            # the root span's identity: the client can link its own spans
+            self.send_header("traceparent", handle.traceparent)
         # no Content-Length: HTTP/1.0 semantics — connection close ends the
         # body; each line is flushed as its token is produced
         self.end_headers()
@@ -210,6 +222,10 @@ class _ServingHandler(BaseHTTPRequestHandler):
                     "tokens": handle.tokens(),
                     "degraded": handle.degraded,
                 },
+                headers=(
+                    {"traceparent": handle.traceparent}
+                    if handle.traceparent else None
+                ),
             )
         except (BrokenPipeError, ConnectionResetError, OSError, InjectedFault):
             # the request already finished (nothing to evict) — just don't

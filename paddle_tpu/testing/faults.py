@@ -63,6 +63,10 @@ KNOWN_SITES = (
     "block_pool.allocate",
     "serving.intake",
     "serving.respond",
+    # span/flight-recorder export seam: a failing export or dump must never
+    # take down the serving pump or the engine step path (the callers there
+    # use the safe_* forms; campaigns prove it)
+    "tracing.export",
 )
 
 
@@ -252,6 +256,14 @@ def _trip(site: str) -> None:
                 break
     if exc_type is not None:
         _injected_total.labels(site=site).inc()
+        # the black box records every fired trigger: a postmortem must be
+        # able to tell an injected failure from an organic one at a glance
+        # (lazy import: the observability package init would cycle here)
+        from paddle_tpu.observability import flight_recorder as _flight
+
+        _flight.record_event(
+            "fault_injected", site=site, index=idx, exception=exc_type.__name__
+        )
         raise exc_type(f"injected fault at site {site!r} (call #{idx})")
 
 

@@ -456,6 +456,95 @@ def test_rb502_suppressible_with_reason():
     assert vs[0].suppressed and vs[0].reason
 
 
+# -- OB: observability discipline --------------------------------------------
+
+def test_ob601_span_opened_without_with_leaks():
+    # armed Span assigned to a variable: __exit__ never runs, silent leak
+    assert codes('sp = tracer.span("phase")\n') == ["OB601"]
+    assert codes('x = self._tracer.span("phase")\n') == ["OB601"]
+    assert codes('GLOBAL_TRACER.span("phase")\n') == ["OB601"]
+    assert codes('s = get_tracer().span("phase")\n') == ["OB601"]
+
+
+def test_ob601_with_statement_and_retroactive_forms_ok():
+    assert codes('with tracer.span("phase") as sp:\n    sp.set_attr("k", 1)\n') == []
+    # add_span/add_event take explicit timestamps: no with required
+    assert codes('tracer.add_span("phase", start_s=0.0, end_s=1.0)\n') == []
+    assert codes('tracer.add_event("mark")\n') == []
+
+
+def test_ob601_unrelated_span_and_record_receivers_not_confused():
+    # .span on a non-tracer receiver, .record on a non-recorder receiver
+    assert codes('cell.span(3)\n') == []
+    assert codes('db.record("row")\n') == []
+    assert codes('wingspan = bird.span("wide")\n') == []
+
+
+def test_ob601_emission_inside_jitted_body():
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    with tracer.span('inner'):\n"
+        "        return x\n"
+    )
+    assert codes(src) == ["OB601"]
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    record_event('admit', req_id=1)\n"
+        "    return x\n"
+    )
+    assert codes(src) == ["OB601"]
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    GLOBAL_FLIGHT_RECORDER.record('admit', req_id=1)\n"
+        "    return x\n"
+    )
+    assert codes(src) == ["OB601"]
+
+
+def test_ob601_emission_inside_pallas_kernel():
+    src = (
+        "import jax.experimental.pallas as pl\n"
+        "def my_kernel(x_ref, o_ref):\n"
+        "    record_event('tile')\n"
+        "    o_ref[...] = x_ref[...]\n"
+        "def run(x):\n"
+        "    return pl.pallas_call(my_kernel, out_shape=x)(x)\n"
+    )
+    assert codes(src) == ["OB601"]
+
+
+def test_ob601_host_call_site_pattern_is_clean():
+    # the sanctioned shape: dispatch inside jit, emission at the call site
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    return x * 2\n"
+        "def drive(x):\n"
+        "    y = step(x)\n"
+        "    record_event('stepped')\n"
+        "    with tracer.span('post') as sp:\n"
+        "        sp.set_attr('ok', True)\n"
+        "    return y\n"
+    )
+    assert codes(src) == []
+
+
+def test_ob601_suppressible_with_reason():
+    vs = analyze_source(
+        "# analysis: disable=OB601 span handed to a helper that closes it\n"
+        "sp = tracer.span('phase')\n"
+    )
+    assert [v.code for v in vs] == ["OB601"]
+    assert vs[0].suppressed and vs[0].reason
+
+
 # -- suppressions ------------------------------------------------------------
 
 def test_suppression_with_reason():
