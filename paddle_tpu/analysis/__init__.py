@@ -19,10 +19,21 @@ Checker families:
   paths (RB501), un-timed blocking waits (``Queue.get``/``Event.wait``/
   ``Thread.join``/``socket.recv``) in the request-serving and collective
   paths ``serving/``/``distributed/``/``inference/`` (RB502)
-  (:mod:`.checkers.robustness`).
+  (:mod:`.checkers.robustness`);
+- **CC** concurrency (interprocedural, over :mod:`.dataflow`) — unguarded
+  access to a lock-dominated field (CC701 guarded-field inference),
+  inverted lock-acquisition order (CC702), iteration/snapshot over a
+  guarded container outside its lock (CC703), flag-registry read on a
+  loop-reachable hot path (CC704) (:mod:`.checkers.concurrency`);
+- **DN** donation/buffer lifetime — use-after-donate through
+  ``jax.jit(fn, donate_argnums=...)`` bindings (DN801), host numpy buffer
+  mutated while a dispatch still aliases it, before any sync point (DN802 —
+  the recovery-replay race class), watchdog/metrics record sequenced before
+  the donated-state commit (DN803) (:mod:`.checkers.donation`).
 
-CLI: ``python -m paddle_tpu.analysis [--format json] paddle_tpu/`` — exits
-non-zero on any unsuppressed violation.
+CLI: ``python -m paddle_tpu.analysis [--format json|sarif] [--baseline
+known.json] paddle_tpu/`` — exits non-zero on any NEW unsuppressed
+violation.
 """
 
 from paddle_tpu.analysis.checkers import CHECKER_CLASSES, all_checkers, all_codes  # noqa: F401

@@ -1,8 +1,11 @@
-"""CLI: ``python -m paddle_tpu.analysis [--format text|json] paths...``
+"""CLI: ``python -m paddle_tpu.analysis [--format text|json|sarif] paths...``
 
-Exit status 0 when every violation is suppressed (with a reason), 1 when any
-unsuppressed violation remains, 2 on usage errors — so the same invocation
-works as a pre-commit hook and as the tier-1 gate."""
+Exit status 0 when every violation is suppressed (with a reason) or covered
+by the ``--baseline`` snapshot, 1 when any NEW unsuppressed violation
+remains, 2 on usage errors — so the same invocation works as a pre-commit
+hook and as the tier-1 gate. ``--write-baseline`` snapshots the current
+unsuppressed findings so the gate can tighten incrementally (new code is
+held to zero while accepted debt burns down)."""
 
 from __future__ import annotations
 
@@ -12,20 +15,38 @@ from typing import List, Optional
 
 from paddle_tpu.analysis.checkers import all_codes
 from paddle_tpu.analysis.core import analyze_paths
-from paddle_tpu.analysis.reporters import render_json, render_text
+from paddle_tpu.analysis.reporters import (
+    load_baseline,
+    new_violations,
+    render_json,
+    render_sarif,
+    render_text,
+    write_baseline,
+)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m paddle_tpu.analysis",
         description="AST static analysis: trace-safety (TS), Pallas purity (PK), "
-        "flag discipline (FD), exception hygiene (EH).",
+        "flag discipline (FD), exception hygiene (EH), robustness (RB), "
+        "observability (OB), concurrency (CC), donation/lifetime (DN).",
     )
     ap.add_argument("paths", nargs="*", help="files or directories to analyze")
-    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--format", choices=("text", "json", "sarif"), default="text")
     ap.add_argument(
         "--select",
         help="comma-separated code prefixes to run (e.g. TS,EH401); default all",
+    )
+    ap.add_argument(
+        "--baseline", metavar="FILE",
+        help="accept-known-findings snapshot: exit 1 only on unsuppressed "
+        "violations NOT covered by the baseline",
+    )
+    ap.add_argument(
+        "--write-baseline", metavar="FILE",
+        help="write the current unsuppressed findings as a baseline snapshot "
+        "and exit 0 (combine with --select to scope it)",
     )
     ap.add_argument(
         "--show-suppressed", action="store_true",
@@ -50,11 +71,35 @@ def main(argv: Optional[List[str]] = None) -> int:
     except FileNotFoundError as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 2
+
+    if args.write_baseline:
+        write_baseline(args.write_baseline, violations)
+        n = sum(1 for v in violations if not v.suppressed)
+        print(f"baseline written to {args.write_baseline} ({n} accepted finding(s))")
+        return 0
+
+    gate = [v for v in violations if not v.suppressed]
+    if args.baseline:
+        try:
+            known = load_baseline(args.baseline)
+        except (OSError, ValueError) as exc:
+            # a missing/corrupt baseline must not turn the gate vacuous
+            print(f"error: baseline unusable: {exc}", file=sys.stderr)
+            return 2
+        gate = new_violations(violations, known)
+
     if args.format == "json":
         print(render_json(violations))
+    elif args.format == "sarif":
+        print(render_sarif(violations, all_codes()))
     else:
         print(render_text(violations, show_suppressed=args.show_suppressed))
-    return 1 if any(not v.suppressed for v in violations) else 0
+        if args.baseline:
+            print(
+                f"{len(gate)} NEW unsuppressed violation(s) vs baseline "
+                f"{args.baseline}"
+            )
+    return 1 if gate else 0
 
 
 if __name__ == "__main__":

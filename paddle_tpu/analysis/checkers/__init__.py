@@ -6,6 +6,8 @@ from __future__ import annotations
 
 from typing import Dict, List, Type
 
+from paddle_tpu.analysis.checkers.concurrency import ConcurrencyChecker
+from paddle_tpu.analysis.checkers.donation import DonationChecker
 from paddle_tpu.analysis.checkers.exception_hygiene import ExceptionHygieneChecker
 from paddle_tpu.analysis.checkers.flag_discipline import FlagDisciplineChecker
 from paddle_tpu.analysis.checkers.observability import ObservabilityChecker
@@ -23,6 +25,8 @@ CHECKER_CLASSES: List[Type[Checker]] = [
     ExceptionHygieneChecker,
     RobustnessChecker,
     ObservabilityChecker,
+    ConcurrencyChecker,
+    DonationChecker,
 ]
 
 

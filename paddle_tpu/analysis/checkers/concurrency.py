@@ -1,0 +1,341 @@
+"""CC — interprocedural concurrency checker (lock discipline).
+
+Built on :mod:`paddle_tpu.analysis.dataflow`: the package-local call graph,
+thread-entry discovery and lock-held regions. Scope control is deliberate —
+every rule below only fires on a class that (a) owns a lock
+(``self._lock = threading.Lock()``/``RLock()``) and (b) is *concurrency
+relevant*: one of its methods is a thread entry (``threading.Thread(target=
+self._run_loop)``), an HTTP handler method, a flag listener, or is reachable
+from any such entry through the call graph. A single-threaded class with a
+vestigial lock never spams.
+
+**Guarded-field inference (CC701)** — a field dominated by a lock in at
+least one access must be guarded everywhere: if any access to ``self.f``
+happens with a class-own lock held, and ``f`` is mutated outside
+``__init__``, then every non-``__init__`` access must hold one of the locks
+observed guarding ``f``. Lock context is interprocedural: a helper method
+whose every resolved call site holds the lock inherits it (fixpoint over the
+call graph), so ``submit() -> _tenant_label()`` does not false-positive.
+Fields holding inherently thread-safe primitives (``Queue``, ``Event``,
+``Condition``, …) are exempt — they do their own locking. Mutation includes
+``self.f = ...``, ``self.f[k] = ...``, ``self.f += ...`` and mutator method
+calls (``append``/``add``/``pop``/…) on container-kind fields.
+
+**Lock order (CC702)** — two locks acquired in both orders anywhere in the
+package (lexical nesting, or a call made with L held reaching a function
+whose acquire-closure contains M) is the classic deadlock shape; every
+acquisition/call site participating in an inverted pair is flagged.
+
+**Unlocked iteration/snapshot (CC703)** — iterating (``for x in self.f``,
+comprehensions, ``list(self.f)``/``sorted(...)``, ``self.f.items()``/
+``.values()``/``.keys()``/``.copy()``) over a guarded container outside its
+lock: another thread's resize mid-iteration is a ``RuntimeError`` at best
+and silent corruption at worst.
+
+**Locked hot read (CC704)** — the `_NAN_CHECK` lesson from PR 3,
+interprocedural this time: a flag-registry read (``GLOBAL_FLAGS.get`` /
+``get_flags``) inside a hot-path-module function that the call graph can
+reach from a loop takes the registry lock once per iteration/op. FD302
+already flags the syntactically-in-a-loop case; CC704 covers reads hidden
+behind a call edge (the exact shape of the original per-dispatch registry
+read in ``core/dispatch.py``). Fix shape: an ``on_change``-cached local
+(see ``core/dispatch.py`` ``_NAN_CHECK``).
+
+- CC701  unguarded access to a lock-dominated mutable field
+- CC702  inconsistent lock acquisition order (deadlock shape)
+- CC703  iteration/snapshot over a guarded container outside its lock
+- CC704  flag-registry read on a loop-reachable hot path (registry lock
+         taken per op — cache through an on_change listener)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from paddle_tpu.analysis.checkers._shared import attr_chain
+from paddle_tpu.analysis.core import Checker, FileContext, Violation
+from paddle_tpu.analysis.dataflow import (
+    ClassInfo,
+    FieldAccess,
+    ModuleGraph,
+    PackageIndex,
+    _MUTATOR_METHODS,
+)
+
+# snapshot/iteration wrappers: self.f handed to one of these leaves the lock
+# with a view that is only safe if the copy completed atomically
+_ITER_WRAPPERS = {"list", "sorted", "tuple", "set", "frozenset", "sum", "max", "min", "dict"}
+_ITER_METHODS = {"items", "values", "keys", "copy"}
+
+_FLAG_READ_CHAINS = {"GLOBAL_FLAGS.get", "get_flags", "paddle.get_flags"}
+
+
+class ConcurrencyChecker(Checker):
+    name = "concurrency"
+    codes = {
+        "CC701": "field guarded by a lock in some accesses but accessed "
+                 "without it elsewhere (guarded-field inference: dominated "
+                 "in >=1 access means guarded everywhere)",
+        "CC702": "two locks acquired in opposite orders on different paths "
+                 "(deadlock shape)",
+        "CC703": "iteration/snapshot over a lock-guarded container outside "
+                 "its lock (concurrent resize corrupts the traversal)",
+        "CC704": "flag-registry read reachable from a loop in a hot-path "
+                 "module (takes the registry lock per op — use an "
+                 "on_change-cached local)",
+    }
+
+    def run(self, ctx: FileContext) -> List[Violation]:
+        index = ctx.project.dataflow()
+        graph = index.module(ctx.path)
+        if graph is None:
+            graph = index.add_module(ctx.path, ctx.tree)
+        out: List[Violation] = []
+        effective = _effective_locks(index, graph)
+        relevant = _relevant_classes(index, graph)
+        for cname in relevant:
+            out.extend(self._check_class(ctx, graph, graph.classes[cname], effective))
+        out.extend(self._check_lock_order(ctx, index))
+        if ctx.hot_path:
+            out.extend(self._check_hot_reads(ctx, index, graph))
+        return out
+
+    # -- CC701 + CC703 --------------------------------------------------------
+    def _check_class(
+        self,
+        ctx: FileContext,
+        graph: ModuleGraph,
+        cls: ClassInfo,
+        effective: Dict[str, FrozenSet[str]],
+    ) -> List[Violation]:
+        own_locks = {f"{cls.name}.{a}" for a in cls.lock_fields}
+        if not own_locks:
+            return []
+        accesses = [
+            a for a in cls.accesses
+            if cls.field_kinds.get(a.field) not in ("sync", "lock")
+        ]
+        # guarding locks per field: class-own locks seen on any access
+        guards: Dict[str, Set[str]] = {}
+        mutated: Set[str] = set()
+        enriched: List[Tuple[FieldAccess, FrozenSet[str], bool]] = []
+        for a in accesses:
+            locks = a.locks_held | effective.get(a.func, frozenset())
+            write = a.kind == "write" or self._is_mutation(ctx, cls, a)
+            enriched.append((a, locks, write))
+            own_held = {lk for lk in locks if lk in own_locks}
+            if own_held:
+                guards.setdefault(a.field, set()).update(own_held)
+            if write and not a.in_init:
+                mutated.add(a.field)
+
+        out: List[Violation] = []
+        seen: Set[Tuple[int, str]] = set()
+        for a, locks, write in enriched:
+            g = guards.get(a.field)
+            if not g or a.field not in mutated or a.in_init:
+                continue
+            if locks & set(g):
+                continue
+            key = (id(a.node), a.field)
+            if key in seen:
+                continue
+            seen.add(key)
+            lock_names = "/".join(sorted(g))
+            if self._is_iteration(ctx, a):
+                out.append(
+                    Violation(
+                        ctx.path, a.lineno, a.col, "CC703",
+                        f"iteration/snapshot over '{cls.name}.{a.field}' "
+                        f"without holding {lock_names} (guarding it in other "
+                        "accesses): a concurrent resize corrupts the "
+                        "traversal — copy under the lock",
+                    )
+                )
+            else:
+                verb = "write to" if write else "read of"
+                out.append(
+                    Violation(
+                        ctx.path, a.lineno, a.col, "CC701",
+                        f"unguarded {verb} '{cls.name}.{a.field}' in "
+                        f"{a.func}: the field is guarded by {lock_names} in "
+                        "other accesses, and a field dominated by a lock in "
+                        ">=1 access must be guarded everywhere",
+                    )
+                )
+        return out
+
+    def _is_mutation(self, ctx: FileContext, cls: ClassInfo, a: FieldAccess) -> bool:
+        """Container-mutator calls and subscript/aug stores count as writes."""
+        parent = ctx.parents.get(a.node)
+        if isinstance(parent, ast.Subscript) and isinstance(
+            parent.ctx, (ast.Store, ast.Del)
+        ):
+            return True
+        if isinstance(parent, ast.AugAssign) and parent.target is a.node:
+            return True
+        if (
+            isinstance(parent, ast.Attribute)
+            and parent.attr in _MUTATOR_METHODS
+            and isinstance(ctx.parents.get(parent), ast.Call)
+            and ctx.parents.get(parent).func is parent  # type: ignore[union-attr]
+            and cls.field_kinds.get(a.field) in ("container", "numpy", None)
+        ):
+            return True
+        return False
+
+    def _is_iteration(self, ctx: FileContext, a: FieldAccess) -> bool:
+        if a.kind == "iterate":
+            return True
+        parent = ctx.parents.get(a.node)
+        if isinstance(parent, (ast.For, ast.AsyncFor)) and parent.iter is a.node:
+            return True
+        if isinstance(parent, ast.comprehension) and parent.iter is a.node:
+            return True
+        if (
+            isinstance(parent, ast.Call)
+            and a.node in parent.args
+            and isinstance(parent.func, ast.Name)
+            and parent.func.id in _ITER_WRAPPERS
+        ):
+            return True
+        if (
+            isinstance(parent, ast.Attribute)
+            and parent.attr in _ITER_METHODS
+            and isinstance(ctx.parents.get(parent), ast.Call)
+        ):
+            return True
+        return False
+
+    # -- CC702 ---------------------------------------------------------------
+    def _check_lock_order(self, ctx: FileContext, index: PackageIndex) -> List[Violation]:
+        pairs = index.lock_order_pairs()
+        out: List[Violation] = []
+        reported: Set[Tuple[str, int]] = set()
+        for (a, b), sites in pairs.items():
+            if a >= b or (b, a) not in pairs:
+                continue  # visit each inverted pair once, from (min, max)
+            for path, line, via in sites + pairs[(b, a)]:
+                if path != ctx.path or (path, line) in reported:
+                    continue
+                reported.add((path, line))
+                out.append(
+                    Violation(
+                        ctx.path, line, 0, "CC702",
+                        f"locks {a} and {b} are acquired in both orders "
+                        f"across the package (here via {via}): two threads "
+                        "taking them in opposite orders deadlock — pick one "
+                        "global order",
+                    )
+                )
+        return out
+
+    # -- CC704 ---------------------------------------------------------------
+    def _check_hot_reads(
+        self, ctx: FileContext, index: PackageIndex, graph: ModuleGraph
+    ) -> List[Violation]:
+        loopset = index.loop_reachable()
+        out: List[Violation] = []
+        for qual, finfo in graph.functions.items():
+            if graph.node_key(qual) not in loopset:
+                continue
+            for node in ast.walk(finfo.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                chain = attr_chain(node.func)
+                if chain is None:
+                    continue
+                is_flag_read = chain in _FLAG_READ_CHAINS or chain.endswith(
+                    ".get_flags"
+                ) or (chain.endswith("GLOBAL_FLAGS.get"))
+                if not is_flag_read:
+                    continue
+                if self._inside_loop(ctx, node):
+                    continue  # FD302's territory (syntactic loop in hot module)
+                out.append(
+                    Violation(
+                        ctx.path, node.lineno, node.col_offset, "CC704",
+                        f"flag-registry read '{chain}' in {qual} is "
+                        "reachable from a loop (call graph): it takes the "
+                        "registry lock once per op — cache the value in a "
+                        "local refreshed by GLOBAL_FLAGS.on_change (the "
+                        "_NAN_CHECK pattern in core/dispatch.py)",
+                    )
+                )
+        return out
+
+    def _inside_loop(self, ctx: FileContext, node: ast.AST) -> bool:
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, (ast.For, ast.AsyncFor, ast.While, ast.GeneratorExp,
+                                ast.ListComp, ast.SetComp, ast.DictComp)):
+                return True
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return False
+        return False
+
+
+# -- shared inference helpers --------------------------------------------------
+
+def _relevant_classes(index: PackageIndex, graph: ModuleGraph) -> List[str]:
+    """Classes that own a lock AND have a concurrency seam (a method that is
+    a thread entry or reachable from one anywhere in the package)."""
+    reach = index.thread_reachable()
+    entry_quals = {q for q, _k, _ln in graph.thread_entries}
+    out: List[str] = []
+    for cname, cinfo in graph.classes.items():
+        if not cinfo.lock_fields:
+            continue
+        methods = [q for q in graph.functions if q.startswith(f"{cname}.")]
+        if any(q in entry_quals for q in methods) or any(
+            graph.node_key(q) in reach for q in methods
+        ):
+            out.append(cname)
+    return out
+
+
+def _effective_locks(index: PackageIndex, graph: ModuleGraph) -> Dict[str, FrozenSet[str]]:
+    """qualname -> locks held at EVERY resolved call site of that function
+    (transitively: site locks include the caller's own inherited set). A
+    method only ever invoked under the lock is as guarded as a ``with``
+    block — this is what lets ``pump() -> _note_progress()`` pass CC701.
+    Functions with no resolved call sites (public API, thread entries) get
+    the empty set."""
+    edges = index._all_edges()
+    # call sites from ANY module participate in the intersection (their
+    # lexical locks count), but inherited sets only chain through THIS
+    # module's functions — a foreign caller's own inherited discipline is
+    # not assumed on its behalf
+    my_keys = {graph.node_key(q): q for q in graph.functions}
+    callers: Dict[str, List[Tuple[str, FrozenSet[str]]]] = {}
+    for src_key, sites in edges.items():
+        for cs in sites:
+            if cs.target in my_keys:
+                callers.setdefault(cs.target, []).append((src_key, cs.locks_held))
+    entry_keys = {
+        g.node_key(q)
+        for g in index.modules()
+        for q, _k, _ln in g.thread_entries
+    }
+    effective: Dict[str, FrozenSet[str]] = {k: frozenset() for k in my_keys.values()}
+    for _ in range(4):  # fixpoint over short call chains
+        changed = False
+        for key, qual in my_keys.items():
+            if key in entry_keys:
+                continue  # a thread entry runs with nothing held
+            sites = callers.get(key)
+            if not sites:
+                continue
+            acc: Optional[Set[str]] = None
+            for src_key, locks in sites:
+                src_qual = my_keys.get(src_key)
+                inherited = effective.get(src_qual, frozenset()) if src_qual else frozenset()
+                site_locks = set(locks) | set(inherited)
+                acc = site_locks if acc is None else (acc & site_locks)
+            new = frozenset(acc or set())
+            if new != effective[qual]:
+                effective[qual] = new
+                changed = True
+        if not changed:
+            break
+    return effective

@@ -1,0 +1,486 @@
+"""DN — donation / buffer-lifetime checker.
+
+The two worst bugs previous PRs shipped-then-caught were buffer-lifetime
+races invisible to single-line pattern matching: the PR 6 recovery-replay
+race (host numpy vectors mutated while an async dispatch still zero-copy
+aliased them) and donated-state hazards around the engine's jitted entry
+points. This checker walks each function as an ordered statement sequence
+(branch bodies merge their taints — path-insensitive but order-aware,
+see :class:`_FunctionScan`) over facts from :mod:`..dataflow`:
+
+- **jit wrappers** resolved through the assignment idiom ``inference/
+  engine.py`` uses — ``self._fn = jax.jit(impl, donate_argnums=(1,))`` (the
+  conditional ``(1,) if donate else ()`` form resolves too), plus local
+  ``g = jax.jit(f, donate_argnums=...)`` bindings;
+- **host buffers**: names/fields assigned from ``np.*`` constructors —
+  jax's CPU backend zero-copies these into device arrays, so they stay
+  aliased until a sync.
+
+**DN801 use-after-donate** — a value passed at a donated position of a jit
+wrapper is dead after the call: reading or mutating it is a
+use-after-free on donating backends (TPU). The safe idiom rebinds in the
+same statement (``tok, self._caches = self._prefill_fn(..., self._caches,
+...)``) and is never flagged; any later read/mutation of a still-donated
+key before a rebind is.
+
+**DN802 mutate-before-sync** — the exact PR 6 replay-race class: a host
+numpy buffer handed to a jit dispatch (directly or via ``jnp.asarray(buf)``
+— no ``.copy()``) and then mutated (``buf[i] = ...``, ``buf += ...``,
+``.fill()``) before a sync point. Sync points: ``int()``/``float()``/
+``bool()`` of a result, ``np.asarray(result)``, ``jax.block_until_ready``
+or ``.block_until_ready()``/``.item()``. ``jnp.asarray(buf.copy())``
+snapshots and is safe — exactly the PR 6 fix shape in ``engine.recover``.
+
+**DN803 record-before-commit** — the PR 2 lesson: when a donating dispatch
+did NOT rebind its donated argument in the same statement, the old state is
+dead and the replacement lives only in result temps; a watchdog/metrics
+record (``record_compile``, ``record_event``, ``.inc()``/``.observe()``)
+sequenced between the dispatch and the ``self.<state> = temp`` commit means
+a warning escalated to an error (warnings-as-errors) discards committed
+donated state — record AFTER the commit.
+
+- DN801  read/mutation of a value after it was donated to a jit dispatch
+- DN802  host numpy buffer mutated after dispatch before a sync point
+- DN803  watchdog/metrics record between a donating dispatch and its
+         donated-state commit
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from paddle_tpu.analysis.checkers._shared import attr_chain
+from paddle_tpu.analysis.core import Checker, FileContext, Violation
+from paddle_tpu.analysis.dataflow import (
+    _NUMPY_CTORS,
+    FunctionInfo,
+    JitWrapper,
+    ModuleGraph,
+    receiver_key,
+)
+
+_SYNC_NAMES = {"int", "float", "bool"}
+_SYNC_METHODS = {"block_until_ready", "item", "tolist"}
+_NP_MUTATORS = {"fill", "sort", "put", "resize", "setfield", "partition"}
+_RECORD_ATTRS = {"record_compile", "record_event", "inc", "observe"}
+
+
+class _Taint:
+    __slots__ = ("line", "wrapper")
+
+    def __init__(self, line: int, wrapper: str) -> None:
+        self.line = line
+        self.wrapper = wrapper
+
+
+class DonationChecker(Checker):
+    name = "donation-lifetime"
+    codes = {
+        "DN801": "value read or mutated after being passed at a "
+                 "donate_argnums position of a jit dispatch (use-after-free "
+                 "on donating backends) — rebind it from the call's result",
+        "DN802": "host numpy buffer mutated after a jit dispatch aliased it "
+                 "and before any sync point (the recovery-replay race class) "
+                 "— snapshot with .copy() or sync first",
+        "DN803": "watchdog/metrics record sequenced between a donating "
+                 "dispatch and its donated-state commit — an escalated "
+                 "warning here discards committed donated state",
+    }
+
+    def run(self, ctx: FileContext) -> List[Violation]:
+        index = ctx.project.dataflow()
+        graph = index.module(ctx.path)
+        if graph is None:
+            graph = index.add_module(ctx.path, ctx.tree)
+        out: List[Violation] = []
+        for qual, finfo in graph.functions.items():
+            scan = _FunctionScan(ctx, graph, finfo)
+            out.extend(scan.run())
+        return out
+
+
+class _FunctionScan:
+    """Order-aware walk of one function body. Branches (if/else, except
+    handlers) are scanned from a snapshot of the incoming state and merged
+    by taint union afterwards, so a donate in the `if` arm taints the code
+    after the branch but not the sibling arm."""
+
+    def __init__(self, ctx: FileContext, graph: ModuleGraph, finfo: FunctionInfo) -> None:
+        self.ctx = ctx
+        self.graph = graph
+        self.finfo = finfo
+        self.violations: List[Violation] = []
+        # receiver key -> wrapper (module-level self-attr wrappers + locals)
+        self.wrappers: Dict[str, JitWrapper] = {}
+        for (cls_name, key), w in graph.jit_wrappers.items():
+            if cls_name is None or cls_name == finfo.class_name:
+                self.wrappers[key] = w
+        # host numpy buffers: class fields of numpy kind + locals (assigned
+        # np.* in this function)
+        self.np_bufs: Set[str] = set()
+        if finfo.class_name:
+            cinfo = graph.classes.get(finfo.class_name)
+            if cinfo:
+                self.np_bufs |= {
+                    f"self.{f}" for f, k in cinfo.field_kinds.items() if k == "numpy"
+                }
+        # temp key -> host buffer keys it zero-copy aliases
+        self.aliases: Dict[str, Set[str]] = {}
+        # donated taints / in-flight aliased buffers / pending commits
+        self.donated: Dict[str, _Taint] = {}
+        self.inflight: Dict[str, int] = {}  # buffer key -> dispatch line
+        # donated key -> (result temps, record call nodes seen since)
+        self.pending: Dict[str, Tuple[Set[str], List[ast.Call]]] = {}
+        # nodes inside a dispatch call expression: the donated argument's own
+        # appearance in the call must not read-flag against its fresh taint
+        self._exempt: Set[int] = set()
+
+    # -- state management -----------------------------------------------------
+    def _snapshot(self):
+        return (
+            dict(self.donated), dict(self.inflight),
+            {k: (set(t), list(r)) for k, (t, r) in self.pending.items()},
+            {k: set(v) for k, v in self.aliases.items()}, set(self.np_bufs),
+            dict(self.wrappers),
+        )
+
+    def _restore(self, snap) -> None:
+        donated, inflight, pending, aliases, np_bufs, wrappers = snap
+        self.donated = dict(donated)
+        self.inflight = dict(inflight)
+        self.pending = {k: (set(t), list(r)) for k, (t, r) in pending.items()}
+        self.aliases = {k: set(v) for k, v in aliases.items()}
+        self.np_bufs = set(np_bufs)
+        self.wrappers = dict(wrappers)
+
+    def _merge(self, other) -> None:
+        donated, inflight, pending, aliases, np_bufs, wrappers = other
+        self.donated.update(donated)
+        self.inflight.update(inflight)
+        for k, (t, r) in pending.items():
+            mine = self.pending.setdefault(k, (set(), []))
+            mine[0].update(t)
+            mine[1].extend(r)
+        for k, v in aliases.items():
+            self.aliases.setdefault(k, set()).update(v)
+        self.np_bufs |= np_bufs
+        self.wrappers.update(wrappers)
+
+    # -- driver ---------------------------------------------------------------
+    def run(self) -> List[Violation]:
+        body = getattr(self.finfo.node, "body", [])
+        self._scan_block(body)
+        return self.violations
+
+    def _scan_block(self, stmts: Sequence[ast.stmt]) -> None:
+        for stmt in stmts:
+            self._scan_stmt(stmt)
+
+    def _scan_stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return  # nested defs are scanned as their own FunctionInfo
+        if isinstance(stmt, ast.If):
+            self._scan_value(stmt.test)
+            snap = self._snapshot()
+            self._scan_block(stmt.body)
+            after_body = self._snapshot()
+            self._restore(snap)
+            self._scan_block(stmt.orelse)
+            self._merge(after_body)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._scan_value(stmt.iter)
+            self._scan_block(stmt.body)
+            self._scan_block(stmt.orelse)
+            return
+        if isinstance(stmt, ast.While):
+            self._scan_value(stmt.test)
+            self._scan_block(stmt.body)
+            self._scan_block(stmt.orelse)
+            return
+        if isinstance(stmt, ast.Try):
+            self._scan_block(stmt.body)
+            after_body = self._snapshot()
+            for h in stmt.handlers:
+                self._restore(after_body)
+                self._scan_block(h.body)
+            self._restore(after_body)
+            self._scan_block(stmt.orelse)
+            self._scan_block(stmt.finalbody)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._scan_value(item.context_expr)
+            self._scan_block(stmt.body)
+            return
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            value = stmt.value
+            targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+            if value is not None:
+                self._scan_value(value, assign_targets=targets)
+            self._apply_bindings(targets, value, stmt)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            self._scan_value(stmt.value)
+            self._check_mutation_target(stmt.target)
+            return
+        if isinstance(stmt, ast.Expr):
+            self._scan_value(stmt.value)
+            return
+        if isinstance(stmt, ast.Return) and stmt.value is not None:
+            self._scan_value(stmt.value)
+            return
+        if isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                key = receiver_key(t)
+                if key:
+                    self._kill(key)
+            return
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._scan_value(child)
+
+    # -- expression scan ------------------------------------------------------
+    def _scan_value(
+        self, expr: ast.expr, assign_targets: Optional[Sequence[ast.expr]] = None
+    ) -> None:
+        """Scan one expression in evaluation position: flag donated reads,
+        process dispatch/sync/record calls."""
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                self._handle_call(node, assign_targets)
+            elif isinstance(node, (ast.Name, ast.Attribute)) and isinstance(
+                getattr(node, "ctx", None), ast.Load
+            ):
+                key = receiver_key(node)
+                if key in self.donated and id(node) not in self._exempt:
+                    t = self.donated[key]
+                    self._flag(
+                        node, "DN801",
+                        f"'{key}' was donated to {t.wrapper} on line {t.line} "
+                        "and is read here before being rebound: on a donating "
+                        "backend this buffer no longer exists",
+                    )
+                    # one report per taint: further reads of the same key
+                    # would repeat the same finding
+                    del self.donated[key]
+            # mutation shapes inside expressions: buf.fill(...), buf.sort()
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+                if node.func.attr in _NP_MUTATORS:
+                    key = receiver_key(node.func.value)
+                    if key is not None:
+                        self._check_mutated_key(key, node)
+
+    # -- calls ----------------------------------------------------------------
+    def _handle_call(
+        self, node: ast.Call, assign_targets: Optional[Sequence[ast.expr]]
+    ) -> None:
+        fn = node.func
+        chain = attr_chain(fn)
+        # local jit wrapper binding handled in _apply_bindings; here: sync,
+        # record, jnp.asarray aliasing, dispatch
+        if isinstance(fn, ast.Name) and fn.id in _SYNC_NAMES and node.args:
+            self._sync()
+            return
+        if chain in ("jax.block_until_ready", "np.asarray", "numpy.asarray"):
+            self._sync()
+            return
+        if isinstance(fn, ast.Attribute) and fn.attr in _SYNC_METHODS:
+            self._sync()
+            return
+        if isinstance(fn, ast.Attribute) and fn.attr in _RECORD_ATTRS:
+            for key, (_temps, records) in self.pending.items():
+                records.append(node)
+        callee_key = receiver_key(fn)
+        wrapper = self.wrappers.get(callee_key) if callee_key else None
+        if wrapper is not None:
+            self._handle_dispatch(node, wrapper, callee_key, assign_targets)
+
+    def _handle_dispatch(
+        self,
+        node: ast.Call,
+        wrapper: JitWrapper,
+        callee_key: str,
+        assign_targets: Optional[Sequence[ast.expr]],
+    ) -> None:
+        target_keys: Set[str] = set()
+        if assign_targets:
+            for t in assign_targets:
+                if isinstance(t, (ast.Tuple, ast.List)):
+                    target_keys |= {k for k in map(receiver_key, t.elts) if k}
+                else:
+                    k = receiver_key(t)
+                    if k:
+                        target_keys.add(k)
+        # the call expression's own nodes never read-flag their fresh taints
+        self._exempt.update(id(n) for n in ast.walk(node))
+        # donated positions -> taint unless rebound by this very statement
+        for pos in wrapper.donated:
+            if pos >= len(node.args):
+                continue
+            key = receiver_key(node.args[pos])
+            if key is None:
+                continue
+            if key in target_keys:
+                continue  # donate-and-rebind: the replacement lands now
+            self.donated[key] = _Taint(node.lineno, callee_key)
+            self.pending[key] = (set(target_keys), [])
+        # every argument that zero-copy aliases a host numpy buffer is in
+        # flight until a sync point
+        for arg in node.args:
+            for buf in self._aliased_buffers(arg):
+                self.inflight[buf] = node.lineno
+
+    def _aliased_buffers(self, arg: ast.expr) -> Set[str]:
+        key = receiver_key(arg)
+        if key is not None:
+            if key in self.np_bufs:
+                return {key}
+            return set(self.aliases.get(key, ()))
+        if isinstance(arg, ast.Call):
+            chain = attr_chain(arg.func)
+            if chain in ("jnp.asarray", "jax.numpy.asarray") and arg.args:
+                inner = arg.args[0]
+                ikey = receiver_key(inner)
+                if ikey is not None:
+                    if ikey in self.np_bufs:
+                        return {ikey}
+                    return set(self.aliases.get(ikey, ()))
+                # jnp.asarray(buf.copy()) snapshots: nothing aliased
+        return set()
+
+    def _sync(self) -> None:
+        self.inflight.clear()
+
+    # -- bindings and mutations ------------------------------------------------
+    def _apply_bindings(
+        self,
+        targets: Sequence[ast.expr],
+        value: Optional[ast.expr],
+        stmt: ast.stmt,
+    ) -> None:
+        # DN803 commit detection BEFORE the kill: self.<attr> = <temp of a
+        # pending donation> closes the window; records seen inside it fire
+        if value is not None:
+            vkey = receiver_key(value)
+            if vkey is not None:
+                for key, (temps, records) in list(self.pending.items()):
+                    if vkey in temps and any(
+                        (receiver_key(t) or "").startswith("self.") or receiver_key(t) == key
+                        for t in targets
+                    ):
+                        for rec in records:
+                            self._flag(
+                                rec, "DN803",
+                                "watchdog/metrics record sequenced between the "
+                                f"donating dispatch (line {self.donated[key].line if key in self.donated else '?'})"
+                                f" and the commit of its replacement state "
+                                f"'{key}': a RecompileBudgetWarning escalated "
+                                "under warnings-as-errors here would discard "
+                                "committed donated state — record after the "
+                                "commit",
+                            )
+                        del self.pending[key]
+        for t in targets:
+            # subscript store on a tracked buffer is a mutation, not a rebind
+            if isinstance(t, ast.Subscript):
+                base = receiver_key(t.value)
+                if base is not None:
+                    self._check_mutated_key(base, t)
+                continue
+            if isinstance(t, (ast.Tuple, ast.List)):
+                for el in t.elts:
+                    self._apply_bindings([el], None, stmt)
+                continue
+            key = receiver_key(t)
+            if key is None:
+                continue
+            self._kill(key)
+            if value is None:
+                continue
+            # classify the new binding
+            if isinstance(value, ast.Call):
+                wrapper = self._match_local_jit(value)
+                if wrapper is not None:
+                    self.wrappers[key] = wrapper
+                    continue
+                chain = attr_chain(value.func) or ""
+                root, _, ctor = chain.rpartition(".")
+                if root in ("np", "numpy") and ctor in _NUMPY_CTORS:
+                    self.np_bufs.add(key)
+                    continue
+                if chain in ("jnp.asarray", "jax.numpy.asarray") and value.args:
+                    bufs = self._aliased_buffers(value)
+                    if bufs:
+                        self.aliases[key] = bufs
+                    continue
+                if isinstance(value.func, ast.Attribute) and value.func.attr == "copy":
+                    base = receiver_key(value.func.value)
+                    if base in self.np_bufs:
+                        self.np_bufs.add(key)  # a fresh buffer, not an alias
+                    continue
+
+    def _match_local_jit(self, value: ast.Call) -> Optional[JitWrapper]:
+        chain = attr_chain(value.func)
+        if chain not in ("jax.jit", "jit"):
+            return None
+        donated: Set[int] = set()
+        for kw in value.keywords:
+            if kw.arg == "donate_argnums":
+                for n in ast.walk(kw.value):
+                    if isinstance(n, ast.Constant) and isinstance(n.value, int) \
+                            and not isinstance(n.value, bool):
+                        donated.add(n.value)
+        return JitWrapper(key="<local>", target=None, donated=frozenset(donated),
+                          lineno=value.lineno)
+
+    def _kill(self, key: str) -> None:
+        self.donated.pop(key, None)
+        self.inflight.pop(key, None)
+        self.aliases.pop(key, None)
+        self.np_bufs.discard(key)
+        # any rebind of the donated key closes its commit window silently
+        self.pending.pop(key, None)
+        # a rebound name is no longer the jit wrapper it once was (the
+        # _apply_bindings classifier re-adds it if the new value is jax.jit)
+        self.wrappers.pop(key, None)
+
+    def _check_mutation_target(self, target: ast.expr) -> None:
+        if isinstance(target, ast.Subscript):
+            base = receiver_key(target.value)
+            if base is not None:
+                self._check_mutated_key(base, target)
+        else:
+            key = receiver_key(target)
+            if key is not None:
+                self._check_mutated_key(key, target)
+
+    def _check_mutated_key(self, key: str, node: ast.AST) -> None:
+        if key in self.donated:
+            t = self.donated.pop(key)
+            self._flag(
+                node, "DN801",
+                f"'{key}' was donated to {t.wrapper} on line {t.line} and is "
+                "mutated here before being rebound: on a donating backend "
+                "this buffer no longer exists",
+            )
+            return
+        if key in self.inflight:
+            line = self.inflight.pop(key)
+            self._flag(
+                node, "DN802",
+                f"host buffer '{key}' was handed to the jit dispatch on line "
+                f"{line} and is mutated here with no sync point between: "
+                "jax zero-copies numpy inputs, so the async dispatch still "
+                "reads this memory — snapshot with .copy() at the call, or "
+                "sync (int()/np.asarray()/block_until_ready) first",
+            )
+
+    def _flag(self, node: ast.AST, code: str, message: str) -> None:
+        self.violations.append(
+            Violation(
+                self.ctx.path, getattr(node, "lineno", 1),
+                getattr(node, "col_offset", 0), code, message,
+            )
+        )

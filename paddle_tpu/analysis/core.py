@@ -73,6 +73,18 @@ class ProjectContext:
     # every flag name registered via flags.py / define_flag across the run's
     # file set (plus the always-scanned canonical flags.py)
     known_flags: Set[str] = field(default_factory=set)
+    # memoized interprocedural dataflow (call graph / thread entries / lock
+    # regions — see analysis.dataflow); built ONCE per run by the drivers,
+    # shared by every checker that consumes it. Optional so ProjectContext
+    # construction stays cheap for checkers that never touch it.
+    index: Optional["PackageIndex"] = None
+
+    def dataflow(self) -> "PackageIndex":
+        if self.index is None:
+            from paddle_tpu.analysis.dataflow import PackageIndex
+
+            self.index = PackageIndex()
+        return self.index
 
 
 @dataclass
@@ -266,6 +278,11 @@ def analyze_paths(
             continue
         parsed.append((f, src, tree))
     project = build_project_context(tree for _, _, tree in parsed)
+    # build the interprocedural index ONCE over the whole file set (cross-
+    # module call edges need every tree); checkers get the memoized graphs
+    index = project.dataflow()
+    for f, _, tree in parsed:
+        index.add_module(str(f), tree)
     for f, src, tree in parsed:
         violations.extend(
             _run_checkers(tree, src, str(f), project, _is_hot_path(f), checkers, select)
@@ -291,6 +308,7 @@ def analyze_source(
         project.known_flags |= _collect_flags_from_tree(tree)
     else:
         project = build_project_context([tree])
+    project.dataflow().add_module(path, tree)
     return _run_checkers(tree, source, path, project, hot_path, checkers, select)
 
 

@@ -1,0 +1,861 @@
+"""Interprocedural dataflow layer under the checker suite.
+
+The single-file AST checkers (TS/PK/FD/EH/RB/OB) pattern-match one tree at a
+time; the two bug classes that slipped past them — the PR 6 recovery-replay
+race (host numpy vectors mutated while an async dispatch still aliased them)
+and per-dispatch registry-lock reads — need facts that span functions and
+modules: who calls whom, which functions run on which threads, which lock is
+held where, and which buffers a jit dispatch donated or aliased. This module
+computes those facts ONCE per run and shares them across checkers:
+
+- :class:`ModuleGraph` — per-module facts, built once per file and memoized
+  in the :class:`PackageIndex` (the CI gate budget depends on this: the
+  CC and DN checker families both consume the same graphs);
+- **call graph** (package-local): edges resolved through ``self.method()``,
+  bound instance fields (``self._mgr = BlockKVCache(...)`` in ``__init__``),
+  module-level singletons (``GLOBAL_FLAGS = FlagRegistry()``), plain module
+  functions, and package imports (``from paddle_tpu.x import f`` /
+  ``import paddle_tpu.x.y as alias``). Unresolvable receivers produce no
+  edge — the graph under-approximates, so reachability-based checks miss
+  rather than spam;
+- **thread entries**: ``threading.Thread(target=...)`` targets, HTTP handler
+  classes (``BaseHTTPRequestHandler`` subclasses — every ``do_*``/helper
+  method runs on a server thread), and flag-listener registrations
+  (``GLOBAL_FLAGS.on_change(name, fn)`` — listeners fire on whichever
+  thread calls ``set_flags``);
+- **lock-held regions**: ``with self._lock:`` / ``with MODULE_LOCK:`` scopes
+  recorded on every field access and call site, so the CC checkers know the
+  holding set at each point (keys are ``Class._lock`` / module-level names);
+- **reaching defs** (intraprocedural, statement-ordered): jit-wrapper
+  bindings (``self._fn = jax.jit(impl, donate_argnums=...)``), host numpy
+  buffer bindings, and ``jnp.asarray(buf)`` aliases — what the DN family
+  walks to find use-after-donate and mutate-before-sync hazards.
+
+Everything here is ``ast``-only (no imports of the analyzed code), like the
+rest of the analysis package.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, Iterable, List, Optional, Sequence, Set, Tuple
+
+__all__ = [
+    "CallSite",
+    "ClassInfo",
+    "FieldAccess",
+    "FunctionInfo",
+    "JitWrapper",
+    "ModuleGraph",
+    "PackageIndex",
+    "receiver_key",
+]
+
+# constructors that make a field an inherently thread-safe sync primitive —
+# method calls on such fields are not shared-state hazards (Queue/Event do
+# their own locking); the lock kinds double as the lock-field detector
+_SYNC_CTORS = {
+    "Lock": "lock", "RLock": "lock", "Condition": "sync", "Event": "sync",
+    "Semaphore": "sync", "BoundedSemaphore": "sync", "Barrier": "sync",
+    "Queue": "sync", "SimpleQueue": "sync", "LifoQueue": "sync",
+    "PriorityQueue": "sync", "local": "sync",
+}
+# constructors that make a field a plain mutable container: mutator METHOD
+# calls on it count as writes for the guarded-field inference
+_CONTAINER_CTORS = {"dict", "set", "list", "deque", "defaultdict", "OrderedDict", "Counter"}
+_MUTATOR_METHODS = {
+    "append", "appendleft", "add", "extend", "insert", "remove", "discard",
+    "pop", "popleft", "popitem", "clear", "update", "setdefault", "sort",
+    "reverse", "rotate",
+}
+# numpy array constructors: a name/field assigned from one of these is a HOST
+# buffer (jax's CPU backend zero-copies them into device arrays)
+_NUMPY_CTORS = {
+    "zeros", "ones", "empty", "full", "asarray", "array", "arange",
+    "concatenate", "frombuffer", "copy", "zeros_like", "ones_like",
+}
+_HTTP_HANDLER_BASES = {"BaseHTTPRequestHandler", "SimpleHTTPRequestHandler"}
+
+
+def receiver_key(node: ast.AST) -> Optional[str]:
+    """``name`` for a Name, ``self.attr`` for a self attribute — the alias
+    granularity every map in this module keys on (same as RB502)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return f"self.{node.attr}"
+    return None
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+@dataclass
+class CallSite:
+    """One call expression with its interprocedural context."""
+
+    target: str  # resolved node key "<module>::<qualname>"
+    node: ast.Call
+    lineno: int
+    in_loop: bool  # lexically inside for/while/comprehension in the caller
+    locks_held: FrozenSet[str]
+
+
+@dataclass
+class FieldAccess:
+    field: str
+    func: str  # qualname of the accessing function ("" = class/module body)
+    kind: str  # "read" | "write" | "iterate"
+    locks_held: FrozenSet[str]
+    node: ast.AST
+    lineno: int
+    col: int
+    in_init: bool
+
+
+@dataclass
+class JitWrapper:
+    """A binding ``<key> = jax.jit(fn, donate_argnums=...)``. ``donated`` is
+    the set of argument positions that MAY be donated (constants collected
+    from tuples anywhere in the kwarg expression — the engine's conditional
+    ``(1,) if donate else ()`` idiom resolves to {1})."""
+
+    key: str  # local name or "self.attr"
+    target: Optional[str]  # resolved wrapped-function node key, if any
+    donated: FrozenSet[int]
+    lineno: int
+
+
+@dataclass
+class FunctionInfo:
+    qualname: str  # "fn", "Class.fn", "outer.<locals>.fn"
+    node: ast.AST  # FunctionDef / AsyncFunctionDef
+    class_name: Optional[str]
+    calls: List[CallSite] = field(default_factory=list)
+    # every lock key this function acquires directly (with-statement)
+    acquires: List[Tuple[str, FrozenSet[str], ast.AST]] = field(default_factory=list)
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    node: ast.ClassDef
+    base_names: Set[str]
+    # lock-kind fields (self._lock = threading.Lock()/RLock())
+    lock_fields: Set[str] = field(default_factory=set)
+    # field -> "sync" | "container" | "numpy" | "plain" (last assign wins)
+    field_kinds: Dict[str, str] = field(default_factory=dict)
+    accesses: List[FieldAccess] = field(default_factory=list)
+    # field -> class name, for self._mgr = SomeClass(...) bindings in methods
+    instance_fields: Dict[str, str] = field(default_factory=dict)
+
+    def fields_locked_somewhere(self) -> Dict[str, Set[str]]:
+        out: Dict[str, Set[str]] = {}
+        for a in self.accesses:
+            for lk in a.locks_held:
+                out.setdefault(a.field, set()).add(lk)
+        return out
+
+
+class ModuleGraph:
+    """All per-module facts. Built once by :class:`PackageIndex`."""
+
+    def __init__(self, path: str, tree: ast.Module, dotted_name: Optional[str]) -> None:
+        self.path = path
+        self.tree = tree
+        self.dotted_name = dotted_name  # "paddle_tpu.serving.frontend" or None
+        self.functions: Dict[str, FunctionInfo] = {}
+        self.classes: Dict[str, ClassInfo] = {}
+        # alias -> dotted module name, for "import paddle_tpu.x as y"
+        self.module_aliases: Dict[str, str] = {}
+        # local name -> (dotted module, original name), for from-imports
+        self.from_imports: Dict[str, Tuple[str, str]] = {}
+        # module-level NAME = ClassName(...) singletons (class local or imported)
+        self.instances: Dict[str, str] = {}
+        # module-level lock names (NAME = threading.Lock())
+        self.module_locks: Set[str] = set()
+        # (qualname, kind) thread entries: kind in thread|handler|listener
+        self.thread_entries: List[Tuple[str, str, int]] = []
+        # jit wrappers visible module-wide (self.attr ones are class-scoped
+        # but donation is keyed by receiver, which includes the class context)
+        self.jit_wrappers: Dict[Tuple[Optional[str], str], JitWrapper] = {}
+        self._build()
+
+    # -- construction --------------------------------------------------------
+    def _build(self) -> None:
+        """Two-phase: register every function shell and binding first (so a
+        call to a method defined LATER in the file still resolves), then
+        walk bodies for accesses/calls/acquires."""
+        self._collect_imports()
+        self._collect_module_level()
+        to_walk: List[Tuple[ast.AST, Optional[str]]] = []
+        for cls_node in [n for n in self.tree.body if isinstance(n, ast.ClassDef)]:
+            self._register_class(cls_node)
+            for item in cls_node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self._register_function(item, cls_node.name)
+                    to_walk.append((item, cls_node.name))
+        for fn in [
+            n for n in self.tree.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]:
+            self._register_function(fn, None)
+            to_walk.append((fn, None))
+        for fn, class_name in to_walk:
+            self._prescan_bindings(fn, class_name)
+        for fn, class_name in to_walk:
+            self._walk_function(fn, class_name)
+        self._collect_thread_entries()
+
+    def _collect_imports(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.module_aliases[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    self.from_imports[a.asname or a.name] = (node.module, a.name)
+
+    def _collect_module_level(self) -> None:
+        for node in self.tree.body:
+            if isinstance(node, (ast.Assign, ast.AnnAssign)) and getattr(node, "value", None):
+                targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+                val = node.value
+                wrapper = self._match_jit(val)
+                if wrapper is not None:
+                    # MODULE-level jit binding: visible to every function in
+                    # the module (function-local ones are scoped to their own
+                    # function by the DN scan — a bare name bound in one
+                    # function must not taint same-named locals elsewhere)
+                    target_fn, donated = wrapper
+                    for t in targets:
+                        if isinstance(t, ast.Name):
+                            self.jit_wrappers[(None, t.id)] = JitWrapper(
+                                key=t.id, target=target_fn, donated=donated,
+                                lineno=node.lineno,
+                            )
+                if isinstance(val, ast.Call):
+                    ctor = self._ctor_name(val.func)
+                    for t in targets:
+                        if not isinstance(t, ast.Name):
+                            continue
+                        if ctor in ("Lock", "RLock"):
+                            self.module_locks.add(t.id)
+                        elif ctor and ctor[0].isupper():
+                            self.instances[t.id] = ctor
+
+    def _ctor_name(self, fn: ast.AST) -> Optional[str]:
+        """Constructor simple name for Name()/mod.Name() calls."""
+        if isinstance(fn, ast.Name):
+            return fn.id
+        if isinstance(fn, ast.Attribute):
+            return fn.attr
+        return None
+
+    def _register_class(self, cls: ast.ClassDef) -> None:
+        self.classes[cls.name] = ClassInfo(
+            name=cls.name, node=cls,
+            base_names={b for b in (_dotted(x) for x in cls.bases) if b} | {
+                x.rsplit(".", 1)[-1] for x in (_dotted(b) for b in cls.bases) if x
+            },
+        )
+
+    def _register_function(self, fn: ast.AST, class_name: Optional[str]) -> FunctionInfo:
+        qual = f"{class_name}.{fn.name}" if class_name else fn.name
+        finfo = FunctionInfo(qualname=qual, node=fn, class_name=class_name)
+        self.functions[qual] = finfo
+        return finfo
+
+    def _prescan_bindings(self, fn: ast.AST, class_name: Optional[str]) -> None:
+        """Field kinds / lock fields / jit wrappers from every assignment,
+        BEFORE any body walk: a ``with self._lock:`` in a method defined
+        above ``__init__`` (or a lock assigned late) must still resolve."""
+        qual = f"{class_name}.{fn.name}" if class_name else fn.name
+        finfo = self.functions[qual]
+        cls = self.classes.get(class_name) if class_name else None
+        in_init = fn.name == "__init__"
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                self._record_binding(node, finfo, cls, in_init)
+
+    # -- the per-function walk (locks, accesses, calls) ----------------------
+    def _walk_function(self, fn: ast.AST, class_name: Optional[str]) -> None:
+        qual = f"{class_name}.{fn.name}" if class_name else fn.name
+        finfo = self.functions[qual]
+        cls = self.classes.get(class_name) if class_name else None
+        in_init = fn.name == "__init__"
+        self._walk_block(
+            fn.body, finfo, cls, in_init,
+            locks=frozenset(), in_loop=False,
+        )
+
+    def _walk_block(
+        self,
+        stmts: Sequence[ast.stmt],
+        finfo: FunctionInfo,
+        cls: Optional[ClassInfo],
+        in_init: bool,
+        locks: FrozenSet[str],
+        in_loop: bool,
+    ) -> None:
+        for stmt in stmts:
+            self._walk_stmt(stmt, finfo, cls, in_init, locks, in_loop)
+
+    def _lock_key(self, expr: ast.AST, cls: Optional[ClassInfo]) -> Optional[str]:
+        key = receiver_key(expr)
+        if key is None:
+            return None
+        if key.startswith("self.") and cls is not None:
+            attr = key[5:]
+            if attr in cls.lock_fields:
+                return f"{cls.name}.{attr}"
+            return None
+        if key in self.module_locks:
+            return f"<module>.{key}"
+        return None
+
+    def _walk_stmt(
+        self,
+        stmt: ast.stmt,
+        finfo: FunctionInfo,
+        cls: Optional[ClassInfo],
+        in_init: bool,
+        locks: FrozenSet[str],
+        in_loop: bool,
+    ) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested function: its body runs later, with no lock held at def
+            # time (closures re-entered from callbacks); record under a
+            # <locals> qualname so thread targets can still resolve to it
+            nested_qual = f"{finfo.qualname}.<locals>.{stmt.name}"
+            nested = FunctionInfo(
+                qualname=nested_qual, node=stmt, class_name=finfo.class_name
+            )
+            self.functions[nested_qual] = nested
+            self._walk_block(stmt.body, nested, cls, False, frozenset(), False)
+            return
+        if isinstance(stmt, ast.ClassDef):
+            return  # method-local classes: out of scope
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            new_locks = set(locks)
+            for item in stmt.items:
+                lk = self._lock_key(item.context_expr, cls)
+                if lk is not None:
+                    finfo.acquires.append((lk, locks, item.context_expr))
+                    new_locks.add(lk)
+                else:
+                    self._scan_exprs([item.context_expr], finfo, cls, in_init, locks, in_loop)
+                if item.optional_vars is not None:
+                    self._scan_exprs([item.optional_vars], finfo, cls, in_init, locks, in_loop)
+            self._walk_block(stmt.body, finfo, cls, in_init, frozenset(new_locks), in_loop)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._scan_exprs([stmt.iter], finfo, cls, in_init, locks, in_loop, iterating=True)
+            self._scan_exprs([stmt.target], finfo, cls, in_init, locks, in_loop)
+            self._walk_block(stmt.body, finfo, cls, in_init, locks, True)
+            self._walk_block(stmt.orelse, finfo, cls, in_init, locks, in_loop)
+            return
+        if isinstance(stmt, ast.While):
+            self._scan_exprs([stmt.test], finfo, cls, in_init, locks, in_loop)
+            self._walk_block(stmt.body, finfo, cls, in_init, locks, True)
+            self._walk_block(stmt.orelse, finfo, cls, in_init, locks, in_loop)
+            return
+        if isinstance(stmt, ast.If):
+            self._scan_exprs([stmt.test], finfo, cls, in_init, locks, in_loop)
+            self._walk_block(stmt.body, finfo, cls, in_init, locks, in_loop)
+            self._walk_block(stmt.orelse, finfo, cls, in_init, locks, in_loop)
+            return
+        if isinstance(stmt, ast.Try):
+            self._walk_block(stmt.body, finfo, cls, in_init, locks, in_loop)
+            for h in stmt.handlers:
+                self._walk_block(h.body, finfo, cls, in_init, locks, in_loop)
+            self._walk_block(stmt.orelse, finfo, cls, in_init, locks, in_loop)
+            self._walk_block(stmt.finalbody, finfo, cls, in_init, locks, in_loop)
+            return
+        # leaf statements: bindings first (field kinds / jit wrappers), then
+        # a generic expression scan for accesses and calls
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            self._record_binding(stmt, finfo, cls, in_init)
+        self._scan_exprs(
+            list(ast.iter_child_nodes(stmt)), finfo, cls, in_init, locks, in_loop,
+            stmt=stmt,
+        )
+
+    def _record_binding(
+        self,
+        stmt: ast.stmt,
+        finfo: FunctionInfo,
+        cls: Optional[ClassInfo],
+        in_init: bool,
+    ) -> None:
+        value = getattr(stmt, "value", None)
+        if value is None:
+            return
+        targets = (
+            stmt.targets if isinstance(stmt, ast.Assign)
+            else [stmt.target]
+        )
+        keys = [receiver_key(t) for t in targets]
+        # jit wrapper binding: self.<attr> = jax.jit(fn, donate_argnums=...)
+        # — only SELF-attribute bindings are recorded class-wide; a bare-name
+        # local (`step = jax.jit(...)` inside one function) stays scoped to
+        # that function's own DN scan, so an unrelated local named `step`
+        # elsewhere in the module can never inherit its donation semantics
+        wrapper = self._match_jit(value)
+        if wrapper is not None:
+            target_fn, donated = wrapper
+            for key in keys:
+                if key is not None and key.startswith("self."):
+                    self.jit_wrappers[(finfo.class_name, key)] = JitWrapper(
+                        key=key, target=target_fn, donated=donated,
+                        lineno=stmt.lineno,
+                    )
+        # field-kind classification for self.<attr> = ctor(...)
+        if cls is None or not isinstance(value, ast.Call):
+            return
+        ctor = self._ctor_name(value.func)
+        for key in keys:
+            if key is None or not key.startswith("self."):
+                continue
+            attr = key[5:]
+            if ctor in ("Lock", "RLock"):
+                cls.lock_fields.add(attr)
+                cls.field_kinds[attr] = "lock"
+            elif ctor in _SYNC_CTORS:
+                cls.field_kinds[attr] = "sync"
+            elif ctor in _CONTAINER_CTORS:
+                cls.field_kinds[attr] = "container"
+            elif ctor in _NUMPY_CTORS and self._is_numpy_call(value):
+                cls.field_kinds[attr] = "numpy"
+            elif ctor and ctor[0].isupper():
+                cls.instance_fields[attr] = ctor
+                cls.field_kinds.setdefault(attr, "instance")
+
+    def _is_numpy_call(self, call: ast.Call) -> bool:
+        if not isinstance(call.func, ast.Attribute):
+            return False
+        root = _dotted(call.func.value)
+        return root in ("np", "numpy")
+
+    def _match_jit(self, value: ast.AST) -> Optional[Tuple[Optional[str], FrozenSet[int]]]:
+        """``jax.jit(fn, ...)`` → (resolved fn or None, donated positions)."""
+        if not isinstance(value, ast.Call):
+            return None
+        chain = _dotted(value.func)
+        if chain not in ("jax.jit", "jit"):
+            return None
+        target = None
+        if value.args:
+            tkey = receiver_key(value.args[0])
+            if tkey is not None:
+                target = tkey  # "impl" or "self._impl" — resolved lazily
+        donated: Set[int] = set()
+        for kw in value.keywords:
+            if kw.arg == "donate_argnums":
+                # collect int constants from tuples ANYWHERE in the value —
+                # handles the engine's `(1,) if donate else ()` conditional
+                for node in ast.walk(kw.value):
+                    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+                            and not isinstance(node.value, bool):
+                        donated.add(node.value)
+        return target, frozenset(donated)
+
+    def _scan_exprs(
+        self,
+        nodes: Sequence[ast.AST],
+        finfo: FunctionInfo,
+        cls: Optional[ClassInfo],
+        in_init: bool,
+        locks: FrozenSet[str],
+        in_loop: bool,
+        iterating: bool = False,
+        stmt: Optional[ast.stmt] = None,
+    ) -> None:
+        """Record field accesses and resolved call sites in expression trees.
+        ``iterating`` marks the top node as a for-loop iterable."""
+        comp_types = (ast.GeneratorExp, ast.ListComp, ast.SetComp, ast.DictComp)
+        for top in nodes:
+            if top is None or isinstance(top, ast.stmt):
+                continue
+            # everything nested inside a comprehension runs once per element
+            comp_members: Set[int] = set()
+            for n in ast.walk(top):
+                if isinstance(n, comp_types):
+                    comp_members.update(id(m) for m in ast.walk(n) if m is not n)
+            for node in ast.walk(top):
+                inner_loop = in_loop or id(node) in comp_members
+                if isinstance(node, ast.Call):
+                    self._record_call(node, finfo, cls, locks, inner_loop)
+                if cls is None:
+                    continue
+                if (
+                    isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"
+                ):
+                    self._record_access(node, finfo, cls, in_init, locks, iterating and node is top)
+
+    def _record_access(
+        self,
+        node: ast.Attribute,
+        finfo: FunctionInfo,
+        cls: ClassInfo,
+        in_init: bool,
+        locks: FrozenSet[str],
+        iterating: bool,
+    ) -> None:
+        attr = node.attr
+        if attr in cls.lock_fields:
+            return
+        parent_kind = "read"
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            parent_kind = "write"
+        cls.accesses.append(
+            FieldAccess(
+                field=attr, func=finfo.qualname,
+                kind="iterate" if iterating and parent_kind == "read" else parent_kind,
+                locks_held=locks, node=node, lineno=node.lineno,
+                col=node.col_offset, in_init=in_init,
+            )
+        )
+
+    def _record_call(
+        self,
+        node: ast.Call,
+        finfo: FunctionInfo,
+        cls: Optional[ClassInfo],
+        locks: FrozenSet[str],
+        in_loop: bool,
+    ) -> None:
+        target = self.resolve_call(node, cls)
+        if target is None:
+            return
+        finfo.calls.append(
+            CallSite(
+                target=target, node=node, lineno=node.lineno,
+                in_loop=in_loop, locks_held=locks,
+            )
+        )
+
+    # -- call resolution ------------------------------------------------------
+    def node_key(self, qualname: str) -> str:
+        return f"{self.path}::{qualname}"
+
+    def resolve_call(self, call: ast.Call, cls: Optional[ClassInfo]) -> Optional[str]:
+        """Best-effort local/package target key for one call, or None."""
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            if fn.id in self.functions:
+                return self.node_key(fn.id)
+            if fn.id in self.from_imports:
+                mod, orig = self.from_imports[fn.id]
+                return f"@{mod}::{orig}"  # cross-module, resolved by the index
+            return None
+        if not isinstance(fn, ast.Attribute):
+            return None
+        recv, meth = fn.value, fn.attr
+        # self.m() -> same-class method
+        if isinstance(recv, ast.Name) and recv.id == "self" and cls is not None:
+            if f"{cls.name}.{meth}" in self.functions:
+                return self.node_key(f"{cls.name}.{meth}")
+            return None
+        # self.attr.m() -> bound instance field's class
+        if (
+            isinstance(recv, ast.Attribute)
+            and isinstance(recv.value, ast.Name)
+            and recv.value.id == "self"
+            and cls is not None
+        ):
+            bound = cls.instance_fields.get(recv.attr)
+            if bound:
+                return self._resolve_class_method(bound, meth)
+            return None
+        if isinstance(recv, ast.Name):
+            # NAME.m() where NAME is a module-level instance
+            bound = self.instances.get(recv.id)
+            if bound:
+                return self._resolve_class_method(bound, meth)
+            # imported singleton: from paddle_tpu.flags import GLOBAL_FLAGS
+            if recv.id in self.from_imports:
+                mod, orig = self.from_imports[recv.id]
+                return f"@{mod}::{orig}.{meth}"  # instance OR submodule fn
+            # module alias: import paddle_tpu.x.y as alias; alias.f()
+            if recv.id in self.module_aliases:
+                return f"@{self.module_aliases[recv.id]}::{meth}"
+            return None
+        return None
+
+    def _resolve_class_method(self, class_name: str, meth: str) -> Optional[str]:
+        if f"{class_name}.{meth}" in self.functions:
+            return self.node_key(f"{class_name}.{meth}")
+        if class_name in self.from_imports:
+            mod, orig = self.from_imports[class_name]
+            return f"@{mod}::{orig}.{meth}"
+        return None
+
+    # -- thread entries --------------------------------------------------------
+    def _collect_thread_entries(self) -> None:
+        # handler classes: every method runs on a server thread
+        for cname, cinfo in self.classes.items():
+            if cinfo.base_names & _HTTP_HANDLER_BASES or any(
+                self._base_is_handler(b) for b in cinfo.base_names
+            ):
+                for qual in self.functions:
+                    if qual.startswith(f"{cname}."):
+                        self.thread_entries.append((qual, "handler", cinfo.node.lineno))
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _dotted(node.func)
+            name = chain.rsplit(".", 1)[-1] if chain else None
+            if name == "Thread":
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        qual = self._callable_qual(kw.value)
+                        if qual:
+                            self.thread_entries.append((qual, "thread", node.lineno))
+            elif name == "on_change":
+                # GLOBAL_FLAGS.on_change("flag", listener): listeners fire on
+                # whatever thread calls set_flags — a cross-thread entry
+                for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                    qual = self._callable_qual(arg)
+                    if qual:
+                        self.thread_entries.append((qual, "listener", node.lineno))
+
+    def _base_is_handler(self, base: str) -> bool:
+        # one level of local subclassing: class A(BaseHTTPRequestHandler);
+        # class B(A) — B's methods are handler entries too
+        parent = self.classes.get(base)
+        return parent is not None and bool(parent.base_names & _HTTP_HANDLER_BASES)
+
+    def _callable_qual(self, expr: ast.AST) -> Optional[str]:
+        key = receiver_key(expr)
+        if key is None:
+            return None
+        if key.startswith("self."):
+            attr = key[5:]
+            for qual in self.functions:
+                if qual.endswith(f".{attr}") or qual == attr:
+                    return qual
+            return None
+        if key in self.functions:
+            return key
+        for qual in self.functions:
+            if qual.endswith(f".<locals>.{key}"):
+                return qual
+        return None
+
+
+class PackageIndex:
+    """Memoized per-module graphs plus the package-level closures the CC/DN
+    checkers share. ``build_count`` counts actual graph constructions — the
+    CI perf gate asserts it equals the number of analyzed modules (i.e. the
+    graphs are built once, not re-resolved per checker)."""
+
+    def __init__(self) -> None:
+        self._modules: Dict[str, ModuleGraph] = {}
+        self.build_count = 0
+        self._thread_reachable: Optional[Set[str]] = None
+        self._loop_reachable: Optional[Set[str]] = None
+        self._edges: Optional[Dict[str, List[CallSite]]] = None
+        self._lock_pairs: Optional[Dict[Tuple[str, str], List[Tuple[str, int, str]]]] = None
+
+    # -- module memoization ---------------------------------------------------
+    def add_module(self, path: str, tree: ast.Module) -> ModuleGraph:
+        if path not in self._modules:
+            self._modules[path] = ModuleGraph(path, tree, _dotted_name_of(path))
+            self.build_count += 1
+            # package-level closures are stale once the module set changes
+            self._thread_reachable = None
+            self._loop_reachable = None
+            self._edges = None
+            self._lock_pairs = None
+        return self._modules[path]
+
+    def module(self, path: str) -> Optional[ModuleGraph]:
+        return self._modules.get(path)
+
+    def modules(self) -> Iterable[ModuleGraph]:
+        return self._modules.values()
+
+    # -- cross-module resolution ----------------------------------------------
+    def _resolve_key(self, key: str) -> List[str]:
+        """Resolve an ``@module::name`` cross-module reference against the
+        indexed modules; concrete ``path::qual`` keys pass through."""
+        if not key.startswith("@"):
+            return [key]
+        mod, name = key[1:].split("::", 1)
+        out: List[str] = []
+        for g in self._modules.values():
+            if g.dotted_name is None:
+                continue
+            # "from paddle_tpu.observability import flight_recorder" imports a
+            # MODULE; "<mod>.<name>" may itself be the module holding the attr
+            if g.dotted_name == mod:
+                out.extend(self._expand_in_module(g, name))
+            elif g.dotted_name == f"{mod}.{name.split('.', 1)[0]}" and "." in name:
+                out.extend(self._expand_in_module(g, name.split(".", 1)[1]))
+        return out
+
+    def _expand_in_module(self, g: ModuleGraph, name: str) -> List[str]:
+        if name in g.functions:
+            return [g.node_key(name)]
+        if "." in name:
+            head, meth = name.rsplit(".", 1)
+            # instance attr call: GLOBAL_FLAGS.get -> FlagRegistry.get
+            inst_cls = g.instances.get(head)
+            if inst_cls and f"{inst_cls}.{meth}" in g.functions:
+                return [g.node_key(f"{inst_cls}.{meth}")]
+            if name in g.functions:  # Class.method direct
+                return [g.node_key(name)]
+            # the head may be a re-exported module alias inside g
+            if head in g.module_aliases or head in g.from_imports:
+                mod = (
+                    g.module_aliases.get(head)
+                    or ".".join(g.from_imports[head])
+                )
+                return self._resolve_key(f"@{mod}::{meth}")
+        if name in g.classes:
+            # calling a class = running __init__
+            if f"{name}.__init__" in g.functions:
+                return [g.node_key(f"{name}.__init__")]
+        return []
+
+    def _all_edges(self) -> Dict[str, List[CallSite]]:
+        if self._edges is None:
+            edges: Dict[str, List[CallSite]] = {}
+            for g in self._modules.values():
+                for qual, finfo in g.functions.items():
+                    resolved: List[CallSite] = []
+                    for cs in finfo.calls:
+                        for tgt in self._resolve_key(cs.target):
+                            resolved.append(
+                                CallSite(
+                                    target=tgt, node=cs.node, lineno=cs.lineno,
+                                    in_loop=cs.in_loop, locks_held=cs.locks_held,
+                                )
+                            )
+                    edges[g.node_key(qual)] = resolved
+            self._edges = edges
+        return self._edges
+
+    # -- reachability closures -------------------------------------------------
+    def thread_reachable(self) -> Set[str]:
+        """Node keys reachable from any thread entry in the package."""
+        if self._thread_reachable is None:
+            roots = [
+                g.node_key(qual)
+                for g in self._modules.values()
+                for qual, _kind, _ln in g.thread_entries
+            ]
+            self._thread_reachable = self._bfs(roots)
+        return self._thread_reachable
+
+    def loop_reachable(self) -> Set[str]:
+        """Node keys reachable from a call site that sits inside a loop —
+        i.e. functions whose body may run once per iteration of some hot
+        loop, directly or transitively."""
+        if self._loop_reachable is None:
+            edges = self._all_edges()
+            roots: List[str] = []
+            for sites in edges.values():
+                for cs in sites:
+                    if cs.in_loop:
+                        roots.append(cs.target)
+            self._loop_reachable = self._bfs(roots)
+        return self._loop_reachable
+
+    def _bfs(self, roots: Sequence[str]) -> Set[str]:
+        edges = self._all_edges()
+        seen: Set[str] = set()
+        stack = list(roots)
+        while stack:
+            key = stack.pop()
+            if key in seen:
+                continue
+            seen.add(key)
+            for cs in edges.get(key, ()):
+                if cs.target not in seen:
+                    stack.append(cs.target)
+        return seen
+
+    # -- lock-order pairs -------------------------------------------------------
+    def lock_order_pairs(self) -> Dict[Tuple[str, str], List[Tuple[str, int, str]]]:
+        """(held, acquired) -> [(path, line, via)] across the package:
+        lexical nesting plus one interprocedural expansion (a call made with
+        L held reaches a function whose acquire-closure contains M).
+        Memoized like the other package-level closures — the checker asks
+        once per analyzed FILE, and recomputing the acquire-closure per file
+        would be O(files x package) on the tier-1 gate path."""
+        if self._lock_pairs is not None:
+            return self._lock_pairs
+        edges = self._all_edges()
+        # direct acquire sets per function, then closure over calls
+        direct: Dict[str, Set[str]] = {}
+        for g in self._modules.values():
+            for qual, finfo in g.functions.items():
+                direct[g.node_key(qual)] = {lk for lk, _held, _n in finfo.acquires}
+        closure: Dict[str, Set[str]] = {}
+
+        def acq_closure(key: str, trail: Set[str]) -> Set[str]:
+            if key in closure:
+                return closure[key]
+            if key in trail:
+                return direct.get(key, set())
+            trail.add(key)
+            out = set(direct.get(key, set()))
+            for cs in edges.get(key, ()):
+                out |= acq_closure(cs.target, trail)
+            closure[key] = out
+            return out
+
+        pairs: Dict[Tuple[str, str], List[Tuple[str, int, str]]] = {}
+        for g in self._modules.values():
+            for qual, finfo in g.functions.items():
+                key = g.node_key(qual)
+                for lk, held, node in finfo.acquires:
+                    for h in held:
+                        if h != lk:
+                            pairs.setdefault((h, lk), []).append(
+                                (g.path, node.lineno, qual)
+                            )
+                for cs in edges.get(key, ()):
+                    if not cs.locks_held:
+                        continue
+                    for m in acq_closure(cs.target, set()):
+                        for h in cs.locks_held:
+                            if h != m:
+                                pairs.setdefault((h, m), []).append(
+                                    (g.path, cs.lineno, qual)
+                                )
+        self._lock_pairs = pairs
+        return pairs
+
+
+def _dotted_name_of(path: str) -> Optional[str]:
+    """Dotted module name from a file path, anchored at the package root
+    (the last path component named ``paddle_tpu``); None for snippets."""
+    parts = [p for p in path.replace("\\", "/").split("/") if p]
+    if not parts or not parts[-1].endswith(".py"):
+        return None
+    anchors = [i for i, p in enumerate(parts) if p == "paddle_tpu"]
+    if not anchors:
+        return None
+    rel = parts[anchors[-1]:]
+    rel[-1] = rel[-1][:-3]
+    if rel[-1] == "__init__":
+        rel = rel[:-1]
+    return ".".join(rel) if rel else None

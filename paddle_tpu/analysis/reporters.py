@@ -1,7 +1,18 @@
-"""Violation reporters: human text and machine JSON.
+"""Violation reporters: human text, machine JSON, SARIF 2.1.0, baselines.
 
-Both render the same violation list; the JSON form is what CI and the tier-1
-gate consume (``python -m paddle_tpu.analysis --format json ...``)."""
+All render the same violation list. The JSON form is what the tier-1 gate
+consumes; SARIF (``--format sarif``) is the interchange format CI code-
+scanning UIs ingest — rule ids are the stable violation codes, suppressed
+findings carry SARIF ``suppressions`` entries so they upload without
+re-alerting.
+
+Baselines (``--baseline known.json`` / ``--write-baseline known.json``) let
+the gate tighten incrementally on a codebase with accepted findings: a
+baseline is a multiset of ``path::code`` fingerprints (line numbers are
+deliberately NOT part of the fingerprint — an unrelated edit shifting lines
+must not resurrect an accepted finding); the CLI exits 1 only on
+unsuppressed violations NOT covered by the baseline's count for their
+fingerprint."""
 
 from __future__ import annotations
 
@@ -10,7 +21,18 @@ from typing import Dict, List, Sequence
 
 from paddle_tpu.analysis.core import Violation
 
-__all__ = ["render_text", "render_json", "summarize"]
+__all__ = [
+    "render_text",
+    "render_json",
+    "render_sarif",
+    "summarize",
+    "baseline_fingerprints",
+    "new_violations",
+    "write_baseline",
+    "load_baseline",
+]
+
+BASELINE_SCHEMA = "paddle_tpu.analysis.baseline/v1"
 
 
 def summarize(violations: Sequence[Violation]) -> Dict[str, int]:
@@ -35,6 +57,123 @@ def render_text(violations: Sequence[Violation], show_suppressed: bool = False) 
         f"{s['suppressed']} suppressed"
     )
     return "\n".join(lines)
+
+
+def render_sarif(violations: Sequence[Violation], rule_descriptions: Dict[str, str]) -> str:
+    """SARIF 2.1.0 with stable rule ids (the violation codes). Suppressed
+    findings are included with a SARIF suppression record (kind
+    ``inSource``) so a code-scanning UI shows them as acknowledged rather
+    than new."""
+    used = sorted({v.code for v in violations} | set(rule_descriptions))
+    rules = [
+        {
+            "id": code,
+            "name": code,
+            "shortDescription": {"text": rule_descriptions.get(code, code)},
+        }
+        for code in used
+    ]
+    rule_index = {code: i for i, code in enumerate(used)}
+    results = []
+    for v in violations:
+        result = {
+            "ruleId": v.code,
+            "ruleIndex": rule_index[v.code],
+            "level": "warning" if v.suppressed else "error",
+            "message": {"text": v.message},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": v.path.replace("\\", "/")},
+                        "region": {
+                            "startLine": max(1, v.line),
+                            "startColumn": max(1, v.col + 1),
+                        },
+                    }
+                }
+            ],
+        }
+        if v.suppressed:
+            result["suppressions"] = [
+                {"kind": "inSource", "justification": v.reason or ""}
+            ]
+        results.append(result)
+    doc = {
+        "$schema": "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/Schemata/sarif-schema-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "paddle_tpu.analysis",
+                        "informationUri": "https://github.com/PaddlePaddle/Paddle",
+                        "rules": rules,
+                    }
+                },
+                "results": results,
+            }
+        ],
+    }
+    return json.dumps(doc, indent=1)
+
+
+# -- baselines (accept-known-findings snapshots) ------------------------------
+
+def baseline_fingerprints(violations: Sequence[Violation]) -> Dict[str, int]:
+    """Multiset of ``path::code`` fingerprints over UNSUPPRESSED violations
+    (suppressed ones are already accepted in-source, with a reason)."""
+    out: Dict[str, int] = {}
+    for v in violations:
+        if v.suppressed:
+            continue
+        fp = f"{v.path.replace(chr(92), '/')}::{v.code}"
+        out[fp] = out.get(fp, 0) + 1
+    return out
+
+
+def write_baseline(path: str, violations: Sequence[Violation]) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(
+            {"schema": BASELINE_SCHEMA, "findings": baseline_fingerprints(violations)},
+            f, indent=1, sort_keys=True,
+        )
+        f.write("\n")
+
+
+def load_baseline(path: str) -> Dict[str, int]:
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or data.get("schema") != BASELINE_SCHEMA:
+        raise ValueError(
+            f"{path} is not a {BASELINE_SCHEMA} baseline (wrong shape/schema)"
+        )
+    findings = data.get("findings")
+    if not isinstance(findings, dict) or not all(
+        isinstance(k, str) and isinstance(c, int) and c >= 0
+        for k, c in findings.items()
+    ):
+        raise ValueError(f"{path}: baseline 'findings' must map fingerprints to counts")
+    return dict(findings)
+
+
+def new_violations(
+    violations: Sequence[Violation], baseline: Dict[str, int]
+) -> List[Violation]:
+    """Unsuppressed violations beyond the baseline's per-fingerprint count.
+    Within one fingerprint the EARLIEST occurrences are treated as the known
+    ones, so the reported new finding is the one furthest from the accepted
+    set (stable given the driver's path/line sort)."""
+    budget = dict(baseline)
+    out: List[Violation] = []
+    for v in violations:
+        if v.suppressed:
+            continue
+        fp = f"{v.path.replace(chr(92), '/')}::{v.code}"
+        if budget.get(fp, 0) > 0:
+            budget[fp] -= 1
+        else:
+            out.append(v)
+    return out
 
 
 def render_json(violations: Sequence[Violation]) -> str:

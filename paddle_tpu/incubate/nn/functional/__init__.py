@@ -7,7 +7,7 @@ profiling says XLA's fusion is insufficient — see ``paddle_tpu.kernels``).
 
 from __future__ import annotations
 
-from typing import Any, Optional, Tuple
+from typing import Any, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -92,51 +92,117 @@ def fused_layer_norm(
     return out
 
 
+# -- rope: XLA composition + rotation adjoint (pure array functions) ---------
+
+def _rope_rotate(x, use_neox):
+    if use_neox:
+        half = x.shape[-1] // 2
+        x1, x2 = x[..., :half], x[..., half:]
+        return jnp.concatenate([-x2, x1], axis=-1)
+    x1 = x[..., 0::2]
+    x2 = x[..., 1::2]
+    return jnp.stack([-x2, x1], axis=-1).reshape(x.shape)
+
+
+def _rope_broadcast_tables(x, sin, cos):
+    s, c = sin, cos
+    if s.ndim == 2:
+        s = s[None, :, None, :]
+        c = c[None, :, None, :]
+    return s.astype(x.dtype), c.astype(x.dtype)
+
+
+def _rope_apply_xla(x, sin, cos, use_neox):
+    s, c = _rope_broadcast_tables(x, sin, cos)
+    return x * c + _rope_rotate(x, use_neox) * s
+
+
+def _rope_adjoint_xla(g, sin, cos, use_neox):
+    """dx for y = x⊙c + rot(x)⊙s: ``g⊙c + unrot(g⊙s)`` — the rotation's
+    adjoint is its inverse sign pattern (exact for asymmetric tables)."""
+    s, c = _rope_broadcast_tables(g, sin, cos)
+    gs = g * s
+    if use_neox:
+        half = g.shape[-1] // 2
+        v1, v2 = gs[..., :half], gs[..., half:]
+        unrot = jnp.concatenate([v2, -v1], axis=-1)
+    else:
+        v1 = gs[..., 0::2]
+        v2 = gs[..., 1::2]
+        unrot = jnp.stack([v2, -v1], axis=-1).reshape(gs.shape)
+    return g * c + unrot
+
+
 @defop("fused_rotary_position_embedding", tensor_method=None)
 def _fused_rope_op(q, k, v, sin, cos, use_neox_rotary_style=True):
     """RoPE (reference ``fused_ops.yaml:408`` fused_rotary_position_embedding;
     kernel ``paddle/phi/kernels/fusion/gpu/fused_rope_kernel.cu``).
-    Layout [B, S, H, D]; sin/cos [1, S, 1, D] (or [S, D])."""
+    Layout [B, S, H, D]; sin/cos [1, S, 1, D] (or [S, D]).
 
-    def rope(x):
-        if x is None:
-            return None
-        # per-batch tables (leading dim > 1, decode with ragged positions)
-        # cannot collapse to the kernel's [S, D] layout — XLA path only
-        if (
-            use_neox_rotary_style
-            and x.shape[-1] % 128 == 0
-            and (cos.ndim == 2 or cos.shape[0] == 1)
-        ):
-            from paddle_tpu.kernels.select import pallas_enabled, warn_fallback
+    Registered raw op = the pure-XLA composition (parity audits, infer_meta,
+    and create_graph re-differentiation trace THIS, never a Pallas call);
+    the serving/train entry :func:`fused_rotary_position_embedding` routes
+    around the generic ``jax.vjp`` dispatch with an explicit tape node whose
+    backward runs the Pallas adjoint kernel directly."""
+    return tuple(
+        _rope_apply_xla(t, sin, cos, use_neox_rotary_style)
+        for t in (q, k, v)
+        if t is not None
+    )
 
-            if pallas_enabled("use_pallas_fused"):
-                try:
-                    from paddle_tpu.kernels.fused import fused_rope_pallas
 
-                    c2 = cos if cos.ndim == 2 else cos.reshape(cos.shape[1], cos.shape[-1])
-                    s2 = sin if sin.ndim == 2 else sin.reshape(sin.shape[1], sin.shape[-1])
-                    return fused_rope_pallas(x, c2, s2)
-                except Exception as exc:  # pragma: no cover - TPU-only path
-                    warn_fallback("fused_rope", exc)
-        s = sin
-        c = cos
-        if s.ndim == 2:
-            s = s[None, :, None, :]
-            c = c[None, :, None, :]
-        s = s.astype(x.dtype)
-        c = c.astype(x.dtype)
-        if use_neox_rotary_style:
-            half = x.shape[-1] // 2
-            x1, x2 = x[..., :half], x[..., half:]
-            rotated = jnp.concatenate([-x2, x1], axis=-1)
-        else:
-            x1 = x[..., 0::2]
-            x2 = x[..., 1::2]
-            rotated = jnp.stack([-x2, x1], axis=-1).reshape(x.shape)
-        return x * c + rotated * s
+def _rope_kernel_tables(x, sin, cos, use_neox):
+    """(cos2, sin2) in the Pallas kernel's [S, D] layout when this shape is
+    kernel-eligible, else None. Per-batch tables (leading dim > 1 — decode
+    with ragged positions) cannot collapse to [S, D]: XLA path only."""
+    if not use_neox or x.shape[-1] % 128 != 0:
+        return None
+    if cos.ndim == 2:
+        return cos, sin
+    if cos.shape[0] == 1:
+        return (
+            cos.reshape(cos.shape[1], cos.shape[-1]),
+            sin.reshape(sin.shape[1], sin.shape[-1]),
+        )
+    return None
 
-    return tuple(rope(t) for t in (q, k, v) if t is not None)
+
+def _rope_fwd_array(x, sin, cos, use_neox):
+    from paddle_tpu.kernels.select import pallas_enabled, warn_fallback
+
+    tabs = _rope_kernel_tables(x, sin, cos, use_neox)
+    if tabs is not None and pallas_enabled("use_pallas_fused"):
+        try:
+            from paddle_tpu.kernels.fused import fused_rope_pallas
+
+            return fused_rope_pallas(x, tabs[0], tabs[1])
+        except Exception as exc:  # pragma: no cover - TPU-only path
+            warn_fallback("fused_rope", exc)
+    return _rope_apply_xla(x, sin, cos, use_neox)
+
+
+def _rope_bwd_array(g, sin, cos, use_neox):
+    from paddle_tpu.kernels.select import pallas_enabled, warn_fallback
+
+    tabs = _rope_kernel_tables(g, sin, cos, use_neox)
+    if tabs is not None and pallas_enabled("use_pallas_fused"):
+        try:
+            from paddle_tpu.kernels.fused import rope_adjoint_pallas
+
+            return rope_adjoint_pallas(g, tabs[0], tabs[1])
+        except Exception as exc:  # pragma: no cover - TPU-only path
+            warn_fallback("fused_rope_bwd", exc)
+    return _rope_adjoint_xla(g, sin, cos, use_neox)
+
+
+def _reduce_to_shape(arr, shape):
+    """Sum ``arr`` down to broadcast source ``shape`` (table cotangents)."""
+    while arr.ndim > len(shape):
+        arr = arr.sum(axis=0)
+    for ax, (have, want) in enumerate(zip(arr.shape, shape)):
+        if want == 1 and have != 1:
+            arr = arr.sum(axis=ax, keepdims=True)
+    return arr.reshape(shape)
 
 
 def fused_rotary_position_embedding(
@@ -150,6 +216,25 @@ def fused_rotary_position_embedding(
     time_major: bool = False,
     rotary_emb_base: float = 10000.0,
 ) -> Tuple[Any, ...]:
+    """RoPE over q/k/v with an EXPLICIT tape backward.
+
+    The generic op dispatch differentiates its forward with ``jax.vjp`` at
+    record time; routed through the Pallas rope kernel's ``custom_vjp`` that
+    linearization is exactly what degraded to XLA on the r03 TPU run
+    ("Linearization failed to produce known values for all output primals"
+    — counted in ``paddle_tpu_kernel_fallbacks_total{kernel=fused_rope}``).
+    This entry instead records a manual :class:`~paddle_tpu.core.autograd.
+    GradNode` (the ``recompute`` pattern): forward and backward each run
+    their own standalone Pallas kernel (``fused_rope_pallas`` /
+    ``rope_adjoint_pallas``) behind the usual applicability gate + XLA
+    fallback, and NO jax AD transform ever sees a ``pallas_call`` — there is
+    nothing left to fail linearization. ``create_graph`` re-differentiation
+    goes through the registered pure-XLA raw op.
+    """
+    from paddle_tpu.core import autograd as _ag
+    from paddle_tpu.core import dispatch as _dispatch
+    from paddle_tpu.core.tensor import Tensor
+
     if sin is None or cos is None:
         # build sin/cos table from base
         b, s, h, d = q.shape
@@ -157,12 +242,103 @@ def fused_rotary_position_embedding(
         t = jnp.arange(s, dtype=jnp.float32)
         freqs = jnp.outer(t, inv)
         emb = jnp.concatenate([freqs, freqs], axis=-1)
-        from paddle_tpu.core.tensor import Tensor
-
         sin = Tensor(jnp.sin(emb))
         cos = Tensor(jnp.cos(emb))
-    outs = _fused_rope_op(q, k, v, sin, cos, use_neox_rotary_style=use_neox_rotary_style)
-    result = list(outs) if isinstance(outs, (list, tuple)) else [outs]
+
+    neox = bool(use_neox_rotary_style)
+    inputs = [q, k, v, sin, cos]
+    arrays = [
+        (t._data if isinstance(t, Tensor) else (None if t is None else jnp.asarray(t)))
+        for t in inputs
+    ]
+    # AMP autocast parity with call_op: a custom_white/black_list naming this
+    # op must still cast its tensor inputs even though dispatch is manual
+    from paddle_tpu.amp.auto_cast import amp_cast_inputs, amp_enabled
+
+    if amp_enabled():
+        present = [i for i, a in enumerate(arrays) if a is not None]
+        cast = amp_cast_inputs(
+            "fused_rotary_position_embedding", [arrays[i] for i in present]
+        )
+        for i, a in zip(present, cast):
+            arrays[i] = a
+    xq, xk, xv, s_arr, c_arr = arrays
+    in_positions = [i for i in (0, 1, 2) if arrays[i] is not None]  # q/k/v present
+    out_arrays = [_rope_fwd_array(arrays[i], s_arr, c_arr, neox) for i in in_positions]
+
+    def _diff(t: Any) -> bool:
+        return (
+            isinstance(t, Tensor)
+            and not t.stop_gradient
+            and jnp.issubdtype(jnp.dtype(t.dtype), jnp.inexact)
+        )
+
+    record = _ag.is_grad_enabled() and any(_diff(t) for t in inputs)
+    node = None
+    if record:
+        diff_pos = [i for i, t in enumerate(inputs) if _diff(t)]
+        diff_tensors = [inputs[i] for i in diff_pos]
+        out_avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in out_arrays]
+        _flat, out_treedef = jax.tree_util.tree_flatten(tuple(out_arrays))
+        # output index for each q/k/v position (outs pack only non-None)
+        out_index = {pos: j for j, pos in enumerate(in_positions)}
+        consts = list(arrays)  # non-diff inputs closed over as arrays
+
+        def vjp_fn(cots: Any) -> Tuple[Any, ...]:
+            # out_treedef is always set, so the sweep hands us the tuple form
+            cot_list = list(cots)
+            grads: List[Any] = []
+            for pos in diff_pos:
+                if pos in out_index:  # q/k/v: one standalone adjoint kernel
+                    g = cot_list[out_index[pos]]
+                    grads.append(_rope_bwd_array(g, s_arr, c_arr, neox))
+                    continue
+                # table cotangents (rare — tables are buffers in every real
+                # model): exact sums over the XLA composition's broadcast
+                total = None
+                for p in in_positions:
+                    g32 = cot_list[out_index[p]].astype(jnp.float32)
+                    x32 = arrays[p].astype(jnp.float32)
+                    term = (
+                        g32 * _rope_rotate(x32, neox)
+                        if pos == 3  # sin
+                        else g32 * x32  # cos
+                    )
+                    total = term if total is None else total + term
+                src = s_arr if pos == 3 else c_arr
+                shape = (
+                    src.shape if src.ndim != 2
+                    else (1, src.shape[0], 1, src.shape[1])
+                )
+                red = _reduce_to_shape(total, shape).reshape(src.shape)
+                grads.append(red.astype(src.dtype))
+            return tuple(grads)
+
+        def closed(*diff_arrays: Any) -> Tuple[Any, ...]:
+            vals = list(consts)
+            for p, arr in zip(diff_pos, diff_arrays):
+                vals[p] = arr
+            return tuple(
+                _rope_apply_xla(vals[i], vals[3], vals[4], neox)
+                for i in in_positions
+            )
+
+        node = _ag.GradNode(
+            "fused_rotary_position_embedding", vjp_fn, diff_tensors, out_avals,
+            fwd_fn=closed, out_treedef=out_treedef,
+        )
+
+    if _dispatch._NAN_CHECK[0]:
+        _dispatch._check_nan_inf("fused_rotary_position_embedding", out_arrays)
+    if _dispatch.op_stats_hook is not None:  # amp.debugging operator stats
+        _dispatch.op_stats_hook("fused_rotary_position_embedding", out_arrays)
+    result: List[Any] = []
+    for j, _pos in enumerate(in_positions):
+        t = Tensor(out_arrays[j], stop_gradient=(node is None))
+        if node is not None:
+            t._grad_node = node
+            t._grad_output_index = j
+        result.append(t)
     while len(result) < 3:
         result.append(None)
     return tuple(result[:3])

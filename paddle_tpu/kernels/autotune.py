@@ -69,6 +69,27 @@ def _refresh_verbose(value: Any) -> None:
 GLOBAL_FLAGS.on_change("kernel_autotune_verbose", _refresh_verbose)
 _sync_verbose_logging(bool(GLOBAL_FLAGS.get("kernel_autotune_verbose")))  # seeds env
 
+# autotune() and the cache's load/persist path run once per KERNEL CALL on
+# tuned shapes (e.g. _autotune_rms_rows fires on every fused_rms_norm
+# dispatch), so their flag reads are on_change-cached locals instead of
+# registry-lock reads (analyzer check CC704, the _NAN_CHECK discipline)
+_TUNE_ENABLED = [False]
+_CACHE_PATH = [""]
+
+
+def _refresh_tune_enabled(value: Any) -> None:
+    _TUNE_ENABLED[0] = bool(value)
+
+
+def _refresh_cache_path(value: Any) -> None:
+    _CACHE_PATH[0] = str(value or "")
+
+
+GLOBAL_FLAGS.on_change("use_kernel_autotune", _refresh_tune_enabled)
+GLOBAL_FLAGS.on_change("kernel_autotune_cache", _refresh_cache_path)
+_TUNE_ENABLED[0] = bool(GLOBAL_FLAGS.get("use_kernel_autotune"))  # seeds env
+_CACHE_PATH[0] = str(GLOBAL_FLAGS.get("kernel_autotune_cache") or "")
+
 __all__ = ["autotune", "AutotuneCache", "cache"]
 
 
@@ -84,7 +105,7 @@ class AutotuneCache:
         return f"{kernel}|{'|'.join(map(str, key))}"
 
     def _maybe_load(self) -> None:
-        path = GLOBAL_FLAGS.get("kernel_autotune_cache")
+        path = _CACHE_PATH[0]
         if path and path != self._loaded_path and os.path.exists(path):
             try:
                 with open(path) as f:
@@ -101,7 +122,7 @@ class AutotuneCache:
 
     def put(self, kernel: str, key: Tuple, config: Any) -> None:
         self._picks[self._k(kernel, key)] = config
-        path = GLOBAL_FLAGS.get("kernel_autotune_cache")
+        path = _CACHE_PATH[0]
         if path:
             try:
                 serial = {
@@ -142,7 +163,7 @@ def autotune(
     Falls back to ``default`` when tuning is disabled, off-TPU, or every
     candidate fails. The chosen config is cached under (kernel, key).
     """
-    if not GLOBAL_FLAGS.get("use_kernel_autotune"):
+    if not _TUNE_ENABLED[0]:
         return default
     try:
         if jax.default_backend() != "tpu":

@@ -20,7 +20,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from paddle_tpu.kernels.select import _CompilerParams
 
-__all__ = ["fused_rms_norm_pallas", "fused_rope_pallas"]
+__all__ = ["fused_rms_norm_pallas", "fused_rope_pallas", "rope_adjoint_pallas"]
 
 
 def _rms_fwd_kernel(x_ref, w_ref, y_ref, rstd_ref, *, eps):
@@ -182,7 +182,9 @@ def _rope_bwd_kernel(g_ref, cos_ref, sin_ref, dx_ref):
 
 
 @functools.lru_cache(maxsize=None)
-def _make_rope(bh, s, d, interpret):
+def _make_rope_runner(bh, s, d, interpret):
+    """One (batch*head)-gridded rope-shaped pallas_call launcher, shared by
+    the forward and the adjoint kernels (identical specs, different body)."""
     grid = (bh,)
     in_specs = [
         pl.BlockSpec((1, 1, s, d), lambda i: (i, 0, 0, 0)),
@@ -202,6 +204,13 @@ def _make_rope(bh, s, d, interpret):
             out_shape=jax.ShapeDtypeStruct((bh, 1, s, d), xh.dtype),
             interpret=interpret,
         )(xh, cos2, sin2)
+
+    return run
+
+
+@functools.lru_cache(maxsize=None)
+def _make_rope(bh, s, d, interpret):
+    run = _make_rope_runner(bh, s, d, interpret)
 
     @jax.custom_vjp
     def core(xh, cos2, sin2):
@@ -243,3 +252,21 @@ def fused_rope_pallas(
     core = _make_rope(b * h, s, d, bool(interpret))
     y = core(xh, cos2, sin2)
     return jnp.moveaxis(y.reshape(b, h, s, d), 1, 2)
+
+
+def rope_adjoint_pallas(
+    g: jax.Array, cos: jax.Array, sin: jax.Array, interpret: bool = False
+) -> jax.Array:
+    """Adjoint of :func:`fused_rope_pallas` w.r.t. ``x`` as ONE standalone
+    Pallas kernel: ``dx = g⊙cos + unrot(g⊙sin)``. The framework tape's rope
+    op calls this directly in its backward (no jax-level differentiation of
+    any ``pallas_call`` ever happens on the train path — the fix for the r03
+    "Linearization failed" fallback), so it must stay callable outside any
+    AD transform. ``g`` [B, S, H, D]; cos/sin [S, D]."""
+    b, s, h, d = g.shape
+    gh = jnp.moveaxis(g, 2, 1).reshape(b * h, 1, s, d)
+    cos2 = cos.reshape(1, s, d)
+    sin2 = sin.reshape(1, s, d)
+    run = _make_rope_runner(b * h, s, d, bool(interpret))
+    dx = run(_rope_bwd_kernel, gh, cos2, sin2)
+    return jnp.moveaxis(dx.reshape(b, h, s, d), 1, 2)

@@ -7,7 +7,7 @@ cannot be caught by the eager try/except)."""
 from __future__ import annotations
 
 import logging
-from typing import Any
+from typing import Any, Dict, List
 
 import jax
 from jax.experimental.pallas import tpu as pltpu
@@ -27,10 +27,30 @@ _fallbacks_total = get_registry().counter(
     labelnames=("kernel",),
 )
 
+# per-flag cached bools kept fresh by on_change listeners: pallas_enabled
+# runs on EVERY kernel-path dispatch (rope calls it once per q/k tensor), so
+# it must not take the flag-registry lock per op (analyzer check CC704 — the
+# same _NAN_CHECK discipline core/dispatch.py uses)
+_flag_cache: Dict[str, List[bool]] = {}
+
+
+def _cached_flag(flag: str) -> bool:
+    cell = _flag_cache.get(flag)
+    if cell is None:
+        cell = _flag_cache.setdefault(flag, [False])
+
+        def _refresh(value: Any, _cell: List[bool] = cell) -> None:
+            _cell[0] = bool(value)
+
+        GLOBAL_FLAGS.on_change(flag, _refresh)
+        # analysis: disable=CC704 one-time cache seeding: runs once per flag lifetime (cell-miss branch), every later call reads the cached cell
+        cell[0] = bool(GLOBAL_FLAGS.get(flag))  # seeds the FLAGS_ env var
+    return cell[0]
+
 
 def pallas_enabled(flag: str) -> bool:
     """Flag on AND running on a TPU backend."""
-    if not GLOBAL_FLAGS.get(flag):
+    if not _cached_flag(flag):
         return False
     try:
         return jax.default_backend() == "tpu"

@@ -99,6 +99,7 @@ class FlightRecorder:
         """Record one event. Lock-free (deque.append is atomic), always on —
         this is the per-admit/per-evict cost, so it stays one small dict
         build + one append. Callers must not pass prompt content."""
+        # analysis: disable=CC701 lock-free by design: deque.append is atomic and snapshot() copies defensively with bounded retry
         self._events.append(
             {
                 "seq": next(self._seq),
@@ -130,9 +131,11 @@ class FlightRecorder:
         return out
 
     def clear(self) -> None:
+        # analysis: disable=CC701 lock-free by design (test reset seam): snapshot() tolerates a concurrent clear via its IndexError fallback
         self._events.clear()
 
     def _default_dir(self) -> str:
+        # analysis: disable=CC704 dump-time only: runs at most once per permanent failure, never per op, and must see a just-set test dir
         configured = str(GLOBAL_FLAGS.get("flight_recorder_dir"))
         return configured or os.path.join(
             tempfile.gettempdir(), "paddle_tpu_flightrec"

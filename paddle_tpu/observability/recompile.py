@@ -51,6 +51,19 @@ CAUSE_MODE_FLIP = "mode_flip"
 
 _MAX_SIGNATURES = 32  # per-fn cap so a retrace storm can't grow host memory
 
+# FLAGS_max_compiles_per_fn as an on_change-cached local: record_compile is
+# reachable from the engine's step loops, so even its once-per-compile flag
+# read follows the no-registry-lock-on-hot-paths discipline (CC704)
+_BUDGET = [0]
+
+
+def _refresh_budget(value: Any) -> None:
+    _BUDGET[0] = int(value or 0)
+
+
+GLOBAL_FLAGS.on_change("max_compiles_per_fn", _refresh_budget)
+_BUDGET[0] = int(GLOBAL_FLAGS.get("max_compiles_per_fn") or 0)  # seeds env
+
 
 class RecompileBudgetWarning(UserWarning):
     """One traced function blew through ``FLAGS_max_compiles_per_fn``."""
@@ -97,7 +110,7 @@ class RecompileWatchdog:
             _tracing.GLOBAL_TRACER.add_event(
                 "jit.compile", attrs={"fn": fn, "cause": cause, "count": count}
             )
-        budget = GLOBAL_FLAGS.get("max_compiles_per_fn")
+        budget = _BUDGET[0]
         # budget counts RE-compiles: first_call traces are expected once per
         # instance (several engines / Layer instances legitimately share one
         # fn name here), so they can never trip the retrace warning

@@ -662,10 +662,16 @@ class ServingFrontend:
 
     def stop(self, timeout: float = 5.0) -> None:
         self._stop.set()
-        t = self._thread
+        # _thread is guarded by the frontend lock (start() mutates it under
+        # the lock); the join itself must happen OUTSIDE the lock or a pump
+        # iteration waiting on the lock could never finish its last pass
+        with self._lock:
+            t = self._thread
         if t is not None:
             t.join(timeout=timeout)
-        self._thread = None
+        with self._lock:
+            if self._thread is t:
+                self._thread = None
 
     # -- introspection -------------------------------------------------------
     def snapshot(self) -> Dict[str, Any]:

@@ -684,6 +684,591 @@ def test_autotune_verbose_handler_follows_the_flag():
         _logger.setLevel(prior)
 
 
+# -- dataflow layer: thread-entry discovery ----------------------------------
+
+def _graph_of(src, path="<snippet>.py"):
+    import ast as _ast
+
+    from paddle_tpu.analysis.dataflow import PackageIndex
+
+    idx = PackageIndex()
+    return idx, idx.add_module(path, _ast.parse(src))
+
+
+def test_thread_entry_thread_target_self_method():
+    _, g = _graph_of(
+        "import threading\n"
+        "class S:\n"
+        "    def start(self):\n"
+        "        self._t = threading.Thread(target=self._run, daemon=True)\n"
+        "    def _run(self):\n"
+        "        pass\n"
+    )
+    assert ("S._run", "thread") in {(q, k) for q, k, _ in g.thread_entries}
+
+
+def test_thread_entry_module_function_target():
+    _, g = _graph_of(
+        "import threading\n"
+        "def worker():\n"
+        "    pass\n"
+        "t = threading.Thread(target=worker)\n"
+    )
+    assert ("worker", "thread") in {(q, k) for q, k, _ in g.thread_entries}
+
+
+def test_thread_entry_http_handler_methods():
+    _, g = _graph_of(
+        "from http.server import BaseHTTPRequestHandler\n"
+        "class H(BaseHTTPRequestHandler):\n"
+        "    def do_GET(self):\n"
+        "        pass\n"
+        "    def _helper(self):\n"
+        "        pass\n"
+    )
+    kinds = {(q, k) for q, k, _ in g.thread_entries}
+    assert ("H.do_GET", "handler") in kinds and ("H._helper", "handler") in kinds
+
+
+def test_thread_entry_flag_listener():
+    _, g = _graph_of(
+        "from paddle_tpu.flags import GLOBAL_FLAGS\n"
+        "def _refresh(value):\n"
+        "    pass\n"
+        "GLOBAL_FLAGS.on_change('enable_metrics', _refresh)\n"
+    )
+    assert ("_refresh", "listener") in {(q, k) for q, k, _ in g.thread_entries}
+
+
+def test_jit_wrapper_conditional_donate_argnums_resolves():
+    """The engine's `(1,) if donate else ()` idiom yields position 1."""
+    _, g = _graph_of(
+        "import jax\n"
+        "class E:\n"
+        "    def __init__(self, impl, donate):\n"
+        "        self._fn = jax.jit(impl, donate_argnums=(1,) if donate else ())\n"
+    )
+    w = g.jit_wrappers[("E", "self._fn")]
+    assert w.donated == frozenset({1})
+
+
+def test_package_index_memoizes_per_module_graphs():
+    import ast as _ast
+
+    from paddle_tpu.analysis.dataflow import PackageIndex
+
+    idx = PackageIndex()
+    tree = _ast.parse("def f():\n    pass\n")
+    idx.add_module("a.py", tree)
+    idx.add_module("a.py", tree)
+    idx.add_module("a.py", tree)
+    assert idx.build_count == 1
+
+
+# -- CC: concurrency ---------------------------------------------------------
+
+_CC_THREADED_CLASS = (
+    "import threading\n"
+    "class Server:\n"
+    "    def __init__(self):\n"
+    "        self._lock = threading.Lock()\n"
+    "        self._jobs = {}\n"
+    "        self._t = threading.Thread(target=self._run)\n"
+    "    def _run(self):\n"
+    "        while True:\n"
+    "            with self._lock:\n"
+    "                self._jobs['x'] = 1\n"
+)
+
+
+def test_cc701_unguarded_read_of_guarded_field():
+    src = _CC_THREADED_CLASS + (
+        "    def peek(self):\n"
+        "        return self._jobs.get('x')\n"
+    )
+    assert "CC701" in codes(src)
+
+
+def test_cc701_negative_all_accesses_locked():
+    src = _CC_THREADED_CLASS + (
+        "    def peek(self):\n"
+        "        with self._lock:\n"
+        "            return self._jobs.get('x')\n"
+    )
+    assert codes(src) == []
+
+
+def test_cc701_negative_helper_inherits_lock_from_call_sites():
+    """A helper whose every call site holds the lock is effectively locked
+    (interprocedural fixpoint) — the frontend's submit->_tenant_label shape."""
+    src = _CC_THREADED_CLASS + (
+        "    def _peek_locked(self):\n"
+        "        return self._jobs.get('x')\n"
+        "    def peek(self):\n"
+        "        with self._lock:\n"
+        "            return self._peek_locked()\n"
+    )
+    assert codes(src) == []
+
+
+def test_cc701_negative_no_thread_seam_means_silence():
+    """A lock-owning class with no thread entry anywhere never fires —
+    single-threaded code with a vestigial lock is not a race."""
+    src = (
+        "import threading\n"
+        "class Quiet:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._jobs = {}\n"
+        "    def put(self):\n"
+        "        with self._lock:\n"
+        "            self._jobs['x'] = 1\n"
+        "    def peek(self):\n"
+        "        return self._jobs.get('x')\n"
+    )
+    assert codes(src) == []
+
+
+def test_cc701_negative_sync_primitive_fields_exempt():
+    src = _CC_THREADED_CLASS + (
+        "    def wait(self):\n"
+        "        self._evt = threading.Event()\n"
+        "        self._evt.wait(1.0)\n"
+    )
+    assert codes(src) == []
+
+
+def test_cc702_inverted_lock_order():
+    src = (
+        "import threading\n"
+        "class A:\n"
+        "    def __init__(self):\n"
+        "        self._la = threading.Lock()\n"
+        "        self._lb = threading.Lock()\n"
+        "        threading.Thread(target=self.f1).start()\n"
+        "    def f1(self):\n"
+        "        with self._la:\n"
+        "            with self._lb:\n"
+        "                pass\n"
+        "    def f2(self):\n"
+        "        with self._lb:\n"
+        "            with self._la:\n"
+        "                pass\n"
+    )
+    assert "CC702" in codes(src)
+
+
+def test_cc702_negative_consistent_order():
+    src = (
+        "import threading\n"
+        "class A:\n"
+        "    def __init__(self):\n"
+        "        self._la = threading.Lock()\n"
+        "        self._lb = threading.Lock()\n"
+        "        threading.Thread(target=self.f1).start()\n"
+        "    def f1(self):\n"
+        "        with self._la:\n"
+        "            with self._lb:\n"
+        "                pass\n"
+        "    def f2(self):\n"
+        "        with self._la:\n"
+        "            with self._lb:\n"
+        "                pass\n"
+    )
+    assert codes(src) == []
+
+
+def test_cc702_interprocedural_through_call_edge():
+    """f2 holds lb and calls g which takes la — inverted vs f1's la->lb."""
+    src = (
+        "import threading\n"
+        "class A:\n"
+        "    def __init__(self):\n"
+        "        self._la = threading.Lock()\n"
+        "        self._lb = threading.Lock()\n"
+        "        threading.Thread(target=self.f1).start()\n"
+        "    def f1(self):\n"
+        "        with self._la:\n"
+        "            with self._lb:\n"
+        "                pass\n"
+        "    def g(self):\n"
+        "        with self._la:\n"
+        "            pass\n"
+        "    def f2(self):\n"
+        "        with self._lb:\n"
+        "            self.g()\n"
+    )
+    assert "CC702" in codes(src)
+
+
+def test_cc703_iteration_outside_lock():
+    src = _CC_THREADED_CLASS + (
+        "    def snapshot(self):\n"
+        "        return list(self._jobs)\n"
+    )
+    assert "CC703" in codes(src)
+
+
+def test_cc703_negative_iteration_under_lock():
+    src = _CC_THREADED_CLASS + (
+        "    def snapshot(self):\n"
+        "        with self._lock:\n"
+        "            return list(self._jobs)\n"
+    )
+    assert codes(src) == []
+
+
+_CC704_HOT_LOOP = (
+    "from paddle_tpu.flags import GLOBAL_FLAGS\n"
+    "def dispatch(x):\n"
+    "    if GLOBAL_FLAGS.get('check_nan_inf'):\n"
+    "        scan(x)\n"
+    "    return x\n"
+    "def run(xs):\n"
+    "    out = []\n"
+    "    for x in xs:\n"
+    "        out.append(dispatch(x))\n"
+    "    return out\n"
+)
+
+
+def test_cc704_reverted_nan_check_shape_is_flagged():
+    """Regression fixture: the pre-PR3 core/dispatch.py shape — a registry
+    read inside a function the call graph reaches from a loop. FD302 could
+    not see this (no syntactic loop around the read); the interprocedural
+    pass can."""
+    assert "CC704" in codes(_CC704_HOT_LOOP, hot_path=True)
+
+
+def test_cc704_negative_outside_hot_path_modules():
+    assert codes(_CC704_HOT_LOOP, hot_path=False) == []
+
+
+def test_cc704_negative_unreachable_from_any_loop():
+    src = (
+        "from paddle_tpu.flags import GLOBAL_FLAGS\n"
+        "def configure():\n"
+        "    return GLOBAL_FLAGS.get('check_nan_inf')\n"
+    )
+    assert codes(src, hot_path=True) == []
+
+
+def test_cc704_current_dispatch_module_is_clean():
+    """The fixed core/dispatch.py (_NAN_CHECK cached locals) stays clean."""
+    vs = analyze_paths([str(PKG / "core" / "dispatch.py")], select=["CC704"])
+    assert [v for v in vs if not v.suppressed] == []
+
+
+# -- DN: donation / buffer lifetime ------------------------------------------
+
+_DN_ENGINE_HEADER = (
+    "import jax\n"
+    "import jax.numpy as jnp\n"
+    "import numpy as np\n"
+    "class Eng:\n"
+    "    def __init__(self, impl):\n"
+    "        self._fn = jax.jit(impl, donate_argnums=(1,))\n"
+    "        self._state = init()\n"
+    "        self._ntok = np.zeros((4,), np.int32)\n"
+    "        self._last_tok = np.zeros((4,), np.int32)\n"
+)
+
+
+def test_dn801_read_after_donate():
+    src = _DN_ENGINE_HEADER + (
+        "    def step(self, x):\n"
+        "        out, new_state = self._fn(x, self._state)\n"
+        "        y = self._state.sum()\n"
+        "        self._state = new_state\n"
+        "        return out, y\n"
+    )
+    assert "DN801" in codes(src)
+
+
+def test_dn801_negative_donate_and_rebind_same_statement():
+    src = _DN_ENGINE_HEADER + (
+        "    def step(self, x):\n"
+        "        out, self._state = self._fn(x, self._state)\n"
+        "        return out\n"
+    )
+    assert codes(src) == []
+
+
+def test_dn801_mutation_after_donate():
+    src = _DN_ENGINE_HEADER + (
+        "    def step(self, x):\n"
+        "        out, new_state = self._fn(x, self._state)\n"
+        "        self._state[0] = 0\n"
+        "        return out\n"
+    )
+    assert "DN801" in codes(src)
+
+
+def test_dn801_negative_read_in_untaken_branch_arm():
+    """A donate in the `if` arm must not taint the sibling `else` arm."""
+    src = _DN_ENGINE_HEADER + (
+        "    def step(self, x, fast):\n"
+        "        if fast:\n"
+        "            out, self._state = self._fn(x, self._state)\n"
+        "        else:\n"
+        "            out = slow(x, self._state)\n"
+        "        return out\n"
+    )
+    assert codes(src) == []
+
+
+def test_dn802_replay_race_minimized_pr6_replica():
+    """The PR 6 recovery-replay race, minimized: host vectors handed to the
+    decode dispatch WITHOUT .copy(), then mutated in the same loop body —
+    replay never syncs (the emitted tokens are discarded), so the async
+    dispatch still aliases the numpy memory being mutated."""
+    src = _DN_ENGINE_HEADER + (
+        "    def replay(self, tables, depth):\n"
+        "        for r in range(depth):\n"
+        "            lens = jnp.asarray(self._ntok)\n"
+        "            toks = jnp.asarray(self._last_tok)\n"
+        "            _nxt, self._state = self._fn(toks, self._state, lens)\n"
+        "            for i in range(4):\n"
+        "                self._ntok[i] += 1\n"
+        "                self._last_tok[i] = 7\n"
+    )
+    found = codes(src)
+    assert "DN802" in found, found
+
+
+def test_dn802_negative_snapshot_copy_is_the_fix():
+    """jnp.asarray(buf.copy()) — the exact PR 6 fix shape — is clean."""
+    src = _DN_ENGINE_HEADER + (
+        "    def replay(self, tables, depth):\n"
+        "        for r in range(depth):\n"
+        "            lens = jnp.asarray(self._ntok.copy())\n"
+        "            toks = jnp.asarray(self._last_tok.copy())\n"
+        "            _nxt, self._state = self._fn(toks, self._state, lens)\n"
+        "            for i in range(4):\n"
+        "                self._ntok[i] += 1\n"
+        "                self._last_tok[i] = 7\n"
+    )
+    assert codes(src) == []
+
+
+def test_dn802_negative_sync_point_before_mutation():
+    """The normal step path: np.asarray(result) syncs before the host-side
+    vectors are mutated — exactly why step() is safe without copies."""
+    src = _DN_ENGINE_HEADER + (
+        "    def step(self):\n"
+        "        lens = jnp.asarray(self._ntok)\n"
+        "        nxt, self._state = self._fn(jnp.asarray(self._last_tok), self._state, lens)\n"
+        "        nxt = np.asarray(nxt)\n"
+        "        self._ntok[0] += 1\n"
+        "        self._last_tok[0] = int(nxt[0])\n"
+    )
+    assert codes(src) == []
+
+
+def test_dn803_record_between_dispatch_and_commit():
+    src = (
+        "import jax\n"
+        "from paddle_tpu.observability.recompile import GLOBAL_WATCHDOG\n"
+        "class SF:\n"
+        "    def __init__(self, impl):\n"
+        "        self._fn = jax.jit(impl, donate_argnums=(1,))\n"
+        "        self._state = init()\n"
+        "    def __call__(self, x):\n"
+        "        out, new_state = self._fn(x, self._state)\n"
+        "        GLOBAL_WATCHDOG.record_compile('sf', signature='x')\n"
+        "        self._state = new_state\n"
+        "        return out\n"
+    )
+    assert "DN803" in codes(src)
+
+
+def test_dn_local_wrapper_name_does_not_leak_across_functions():
+    """A bare-name jit wrapper bound INSIDE one function must not make a
+    same-named local in another function look like a donating dispatch
+    (review repro: `step` in build() vs a plain callable `step` elsewhere)."""
+    src = (
+        "import jax\n"
+        "def build(impl):\n"
+        "    step = jax.jit(impl, donate_argnums=(1,))\n"
+        "    return step\n"
+        "def other(x, state, make_plain):\n"
+        "    step = make_plain()\n"
+        "    out = step(x, state)\n"
+        "    y = state.sum()\n"
+        "    return out, y\n"
+    )
+    assert codes(src) == []
+
+
+def test_dn_module_level_wrapper_applies_module_wide():
+    src = (
+        "import jax\n"
+        "_step = jax.jit(impl, donate_argnums=(1,))\n"
+        "def use(x, state):\n"
+        "    out, new_state = _step(x, state)\n"
+        "    y = state.sum()\n"
+        "    return out, y\n"
+    )
+    assert "DN801" in codes(src)
+
+
+def test_dn_rebound_wrapper_name_stops_donating():
+    """Rebinding the wrapper name to a plain callable kills its donation
+    semantics for the rest of the function."""
+    src = (
+        "import jax\n"
+        "def use(x, state, plain):\n"
+        "    step = jax.jit(impl, donate_argnums=(1,))\n"
+        "    step = plain\n"
+        "    out = step(x, state)\n"
+        "    y = state.sum()\n"
+        "    return out, y\n"
+    )
+    assert codes(src) == []
+
+
+def test_dn803_negative_record_after_commit():
+    src = (
+        "import jax\n"
+        "from paddle_tpu.observability.recompile import GLOBAL_WATCHDOG\n"
+        "class SF:\n"
+        "    def __init__(self, impl):\n"
+        "        self._fn = jax.jit(impl, donate_argnums=(1,))\n"
+        "        self._state = init()\n"
+        "    def __call__(self, x):\n"
+        "        out, new_state = self._fn(x, self._state)\n"
+        "        self._state = new_state\n"
+        "        GLOBAL_WATCHDOG.record_compile('sf', signature='x')\n"
+        "        return out\n"
+    )
+    assert codes(src) == []
+
+
+def test_dn_engine_module_is_clean():
+    """inference/engine.py (donate-and-rebind + snapshot-copy replay + sync
+    before mutation) passes the DN family as written."""
+    vs = analyze_paths([str(PKG / "inference" / "engine.py")], select=["DN"])
+    assert [v for v in vs if not v.suppressed] == []
+
+
+# -- SARIF + baseline ---------------------------------------------------------
+
+def test_sarif_output_shape_and_rule_ids():
+    from paddle_tpu.analysis import all_codes as _codes
+    from paddle_tpu.analysis.reporters import render_sarif
+
+    vs = analyze_source(
+        "try:\n"
+        "    f()\n"
+        "except:\n"
+        "    pass\n"
+        "try:\n"
+        "    g()\n"
+        "except:  # analysis: disable=EH401 fixture accepts this one\n"
+        "    pass\n"
+    )
+    doc = json.loads(render_sarif(vs, _codes()))
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    rules = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert "EH401" in rules and "CC701" in rules and "DN802" in rules
+    results = run["results"]
+    live = [r for r in results if "suppressions" not in r]
+    sup = [r for r in results if "suppressions" in r]
+    assert len(live) >= 1 and len(sup) == 1
+    assert sup[0]["suppressions"][0]["justification"] == "fixture accepts this one"
+    loc = live[0]["locations"][0]["physicalLocation"]
+    assert loc["region"]["startLine"] >= 1 and loc["region"]["startColumn"] >= 1
+
+
+def test_baseline_accepts_known_and_catches_new(tmp_path):
+    from paddle_tpu.analysis.reporters import (
+        load_baseline,
+        new_violations,
+        write_baseline,
+    )
+
+    one = analyze_source("try:\n    f()\nexcept:\n    pass\n")
+    base = tmp_path / "base.json"
+    write_baseline(str(base), one)
+    known = load_baseline(str(base))
+    # same findings: nothing new
+    assert new_violations(one, known) == []
+    # a second bare except in the same file is NEW (count-based fingerprints)
+    two = analyze_source(
+        "try:\n    f()\nexcept:\n    pass\n"
+        "try:\n    g()\nexcept:\n    pass\n"
+    )
+    fresh = new_violations(two, known)
+    assert len(fresh) == 1 and fresh[0].code in ("EH401",)
+
+
+def test_baseline_rejects_wrong_shape(tmp_path):
+    from paddle_tpu.analysis.reporters import load_baseline
+
+    bad = tmp_path / "bad.json"
+    bad.write_text('{"findings": {"a": 1}}')
+    with pytest.raises(ValueError):
+        load_baseline(str(bad))
+
+
+def test_cli_sarif_and_baseline_gate(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("try:\n    f()\nexcept:\n    pass\n")
+    r = _run_cli(["--format", "sarif", str(bad)])
+    assert r.returncode == 1
+    assert json.loads(r.stdout)["version"] == "2.1.0"
+    base = tmp_path / "base.json"
+    r = _run_cli(["--write-baseline", str(base), str(bad)])
+    assert r.returncode == 0 and base.exists()
+    # baselined: the known finding no longer gates
+    r = _run_cli(["--baseline", str(base), str(bad)])
+    assert r.returncode == 0
+    # a NEW finding past the baseline count gates again
+    bad.write_text(
+        "try:\n    f()\nexcept:\n    pass\n"
+        "try:\n    g()\nexcept:\n    pass\n"
+    )
+    r = _run_cli(["--baseline", str(base), str(bad)])
+    assert r.returncode == 1
+    # a corrupt baseline must not turn the gate vacuous
+    base.write_text("not json")
+    r = _run_cli(["--baseline", str(base), str(bad)])
+    assert r.returncode == 2
+
+
+# -- CI perf gate: one memoized dataflow pass, bounded wall time --------------
+
+def test_analyzer_wall_time_and_single_dataflow_pass():
+    """The tier-1 gate runs every checker family over the whole package; the
+    dataflow graphs must be built once per module (memoized in the
+    PackageIndex) and the whole run must stay under 30 s."""
+    import time as _time
+
+    from paddle_tpu.analysis import dataflow as _df
+
+    builds = {"n": 0}
+    orig = _df.ModuleGraph._build
+
+    def counting_build(self):
+        builds["n"] += 1
+        return orig(self)
+
+    _df.ModuleGraph._build = counting_build
+    try:
+        t0 = _time.perf_counter()
+        vs = analyze_paths([str(PKG)])
+        dt = _time.perf_counter() - t0
+    finally:
+        _df.ModuleGraph._build = orig
+    n_modules = len(list(PKG.rglob("*.py")))
+    assert builds["n"] <= n_modules, (
+        f"dataflow graphs rebuilt: {builds['n']} builds for {n_modules} modules"
+    )
+    assert dt < 30.0, f"whole-package analysis took {dt:.1f}s (budget 30s)"
+    assert isinstance(vs, list)
+
+
 # -- the tier-1 gate: the package must analyze clean -------------------------
 
 def test_whole_package_clean():
