@@ -399,6 +399,7 @@ def _main_timed(platform, paddle, cfg, batch, seq, steps, warmup) -> None:
         _bench_int8_decode(paddle, platform),
         _bench_paged_decode(paddle, platform),
         _bench_engine_decode(paddle, platform),
+        _bench_shared_prefix_ttft(paddle, platform),
         _bench_engine_fault_recovery(paddle, platform),
         _bench_serving_goodput(paddle, platform),
         _bench_traced_request_breakdown(paddle, platform),
@@ -633,9 +634,9 @@ def _bench_paged_decode(paddle, platform: str) -> dict:
 
 def _bench_engine_decode(paddle, platform: str) -> dict:
     """Continuous-batching decode throughput: a mixed-length request stream
-    through the two-signature engine (``inference.ContinuousBatchingEngine``)
+    through the one-signature engine (``inference.ContinuousBatchingEngine``)
     — generated tokens/sec with slots refilled as sequences finish. The
-    compiled-signature count rides along as an honesty check: > 2 means the
+    compiled-signature count rides along as an honesty check: > 1 means the
     engine retraced mid-serve and the number is measuring compiles. Runs with
     FLAGS_enable_metrics on, so the record carries the observability snapshot
     (TTFT/decode-latency percentiles, pool-utilization high-water, and the
@@ -684,9 +685,9 @@ def _bench_engine_decode(paddle, platform: str) -> dict:
                     max_new_tokens=int(rng.integers(max_new // 2, max_new + 1)),
                 )
 
-        submit(2)  # warmup: compiles the prefill + decode signatures
+        submit(2)  # warmup: compiles the unified step signature
         engine.run()
-        # keep the watchdog ledger (warmup compiles ARE the two signatures;
+        # keep the watchdog ledger (the warmup compile IS the signature;
         # any compile past them is the retrace the honesty check exists for)
         # but zero the latency/pool metrics so percentiles cover only the
         # timed window
@@ -738,11 +739,137 @@ def _bench_engine_decode(paddle, platform: str) -> dict:
         paddle.set_flags(prior_flags)
 
 
+def _bench_shared_prefix_ttft(paddle, platform: str) -> dict:
+    """Prefix-cache acceptance bench (guarded): N requests share a long
+    system prompt. Cold phase computes it once; the warm phase must MAP it
+    (content-hash block dedup) instead of recomputing — warm TTFT below cold
+    TTFT, hit rate > 0, and the prefill token-compute counter showing the
+    shared prefix computed exactly once across all N requests. The 1-compile
+    watchdog count rides along as the chunked-prefill honesty check."""
+    from paddle_tpu import observability as obs
+    from paddle_tpu.inference import ContinuousBatchingEngine
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    prior = paddle.get_flags(
+        ["FLAGS_enable_metrics", "FLAGS_enable_prefix_cache"]
+    )
+    try:
+        if platform == "tpu":
+            cfg = LlamaConfig(
+                vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+                num_hidden_layers=8, num_attention_heads=16,
+                num_key_value_heads=16, max_position_embeddings=1024,
+            )
+            slots, bs, bucket, n_warm, shared_len, tail, max_new = (
+                8, 16, 256, 12, 192, 16, 16
+            )
+        else:
+            cfg = LlamaConfig.tiny()
+            slots, bs, bucket, n_warm, shared_len, tail, max_new = (
+                2, 4, 32, 4, 20, 3, 4
+            )
+        paddle.set_flags(
+            {"FLAGS_enable_metrics": True, "FLAGS_enable_prefix_cache": True}
+        )
+        obs.GLOBAL_METRICS.reset()
+        obs.GLOBAL_WATCHDOG.reset()
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg)
+        if platform == "tpu":
+            model = model.to(dtype="bfloat16")
+        model.eval()
+        engine = ContinuousBatchingEngine(
+            model, max_slots=slots, block_size=bs, prompt_bucket=bucket
+        )
+        rng = np.random.default_rng(7)
+        system_prompt = rng.integers(0, cfg.vocab_size, (shared_len,)).astype(np.int32)
+
+        def submit_one():
+            user = rng.integers(0, cfg.vocab_size, (tail,)).astype(np.int32)
+            return engine.add_request(
+                np.concatenate([system_prompt, user]), max_new_tokens=max_new
+            )
+
+        def ttfts(out):
+            return sorted(
+                r.admit_time - r.arrival_time for r in out.values()
+            )
+
+        # cold: ONE request computes the shared prefix (plus the engine's
+        # one compile — excluded from timing by a throwaway warmup first)
+        engine.add_request(
+            rng.integers(0, cfg.vocab_size, (4,)).astype(np.int32),
+            max_new_tokens=2,
+        )
+        engine.run()
+        computed_before = engine.stats["prompt_tokens_computed"]
+        submit_one()
+        cold_out = engine.run()
+        cold_ttft = ttfts(cold_out)
+        cold_prefix_computed = (
+            engine.stats["prompt_tokens_computed"] - computed_before
+        )
+
+        # warm: N requests repeat the system prompt with distinct tails
+        computed_before = engine.stats["prompt_tokens_computed"]
+        for _ in range(n_warm):
+            submit_one()
+        warm_out = engine.run()
+        warm_ttft = ttfts(warm_out)
+        warm_computed = engine.stats["prompt_tokens_computed"] - computed_before
+
+        cache = engine.prefix_cache_stats()
+        wd = {
+            fn: rec["count"]
+            for fn, rec in obs.GLOBAL_WATCHDOG.report().items()
+            if fn.startswith("ContinuousBatchingEngine.")
+        }
+        # the shared prefix's full blocks were computed exactly once (by the
+        # cold request); warm requests computed only tails + ragged ends
+        shared_full = (shared_len // bs) * bs
+        per_warm_computed = warm_computed / n_warm
+
+        def pct(sorted_vals, q):
+            if not sorted_vals:
+                return 0.0
+            i = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
+            return sorted_vals[i]
+
+        return {
+            "metric": "shared_prefix_ttft_speedup",
+            "value": round(
+                pct(cold_ttft, 0.5) / max(pct(warm_ttft, 0.5), 1e-9), 3
+            ),
+            "unit": "x (cold TTFT p50 / warm TTFT p50)",
+            "cold_ttft_ms": {"p50": round(pct(cold_ttft, 0.5) * 1e3, 3),
+                             "p99": round(pct(cold_ttft, 0.99) * 1e3, 3)},
+            "warm_ttft_ms": {"p50": round(pct(warm_ttft, 0.5) * 1e3, 3),
+                             "p99": round(pct(warm_ttft, 0.99) * 1e3, 3)},
+            "shared_prefix_tokens": int(shared_len),
+            "warm_requests": n_warm,
+            "hit_rate": round(cache["hit_rate"], 4),
+            "tokens_reused": cache["tokens_reused"],
+            "bytes_saved": cache["bytes_saved"],
+            "cow_forks": cache["cow_forks"],
+            "prefix_computed_once": bool(
+                cold_prefix_computed >= shared_full
+                and per_warm_computed <= (shared_len - shared_full) + tail + bs
+            ),
+            "prompt_tokens_computed_per_warm_request": round(per_warm_computed, 2),
+            # honesty check: chunked prefill + cache hits through ONE program
+            "compiled_signatures": sum(wd.values()),
+        }
+    except Exception as exc:  # noqa: BLE001 - secondary must never kill primary
+        return {"metric": "shared_prefix_ttft_speedup", "error": f"{exc!r}"[:300]}
+    finally:
+        paddle.set_flags(prior)
+
+
 def _bench_engine_fault_recovery(paddle, platform: str) -> dict:
     """Fault-injection smoke (guarded): one injected decode-step fault
     mid-workload; the engine must recover — reallocate the KV pools, replay
     every live request from host truth — and finish the whole workload
-    through the SAME two compiled programs. Records the recovered decode
+    through the SAME compiled program. Records the recovered decode
     throughput and the recovery counters, so a fault-tolerance regression
     shows up in BENCH_r*.json, not just in tier-1."""
     from paddle_tpu import observability as obs
@@ -781,8 +908,8 @@ def _bench_engine_fault_recovery(paddle, platform: str) -> dict:
                 rng.integers(0, cfg.vocab_size, (plen,)).astype(np.int32),
                 max_new_tokens=int(rng.integers(max_new // 2, max_new + 1)),
             )
-        # the fault lands mid-workload (a few decode dispatches in), after
-        # both signatures compiled — the recovery itself is what's timed
+        # the fault lands mid-workload (a few dispatches in), after the
+        # signature compiled — the recovery itself is what's timed
         plan = faults.FaultPlan.single("engine.decode", call_index=3)
         t0 = time.perf_counter()
         with faults.inject(plan):
@@ -805,7 +932,7 @@ def _bench_engine_fault_recovery(paddle, platform: str) -> dict:
             "faults_injected": int(reg.get("faults_injected_total").total()),
             "recoveries": int(reg.get("engine_recoveries_total").value()),
             "requests_replayed": int(reg.get("engine_requests_replayed_total").value()),
-            # honesty check: recovery must REUSE the two compiled programs
+            # honesty check: recovery must REUSE the one compiled program
             "compiled_signatures": sum(wd.values()),
         }
     except Exception as exc:  # noqa: BLE001 - secondary must never kill primary
@@ -991,10 +1118,9 @@ def _bench_traced_request_breakdown(paddle, platform: str) -> dict:
             ],
             "requests": n_req,
             # honesty check: tracing must add ZERO compiled signatures —
-            # still exactly one prefill + one decode program
+            # still exactly one unified prefill/decode program
             "compiled_signatures": {
-                "prefill": compiles.get("ContinuousBatchingEngine.prefill", 0),
-                "decode": compiles.get("ContinuousBatchingEngine.decode", 0),
+                "step": compiles.get("ContinuousBatchingEngine.step", 0),
             },
         }
     except Exception as exc:  # noqa: BLE001 - secondary must never kill primary

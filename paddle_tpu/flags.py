@@ -151,6 +151,10 @@ def _define_builtin_flags() -> None:
     # serving front end (paddle_tpu/serving/): same opt-in localhost pattern
     # as metrics_port — nothing listens unless asked
     d("serving_port", int, 0, "Serve the streaming generation HTTP endpoint (serving.start_serving_server) on this localhost port; 0 disables the endpoint.")
+    # prefix-cache KV subsystem (inference/prefix_cache.py): content-hash
+    # block dedup with copy-on-write over the paged pool; read at engine
+    # construction (per-engine override via the enable_prefix_cache kwarg)
+    d("enable_prefix_cache", bool, True, "Reference-counted content-hash KV block dedup for the continuous-batching engine: shared prompt prefixes are computed once and mapped copy-on-write into every request that repeats them; off = every prompt recomputes from token zero.")
 
 
 _define_builtin_flags()

@@ -28,8 +28,11 @@ __all__ = [
     "fused_dropout_add",
     "masked_multihead_attention",
     "block_multihead_attention",
+    "block_multihead_chunk_attention",
     "block_cache_prefill",
     "block_cache_append",
+    "block_cache_append_chunk",
+    "block_cache_cow_copy",
     "BlockKVCache",
     "fused_moe",
 ]
@@ -37,8 +40,11 @@ __all__ = [
 from paddle_tpu.incubate.nn.functional.block_attention import (  # noqa: E402,F401
     BlockKVCache,
     block_cache_append,
+    block_cache_append_chunk,
+    block_cache_cow_copy,
     block_cache_prefill,
     block_multihead_attention,
+    block_multihead_chunk_attention,
 )
 from paddle_tpu.incubate.nn.functional.fused_moe import fused_moe  # noqa: E402,F401
 

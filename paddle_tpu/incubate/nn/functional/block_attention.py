@@ -19,7 +19,8 @@ the reference where block tables are produced by the serving scheduler.
 
 from __future__ import annotations
 
-from typing import Any, List, Optional, Sequence, Tuple
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -30,14 +31,34 @@ from paddle_tpu.testing.faults import fault_point as _fault_point
 __all__ = [
     "BlockKVCache",
     "block_multihead_attention",
+    "block_multihead_chunk_attention",
     "block_cache_prefill",
     "block_cache_append",
+    "block_cache_append_chunk",
+    "block_cache_cow_copy",
 ]
 
 
 class BlockKVCache:
     """Host-side paged-cache manager: physical block pool + per-sequence block
-    tables (reference: the serving scheduler that feeds ``block_tables``)."""
+    tables (reference: the serving scheduler that feeds ``block_tables``).
+
+    Two allocation surfaces share the one physical free list:
+
+    - the historical per-sequence table API (``allocate``/``free``/
+      ``block_table``) used by ``generate_paged``, where a sequence owns its
+      blocks exclusively; and
+    - a reference-counted per-block API (``acquire_block``/``incref``/
+      ``decref``) used by the prefix-cache layer
+      (``inference/prefix_cache.py``), where one physical block may be mapped
+      by many requests' block tables at once and is returned to the free list
+      only when the last owner drops it.
+
+    All accounting is guarded by one internal lock: the serving front end
+    pumps the engine from a daemon thread while intake threads size requests
+    against ``free_blocks``, so the pool's counters must never be read
+    mid-update.
+    """
 
     def __init__(
         self,
@@ -60,9 +81,11 @@ class BlockKVCache:
         # never pay this HBM
         self._key_cache = None
         self._value_cache = None
+        self._lock = threading.Lock()
         self._free: List[int] = list(range(num_blocks - 1, -1, -1))
         self._tables: dict = {}  # seq id -> list of physical block ids
         self._lens: dict = {}  # seq id -> tokens stored
+        self._ref: Dict[int, int] = {}  # block id -> refcount (refcounted API)
 
     @property
     def key_cache(self) -> Any:
@@ -88,63 +111,121 @@ class BlockKVCache:
     def allocate(self, seq_id: int, num_tokens: int) -> None:
         """Ensure ``seq_id`` has blocks for ``num_tokens`` more tokens."""
         _fault_point("block_pool.allocate")
-        table = self._tables.setdefault(seq_id, [])
-        cur = self._lens.get(seq_id, 0)
-        need_blocks = -(-(cur + num_tokens) // self.block_size)
-        while len(table) < need_blocks:
-            if not self._free:
-                raise MemoryError("paged KV cache out of physical blocks")
-            if len(table) >= self.max_blocks_per_seq:
-                raise MemoryError(
-                    f"sequence {seq_id} exceeds max_blocks_per_seq={self.max_blocks_per_seq}"
-                )
-            table.append(self._free.pop())
-        self._lens[seq_id] = cur + num_tokens
+        with self._lock:
+            table = self._tables.setdefault(seq_id, [])
+            cur = self._lens.get(seq_id, 0)
+            need_blocks = -(-(cur + num_tokens) // self.block_size)
+            while len(table) < need_blocks:
+                if not self._free:
+                    raise MemoryError("paged KV cache out of physical blocks")
+                if len(table) >= self.max_blocks_per_seq:
+                    raise MemoryError(
+                        f"sequence {seq_id} exceeds max_blocks_per_seq={self.max_blocks_per_seq}"
+                    )
+                table.append(self._free.pop())
+            self._lens[seq_id] = cur + num_tokens
 
     def free(self, seq_id: int) -> None:
         """Return a finished sequence's blocks to the pool."""
-        for b in self._tables.pop(seq_id, []):
-            self._free.append(b)
-        self._lens.pop(seq_id, None)
+        with self._lock:
+            for b in self._tables.pop(seq_id, []):
+                self._free.append(b)
+            self._lens.pop(seq_id, None)
 
     def truncate(self, seq_id: int, num_tokens: int) -> None:
         """Roll ``seq_id`` back to ``num_tokens`` stored tokens, returning
         now-unused tail blocks to the pool — the undo for a speculative or
         failed step whose ``allocate`` already ran."""
-        table = self._tables.get(seq_id)
-        if table is None:
-            return
-        keep = -(-num_tokens // self.block_size) if num_tokens > 0 else 0
-        while len(table) > keep:
-            self._free.append(table.pop())
-        self._lens[seq_id] = num_tokens
+        with self._lock:
+            table = self._tables.get(seq_id)
+            if table is None:
+                return
+            keep = -(-num_tokens // self.block_size) if num_tokens > 0 else 0
+            while len(table) > keep:
+                self._free.append(table.pop())
+            self._lens[seq_id] = num_tokens
 
     def seq_len(self, seq_id: int) -> int:
-        return self._lens.get(seq_id, 0)
+        with self._lock:
+            return self._lens.get(seq_id, 0)
 
     def blocks_allocated(self, seq_id: Optional[int] = None) -> int:
         """Physical blocks held by ``seq_id`` (all sequences when None) —
         the public accounting surface the serving engine's admission math
-        relies on."""
-        if seq_id is not None:
-            return len(self._tables.get(seq_id, ()))
-        return sum(len(t) for t in self._tables.values())
+        relies on. Refcounted blocks (prefix-cache layer) are not attributed
+        to any sequence; use ``num_blocks - free_blocks`` for whole-pool
+        occupancy."""
+        with self._lock:
+            if seq_id is not None:
+                return len(self._tables.get(seq_id, ()))
+            return sum(len(t) for t in self._tables.values())
 
     @property
     def free_blocks(self) -> int:
-        return len(self._free)
+        with self._lock:
+            return len(self._free)
 
     def block_table(self, seq_ids: Sequence[int]) -> jnp.ndarray:
         """Dense ``[B, max_blocks_per_seq]`` table (unused slots point at
         block 0; masking makes them unreachable)."""
         out = np.zeros((len(seq_ids), self.max_blocks_per_seq), np.int32)
-        for i, sid in enumerate(seq_ids):
-            t = self._tables.get(sid, [])
-            out[i, : len(t)] = t
+        with self._lock:
+            for i, sid in enumerate(seq_ids):
+                t = self._tables.get(sid, [])
+                out[i, : len(t)] = t
         return jnp.asarray(out)
 
     def seq_lens(self, seq_ids: Sequence[int]) -> jnp.ndarray:
-        return jnp.asarray([self._lens.get(s, 0) for s in seq_ids], jnp.int32)
+        with self._lock:
+            return jnp.asarray(
+                [self._lens.get(s, 0) for s in seq_ids], jnp.int32
+            )
+
+    # -- refcounted per-block API (prefix-cache layer) -----------------------
+    def acquire_block(self) -> int:
+        """Take one physical block off the free list with refcount 1. The
+        block belongs to the CALLER's accounting (a request's block table or
+        a prefix-cache chain node), not to any ``seq_id`` table."""
+        _fault_point("block_pool.allocate")
+        with self._lock:
+            if not self._free:
+                raise MemoryError("paged KV cache out of physical blocks")
+            blk = self._free.pop()
+            self._ref[blk] = 1
+            return blk
+
+    def incref(self, block: int) -> int:
+        """Add one owner to a refcounted block; returns the new count."""
+        with self._lock:
+            cur = self._ref.get(block)
+            if cur is None:
+                raise ValueError(f"block {block} is not refcount-managed")
+            self._ref[block] = cur + 1
+            return cur + 1
+
+    def decref(self, block: int) -> bool:
+        """Drop one owner; returns True when this freed the block."""
+        with self._lock:
+            cur = self._ref.get(block)
+            if cur is None:
+                raise ValueError(f"block {block} is not refcount-managed")
+            if cur <= 1:
+                del self._ref[block]
+                self._free.append(block)
+                return True
+            self._ref[block] = cur - 1
+            return False
+
+    def refcount(self, block: int) -> int:
+        """Current owner count of a refcounted block (0 if unmanaged)."""
+        with self._lock:
+            return self._ref.get(block, 0)
+
+    def refcounts(self) -> Dict[int, int]:
+        """Snapshot of every refcount-managed block's owner count (for
+        invariant checks; copied under the lock)."""
+        with self._lock:
+            return dict(self._ref)
 
 
 def block_cache_append(
@@ -202,6 +283,188 @@ def block_cache_prefill(
     key_cache = key_cache.at[flat_phys, :, flat_off].set(flat_k, mode="drop")
     value_cache = value_cache.at[flat_phys, :, flat_off].set(flat_v, mode="drop")
     return key_cache, value_cache
+
+
+def block_cache_cow_copy(
+    key_cache: jax.Array,  # [NB, H, BS, D]
+    value_cache: jax.Array,
+    src: jax.Array,  # [B] int32 physical block to fork from
+    dst: jax.Array,  # [B] int32 private destination (== NB: no-op, dropped)
+) -> Tuple[jax.Array, jax.Array]:
+    """Copy-on-write fork: duplicate whole physical blocks ``src`` into
+    ``dst`` so a request that diverges inside a shared (refcounted) block can
+    reuse its cached prefix KV without ever writing to the shared copy.
+
+    The no-fork case is routed through the scatter's ``drop`` mode (``dst ==
+    num_blocks``), so the same compiled program serves steps with and without
+    forks — the fork set is data, never shape. The whole copy is skipped via
+    ``lax.cond`` when no slot forks this step (the overwhelmingly common
+    decode-only step pays one predicate, not a gather/scatter per layer)."""
+    nb = key_cache.shape[0]
+    src = jnp.asarray(src, jnp.int32)
+    dst = jnp.asarray(dst, jnp.int32)
+
+    def _copy(kv):
+        kc, vc = kv
+        kc = kc.at[dst].set(kc[jnp.clip(src, 0, nb - 1)], mode="drop")
+        vc = vc.at[dst].set(vc[jnp.clip(src, 0, nb - 1)], mode="drop")
+        return kc, vc
+
+    return jax.lax.cond(
+        jnp.any(dst < nb), _copy, lambda kv: kv, (key_cache, value_cache)
+    )
+
+
+def block_cache_append_chunk(
+    key_cache: jax.Array,  # [NB, H, BS, D]
+    value_cache: jax.Array,
+    k: jax.Array,  # [B, C, H, D] up to C new tokens per sequence
+    v: jax.Array,
+    block_tables: jax.Array,  # [B, MBS]
+    seq_lens: jax.Array,  # [B] tokens already stored (chunk writes AFTER them)
+    q_lens: jax.Array,  # [B] valid new tokens this step (<= C; 0 = none)
+    slot_mask: Optional[jax.Array] = None,  # [B] bool; False = padded slot
+) -> Tuple[jax.Array, jax.Array]:
+    """Scatter a ragged chunk of new KV per sequence into its physical
+    blocks: token ``j`` of sequence ``b`` lands at logical position
+    ``seq_lens[b] + j``. Rows past ``q_lens`` (and masked-off slots) are
+    routed out of bounds and dropped — a decode row (``q_lens == 1``) and a
+    prompt-chunk row (``q_lens == C``) ride the same scatter."""
+    b, c, h, d = k.shape
+    nb, bs = key_cache.shape[0], key_cache.shape[2]
+    j = jnp.arange(c)[None, :]  # [1, C]
+    pos = seq_lens[:, None] + j  # [B, C] absolute token index
+    valid = j < q_lens[:, None]
+    if slot_mask is not None:
+        valid = valid & slot_mask[:, None]
+    blk_idx = jnp.minimum(pos // bs, block_tables.shape[1] - 1)
+    off = pos % bs
+    phys = jnp.take_along_axis(block_tables, blk_idx, axis=1)  # [B, C]
+    # invalid rows go OUT OF BOUNDS and are dropped by the scatter — clamping
+    # them onto a real block would collide with valid writes (duplicate-index
+    # scatter order is undefined), exactly the block_cache_prefill rule
+    phys = jnp.where(valid, phys, nb)
+    flat_phys = phys.reshape(-1)
+    flat_off = off.reshape(-1)
+    flat_k = k.reshape(b * c, h, d).astype(key_cache.dtype)
+    flat_v = v.reshape(b * c, h, d).astype(value_cache.dtype)
+    key_cache = key_cache.at[flat_phys, :, flat_off].set(flat_k, mode="drop")
+    value_cache = value_cache.at[flat_phys, :, flat_off].set(flat_v, mode="drop")
+    return key_cache, value_cache
+
+
+def _gather_chunk_attend(
+    q: jax.Array,  # [B, C, HQ, D] (C == 1 for a pure decode step)
+    key_cache: jax.Array,
+    value_cache: jax.Array,
+    block_tables: jax.Array,
+    seq_lens: jax.Array,  # [B] tokens cached BEFORE the new rows
+    attend_q: jax.Array,  # [B] valid new rows (0 = masked slot: exact zeros)
+    scale: float,
+) -> jax.Array:
+    """The ONE XLA dense-gather attention fallback shared by the decode and
+    chunked paths: gather each sequence's physical blocks, mask each query
+    row to its causal limit (``seq_lens + j + 1`` for row ``j``), fp32
+    softmax. Rows past ``attend_q`` return exact zeros — lockstep with the
+    Pallas kernels' skip, so slot padding never changes numerics."""
+    b, c, hq, d = q.shape
+    hkv = key_cache.shape[1]
+    # gather each sequence's blocks: [B, MBS, HKV, BS, D] -> [B, L, HKV, D]
+    gk = jnp.moveaxis(key_cache[block_tables], 2, 3)
+    gv = jnp.moveaxis(value_cache[block_tables], 2, 3)
+    mbs, bs = block_tables.shape[1], key_cache.shape[2]
+    L = mbs * bs
+    gk = gk.reshape(b, L, hkv, d)
+    gv = gv.reshape(b, L, hkv, d)
+    if hkv != hq:
+        if hq % hkv != 0:
+            raise ValueError(f"q heads {hq} not a multiple of kv heads {hkv}")
+        rep = hq // hkv
+        gk = jnp.repeat(gk, rep, axis=2)
+        gv = jnp.repeat(gv, rep, axis=2)
+    qf = q.astype(jnp.float32) * scale  # [B, C, HQ, D]
+    scores = jnp.einsum("bchd,blhd->bchl", qf, gk.astype(jnp.float32))
+    pos = jnp.arange(L)[None, None, :]  # [1, 1, L]
+    # query j sees cached history plus the chunk's own tokens 0..j (causal)
+    limit = seq_lens[:, None] + jnp.arange(c)[None, :] + 1  # [B, C]
+    mask = pos < limit[:, :, None]  # [B, C, L]
+    scores = jnp.where(mask[:, :, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bchl,blhd->bchd", probs, gv.astype(jnp.float32))
+    # rows past attend_q (and fully-masked slots) degenerate to a uniform
+    # mean over garbage in softmax — force exact zeros, matching the kernels
+    row_valid = jnp.arange(c)[None, :] < attend_q[:, None]  # [B, C]
+    out = jnp.where(row_valid[:, :, None, None], out, 0.0)
+    return out.astype(q.dtype)
+
+
+def block_multihead_chunk_attention(
+    q: jax.Array,  # [B, C, HQ, D] ragged chunk of new tokens per sequence
+    k: jax.Array,  # [B, C, HKV, D]
+    v: jax.Array,
+    key_cache: jax.Array,  # [NB, HKV, BS, D]
+    value_cache: jax.Array,
+    block_tables: jax.Array,  # [B, MBS] int32
+    seq_lens: jax.Array,  # [B] tokens already cached (EXCLUDING this chunk)
+    q_lens: jax.Array,  # [B] valid new tokens this step (1 = decode row)
+    scale: Optional[float] = None,
+    slot_mask: Optional[jax.Array] = None,  # [B] bool; False = padded slot
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One MIXED prefill/decode step over the paged cache — the chunked-
+    prefill dispatch ("Ragged Paged Attention", arxiv 2604.15464): every
+    batch row carries up to ``C`` new tokens; a decode row has ``q_lens ==
+    1``, a prompt-chunk row up to ``C``. The chunk's KV is appended first, so
+    query token ``j`` (absolute position ``seq_lens + j``) attends over every
+    cached position ``<= seq_lens + j`` — causal within the chunk, full
+    history before it. Rows past ``q_lens`` and masked-off slots return
+    exactly zeros (lockstep with the Pallas kernel's skip).
+
+    Returns ``(out [B, C, HQ, D], key_cache, value_cache)``.
+    """
+    b, c, hq, d = q.shape
+    hkv = k.shape[2]
+    if scale is None:
+        scale = 1.0 / (d**0.5)
+    key_cache, value_cache = block_cache_append_chunk(
+        key_cache, value_cache, k, v, block_tables, seq_lens, q_lens,
+        slot_mask=slot_mask,
+    )
+    attend_q = q_lens
+    if slot_mask is not None:
+        attend_q = jnp.where(slot_mask, attend_q, 0)
+    from paddle_tpu.kernels.select import pallas_enabled, warn_fallback
+
+    if pallas_enabled("use_pallas_paged_attention"):
+        # ragged mixed prefill/decode kernel: one grid walks each sequence's
+        # physical blocks once, serving its decode row and its prompt-chunk
+        # rows alike; applicability is probed host-side at trace time (a
+        # Mosaic error inside the jitted step is uncatchable at run time)
+        from paddle_tpu.kernels.paged_attention import (
+            chunk_lowering_supported,
+            paged_flash_chunk,
+        )
+
+        nb, hkv_c, bs, d_c = key_cache.shape
+        if chunk_lowering_supported(
+            b, c, hq, hkv_c, d_c, nb, bs, block_tables.shape[1], str(q.dtype)
+        ):
+            try:
+                out = paged_flash_chunk(
+                    q, key_cache, value_cache, block_tables,
+                    seq_lens, attend_q, scale=scale,
+                )
+                return out, key_cache, value_cache
+            except Exception as exc:  # noqa: BLE001 - XLA fallback below
+                warn_fallback("paged_flash_chunk", exc)
+        else:
+            warn_fallback(
+                "paged_flash_chunk",
+                RuntimeError("Mosaic lowering unsupported for geometry"),
+            )
+    out = _gather_chunk_attend(
+        q, key_cache, value_cache, block_tables, seq_lens, attend_q, scale
+    )
+    return out, key_cache, value_cache
 
 
 def block_multihead_attention(
@@ -267,28 +530,10 @@ def block_multihead_attention(
             warn_fallback(
                 "paged_flash_decode", RuntimeError("Mosaic lowering unsupported for geometry")
             )
-    # gather each sequence's blocks: [B, MBS, HKV, BS, D] -> [B, L, HKV, D]
-    gk = jnp.moveaxis(key_cache[block_tables], 2, 3)
-    gv = jnp.moveaxis(value_cache[block_tables], 2, 3)
-    mbs, bs = block_tables.shape[1], key_cache.shape[2]
-    L = mbs * bs
-    gk = gk.reshape(b, L, hkv, d)
-    gv = gv.reshape(b, L, hkv, d)
-    if hkv != hq:
-        if hq % hkv != 0:
-            raise ValueError(f"q heads {hq} not a multiple of kv heads {hkv}")
-        rep = hq // hkv
-        gk = jnp.repeat(gk, rep, axis=2)
-        gv = jnp.repeat(gv, rep, axis=2)
-    qf = q[:, 0].astype(jnp.float32) * scale  # [B, HQ, D]
-    scores = jnp.einsum("bhd,blhd->bhl", qf, gk.astype(jnp.float32))
-    pos = jnp.arange(L)[None, None, :]
-    mask = pos < attend_lens[:, None, None]  # attends the freshly-appended token
-    scores = jnp.where(mask, scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhl,blhd->bhd", probs, gv.astype(jnp.float32))
-    if slot_mask is not None:
-        # fully-masked softmax degenerates to a uniform mean over garbage;
-        # the kernel emits exact zeros for skipped slots — match it
-        out = jnp.where(slot_mask[:, None, None], out, 0.0)
-    return out[:, None].astype(q.dtype), key_cache, value_cache
+    # the decode step IS the C == 1 chunk: one new row per sequence whose
+    # causal limit is seq_lens + 1 (attend_lens), masked slots exact zeros
+    out = _gather_chunk_attend(
+        q, key_cache, value_cache, block_tables, seq_lens,
+        attend_lens - seq_lens, scale,
+    )
+    return out.astype(q.dtype), key_cache, value_cache

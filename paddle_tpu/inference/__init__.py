@@ -36,6 +36,7 @@ __all__ = [
     "ContinuousBatchingEngine",
     "FIFOAdmission",
     "InferenceRequest",
+    "PrefixCache",
     "IntakeError",
     "EmptyPromptError",
     "InvalidTokenBudgetError",
@@ -44,6 +45,7 @@ __all__ = [
     "RequestUnservableError",
 ]
 
+from paddle_tpu.inference.prefix_cache import PrefixCache  # noqa: E402
 from paddle_tpu.inference.engine import (  # noqa: E402
     AdmissionPolicy,
     ContinuousBatchingEngine,

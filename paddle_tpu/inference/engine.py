@@ -1,4 +1,4 @@
-"""Continuous-batching decode engine over a ragged paged KV pool.
+"""Continuous-batching engine over a prefix-cached, ragged paged KV pool.
 
 The serving-grade decode path: where ``generation.py::generate_paged`` runs
 one static batch to completion (a finished sequence holds its batch slot and
@@ -7,33 +7,44 @@ freed slots every step and reclaims a finished sequence's blocks immediately
 — the scheduling model of vLLM / the reference's serving stack, shaped for
 TPU: all device shapes are FIXED (max-slots batch, dense block tables,
 per-slot lengths as data), so the whole mixed workload runs through exactly
-TWO compiled programs per (model, config):
+ONE compiled program per (model, config):
 
-- one PREFILL signature: ``[1, prompt_bucket]`` padded prompt, scattered into
-  the pool via ``block_cache_prefill`` (positions past the true length are
-  dropped), first token read at the true last position;
-- one DECODE signature: ``[max_slots]`` tokens over the shared block pool,
-  padded slots carried by an active-slot mask (they write no KV, attend over
+- one unified STEP signature: ``[max_slots, chunk]`` new tokens over the
+  shared block pool. A decode slot contributes one valid row; a slot still
+  prefilling contributes up to ``chunk`` prompt tokens (**chunked prefill**,
+  "Ragged Paged Attention" arxiv 2604.15464) — prompt chunks ride the same
+  dispatch as decode rows, so a long prompt never head-of-line-blocks the
+  decode batch, and the recompile watchdog reports exactly 1 signature.
+  Padded slots are carried by an active mask (they write no KV, attend over
   nothing, and the ragged Pallas kernel skips their compute — see
   ``kernels/paged_attention.py``).
 
 Admits and evictions only rewrite HOST-side numpy state (block tables,
 lengths, the active mask) that is passed to the compiled step as data — the
-program never retraces as the request mix changes. "Ragged Paged Attention"
-(arxiv 2604.15464) is the kernel shape; "Efficient Operation Fusion"
-(arxiv 2502.17728) is why each step stays one fused program.
+program never retraces as the request mix changes.
+
+**Prefix caching**: with ``FLAGS_enable_prefix_cache`` (default on), prompts
+are chunked into block-aligned segments keyed by a rolling content hash, and
+the longest cached prefix chain is mapped straight into an admitted
+request's block table with refcounts bumped — the shared prefix is computed
+once and mapped by all (``inference/prefix_cache.py``). The first divergent
+block is copy-on-write: the fork is carried INTO the unified step as data
+(``cow_src``/``cow_dst`` per slot), so CoW adds no compiled signature.
+Eviction is LRU over zero-reference chains only — a live request can never
+lose a block — and the worst-case admission reservation stays honest by
+counting only non-shared blocks.
 
 The block allocator is host-side Python (it runs between steps, not inside
-the program), reusing ``BlockKVCache``'s accounting; admission reserves a
-request's worst-case block need up front so a mid-flight decode step can
-never hit pool exhaustion.
+the program); admission reserves a request's worst-case PRIVATE block need
+up front so a mid-flight step can never hit pool exhaustion.
 
 Fault tolerance: because every request's prompt and generated tokens live on
 the host (``InferenceRequest``), a dispatch failure that consumed the
 donated KV buffers is recoverable — ``step()`` retries with backoff through
-``recover()``, which rebuilds the pools and replays every live slot from
-host truth through the SAME two compiled programs (see README "Fault
-tolerance"). Only exhausted retries mark the engine permanently failed.
+``recover()``, which rebuilds the pools (and a FRESH prefix cache: the old
+chain nodes point at lost KV) and replays every live slot from host truth
+through the SAME compiled program (see README "Fault tolerance"). Only
+exhausted retries mark the engine permanently failed.
 """
 
 from __future__ import annotations
@@ -41,12 +52,14 @@ from __future__ import annotations
 import itertools
 import time
 from collections import deque
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from paddle_tpu.flags import GLOBAL_FLAGS
+from paddle_tpu.inference.prefix_cache import ChainNode, PrefixCache
 from paddle_tpu.observability import flight_recorder as _flight
 from paddle_tpu.observability import metrics as _obs
 from paddle_tpu.observability import tracing as _tracing
@@ -89,7 +102,7 @@ class InvalidTokenBudgetError(IntakeError):
 
 
 class PromptTooLongError(IntakeError):
-    """The prompt does not fit the configured ``prompt_bucket``."""
+    """The prompt does not fit the configured ``prompt_bucket`` intake cap."""
 
 
 class RequestTooLongError(IntakeError):
@@ -112,11 +125,11 @@ def _engine_metrics() -> Dict[str, Any]:
         ),
         "step": reg.histogram(
             "engine_decode_step_seconds",
-            "Latency of one decode step over all active slots (incl. host sync).",
+            "Latency of one unified step over all active slots (incl. host sync).",
         ),
         "admitted": reg.counter(
             "engine_requests_admitted_total",
-            "Requests admitted into a slot (prefill ran).",
+            "Requests admitted into a slot (prefill started).",
         ),
         "finished": reg.counter(
             "engine_requests_finished_total",
@@ -141,7 +154,7 @@ def _engine_metrics() -> Dict[str, Any]:
         ),
         "blocks_reserved": reg.gauge(
             "engine_kv_blocks_reserved",
-            "Worst-case blocks reserved by live sequences (admission guarantee).",
+            "Worst-case private blocks reserved by live sequences (admission guarantee).",
         ),
         "recoveries": reg.counter(
             "engine_recoveries_total",
@@ -155,7 +168,13 @@ def _engine_metrics() -> Dict[str, Any]:
         ),
         "util": reg.gauge(
             "engine_kv_pool_utilization",
-            "allocated/total blocks, 0..1; high-water mark tracked since reset.",
+            "Blocks held by LIVE work / total, 0..1 (evictable cached blocks "
+            "excluded); high-water mark tracked since reset.",
+        ),
+        "prefill_tokens": reg.counter(
+            "engine_prefill_tokens_computed_total",
+            "Prompt tokens actually computed by prefill chunks (cache hits "
+            "are NOT counted here — the shared-prefix honesty counter).",
         ),
     }
 
@@ -191,7 +210,9 @@ class InferenceRequest:
         # "stop" | "length" | "deadline" | a cancel_request() reason
         self.finish_reason: Optional[str] = None
         self.arrival_time = time.perf_counter()  # TTFT anchor
-        self.admit_time: Optional[float] = None  # None until prefill succeeded
+        self.admit_time: Optional[float] = None  # None until the first token
+        # prompt tokens served from the prefix cache at admission (0 = cold)
+        self.cached_tokens = 0
         # lifecycle timestamps the tracing layer turns into phase spans at
         # terminal time (plain floats — kept regardless of sampling)
         self.prefill_start: Optional[float] = None
@@ -254,11 +275,13 @@ class FIFOAdmission(AdmissionPolicy):
 
 
 class ContinuousBatchingEngine:
-    """Host-side scheduler driving one jitted prefill + one jitted decode.
+    """Host-side scheduler driving ONE jitted unified prefill/decode step.
 
     ``max_slots`` bounds the live batch; ``num_blocks`` sizes the global KV
-    pool shared by all slots; ``prompt_bucket`` is the single padded prompt
-    length every admitted prompt is chunked into (one prefill signature).
+    pool shared by all slots; ``prompt_bucket`` is the intake cap on prompt
+    length (prompts are chunked — the bucket no longer shapes any compiled
+    program); ``prefill_chunk`` is the chunk width ``C`` of the unified
+    ``[max_slots, C]`` step (default: one KV block).
     """
 
     def __init__(
@@ -272,6 +295,8 @@ class ContinuousBatchingEngine:
         max_recoveries: int = 2,
         recovery_backoff: float = 0.05,
         admission_policy: Optional[AdmissionPolicy] = None,
+        prefill_chunk: Optional[int] = None,
+        enable_prefix_cache: Optional[bool] = None,
     ) -> None:
         from paddle_tpu.incubate.nn.functional import BlockKVCache
 
@@ -280,6 +305,9 @@ class ContinuousBatchingEngine:
         self.max_slots = int(max_slots)
         self.block_size = int(block_size)
         self.prompt_bucket = int(prompt_bucket)
+        self.prefill_chunk = int(prefill_chunk or self.block_size)
+        if self.prefill_chunk < 1:
+            raise ValueError(f"prefill_chunk must be >= 1, got {self.prefill_chunk}")
         self.max_model_len = int(
             max_model_len
             or getattr(cfg, "max_position_embeddings", None)
@@ -301,14 +329,20 @@ class ContinuousBatchingEngine:
         self._num_layers = cfg.num_hidden_layers
         dtype = next(iter(model.parameters())).dtype
         # cache geometry, kept so recover() can rebuild identical buffers
-        # (identical shapes/dtypes -> the compiled programs are reused)
+        # (identical shapes/dtypes -> the compiled program is reused)
         self._kvh, self._hd, self._cache_dtype = kvh, hd, dtype
         self._cache_shape = (self.num_blocks, kvh, self.block_size, hd)
-        # host-side allocator/accounting only; the device pool lives below
+        # host-side refcounted block pool; the device pool lives below
         self._mgr = BlockKVCache(
             self.num_blocks, self.block_size, kvh, hd,
             self.max_blocks_per_seq, dtype=dtype,
         )
+        self._use_prefix_cache = bool(
+            GLOBAL_FLAGS.get("enable_prefix_cache")
+            if enable_prefix_cache is None
+            else enable_prefix_cache
+        )
+        self._cache = self._new_prefix_cache()
         # ONE global paged pool shared by every layer's sequences would alias
         # writes across layers — each layer owns its [NB, KVH, BS, D] pair,
         # all indexed by the SAME block tables (the reference layout).
@@ -320,17 +354,26 @@ class ContinuousBatchingEngine:
         # per-slot host state (rewritten freely between steps — it is DATA to
         # the compiled step, never part of its shape)
         self._slot_req: List[Optional[InferenceRequest]] = [None] * self.max_slots
-        self._ntok = np.zeros((self.max_slots,), np.int32)  # tokens stored in pool
+        self._blocks: List[List[int]] = [[] for _ in range(self.max_slots)]
+        # leading prefix of _blocks owned by cache chain nodes (refs held);
+        # invariant: _nodes[s][i].block == _blocks[s][i]
+        self._nodes: List[List[ChainNode]] = [[] for _ in range(self.max_slots)]
+        self._no_insert = [False] * self.max_slots  # stop chain growth (race)
+        self._matched_blocks = np.zeros((self.max_slots,), np.int64)  # at admit
+        self._pending_cow: List[Optional[Tuple[ChainNode, int, int]]] = (
+            [None] * self.max_slots
+        )
+        self._ntok = np.zeros((self.max_slots,), np.int32)  # tokens in pool
         self._last_tok = np.zeros((self.max_slots,), np.int32)
-        self._reserved = np.zeros((self.max_slots,), np.int64)  # admission worst case
+        self._reserved = np.zeros((self.max_slots,), np.int64)  # worst case
         self._waiting: deque = deque()
         self._ids = itertools.count()
         self._policy: AdmissionPolicy = admission_policy or FIFOAdmission()
 
         self._named = list(model.named_parameters())
         self.stats = {
-            "prefill_traces": 0, "decode_traces": 0, "steps": 0,
-            "admitted": 0, "recoveries": 0,
+            "step_traces": 0, "steps": 0, "admitted": 0, "recoveries": 0,
+            "prompt_tokens_computed": 0, "prompt_tokens_reused": 0,
         }
         self._metrics = _engine_metrics()
         self._update_pool_gauges()
@@ -347,28 +390,54 @@ class ContinuousBatchingEngine:
         self.max_recoveries = int(max_recoveries)
         self.recovery_backoff = float(recovery_backoff)
         # finished requests awaiting delivery: survives a failed attempt so
-        # a request that finished at prefill before the decode dispatch died
-        # is still delivered exactly once by the step() that succeeds
+        # a request that finished before the dispatch died is still delivered
+        # exactly once by the step() that succeeds
         self._pending_done: List[InferenceRequest] = []
-        # per-engine "first successful compile recorded" markers: the watchdog
+        # per-engine "first successful compile recorded" marker: the watchdog
         # attributes each engine instance's initial trace as first_call
-        self._prefill_recorded = False
-        self._decode_recorded = False
+        self._step_recorded = False
         donate = jax.default_backend() != "cpu"  # donation warns (no-op) on cpu
-        self._prefill_fn = jax.jit(
-            self._prefill_impl, donate_argnums=(1,) if donate else ()
+        self._step_fn = jax.jit(
+            self._step_impl, donate_argnums=(1,) if donate else ()
         )
-        self._decode_fn = jax.jit(
-            self._decode_impl, donate_argnums=(1,) if donate else ()
+
+    def _new_prefix_cache(self) -> Optional[PrefixCache]:
+        if not self._use_prefix_cache:
+            return None
+        bytes_per_token = (
+            2 * self._num_layers * self._kvh * self._hd
+            * jnp.dtype(self._cache_dtype).itemsize
         )
+        return PrefixCache(self._mgr, self.block_size, bytes_per_token)
 
     # -- pool accounting -----------------------------------------------------
     def pool_stats(self) -> Dict[str, int]:
+        free = self._mgr.free_blocks
         return {
             "total": self.num_blocks,
-            "free": self._mgr.free_blocks,
-            "allocated": self._mgr.blocks_allocated(),
+            "free": free,
+            "allocated": self.num_blocks - free,
+            # blocks the prefix cache retains warm but surrenders under
+            # pressure: reclaimable, so admission/overload math treats them
+            # as headroom, not load
+            "cached_reusable": (
+                self._cache.evictable_blocks if self._cache is not None else 0
+            ),
+            # ALL cache-owned blocks (incl. chain interiors pinned by
+            # children): with no live work, free + cached_blocks == total
+            "cached_blocks": (
+                self._cache.node_count if self._cache is not None else 0
+            ),
         }
+
+    def prefix_cache_stats(self) -> Dict[str, Any]:
+        """Hit-rate / sharing signals for the serving layer (empty when the
+        prefix cache is disabled)."""
+        if self._cache is None:
+            return {"enabled": False}
+        out: Dict[str, Any] = {"enabled": True}
+        out.update(self._cache.stats_snapshot())
+        return out
 
     def _update_pool_gauges(self) -> None:
         """Refresh the pool/queue gauges straight from ``pool_stats()``; called
@@ -381,17 +450,24 @@ class ContinuousBatchingEngine:
         m["blocks_alloc"].set(s["allocated"])
         m["blocks_free"].set(s["free"])
         m["blocks_reserved"].set(int(self._reserved.sum()))
-        m["util"].set(s["allocated"] / s["total"] if s["total"] else 0.0)
+        live = s["allocated"] - s["cached_reusable"]
+        m["util"].set(live / s["total"] if s["total"] else 0.0)
         m["queue"].set(len(self._waiting))
         m["active"].set(sum(r is not None for r in self._slot_req))
+        if self._cache is not None:
+            self._cache.update_shared_gauge()
 
     def _unreserved_free(self) -> int:
-        """Free blocks not spoken for by live sequences' worst-case growth."""
+        """Blocks available to new admissions: free + evictable cached,
+        minus live sequences' outstanding worst-case PRIVATE growth (shared
+        mapped blocks never grow — they are counted at zero)."""
         outstanding = 0
         for slot, req in enumerate(self._slot_req):
             if req is not None:
-                outstanding += int(self._reserved[slot]) - self._mgr.blocks_allocated(slot)
-        return self._mgr.free_blocks - outstanding
+                private = len(self._blocks[slot]) - int(self._matched_blocks[slot])
+                outstanding += int(self._reserved[slot]) - private
+        reusable = self._cache.evictable_blocks if self._cache is not None else 0
+        return self._mgr.free_blocks + reusable - outstanding
 
     def _buffers_lost(self) -> bool:
         return any(
@@ -545,61 +621,54 @@ class ContinuousBatchingEngine:
                 return req
         return None
 
-    # -- compiled programs (each traces exactly ONCE per engine) -------------
+    # -- the compiled program (traces exactly ONCE per engine) ---------------
     def _param_arrays(self) -> List[Any]:
         # re-read each call: weight updates after construction are served
         # without retraces (same shapes/dtypes -> same compiled program)
         return [p._data for _, p in self._named]
 
-    def _prefill_impl(self, param_arrays, caches, ids, table, ln):
-        """ids [1, prompt_bucket] right-padded; table [1, MBS]; ln [1].
-
-        Dense causal forward over the padded prompt (positions >= ln only
-        read earlier positions, so padding never perturbs real tokens), pour
-        each layer's K/V into this sequence's pool blocks (pad positions are
-        scatter-dropped), take the first greedy token at the true last row.
-        """
+    def _step_impl(
+        self, param_arrays, caches, toks, tables, lens, q_lens, active,
+        cow_src, cow_dst,
+    ):
+        """The ONE program: ``toks [S, C]`` ragged new tokens per slot
+        (decode rows have one valid token, prefill chunks up to C);
+        ``tables [S, MBS]``; ``lens`` tokens already cached per slot;
+        ``q_lens`` valid new tokens; ``active`` the slot mask; ``cow_*`` the
+        copy-on-write fork set (``dst == num_blocks``: no fork). Applies
+        pending CoW forks, appends the ragged chunk KV, attends, and returns
+        each slot's next greedy token (read at its last valid row)."""
         import paddle_tpu
         from paddle_tpu.core.tensor import Tensor
-        from paddle_tpu.incubate.nn.functional import block_cache_prefill
+        from paddle_tpu.incubate.nn.functional import block_cache_cow_copy
         from paddle_tpu.nn.layer.layers import bind_param_arrays
 
-        self.stats["prefill_traces"] += 1  # Python side: counts TRACES only
+        self.stats["step_traces"] += 1  # Python side: counts TRACES only
         with bind_param_arrays(self._named, param_arrays):
-            with paddle_tpu.no_grad():
-                logits, dense = self.model(Tensor(ids), use_cache=True)
-            new_caches = []
-            for (kc, vc), (k_t, v_t) in zip(caches, dense):
-                new_caches.append(
-                    block_cache_prefill(kc, vc, k_t._data, v_t._data, table, ln)
-                )
-            row = jnp.take(logits._data[0], ln[0] - 1, axis=0)  # [V] true last
-            tok = jnp.argmax(row.astype(jnp.float32)).astype(jnp.int32)
-            return tok, new_caches
-
-    def _decode_impl(self, param_arrays, caches, toks, tables, lens, active):
-        """toks/lens/active [S]; tables [S, MBS]. One fused step for every
-        slot: append each active slot's last token, ragged-attend, argmax."""
-        import paddle_tpu
-        from paddle_tpu.core.tensor import Tensor
-        from paddle_tpu.nn.layer.layers import bind_param_arrays
-
-        self.stats["decode_traces"] += 1  # Python side: counts TRACES only
-        with bind_param_arrays(self._named, param_arrays):
-            pkv = [
-                (Tensor(kc), Tensor(vc), Tensor(tables), Tensor(lens), Tensor(active))
+            forked = [
+                block_cache_cow_copy(kc, vc, cow_src, cow_dst)
                 for kc, vc in caches
+            ]
+            pkv = [
+                (
+                    Tensor(kc), Tensor(vc), Tensor(tables), Tensor(lens),
+                    Tensor(active), Tensor(q_lens),
+                )
+                for kc, vc in forked
             ]
             with paddle_tpu.no_grad():
                 logits, new_pkv = self.model(
-                    Tensor(toks[:, None]),
+                    Tensor(toks),
                     past_key_values=pkv,
                     use_cache=True,
                     cache_position=Tensor(lens),
                 )
-            nxt = jnp.argmax(
-                logits._data[:, -1, :].astype(jnp.float32), axis=-1
-            ).astype(jnp.int32)
+            # each slot's next token comes from its LAST valid row
+            idx = jnp.maximum(q_lens - 1, 0)
+            rows = jnp.take_along_axis(
+                logits._data, idx[:, None, None], axis=1
+            )[:, 0]  # [S, V]
+            nxt = jnp.argmax(rows.astype(jnp.float32), axis=-1).astype(jnp.int32)
             return nxt, [(c[0]._data, c[1]._data) for c in new_pkv]
 
     # -- scheduling ----------------------------------------------------------
@@ -610,7 +679,24 @@ class ContinuousBatchingEngine:
         return -(-worst // self.block_size)
 
     def _can_fit(self, req: InferenceRequest) -> bool:
-        return self._unreserved_free() >= self._blocks_needed(req)
+        need = self._blocks_needed(req)
+        avail = self._unreserved_free()
+        if self._cache is not None:
+            matched, matched_evictable = self._cache.peek_cached_blocks(req.prompt)
+            # matched blocks are mapped, not allocated — but a matched block
+            # currently sitting in the evictable LRU was ALSO counted as
+            # reclaimable headroom; pinning it consumes that headroom
+            need -= matched
+            avail -= matched_evictable
+        return avail >= need
+
+    def _alloc_private_block(self) -> int:
+        """One request-private block, evicting zero-ref cached chains under
+        pressure (the reservation math guarantees this succeeds for live
+        slots' growth)."""
+        if self._cache is not None:
+            return self._cache.alloc_private_block()
+        return self._mgr.acquire_block()
 
     def _shed_expired_queued(self, done: List[InferenceRequest]) -> None:
         """Shed queued requests whose deadline already passed — BEFORE any
@@ -656,73 +742,107 @@ class ContinuousBatchingEngine:
                 )
             self._waiting.remove(req)
             self._admit(req, free_slots[0])
-            if req.finished:  # finished at prefill (eos / max_new_tokens == 1)
-                done.append(req)
+
+    def _match_and_map(self, req: InferenceRequest, slot: int) -> None:
+        """Map the longest cached prefix into ``slot``'s block table (host
+        bookkeeping only — the slot's first chunk rides the NEXT unified
+        step). A failing cache lookup (including an injected
+        ``prefix_cache.match`` fault) degrades to a cold miss: the prompt is
+        simply recomputed."""
+        result = None
+        if self._cache is not None:
+            try:
+                result = self._cache.match(req.prompt)
+            except Exception as exc:  # noqa: BLE001 - lookup must never kill admission
+                _flight.record_event(
+                    "prefix_match_failed", req_id=req.req_id,
+                    error=f"{type(exc).__name__}: {exc}"[:120],
+                )
+        nodes = result.nodes if result is not None else []
+        cached = result.cached_tokens if result is not None else 0
+        cow = result.cow if result is not None else None
+        self._nodes[slot] = list(nodes)
+        self._blocks[slot] = [n.block for n in nodes]
+        self._matched_blocks[slot] = len(nodes)
+        self._no_insert[slot] = False
+        self._pending_cow[slot] = None
+        if cow is not None:
+            src_node, dst_block, partial = cow
+            self._blocks[slot].append(dst_block)
+            self._pending_cow[slot] = cow
+            _flight.record_event(
+                "cow_fork", req_id=req.req_id, slot=slot,
+                src_block=src_node.block, dst_block=dst_block,
+                reused_tokens=partial,
+            )
+        self._reserved[slot] = self._blocks_needed(req) - len(nodes)
+        self._ntok[slot] = cached
+        req.cached_tokens = cached
+        self.stats["prompt_tokens_reused"] += cached
 
     def _admit(self, req: InferenceRequest, slot: int) -> None:
-        plen = req.prompt.size
-        self._mgr.allocate(slot, plen)
-        self._reserved[slot] = self._blocks_needed(req)
-        table = jnp.asarray(self._mgr.block_table([slot]))  # [1, MBS]
-        ids = np.zeros((1, self.prompt_bucket), np.int32)
-        ids[0, :plen] = req.prompt
-        traces_before = self.stats["prefill_traces"]
-        req.prefill_start = time.perf_counter()
+        # the prefill fault site moved host-side with chunked prefill: it
+        # models an admission-time failure (match/map), and — like a real
+        # dispatch loss — an InjectedFault here takes the recovery path
         try:
             fault_point("engine.prefill")
-            tok, self._caches = self._prefill_fn(
-                self._param_arrays(), self._caches, jnp.asarray(ids), table,
-                jnp.asarray([plen], jnp.int32),
-            )
+            self._match_and_map(req, slot)
         except BaseException:
-            # undo the allocation so a transient device failure leaves the
-            # pool accounting exactly as before this admit; whether the
-            # failure is recoverable (buffers lost -> recover + retry) or
-            # permanent is decided by step()'s retry loop
-            self._mgr.free(slot)
-            self._reserved[slot] = 0
+            # broad on purpose: whatever kills admission (injected fault,
+            # MemoryError from the CoW alloc, operator interrupt), the
+            # partially-mapped slot must be unwound so pool accounting is
+            # exactly as before this admit; step()'s retry loop classifies
+            self._rollback_admit(slot)
             self._waiting.appendleft(req)  # keeps FIFO order for a retry
             raise
-        if self.stats["prefill_traces"] > traces_before:
-            # recorded HERE, after the jit call returned: a trace that died
-            # mid-body bumped the stats counter but produced no program, and
-            # the watchdog ledger must only count compiles that exist
-            GLOBAL_WATCHDOG.record_compile(
-                "ContinuousBatchingEngine.prefill",
-                signature=f"ids[1,{self.prompt_bucket}]",
-                cause=CAUSE_FIRST_CALL
-                if not self._prefill_recorded
-                else CAUSE_NEW_SHAPE_DTYPE,
-            )
-            self._prefill_recorded = True
+        req.prefill_start = time.perf_counter()
+        self._slot_req[slot] = req
+        self._last_tok[slot] = 0
         self.stats["admitted"] += 1
-        tok = int(tok)  # device sync: the first token exists past this line
-        req.admit_time = time.perf_counter()
-        # black box: ids and sizes only, never prompt content
         _flight.record_event(
-            "admit", req_id=req.req_id, slot=slot, prompt_len=int(plen),
+            "admit", req_id=req.req_id, slot=slot,
+            prompt_len=int(req.prompt.size), cached_tokens=int(req.cached_tokens),
             queue_depth=len(self._waiting),
         )
         self._metrics["admitted"].inc()
-        self._metrics["ttft"].observe(req.admit_time - req.arrival_time)
-        req.generated.append(tok)
-        if req.eos_token_id is not None and tok == req.eos_token_id:
-            req.finish_reason = "stop"
-        elif len(req.generated) >= req.max_new_tokens:
-            req.finish_reason = "length"
-        if req.finished:
-            self._release(slot, req)  # blocks reclaimed before the next admit
-            return
-        self._slot_req[slot] = req
-        self._ntok[slot] = plen
-        self._last_tok[slot] = tok
         self._update_pool_gauges()
+
+    def _rollback_admit(self, slot: int) -> None:
+        """Undo a partially-mapped admission so a failure leaves the pool
+        accounting exactly as before."""
+        if self._cache is not None:
+            if self._pending_cow[slot] is not None:
+                src_node, dst_block, _ = self._pending_cow[slot]
+                self._cache.release_cow_source(src_node)
+                self._mgr.decref(dst_block)
+                if self._blocks[slot] and self._blocks[slot][-1] == dst_block:
+                    self._blocks[slot].pop()
+            if self._nodes[slot]:
+                self._cache.release(self._nodes[slot])
+        self._nodes[slot] = []
+        self._blocks[slot] = []
+        self._matched_blocks[slot] = 0
+        self._pending_cow[slot] = None
+        self._reserved[slot] = 0
+        self._ntok[slot] = 0
 
     def _release(self, slot: int, req: InferenceRequest) -> None:
         # finished requests are handed back ONLY through step()'s return
         # value (run() accumulates them); the engine keeps no reference, so
         # a long-running step()-driven server never grows host memory
-        self._mgr.free(slot)
+        if self._cache is not None and self._pending_cow[slot] is not None:
+            # cancelled before its first step: unpin the CoW source
+            self._cache.release_cow_source(self._pending_cow[slot][0])
+        self._pending_cow[slot] = None
+        nodes = self._nodes[slot]
+        if self._cache is not None and nodes:
+            self._cache.release(nodes)
+        for blk in self._blocks[slot][len(nodes):]:
+            self._mgr.decref(blk)  # private blocks free immediately
+        self._nodes[slot] = []
+        self._blocks[slot] = []
+        self._matched_blocks[slot] = 0
+        self._no_insert[slot] = False
         self._reserved[slot] = 0
         self._slot_req[slot] = None
         self._ntok[slot] = 0
@@ -738,11 +858,11 @@ class ContinuousBatchingEngine:
         self._update_pool_gauges()
 
     def step(self) -> List[InferenceRequest]:
-        """One engine iteration: reclaim/admit, then one decode step over all
-        active slots. Returns requests that finished during this step — the
-        ONLY handback: the engine keeps no reference to finished requests
-        (a step()-driven server never grows host memory), so a later run()
-        will not re-deliver them.
+        """One engine iteration: reclaim/admit, then one unified
+        prefill/decode step over all active slots. Returns requests that
+        finished during this step — the ONLY handback: the engine keeps no
+        reference to finished requests (a step()-driven server never grows
+        host memory), so a later run() will not re-deliver them.
 
         Failure policy: a dispatch failure that left the cache buffers
         intact (no donation consumed them) re-raises immediately with host
@@ -786,8 +906,8 @@ class ContinuousBatchingEngine:
                     self._dump_black_box(rexc)
                     raise
         # deliver everything that finished during this (possibly retried)
-        # step exactly once — including prefill-finishers from an attempt
-        # whose decode dispatch later died
+        # step exactly once — including finishers from an attempt whose
+        # dispatch later died
         return self.drain_finished()
 
     def _dump_black_box(self, exc: BaseException) -> None:
@@ -820,8 +940,117 @@ class ContinuousBatchingEngine:
         out, self._pending_done = self._pending_done, []
         return out
 
+    # -- the unified dispatch ------------------------------------------------
+    def _dense_tables(self) -> np.ndarray:
+        out = np.zeros((self.max_slots, self.max_blocks_per_seq), np.int32)
+        for s, blocks in enumerate(self._blocks):
+            if blocks:
+                out[s, : len(blocks)] = blocks
+        return out
+
+    def _dispatch(
+        self,
+        toks: np.ndarray,  # [S, C]
+        q_lens: np.ndarray,  # [S]
+        active: np.ndarray,  # [S] bool
+    ) -> np.ndarray:
+        """Run ONE unified step over the given ragged rows: grow block
+        tables for the new tokens, fold in pending CoW forks, dispatch, sync,
+        then advance ``_ntok`` and register freshly completed full prompt
+        blocks with the prefix cache. Host token bookkeeping (emission,
+        finish checks) is the caller's. On failure every block allocated for
+        this step is returned, so repeated failed steps cannot drift the
+        reservation invariant."""
+        appended: List[Tuple[int, int]] = []  # (slot, block) rollback list
+        active_slots = [i for i in range(self.max_slots) if active[i]]
+        cow_src = np.zeros((self.max_slots,), np.int32)
+        cow_dst = np.full((self.max_slots,), self.num_blocks, np.int32)
+        try:
+            for i in active_slots:
+                need_tokens = int(self._ntok[i]) + int(q_lens[i])
+                while len(self._blocks[i]) * self.block_size < need_tokens:
+                    blk = self._alloc_private_block()
+                    self._blocks[i].append(blk)
+                    appended.append((i, blk))
+                pending = self._pending_cow[i]
+                if pending is not None:
+                    cow_src[i] = pending[0].block
+                    cow_dst[i] = pending[1]
+            tables = self._dense_tables()
+            fault_point("engine.decode")
+            traces_before = self.stats["step_traces"]
+            nxt, self._caches = self._step_fn(
+                self._param_arrays(), self._caches, jnp.asarray(toks),
+                jnp.asarray(tables), jnp.asarray(self._ntok.copy()),
+                jnp.asarray(q_lens), jnp.asarray(active),
+                jnp.asarray(cow_src), jnp.asarray(cow_dst),
+            )
+        except BaseException:
+            # roll the per-step allocations back so a transient failure
+            # leaves the allocator in lockstep with _ntok (retried steps
+            # neither leak blocks nor break the reservation invariant);
+            # pending CoW forks stay pending — a retry re-copies
+            for slot, blk in appended:
+                self._blocks[slot].remove(blk)
+                self._mgr.decref(blk)
+            raise
+        if self.stats["step_traces"] > traces_before:
+            # recorded HERE, after the jit call returned: a trace that died
+            # mid-body bumped the stats counter but produced no program, and
+            # the watchdog ledger must only count compiles that exist
+            GLOBAL_WATCHDOG.record_compile(
+                "ContinuousBatchingEngine.step",
+                signature=f"toks[{self.max_slots},{self.prefill_chunk}]",
+                cause=CAUSE_FIRST_CALL
+                if not self._step_recorded
+                else CAUSE_NEW_SHAPE_DTYPE,
+            )
+            self._step_recorded = True
+        nxt = np.asarray(nxt)  # device sync: the step's tokens are real here
+        for i in active_slots:
+            pending = self._pending_cow[i]
+            if pending is not None:
+                # the fork's device copy has executed — unpin the source
+                if self._cache is not None:
+                    self._cache.release_cow_source(pending[0])
+                self._pending_cow[i] = None
+            self._ntok[i] += int(q_lens[i])
+            self._extend_chain(i)
+        return nxt
+
+    def _extend_chain(self, slot: int) -> None:
+        """Register this slot's freshly COMPLETED full prompt blocks as
+        chain nodes (in-flight insertion: later admissions share them the
+        moment they are computed). Blocks containing any generated token
+        stay private — the cache stores prompt content only."""
+        if self._cache is None or self._no_insert[slot]:
+            return
+        req = self._slot_req[slot]
+        if req is None:
+            return
+        plen = req.prompt.size
+        bs = self.block_size
+        while True:
+            idx = len(self._nodes[slot])
+            end = (idx + 1) * bs
+            if end > plen or end > int(self._ntok[slot]):
+                return
+            if idx >= len(self._blocks[slot]):
+                return
+            parent = self._nodes[slot][-1] if self._nodes[slot] else None
+            node = self._cache.insert(
+                parent, req.prompt[idx * bs : end], self._blocks[slot][idx]
+            )
+            if node is None:
+                # another request registered the same chain block first
+                # (same-boundary concurrent compute); keep ours private and
+                # stop extending so node/block alignment stays simple
+                self._no_insert[slot] = True
+                return
+            self._nodes[slot].append(node)
+
     def _step_attempt(self) -> None:
-        """One admit+decode pass; finished requests land in
+        """One admit+dispatch pass; finished requests land in
         ``_pending_done`` (never lost to an exception mid-attempt)."""
         # mid-decode deadline expiry FIRST: evict before paying for another
         # step of this slot's compute, so the freed slot/blocks are available
@@ -836,41 +1065,30 @@ class ContinuousBatchingEngine:
         active_slots = [i for i, r in enumerate(self._slot_req) if r is not None]
         if not active_slots:
             return
-        for i in active_slots:
-            self._mgr.allocate(i, 1)  # room for the token appended this step
-        tables = jnp.asarray(self._mgr.block_table(range(self.max_slots)))
-        lens = jnp.asarray(self._ntok)  # EXCLUDING the token being appended
+        C = self.prefill_chunk
+        toks = np.zeros((self.max_slots, C), np.int32)
+        q_lens = np.zeros((self.max_slots,), np.int32)
         active = np.zeros((self.max_slots,), bool)
-        active[active_slots] = True
+        prefill_tokens = 0
+        for i in active_slots:
+            req = self._slot_req[i]
+            plen = req.prompt.size
+            cur = int(self._ntok[i])
+            active[i] = True
+            if cur < plen:  # chunked prefill row(s)
+                n = min(C, plen - cur)
+                toks[i, :n] = req.prompt[cur : cur + n]
+                q_lens[i] = n
+                prefill_tokens += n
+            else:  # decode row
+                toks[i, 0] = self._last_tok[i]
+                q_lens[i] = 1
         t0 = time.perf_counter()
-        traces_before = self.stats["decode_traces"]
-        try:
-            fault_point("engine.decode")
-            nxt, self._caches = self._decode_fn(
-                self._param_arrays(), self._caches, jnp.asarray(self._last_tok),
-                tables, lens, jnp.asarray(active),
-            )
-        except BaseException:
-            # roll the per-step allocations back so repeated failed steps
-            # can't drift mgr lengths past _ntok and break the reservation
-            # invariant (_unreserved_free would over-report and over-admit)
-            for i in active_slots:
-                self._mgr.truncate(i, int(self._ntok[i]))
-            raise
-        if self.stats["decode_traces"] > traces_before:
-            # recorded HERE, after the jit call returned: a trace that died
-            # mid-body bumped the stats counter but produced no program, and
-            # the watchdog ledger must only count compiles that exist
-            GLOBAL_WATCHDOG.record_compile(
-                "ContinuousBatchingEngine.decode",
-                signature=f"toks[{self.max_slots}]",
-                cause=CAUSE_FIRST_CALL
-                if not self._decode_recorded
-                else CAUSE_NEW_SHAPE_DTYPE,
-            )
-            self._decode_recorded = True
+        nxt = self._dispatch(toks, q_lens, active)
         self.stats["steps"] += 1
-        nxt = np.asarray(nxt)  # device sync: the step's tokens are real here
+        self.stats["prompt_tokens_computed"] += prefill_tokens
+        if prefill_tokens:
+            self._metrics["prefill_tokens"].inc(prefill_tokens)
         t1 = time.perf_counter()
         self._metrics["step"].observe(t1 - t0)
         if _tracing.tracing_enabled():
@@ -899,9 +1117,15 @@ class ContinuousBatchingEngine:
                 )
         for i in active_slots:
             req = self._slot_req[i]
+            if int(self._ntok[i]) < req.prompt.size:
+                continue  # prompt not fully prefilled yet: no emission
             tok = int(nxt[i])
+            if not req.generated:
+                # the prompt just completed: this is the request's FIRST
+                # token (TTFT ends here, not at admission)
+                req.admit_time = time.perf_counter()
+                self._metrics["ttft"].observe(req.admit_time - req.arrival_time)
             req.generated.append(tok)
-            self._ntok[i] += 1
             self._last_tok[i] = tok
             if req.eos_token_id is not None and tok == req.eos_token_id:
                 req.finish_reason = "stop"
@@ -910,20 +1134,31 @@ class ContinuousBatchingEngine:
             if req.finished:
                 self._release(i, req)
                 self._pending_done.append(req)
-        self._update_pool_gauges()  # step appended one token per active slot
+        self._update_pool_gauges()  # step advanced every active slot
 
     def recover(self) -> None:
         """Rebuild device KV state after a dispatch failure consumed the
         donated cache buffers: reallocate the per-layer pools, reset the
-        block allocator, then re-prefill and replay every live slot from
-        host-side truth (``InferenceRequest`` holds the prompt and every
-        token generated so far). Request ids, emitted tokens, the waiting
-        queue and pending finished deliveries are all preserved.
+        block allocator AND the prefix cache (its chain nodes point at lost
+        KV), then re-prefill and replay every live slot from host-side truth
+        (``InferenceRequest`` holds the prompt and every token generated so
+        far). Request ids, emitted tokens, the waiting queue and pending
+        finished deliveries are all preserved. Slots are re-prefilled ONE AT
+        A TIME so slots sharing a prefix re-share it through the fresh cache
+        (recovery can never need more blocks than the original admissions).
 
-        The rebuilt buffers have identical shapes/dtypes, so BOTH compiled
-        programs are reused — a recovery must not add compiles (the
-        recompile watchdog still reports exactly 2 for this engine)."""
+        The rebuilt buffers have identical shapes/dtypes, so the compiled
+        program is reused — a recovery must not add compiles (the recompile
+        watchdog still reports exactly 1 for this engine)."""
+        from paddle_tpu.incubate.nn.functional import BlockKVCache
+
         live = [(i, req) for i, req in enumerate(self._slot_req) if req is not None]
+        # chunked prefill means a live slot may be MID-PROMPT (no token
+        # emitted yet): capture its progress before the reset so the replay
+        # restores exactly the prefilled span, not the whole prompt
+        prior_prefill = {
+            i: int(min(self._ntok[i], req.prompt.size)) for i, req in live
+        }
         t_recover = time.perf_counter()
         _flight.record_event(
             "recovery", live=len(live), queued=len(self._waiting),
@@ -936,79 +1171,69 @@ class ContinuousBatchingEngine:
             )
             for _ in range(self._num_layers)
         ]
-        from paddle_tpu.incubate.nn.functional import BlockKVCache
-
         self._mgr = BlockKVCache(
             self.num_blocks, self.block_size, self._kvh, self._hd,
             self.max_blocks_per_seq, dtype=self._cache_dtype,
         )
+        self._cache = self._new_prefix_cache()
+        for i in range(self.max_slots):
+            self._blocks[i] = []
+            self._nodes[i] = []
+            self._no_insert[i] = False
+            self._pending_cow[i] = None
+        self._matched_blocks[:] = 0
         self._ntok[:] = 0
         self._last_tok[:] = 0
         self._reserved[:] = 0
         self.stats["recoveries"] += 1
         self._metrics["recoveries"].inc()
 
-        # phase 1: re-prefill each live slot's prompt (the same [1, bucket]
-        # signature — compiled program reused; a retrace here would be a bug
-        # and is recorded so the 2-compile invariant test catches it)
+        # phase 1: re-prefill each live slot's prompt through the SAME
+        # unified signature (chunked; a retrace here would be a bug and is
+        # recorded so the 1-compile invariant test catches it); one slot at
+        # a time so the fresh prefix cache re-deduplicates shared prefixes
+        C = self.prefill_chunk
         for slot, req in live:
+            self._match_and_map(req, slot)
             plen = req.prompt.size
-            self._mgr.allocate(slot, plen)
-            self._reserved[slot] = self._blocks_needed(req)
-            table = jnp.asarray(self._mgr.block_table([slot]))
-            ids = np.zeros((1, self.prompt_bucket), np.int32)
-            ids[0, :plen] = req.prompt
-            traces_before = self.stats["prefill_traces"]
-            _tok, self._caches = self._prefill_fn(
-                self._param_arrays(), self._caches, jnp.asarray(ids), table,
-                jnp.asarray([plen], jnp.int32),
-            )
-            if self.stats["prefill_traces"] > traces_before:
-                GLOBAL_WATCHDOG.record_compile(
-                    "ContinuousBatchingEngine.prefill",
-                    signature=f"ids[1,{self.prompt_bucket}]",
-                    cause=CAUSE_NEW_SHAPE_DTYPE,
-                )
-            self._ntok[slot] = plen
+            # a slot that never emitted replays only its prior prefill span
+            # (the normal step flow finishes the prompt afterwards); a
+            # decode-phase slot replays the whole prompt. The fresh cache may
+            # map MORE than the prior span — cached KV is real content.
+            target = plen if req.generated else prior_prefill[slot]
+            while int(self._ntok[slot]) < target:
+                toks = np.zeros((self.max_slots, C), np.int32)
+                q_lens = np.zeros((self.max_slots,), np.int32)
+                active = np.zeros((self.max_slots,), bool)
+                cur = int(self._ntok[slot])
+                n = min(C, target - cur)
+                toks[slot, :n] = req.prompt[cur : cur + n]
+                q_lens[slot] = n
+                active[slot] = True
+                self._dispatch(toks, q_lens, active)
             # the re-emitted first token is identical by determinism; host
             # truth is authoritative either way (the request already holds it)
-            self._last_tok[slot] = req.generated[0]
+            if req.generated:
+                self._last_tok[slot] = req.generated[0]
             self._metrics["replayed"].inc()
 
-        # phase 2: lockstep replay of already-generated tokens through the
-        # decode signature (one call per replay depth, every catching-up
-        # slot active) — the KV append is the effect we need; the re-emitted
-        # next tokens are discarded in favor of the recorded ones
+        # phase 2: lockstep replay of already-generated tokens (one decode
+        # row per catching-up slot per dispatch) — the KV append is the
+        # effect we need; the re-emitted next tokens are discarded in favor
+        # of the recorded ones
         max_replay = max((len(req.generated) - 1 for _, req in live), default=0)
         for r in range(max_replay):
             replay_slots = [i for i, req in live if len(req.generated) - 1 > r]
-            for i in replay_slots:
-                self._mgr.allocate(i, 1)
-            tables = jnp.asarray(self._mgr.block_table(range(self.max_slots)))
-            # SNAPSHOT the host-side vectors handed to the dispatch: replay
-            # never syncs (the emitted tokens are discarded), and jax's CPU
-            # backend zero-copies numpy inputs — mutating _ntok/_last_tok
-            # below while the async dispatch is still in flight would race
-            # the aliased buffers and corrupt the replayed KV. The normal
-            # step path is safe only because it syncs on nxt BEFORE mutating.
-            lens = jnp.asarray(self._ntok.copy())
-            toks = jnp.asarray(self._last_tok.copy())
+            toks = np.zeros((self.max_slots, C), np.int32)
+            q_lens = np.zeros((self.max_slots,), np.int32)
             active = np.zeros((self.max_slots,), bool)
-            active[replay_slots] = True
-            traces_before = self.stats["decode_traces"]
-            _nxt, self._caches = self._decode_fn(
-                self._param_arrays(), self._caches, toks, tables, lens,
-                jnp.asarray(active),
-            )
-            if self.stats["decode_traces"] > traces_before:
-                GLOBAL_WATCHDOG.record_compile(
-                    "ContinuousBatchingEngine.decode",
-                    signature=f"toks[{self.max_slots}]",
-                    cause=CAUSE_NEW_SHAPE_DTYPE,
-                )
+            for i in replay_slots:
+                toks[i, 0] = self._last_tok[i]
+                q_lens[i] = 1
+                active[i] = True
+            self._dispatch(toks, q_lens, active)
             for i in replay_slots:
                 req = self._slot_req[i]
-                self._ntok[i] += 1
                 self._last_tok[i] = req.generated[r + 1]
         if _tracing.tracing_enabled():
             _tracing.GLOBAL_TRACER.add_span(

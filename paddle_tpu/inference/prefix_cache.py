@@ -1,0 +1,472 @@
+"""Content-addressed prefix cache over the paged KV block pool.
+
+At production scale most requests share long prefixes (system prompts,
+few-shot templates), yet a plain paged engine recomputes every prompt from
+token zero. This module is the layer between the scheduler and the pool that
+makes shared prefixes *computed once, mapped by all*:
+
+- **Chain nodes.** A prompt is chunked into block-aligned segments; each
+  full block of prompt tokens is keyed by a rolling content hash
+  ``digest = H(parent_digest, token_ids)``, so a node identifies not just
+  its own tokens but the entire prefix that produced its KV — two blocks
+  with identical tokens under different histories never alias.
+- **Match + map.** On admission the longest chain of cached nodes matching
+  the prompt is mapped straight into the request's block table with
+  refcounts bumped — those tokens are never recomputed. Matching is capped
+  at ``prompt_len - 1``: the engine always computes at least one prompt
+  position, because the first generated token comes from the last prompt
+  position's logits.
+- **Copy-on-write.** When the first divergent block is a *prefix* of some
+  cached child block (a ragged prompt tail, or the one token held back by
+  the cap), that child's physical block is forked: the engine copies the
+  block device-side in its next step and the request continues writing into
+  its private copy — the shared block is never written. The source node
+  holds a reference until the fork's copy has executed.
+- **Refcounts + eviction.** A node's block returns to the free list only
+  when no request maps it, no child chains under it, AND the LRU decides to
+  evict it; until then a finished request's prompt blocks stay warm for the
+  next match. Eviction walks zero-reference chain tails only — a live
+  request can never lose a block.
+
+Thread safety: the cache has one internal lock ordered strictly above the
+pool's (cache -> pool, never the reverse); the serving front end's pump
+thread and intake threads may race engine introspection against admissions.
+
+Fault sites ``prefix_cache.match`` and ``prefix_cache.cow`` let the fault
+campaign force cache-miss and CoW-failure paths deterministically; both
+degrade to recompute, never to a failed request.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from paddle_tpu.observability import flight_recorder as _flight
+from paddle_tpu.observability import metrics as _obs
+from paddle_tpu.testing.faults import InjectedFault, fault_point
+
+__all__ = ["ChainNode", "MatchResult", "PrefixCache"]
+
+_ROOT_DIGEST = b"prefix-cache-root"
+
+
+def _cache_metrics() -> Dict[str, Any]:
+    """Get-or-create the prefix-cache metric families (process-global, like
+    the engine's). Recording is a no-op behind the registry's cached-bool
+    gate when ``FLAGS_enable_metrics`` is off."""
+    reg = _obs.GLOBAL_METRICS
+    return {
+        "hits": reg.counter(
+            "prefix_cache_hits_total",
+            "Admissions that mapped at least one cached prefix block.",
+        ),
+        "misses": reg.counter(
+            "prefix_cache_misses_total",
+            "Admissions that found no reusable prefix (cold compute).",
+        ),
+        "evictions": reg.counter(
+            "prefix_cache_evictions_total",
+            "Cached blocks evicted (LRU over zero-reference chain tails).",
+        ),
+        "shared": reg.gauge(
+            "prefix_cache_blocks_shared",
+            "Cache-owned blocks currently mapped by two or more requests.",
+        ),
+        "saved": reg.gauge(
+            "prefix_cache_bytes_saved",
+            "Cumulative KV bytes served from cache instead of recomputed.",
+        ),
+    }
+
+
+class ChainNode:
+    """One cached full block of prompt KV: a link in a content-hash chain.
+
+    ``req_refs`` counts live request mappings (including a pending CoW fork
+    reading from this block); ``child_refs`` counts cached child nodes. The
+    node is evictable only when both are zero."""
+
+    __slots__ = (
+        "key", "digest", "block", "parent", "token_bytes",
+        "req_refs", "child_refs",
+    )
+
+    def __init__(
+        self,
+        key: Tuple[bytes, bytes],
+        digest: bytes,
+        block: int,
+        parent: Optional["ChainNode"],
+        token_bytes: bytes,
+    ) -> None:
+        self.key = key
+        self.digest = digest
+        self.block = block
+        self.parent = parent
+        self.token_bytes = token_bytes
+        self.req_refs = 0
+        self.child_refs = 0
+
+
+class MatchResult:
+    """Outcome of :meth:`PrefixCache.match` — already reference-held.
+
+    ``nodes`` are the matched full-block chain (refs taken); ``cached_tokens``
+    counts every token served from cache including the CoW partial;
+    ``cow`` is ``(src_node, dst_block, partial_len)`` when the first
+    divergent block was forked (refs taken on ``src_node`` until
+    :meth:`PrefixCache.release_cow_source`)."""
+
+    __slots__ = ("nodes", "cached_tokens", "cow")
+
+    def __init__(
+        self,
+        nodes: List[ChainNode],
+        cached_tokens: int,
+        cow: Optional[Tuple[ChainNode, int, int]],
+    ) -> None:
+        self.nodes = nodes
+        self.cached_tokens = cached_tokens
+        self.cow = cow
+
+
+class PrefixCache:
+    """Content-addressed, reference-counted block cache over a
+    :class:`~paddle_tpu.incubate.nn.functional.BlockKVCache` pool.
+
+    ``bytes_per_token`` sizes the bytes-saved gauge: KV bytes across all
+    layers for one token (2 x layers x kv_heads x head_dim x itemsize).
+    """
+
+    def __init__(self, pool: Any, block_size: int, bytes_per_token: int = 0) -> None:
+        self._pool = pool
+        self.block_size = int(block_size)
+        self.bytes_per_token = int(bytes_per_token)
+        self._lock = threading.Lock()
+        self._nodes: Dict[Tuple[bytes, bytes], ChainNode] = {}
+        # parent digest -> insertion-ordered child keys (partial-match scan)
+        self._children: Dict[bytes, List[Tuple[bytes, bytes]]] = {}
+        # zero-ref chain TAILS in LRU order (oldest first) — the eviction
+        # walk order; interior dead nodes are reached by parent cascade
+        self._evictable: "OrderedDict[Tuple[bytes, bytes], ChainNode]" = OrderedDict()
+        # O(1) reclaim/sharing accounting. Invariant: a request that maps a
+        # node maps (and holds) its whole ancestor chain, so req_refs == 0
+        # implies every descendant is dead too — ALL dead nodes are
+        # eventually evictable via the leaf-first cascade, and the dead
+        # count IS the reclaimable-headroom count admission may use.
+        self._dead = 0  # nodes with req_refs == 0
+        self._shared = 0  # nodes with req_refs >= 2
+        # host-side counters (always on — introspection must not depend on
+        # the metrics flag); the metric families mirror them when enabled
+        self._hits = 0
+        self._misses = 0
+        self._evictions = 0
+        self._tokens_reused = 0
+        self._cow_forks = 0
+        self._metrics = _cache_metrics()
+
+    # -- hashing -------------------------------------------------------------
+    @staticmethod
+    def _digest(parent_digest: bytes, token_bytes: bytes) -> bytes:
+        h = hashlib.blake2b(digest_size=16)
+        h.update(parent_digest)
+        h.update(token_bytes)
+        return h.digest()
+
+    # -- introspection -------------------------------------------------------
+    @property
+    def evictable_blocks(self) -> int:
+        """Blocks the cache retains but would surrender under pressure:
+        EVERY node with zero request references (the leaf-first eviction
+        cascade reaches interior dead nodes too) — admission may count all
+        of them as reclaimable headroom."""
+        with self._lock:
+            return self._dead
+
+    @property
+    def node_count(self) -> int:
+        with self._lock:
+            return len(self._nodes)
+
+    def shared_block_count(self) -> int:
+        """Cache-owned blocks currently mapped by >= 2 requests."""
+        with self._lock:
+            return self._shared
+
+    def stats_snapshot(self) -> Dict[str, Any]:
+        """Cheap health view for the serving layer and bench records."""
+        with self._lock:
+            lookups = self._hits + self._misses
+            # counters only — this runs on every serving pump tick, so it
+            # must never scan the node table under the lock
+            return {
+                "hits": self._hits,
+                "misses": self._misses,
+                "hit_rate": (self._hits / lookups) if lookups else 0.0,
+                "tokens_reused": self._tokens_reused,
+                "bytes_saved": self._tokens_reused * self.bytes_per_token,
+                "cow_forks": self._cow_forks,
+                "evictions": self._evictions,
+                "nodes": len(self._nodes),
+                "evictable_blocks": self._dead,
+                "blocks_shared": self._shared,
+            }
+
+    def peek_cached_blocks(self, prompt: np.ndarray) -> Tuple[int, int]:
+        """``(matched, matched_evictable)``: the full blocks a :meth:`match`
+        of ``prompt`` would map, WITHOUT taking references — the admission
+        reservation uses this to count only non-shared blocks against the
+        pool. ``matched_evictable`` counts matched blocks currently DEAD
+        (zero request refs): pinning those consumes reclaimable headroom the
+        caller may otherwise have counted as free."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        cap = prompt.size - 1
+        bs = self.block_size
+        with self._lock:
+            parent_digest = _ROOT_DIGEST
+            pos = 0
+            n = 0
+            n_evictable = 0
+            while pos + bs <= cap:
+                key = (parent_digest, prompt[pos : pos + bs].tobytes())
+                node = self._nodes.get(key)
+                if node is None:
+                    break
+                n += 1
+                if node.req_refs == 0:
+                    n_evictable += 1
+                pos += bs
+                parent_digest = node.digest
+            return n, n_evictable
+
+    # -- match / acquire -----------------------------------------------------
+    def match(self, prompt: np.ndarray) -> MatchResult:
+        """Map the longest cached prefix chain of ``prompt``; references are
+        taken atomically under the cache lock (matched nodes can never be
+        evicted between match and use). The fault site at the top models a
+        corrupted/unavailable index — callers degrade to a cold miss."""
+        fault_point("prefix_cache.match")
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        cap = prompt.size - 1  # >= 1 token must be computed for logits
+        bs = self.block_size
+        with self._lock:
+            nodes: List[ChainNode] = []
+            parent: Optional[ChainNode] = None
+            parent_digest = _ROOT_DIGEST
+            pos = 0
+            while pos + bs <= cap:
+                key = (parent_digest, prompt[pos : pos + bs].tobytes())
+                node = self._nodes.get(key)
+                if node is None:
+                    break
+                nodes.append(node)
+                pos += bs
+                parent = node
+                parent_digest = node.digest
+            cow = self._match_partial_locked(prompt, pos, cap, parent_digest)
+            for node in nodes:
+                self._acquire_locked(node)
+            cached = pos + (cow[2] if cow is not None else 0)
+            if cached > 0:
+                self._hits += 1
+                self._tokens_reused += cached
+                self._metrics["hits"].inc()
+                self._metrics["saved"].set(
+                    self._tokens_reused * self.bytes_per_token
+                )
+            else:
+                self._misses += 1
+                self._metrics["misses"].inc()
+            return MatchResult(nodes, cached, cow)
+
+    def _match_partial_locked(
+        self,
+        prompt: np.ndarray,
+        pos: int,
+        cap: int,
+        parent_digest: bytes,
+    ) -> Optional[Tuple[ChainNode, int, int]]:
+        """The copy-on-write arm: the FIRST DIVERGENT block. The remaining
+        prompt (a ragged tail, the one token held back by the cap, or a
+        mid-block divergence) may share a leading run of tokens with some
+        cached child block. Fork the child with the longest common prefix
+        into a private copy so that cached KV is reused without
+        recomputation and the divergent writes never touch the shared block.
+        Returns ``(src_node, dst_block, partial_len)``."""
+        remaining = prompt[pos : min(cap, pos + self.block_size)]
+        if remaining.size < 1:
+            return None
+        src: Optional[ChainNode] = None
+        best = 0
+        for key in self._children.get(parent_digest, ()):
+            node = self._nodes.get(key)
+            if node is None:
+                continue
+            cand = np.frombuffer(node.token_bytes, np.int32)[: remaining.size]
+            neq = np.nonzero(cand != remaining)[0]
+            k = int(neq[0]) if neq.size else int(remaining.size)
+            if k > best:
+                best, src = k, node
+        if src is None:
+            return None
+        remaining = remaining[:best]
+        try:
+            fault_point("prefix_cache.cow")
+            dst = self._alloc_block_locked()
+        except (InjectedFault, MemoryError) as exc:
+            # CoW failure degrades to recompute — never to a failed request
+            _flight.record_event(
+                "cow_fork_failed", error=f"{type(exc).__name__}: {exc}"[:120]
+            )
+            return None
+        self._acquire_locked(src)  # pin the source until the copy executes
+        self._cow_forks += 1
+        return (src, dst, int(remaining.size))
+
+    def _acquire_locked(self, node: ChainNode) -> None:
+        if node.req_refs == 0:
+            self._dead -= 1
+        elif node.req_refs == 1:
+            self._shared += 1
+        node.req_refs += 1
+        self._pool.incref(node.block)
+        self._evictable.pop(node.key, None)
+
+    def acquire(self, nodes: List[ChainNode]) -> None:
+        """Re-take request references on an already-matched chain (recovery
+        replay re-maps a live slot's chain through the same accounting)."""
+        with self._lock:
+            for node in nodes:
+                self._acquire_locked(node)
+
+    # -- insert (in-flight registration) -------------------------------------
+    def insert(
+        self,
+        parent: Optional[ChainNode],
+        tokens: np.ndarray,
+        block: int,
+    ) -> Optional[ChainNode]:
+        """Register a request's freshly COMPUTED full prompt block as a chain
+        node (in-flight: later admissions match it immediately). The cache
+        becomes a co-owner of the physical block (pool incref); the request
+        keeps its own reference. Returns None when the key already exists —
+        two requests computed the same block concurrently; the caller keeps
+        its copy private and the cache keeps the first."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        if tokens.size != self.block_size:
+            raise ValueError(
+                f"only full blocks are cacheable: got {tokens.size} tokens, "
+                f"block_size={self.block_size}"
+            )
+        token_bytes = tokens.tobytes()
+        parent_digest = parent.digest if parent is not None else _ROOT_DIGEST
+        key = (parent_digest, token_bytes)
+        with self._lock:
+            if key in self._nodes:
+                return None
+            node = ChainNode(
+                key, self._digest(parent_digest, token_bytes), int(block),
+                parent, token_bytes,
+            )
+            node.req_refs = 1
+            self._pool.incref(block)  # cache co-ownership
+            if parent is not None:
+                parent.child_refs += 1
+                self._evictable.pop(parent.key, None)
+            self._nodes[key] = node
+            self._children.setdefault(parent_digest, []).append(key)
+            return node
+
+    # -- release / evict -----------------------------------------------------
+    def release(self, nodes: List[ChainNode]) -> None:
+        """Drop one request reference per node (finished/cancelled request).
+        Blocks are NOT freed — zero-ref chain tails enter the LRU and stay
+        warm until pressure evicts them."""
+        with self._lock:
+            for node in reversed(nodes):
+                self._release_locked(node)
+
+    def release_cow_source(self, node: ChainNode) -> None:
+        """Drop the pin taken on a CoW fork's source once the device copy
+        has executed."""
+        with self._lock:
+            self._release_locked(node)
+
+    def _release_locked(self, node: ChainNode) -> None:
+        if node.req_refs <= 0:
+            raise RuntimeError(
+                f"refcount underflow on cached block {node.block}"
+            )
+        node.req_refs -= 1
+        if node.req_refs == 0:
+            self._dead += 1
+        elif node.req_refs == 1:
+            self._shared -= 1
+        self._pool.decref(node.block)
+        if node.req_refs == 0 and node.child_refs == 0:
+            self._evictable[node.key] = node  # most-recent end
+
+    def evict_blocks(self, n: int) -> int:
+        """Evict up to ``n`` zero-reference nodes, LRU-first, returning
+        their physical blocks to the pool; cascades availability to parents
+        whose last child left. Returns the number evicted."""
+        with self._lock:
+            return self._evict_locked(n)
+
+    def _evict_locked(self, n: int) -> int:
+        done = 0
+        while done < n and self._evictable:
+            _key, node = self._evictable.popitem(last=False)  # oldest
+            self._drop_node_locked(node)
+            done += 1
+        if done:
+            self._evictions += done
+            self._metrics["evictions"].inc(done)
+            _flight.record_event("prefix_evict", blocks=done)
+        return done
+
+    def _drop_node_locked(self, node: ChainNode) -> None:
+        self._dead -= 1  # only dead nodes ever reach the eviction walk
+        del self._nodes[node.key]
+        siblings = self._children.get(node.key[0])
+        if siblings is not None:
+            siblings.remove(node.key)
+            if not siblings:
+                del self._children[node.key[0]]
+        self._pool.decref(node.block)  # cache ownership drop; frees at zero
+        parent = node.parent
+        if parent is not None:
+            parent.child_refs -= 1
+            if parent.child_refs == 0 and parent.req_refs == 0:
+                # the parent was pinned only by this child; it is OLDER than
+                # anything in the LRU, so it goes to the eviction head
+                self._evictable[parent.key] = parent
+                self._evictable.move_to_end(parent.key, last=False)
+
+    def _alloc_block_locked(self) -> int:
+        """One private block for the CoW fork, evicting under pressure."""
+        try:
+            return self._pool.acquire_block()
+        except MemoryError:
+            if self._evict_locked(1) == 0:
+                raise
+            return self._pool.acquire_block()
+
+    def alloc_private_block(self) -> int:
+        """Allocate one request-private block, evicting zero-ref cached
+        chains LRU-first under pressure — the engine's single allocation
+        seam, so cache retention can never starve live requests."""
+        with self._lock:
+            return self._alloc_block_locked()
+
+    def update_shared_gauge(self) -> None:
+        """Refresh the blocks-shared gauge (cheap; engine calls it at
+        admit/release boundaries behind the metrics gate)."""
+        if not _obs.metrics_enabled():
+            return
+        self._metrics["shared"].set(self.shared_block_count())

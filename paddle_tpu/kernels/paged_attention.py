@@ -174,3 +174,194 @@ def paged_flash_decode(
         interpret=interpret,
     )(block_tables.astype(jnp.int32), seq_lens.astype(jnp.int32), qg, key_cache, value_cache)
     return out.reshape(b, hq, d)
+
+
+# ---------------------------------------------------------------------------
+# Ragged MIXED prefill/decode kernel (chunked prefill)
+# ---------------------------------------------------------------------------
+#
+# One grid cell serves every new token of one sequence at once: the row
+# dimension packs the chunk's C token positions x the G grouped query heads
+# of one KV head, so a decode row (1 valid token) and a prompt-chunk row
+# (up to C tokens) are the SAME kernel — the engine's single compiled
+# signature. Each packed row carries its own causal limit
+# (``seq_lens + j + 1`` for chunk token j), which is what makes the batch
+# ragged rather than rectangular ("Ragged Paged Attention", arxiv
+# 2604.15464).
+
+
+def _chunk_kernel(
+    tables_ref,  # scalar prefetch: [B, MBS] int32
+    lens_ref,  # scalar prefetch: [B] int32 tokens cached BEFORE the chunk
+    qlens_ref,  # scalar prefetch: [B] int32 valid new tokens (0 = skip row)
+    q_ref,  # [1, 1, C*G, D] chunk-major packed rows (row = j*G + g)
+    k_ref,  # [1, 1, BS, D] this logical block's physical KV (one head)
+    v_ref,
+    o_ref,  # [1, 1, C*G, D]
+    m_ref,  # VMEM [C*G, 1] running max
+    l_ref,  # VMEM [C*G, 1] running denom
+    acc_ref,  # VMEM [C*G, D] running numerator
+    *,
+    scale: float,
+    block_size: int,
+    num_blocks: int,
+    group: int,
+):
+    bi = pl.program_id(0)
+    i = pl.program_id(2)
+    rows = q_ref.shape[2]
+
+    @pl.when(i == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # ragged skip: the LAST position any of this sequence's rows may see is
+    # lens + q_lens - 1 (the chunk's final token attending to itself); blocks
+    # wholly past it are predicated away — a decode row costs the same blocks
+    # it did under the decode-only kernel, and an inactive slot (q_lens == 0)
+    # never takes this branch at all.
+    @pl.when(i * block_size < lens_ref[bi] + qlens_ref[bi])
+    def _attend():
+        q = q_ref[0, 0].astype(jnp.float32) * scale  # [C*G, D]
+        k = k_ref[0, 0].astype(jnp.float32)  # [BS, D]
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [C*G, BS]
+        pos = i * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (rows, block_size), 1
+        )
+        # per-row causal limit: packed row r serves chunk token j = r // G at
+        # absolute position lens + j, so it may see pos <= lens + j
+        row_j = jax.lax.broadcasted_iota(jnp.int32, (rows, block_size), 0) // group
+        valid = (pos < lens_ref[bi] + row_j + 1) & (row_j < qlens_ref[bi])
+        s = jnp.where(valid, s, NEG_INF)
+
+        m_prev = m_ref[...]  # [C*G, 1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        # the explicit valid multiply keeps fully-masked rows at p == 0 (a
+        # row past q_lens has every position masked: exp(s - NEG_INF) would
+        # otherwise be 1 everywhere — silent garbage)
+        p = jnp.exp(s - m_new) * valid.astype(jnp.float32)  # [C*G, BS]
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_ref[...] = m_new
+
+    @pl.when(i == num_blocks - 1)
+    def _finish():
+        denom = jnp.maximum(l_ref[...], 1e-30)
+        out = acc_ref[...] / denom  # [C*G, D]
+        # rows past q_lens emitted exact zeros (their l stayed 0 -> out is
+        # 0/1e-30 = 0 already via the masked p), but force it explicitly so
+        # the contract does not hinge on the epsilon
+        row_j = jax.lax.broadcasted_iota(jnp.int32, (rows, 1), 0) // group
+        out = jnp.where(row_j < qlens_ref[bi], out, 0.0)
+        o_ref[0, 0] = out.astype(o_ref.dtype)
+
+
+@functools.lru_cache(maxsize=64)
+def chunk_lowering_supported(b: int, c: int, hq: int, hkv: int, d: int, nb: int,
+                             bs: int, mbs: int, dtype: str) -> bool:
+    """Static Mosaic-lowering probe for the mixed prefill/decode kernel,
+    cached per geometry (same rule as :func:`lowering_supported`)."""
+    import numpy as np
+
+    q = jax.ShapeDtypeStruct((b, c, hq, d), np.dtype(dtype))
+    kc = jax.ShapeDtypeStruct((nb, hkv, bs, d), np.dtype(dtype))
+    tb = jax.ShapeDtypeStruct((b, mbs), np.int32)
+    ln = jax.ShapeDtypeStruct((b,), np.int32)
+    try:
+        jax.export.export(
+            jax.jit(
+                lambda q, kc, vc, t, l, ql: paged_flash_chunk(q, kc, vc, t, l, ql)
+            ),
+            platforms=["tpu"],
+        )(q, kc, kc, tb, ln, ln)
+        return True
+    except Exception:  # noqa: BLE001 - any lowering failure means "don't"
+        return False
+
+
+def paged_flash_chunk(
+    q: jax.Array,  # [B, C, HQ, D] ragged chunk (row j valid iff j < q_lens)
+    key_cache: jax.Array,  # [NB, HKV, BS, D] chunk KV ALREADY appended
+    value_cache: jax.Array,
+    block_tables: jax.Array,  # [B, MBS] int32
+    seq_lens: jax.Array,  # [B] tokens cached BEFORE the chunk
+    q_lens: jax.Array,  # [B] valid new tokens (0 = inactive slot)
+    scale: Optional[float] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Flash attention for one mixed prefill/decode step over the paged
+    cache. Returns ``[B, C, HQ, D]`` with rows past ``q_lens`` exactly 0."""
+    b, c, hq, d = q.shape
+    nb, hkv, bs, _ = key_cache.shape
+    mbs = block_tables.shape[1]
+    if hq % hkv != 0:
+        raise ValueError(f"q heads {hq} not a multiple of kv heads {hkv}")
+    g = hq // hkv
+    if scale is None:
+        scale = 1.0 / (d**0.5)
+    # pack rows chunk-major per KV head: [B, C, HKV, G, D] -> [B, HKV, C*G, D]
+    qg = q.reshape(b, c, hkv, g, d).transpose(0, 2, 1, 3, 4).reshape(b, hkv, c * g, d)
+
+    grid = (b, hkv, mbs)
+    kernel = functools.partial(
+        _chunk_kernel, scale=float(scale), block_size=bs, num_blocks=mbs,
+        group=g,
+    )
+
+    def _kv_index(bi, hi, i, tables, lens, qlens):
+        # logical blocks past the LAST in-use block (which now includes the
+        # freshly appended chunk) clamp onto it: the pipeline sees the same
+        # physical index as the previous grid step and skips the HBM->VMEM
+        # copy, so ragged tails cost no DMA (the matching compute skip is the
+        # pl.when in the kernel)
+        last = jnp.maximum((lens[bi] + qlens[bi] + bs - 1) // bs - 1, 0)
+        return (tables[bi, jnp.minimum(i, last)], hi, 0, 0)
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec(
+                    (1, 1, c * g, d),
+                    lambda bi, hi, i, tables, lens, qlens: (bi, hi, 0, 0),
+                ),
+                pl.BlockSpec((1, 1, bs, d), _kv_index),
+                pl.BlockSpec((1, 1, bs, d), _kv_index),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, c * g, d),
+                lambda bi, hi, i, tables, lens, qlens: (bi, hi, 0, 0),
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((c * g, 1), jnp.float32),
+                pltpu.VMEM((c * g, 1), jnp.float32),
+                pltpu.VMEM((c * g, d), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, hkv, c * g, d), q.dtype),
+        # batch and kv-head cells are independent; the block walk accumulates
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(
+        block_tables.astype(jnp.int32),
+        seq_lens.astype(jnp.int32),
+        q_lens.astype(jnp.int32),
+        qg,
+        key_cache,
+        value_cache,
+    )
+    # [B, HKV, C*G, D] -> [B, C, HQ, D]
+    return out.reshape(b, hkv, c, g, d).transpose(0, 2, 1, 3, 4).reshape(b, c, hq, d)

@@ -82,18 +82,24 @@ class LlamaRotaryEmbedding(nn.Layer):
         if isinstance(offset, Tensor):
             # decode path: position is a traced scalar — or a [B] vector for
             # batches whose sequences sit at different lengths — so the table
-            # lookup must be a dynamic_slice (vmapped for the vector case)
+            # lookup must be a dynamic lookup
             from paddle_tpu.core.dispatch import call_op
             import jax
 
             def sl(tab, off):
-                if off.ndim == 0 or off.size == 1:
+                if off.ndim == 0:
+                    # true scalar (static-cache decode): one slice suffices
                     return jax.lax.dynamic_slice_in_dim(
                         tab, off.reshape(()), seq_len, axis=0
                     )
-                per = jax.vmap(
-                    lambda o: jax.lax.dynamic_slice_in_dim(tab, o, seq_len, axis=0)
-                )(off.reshape(-1))
+                # chunked rows: a dynamic_slice of width seq_len CLAMPS its
+                # start to table_len - seq_len, which would silently rotate
+                # the last chunk of a near-max-length context with wrong
+                # positions — gather exact per-position rows instead (rows
+                # past the table end clip to the last entry; those positions
+                # are masked rows / beyond max_position anyway)
+                pos = off.reshape(-1)[:, None] + jnp.arange(seq_len)[None, :]
+                per = tab[jnp.clip(pos, 0, tab.shape[0] - 1)]
                 return per[:, :, None, :]  # [B, s, 1, D] broadcasts over heads
 
             return (
@@ -138,43 +144,65 @@ class LlamaAttention(nn.Layer):
         if (
             cache_position is not None
             and past_key_value is not None
-            and len(past_key_value) in (4, 5)
+            and len(past_key_value) in (4, 5, 6)
         ):
-            # paged decode: past is (key_cache [NB,HK,BS,D], value_cache,
-            # block_tables [B,MBS], seq_lens [B][, slot_mask [B]]) — the
-            # vLLM-style serving cache (reference `block_multihead_attention_`
-            # fused_ops.yaml:45). Positions are ragged per sequence: rope
-            # tables gather per-seq. The optional 5th element is the
-            # continuous-batching engine's active-slot mask: padded batch
-            # slots write no KV and return zeros, so the decode step's shape
-            # stays fixed while the live batch composition changes.
+            # paged serving: past is (key_cache [NB,HK,BS,D], value_cache,
+            # block_tables [B,MBS], seq_lens [B][, slot_mask [B][, q_lens
+            # [B]]]) — the vLLM-style serving cache (reference
+            # `block_multihead_attention_` fused_ops.yaml:45). Positions are
+            # ragged per sequence: rope tables gather per-seq. The optional
+            # 5th element is the continuous-batching engine's active-slot
+            # mask: padded batch slots write no KV and return zeros, so the
+            # step's shape stays fixed while the live batch composition
+            # changes. The optional 6th element is the CHUNKED-PREFILL row
+            # count: each slot carries up to ``s`` new tokens (a decode row
+            # has q_lens == 1, a prompt chunk up to s) through ONE mixed
+            # ragged dispatch — the engine's single compiled signature.
             from paddle_tpu.core.tensor import Tensor as _T
-            from paddle_tpu.incubate.nn.functional import block_multihead_attention
+            from paddle_tpu.incubate.nn.functional import (
+                block_multihead_attention,
+                block_multihead_chunk_attention,
+            )
 
             kc, vc, tables, lens = past_key_value[:4]
-            slot_mask = past_key_value[4] if len(past_key_value) == 5 else None
+            slot_mask = past_key_value[4] if len(past_key_value) >= 5 else None
+            q_lens = past_key_value[5] if len(past_key_value) == 6 else None
             lens_t = lens if isinstance(lens, _T) else _T(lens)
             lens_arr = lens_t._data
-            cos, sin = self.rotary_emb(s, lens_t)  # ragged: [B, 1, 1, D]
+            cos, sin = self.rotary_emb(s, lens_t)  # ragged: [B, s, 1, D]
             q, k, _ = fused_rotary_position_embedding(q, k, None, sin=sin, cos=cos)
-            out_a, kc2, vc2 = block_multihead_attention(
-                q._data,
-                k._data,
-                v._data,
-                kc._data if isinstance(kc, _T) else kc,
-                vc._data if isinstance(vc, _T) else vc,
-                tables._data if isinstance(tables, _T) else tables,
-                lens_arr,
-                slot_mask=(
-                    slot_mask._data if isinstance(slot_mask, _T) else slot_mask
-                ),
-            )
+            mask_arr = slot_mask._data if isinstance(slot_mask, _T) else slot_mask
+            if q_lens is not None:
+                out_a, kc2, vc2 = block_multihead_chunk_attention(
+                    q._data,
+                    k._data,
+                    v._data,
+                    kc._data if isinstance(kc, _T) else kc,
+                    vc._data if isinstance(vc, _T) else vc,
+                    tables._data if isinstance(tables, _T) else tables,
+                    lens_arr,
+                    q_lens._data if isinstance(q_lens, _T) else q_lens,
+                    slot_mask=mask_arr,
+                )
+            else:
+                out_a, kc2, vc2 = block_multihead_attention(
+                    q._data,
+                    k._data,
+                    v._data,
+                    kc._data if isinstance(kc, _T) else kc,
+                    vc._data if isinstance(vc, _T) else vc,
+                    tables._data if isinstance(tables, _T) else tables,
+                    lens_arr,
+                    slot_mask=mask_arr,
+                )
             out = self.o_proj(reshape(_T(out_a), [b, s, self.num_heads * self.head_dim]))
             if not use_cache:
                 return out
             new_past = (_T(kc2), _T(vc2), tables, lens)
-            if len(past_key_value) == 5:
+            if len(past_key_value) >= 5:
                 new_past = new_past + (slot_mask,)
+            if len(past_key_value) == 6:
+                new_past = new_past + (q_lens,)
             return out, new_past
         if cache_position is not None and past_key_value is not None:
             # static-cache decode: past is a FIXED [B, S_max, HK, D] buffer

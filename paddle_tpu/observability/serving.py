@@ -105,4 +105,10 @@ def serving_metrics() -> Dict[str, Any]:
             "HTTP responses by status code (200/400/404/429/500).",
             labelnames=("code",),
         ),
+        "prefix_hit_rate": reg.gauge(
+            "serving_prefix_cache_hit_rate",
+            "Engine prefix-cache hit rate (admissions that reused cached "
+            "prefix KV / all admissions) since engine construction; 0 when "
+            "the prefix cache is disabled.",
+        ),
     }

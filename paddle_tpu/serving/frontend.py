@@ -495,9 +495,11 @@ class ServingFrontend:
                 ttft = now - handle.submit_time
                 self._ttfts.append(ttft)
                 self._metrics["ttft"].labels(priority=pr).observe(ttft)
-                if handle.inner.admit_time is not None:
+                if handle.inner.prefill_start is not None:
+                    # queue wait ends when the slot is mapped (chunked
+                    # prefill then runs across subsequent engine steps)
                     self._metrics["queue_wait"].labels(priority=pr).observe(
-                        handle.inner.admit_time - handle.submit_time
+                        handle.inner.prefill_start - handle.submit_time
                     )
 
     def _finalize(self, handle: ServingRequest, now: float) -> ServingRequest:
@@ -510,7 +512,7 @@ class ServingFrontend:
                     len(handle.inner.generated)
                 )
         elif reason == "deadline":
-            stage = "queued" if handle.inner.admit_time is None else "decode"
+            stage = "queued" if handle.inner.prefill_start is None else "decode"
             outcome = f"deadline_{stage}"
             self._count_shed(outcome)
             self._metrics["deadline_miss"].labels(stage=stage).inc()
@@ -584,7 +586,11 @@ class ServingFrontend:
 
     def _update_controller(self) -> int:
         stats = self.engine.pool_stats()
-        util = stats["allocated"] / stats["total"] if stats["total"] else 0.0
+        # blocks the prefix cache retains warm but surrenders under pressure
+        # are headroom, not load — counting them would shed traffic a single
+        # eviction could have served
+        live = stats["allocated"] - stats.get("cached_reusable", 0)
+        util = live / stats["total"] if stats["total"] else 0.0
         queue_frac = self.engine.queue_depth() / self.config.max_queue
         prev = self.controller.level
         level = self.controller.update(queue_frac, util, self._ttft_p99())
@@ -601,6 +607,9 @@ class ServingFrontend:
     def _update_gauges(self) -> None:
         self._metrics["queue_depth"].set(self.engine.queue_depth())
         self._metrics["level"].set(self.controller.level)
+        cache = self.engine.prefix_cache_stats()
+        if cache.get("enabled"):
+            self._metrics["prefix_hit_rate"].set(cache["hit_rate"])
 
     # -- pump thread ---------------------------------------------------------
     def start(self) -> "ServingFrontend":
@@ -678,14 +687,22 @@ class ServingFrontend:
         """Cheap health view (the HTTP /healthz payload)."""
         with self._lock:
             stats = self.engine.pool_stats()
+            live = stats["allocated"] - stats.get("cached_reusable", 0)
+            cache = self.engine.prefix_cache_stats()
             return {
                 "level": self.controller.level_name,
                 "queue_depth": self.engine.queue_depth(),
                 "max_queue": self.config.max_queue,
                 "live_requests": len(self._live),
                 "kv_utilization": round(
-                    stats["allocated"] / stats["total"] if stats["total"] else 0.0, 4
+                    live / stats["total"] if stats["total"] else 0.0, 4
                 ),
                 "ttft_p99_s": round(self._ttft_p99(), 4),
                 "failed": self._failed,
+                "prefix_cache": {
+                    "enabled": bool(cache.get("enabled")),
+                    "hit_rate": round(cache.get("hit_rate", 0.0), 4),
+                    "tokens_reused": cache.get("tokens_reused", 0),
+                    "evictable_blocks": cache.get("evictable_blocks", 0),
+                },
             }

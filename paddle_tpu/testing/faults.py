@@ -67,6 +67,13 @@ KNOWN_SITES = (
     # take down the serving pump or the engine step path (the callers there
     # use the safe_* forms; campaigns prove it)
     "tracing.export",
+    # prefix-cache seams (inference/prefix_cache.py): a failing chain lookup
+    # must degrade to a cold cache miss (the prompt is recomputed), and a
+    # failing copy-on-write fork must degrade to recompute of the partial
+    # block — campaigns prove neither can fail a request. Both are pinned
+    # zero-cost-when-empty like block_pool.allocate.
+    "prefix_cache.match",
+    "prefix_cache.cow",
 )
 
 

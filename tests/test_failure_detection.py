@@ -479,7 +479,7 @@ class TestEngineRecovery:
             for p, t in specs
         ]
 
-    def test_recovery_tokens_byte_identical_two_compiles(self):
+    def test_recovery_tokens_byte_identical_one_compile(self):
         from paddle_tpu import observability as obs
         from paddle_tpu.inference import ContinuousBatchingEngine
 
@@ -504,20 +504,17 @@ class TestEngineRecovery:
                 out_a[ra].tokens(), out_b[rb].tokens()
             )
             assert out_a[ra].finish_reason == out_b[rb].finish_reason
-        # the 2-compile invariant holds ACROSS a recovery: replay reuses
-        # both compiled programs (recompile watchdog is the honesty source)
+        # the 1-compile invariant holds ACROSS a recovery: replay reuses
+        # the one compiled program (recompile watchdog is the honesty source)
         rep = {
             k: v["count"]
             for k, v in obs.GLOBAL_WATCHDOG.report().items()
             if k.startswith("ContinuousBatchingEngine.")
         }
-        assert rep == {
-            "ContinuousBatchingEngine.prefill": 1,
-            "ContinuousBatchingEngine.decode": 1,
-        }
-        assert eng_b.stats["prefill_traces"] == 1
-        assert eng_b.stats["decode_traces"] == 1
-        assert eng_b.pool_stats()["free"] == eng_b.num_blocks
+        assert rep == {"ContinuousBatchingEngine.step": 1}
+        assert eng_b.stats["step_traces"] == 1
+        s = eng_b.pool_stats()
+        assert s["free"] + s["cached_blocks"] == eng_b.num_blocks
 
     def test_prefill_fault_recovers_too(self):
         m, cfg, eng = _tiny_engine(seed=21)
@@ -806,7 +803,7 @@ class TestReviewHardening:
         def interrupted(*a, **k):
             raise KeyboardInterrupt()
 
-        eng._decode_fn = interrupted
+        eng._step_fn = interrupted
         with pytest.raises(KeyboardInterrupt):
             eng.step()
         # propagated directly: no recovery attempt consumed the interrupt,
@@ -817,15 +814,15 @@ class TestReviewHardening:
     def test_drain_finished_salvages_after_permanent_failure(self):
         m, cfg, eng = _tiny_engine(seed=31, max_recoveries=0)
         rng = np.random.default_rng(31)
-        # finishes AT PREFILL (max_new_tokens=1) during the same step whose
-        # decode dispatch then permanently fails
-        done_rid = eng.add_request(
-            rng.integers(0, cfg.vocab_size, (3,)).astype(np.int32),
-            max_new_tokens=1,
-        )
         eng.add_request(
             rng.integers(0, cfg.vocab_size, (4,)).astype(np.int32),
             max_new_tokens=4,
+        )
+        # shed into the pending-delivery buffer (deadline already expired)
+        # during the same step whose dispatch then permanently fails
+        done_rid = eng.add_request(
+            rng.integers(0, cfg.vocab_size, (3,)).astype(np.int32),
+            max_new_tokens=4, deadline=time.perf_counter() - 1.0,
         )
         with faults.inject(
             faults.FaultPlan([faults.FaultTrigger("engine.decode", i) for i in range(4)])

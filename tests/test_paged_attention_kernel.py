@@ -201,3 +201,102 @@ class TestRaggedSkip:
         lens = jnp.zeros((q.shape[0],), jnp.int32)
         out = np.asarray(paged_flash_decode(q, kc, vc, tables, lens, interpret=True))
         assert (out == 0.0).all()
+
+
+# -- ragged MIXED prefill/decode kernel (chunked prefill) ---------------------
+
+from paddle_tpu.kernels.paged_attention import paged_flash_chunk  # noqa: E402
+
+
+def _chunk_reference(q, key_cache, value_cache, tables, lens, q_lens):
+    """Dense-gather reference for the mixed step (the XLA chunk path's
+    math): query token j of sequence b sees cached positions < lens[b]+j+1;
+    rows past q_lens emit zeros."""
+    b, c, hq, d = q.shape
+    hkv = key_cache.shape[1]
+    gk = jnp.moveaxis(key_cache[tables], 2, 3).reshape(b, -1, hkv, d)
+    gv = jnp.moveaxis(value_cache[tables], 2, 3).reshape(b, -1, hkv, d)
+    if hkv != hq:
+        gk = jnp.repeat(gk, hq // hkv, axis=2)
+        gv = jnp.repeat(gv, hq // hkv, axis=2)
+    qf = q.astype(jnp.float32) / np.sqrt(d)
+    s = jnp.einsum("bchd,blhd->bchl", qf, gk.astype(jnp.float32))
+    L = gk.shape[1]
+    limit = lens[:, None] + jnp.arange(c)[None, :] + 1  # [B, C]
+    mask = jnp.arange(L)[None, None, :] < limit[:, :, None]
+    s = jnp.where(mask[:, :, None, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bchl,blhd->bchd", p, gv.astype(jnp.float32))
+    row_valid = jnp.arange(c)[None, :] < q_lens[:, None]
+    return jnp.where(row_valid[:, :, None, None], out, 0.0).astype(q.dtype)
+
+
+def _chunk_setup(b=3, c=4, hq=4, hkv=4, d=64, mbs=4, nb=16, seed=0,
+                 dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.normal(size=(b, c, hq, d)), dtype)
+    kc = jnp.asarray(rng.normal(size=(nb, hkv, BS, d)), dtype)
+    vc = jnp.asarray(rng.normal(size=(nb, hkv, BS, d)), dtype)
+    tables = jnp.asarray(rng.permutation(nb)[: b * mbs].reshape(b, mbs), jnp.int32)
+    # ragged mix: a decode row (1), a full prompt chunk (c), an inactive (0)
+    q_lens = jnp.asarray([1, c, 0][:b] + [1] * max(0, b - 3), jnp.int32)
+    lens = jnp.asarray(rng.integers(0, mbs * BS - c, (b,)), jnp.int32)
+    return q, kc, vc, tables, lens, q_lens
+
+
+class TestPagedFlashChunk:
+    def test_mixed_rows_match_dense_gather(self):
+        args = _chunk_setup()
+        out = paged_flash_chunk(*args, interpret=True)
+        ref = _chunk_reference(*args)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+    def test_gqa_chunk(self):
+        args = _chunk_setup(hq=8, hkv=2, seed=1)
+        out = paged_flash_chunk(*args, interpret=True)
+        ref = _chunk_reference(*args)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+    def test_inactive_rows_exact_zero_even_poisoned_pool(self):
+        """q_lens == 0 slots and rows past q_lens must emit EXACT zeros even
+        when every pool value is NaN — the engine's padded slots."""
+        q, kc, vc, tables, lens, _ = _chunk_setup(seed=2)
+        kc = jnp.full_like(kc, jnp.nan)
+        vc = jnp.full_like(vc, jnp.nan)
+        q_lens = jnp.zeros((q.shape[0],), jnp.int32)
+        out = paged_flash_chunk(q, kc, vc, tables, lens, q_lens, interpret=True)
+        assert np.array_equal(np.asarray(out), np.zeros_like(np.asarray(out)))
+
+    def test_decode_row_equals_decode_kernel(self):
+        """A chunk with q_lens == 1 must reproduce the decode kernel's
+        output for its first row — the two raggednesses agree."""
+        q, kc, vc, tables, lens = _setup(seed=5)
+        b, hq, d = q.shape
+        c = 4
+        qc = jnp.zeros((b, c, hq, d), q.dtype).at[:, 0].set(q)
+        q_lens = jnp.ones((b,), jnp.int32)
+        # decode semantics: the current token is ALREADY appended in the
+        # pool, and `lens` EXCLUDES it — mirror that for the chunk call
+        out_c = paged_flash_chunk(
+            qc, kc, vc, tables, jnp.maximum(lens - 1, 0), q_lens, interpret=True
+        )
+        out_d = paged_flash_decode(q, kc, vc, tables, lens, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(out_c[:, 0]), np.asarray(out_d), rtol=2e-5, atol=2e-5
+        )
+
+    def test_chunk_lowers_for_tpu_serving_shape(self):
+        """The engine's unified mixed step lowers for TPU at a serving
+        geometry (8 slots x 16-token chunks, llama-7B-ish heads)."""
+        args = _chunk_setup(b=8, c=16, hq=32, hkv=32, d=128, mbs=16, nb=256,
+                            dtype=jnp.bfloat16)
+
+        def fn(q, kc, vc, tables, lens, q_lens):
+            return paged_flash_chunk(q, kc, vc, tables, lens, q_lens)
+
+        jax.export.export(jax.jit(fn), platforms=["tpu"])(*args)
+
+    def test_chunk_lowering_probe_matches_export(self):
+        from paddle_tpu.kernels.paged_attention import chunk_lowering_supported
+
+        assert chunk_lowering_supported(8, 16, 32, 32, 128, 256, 16, 16, "bfloat16")

@@ -58,6 +58,13 @@ def _frontend(seed=0, max_queue=8, config=None, **engine_kw):
     return fe, eng, cfg
 
 
+def _drained(eng):
+    """With no live work, every block is either free or retained (warm,
+    reclaimable) by the prefix cache — anything else is a leak."""
+    s = eng.pool_stats()
+    return s["free"] + s["cached_blocks"] == s["total"]
+
+
 def _prompt(rng, cfg, n=4):
     return rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
 
@@ -311,7 +318,7 @@ class TestDeadlines:
         assert eng.stats["admitted"] == prefills_before + 1
         assert metrics_on.get("serving_deadline_miss_total").value(stage="queued") == 2
         assert metrics_on.get("serving_shed_total").value(reason="deadline_queued") == 2
-        assert eng.pool_stats()["free"] == eng.num_blocks
+        assert _drained(eng)
 
     def test_mid_decode_expiry_evicts_and_reclaims(self, metrics_on):
         fe, eng, cfg = _frontend(seed=5, max_queue=4)
@@ -330,7 +337,7 @@ class TestDeadlines:
         assert 1 <= len(h.inner.generated) < 64  # evicted mid-generation
         assert metrics_on.get("serving_deadline_miss_total").value(stage="decode") == 1
         assert metrics_on.get("serving_shed_total").value(reason="deadline_decode") == 1
-        assert eng.pool_stats()["free"] == eng.num_blocks  # blocks reclaimed
+        assert _drained(eng)  # blocks reclaimed (cache retention is not a leak)
 
     def test_engine_level_deadline_without_frontend(self):
         # the engine enforces deadlines for direct users too
@@ -356,7 +363,7 @@ class TestDeadlines:
         fe.pump()
         assert fe.cancel(h.id, reason="client_disconnect") is True
         assert h.outcome == "client_disconnect" and h.finished
-        assert eng.pool_stats()["free"] == eng.num_blocks
+        assert _drained(eng)
         assert metrics_on.get("serving_shed_total").value(reason="client_disconnect") == 1
         assert fe.cancel(h.id) is False  # already terminal: exactly once
 
@@ -410,7 +417,7 @@ class TestStreaming:
         # the pump thread must retry, not fail every live stream
         fe, eng, cfg = _frontend(seed=20, max_queue=4)
         rng = np.random.default_rng(20)
-        real, tripped = eng._decode_fn, []
+        real, tripped = eng._step_fn, []
 
         def flaky(*a, **k):
             if not tripped:
@@ -418,7 +425,7 @@ class TestStreaming:
                 raise RuntimeError("transient device failure")
             return real(*a, **k)
 
-        eng._decode_fn = flaky
+        eng._step_fn = flaky
         h = fe.submit(_prompt(rng, cfg), max_new_tokens=4)
         fe.start()
         try:
@@ -599,12 +606,12 @@ class TestServingHTTP:
             if (
                 metrics_on.get("serving_shed_total").value(reason="client_disconnect")
                 == 1
-                and eng.pool_stats()["free"] == eng.num_blocks
+                and _drained(eng)
             ):
                 break
             time.sleep(0.02)
         assert metrics_on.get("serving_shed_total").value(reason="client_disconnect") == 1
-        assert eng.pool_stats()["free"] == eng.num_blocks
+        assert _drained(eng)
 
     def test_real_client_disconnect_never_leaks_pool_blocks(self, http_frontend):
         fe, eng, cfg, port = http_frontend
@@ -622,12 +629,12 @@ class TestServingHTTP:
         while time.monotonic() < deadline:
             with fe._lock:
                 if (
-                    eng.pool_stats()["free"] == eng.num_blocks
+                    _drained(eng)
                     and not eng.has_work()
                 ):
                     break
             time.sleep(0.02)
-        assert eng.pool_stats()["free"] == eng.num_blocks
+        assert _drained(eng)
 
 
 # -- sustained-overload engine invariants (property-style churn) --------------
@@ -706,13 +713,13 @@ class TestOverloadChurnInvariants:
         )
         non_ok = sum(1 for o in terminal.values() if o != "ok")
         assert shed_total == non_ok + rejected_at_intake
-        assert eng.pool_stats()["free"] == eng.num_blocks
+        assert _drained(eng)
 
 
 # -- the overload acceptance test ---------------------------------------------
 
 class TestOverloadAcceptance:
-    def test_2x_overload_sheds_explicitly_and_keeps_two_compiles(self, metrics_on):
+    def test_2x_overload_sheds_explicitly_and_keeps_one_compile(self, metrics_on):
         """ISSUE acceptance: arrivals at 2x the calibrated sustainable rate.
         The frontend must shed (Overloaded/429 paths) rather than grow the
         queue unboundedly, high-priority SLO attainment must not fall below
@@ -763,7 +770,7 @@ class TestOverloadAcceptance:
         assert sum(shed_cells.values()) == total_refused, (shed_cells, report)
         assert all(reason for reason in shed_cells)
         # the 2-compile honesty check: overload adds no compiles
-        assert report["compiled_signatures_total"] == 2, report
+        assert report["compiled_signatures_total"] == 1, report
         assert sum(report["compiles_during_run"].values()) == 0
 
 
@@ -823,7 +830,7 @@ class TestEngineAdmissionPolicy:
         assert got.generated == []  # never admitted: no prefill spent
         got2 = eng.cancel_request(running, reason="shed")
         assert got2.req_id == running and len(got2.generated) >= 1
-        assert eng.pool_stats()["free"] == eng.num_blocks  # blocks reclaimed
+        assert _drained(eng)  # blocks reclaimed (cache retention is not a leak)
         assert eng.cancel_request(running) is None  # exactly once
         assert not eng.has_work()
         assert eng.run() == {}  # cancelled requests are NOT re-delivered
@@ -840,7 +847,7 @@ def test_bench_serving_goodput_cpu_smoke():
     assert "error" not in rec, rec
     assert rec["metric"] == "serving_goodput_tokens_per_sec"
     assert rec["value"] >= 0
-    assert rec["compiled_signatures"] == 2, rec
+    assert rec["compiled_signatures"] == 1, rec
     assert rec["compiles_during_overload"] == 0, rec
     assert set(rec["slo_attainment"]) == {
         "chat/interactive", "app/standard", "batch/best_effort"

@@ -1051,6 +1051,42 @@ def test_dn802_negative_snapshot_copy_is_the_fix():
     assert codes(src) == []
 
 
+def test_dn802_chunked_dispatch_block_table_mutation():
+    """Chunked-prefill shape of the replay race: the per-slot block table
+    (host numpy) is handed to the unified mixed prefill/decode dispatch,
+    then mutated (a new block appended for the next chunk) before any sync
+    point — the async dispatch still aliases the table memory."""
+    src = _DN_ENGINE_HEADER + (
+        "    def chunk_steps(self, depth):\n"
+        "        for r in range(depth):\n"
+        "            tables = jnp.asarray(self._ntok)\n"
+        "            q_lens = jnp.asarray(self._last_tok)\n"
+        "            _nxt, self._state = self._fn(tables, self._state, q_lens)\n"
+        "            for i in range(4):\n"
+        "                self._ntok[i] = 9\n"
+        "                self._last_tok[i] += 1\n"
+    )
+    found = codes(src)
+    assert "DN802" in found, found
+
+
+def test_dn802_negative_chunked_dispatch_synced_then_mutated():
+    """The engine's actual unified-step shape: np.asarray(nxt) syncs the
+    dispatch before _ntok advances and the tables regrow — clean."""
+    src = _DN_ENGINE_HEADER + (
+        "    def chunk_steps(self, depth):\n"
+        "        for r in range(depth):\n"
+        "            tables = jnp.asarray(self._ntok)\n"
+        "            q_lens = jnp.asarray(self._last_tok)\n"
+        "            nxt, self._state = self._fn(tables, self._state, q_lens)\n"
+        "            nxt = np.asarray(nxt)\n"
+        "            for i in range(4):\n"
+        "                self._ntok[i] = 9\n"
+        "                self._last_tok[i] += 1\n"
+    )
+    assert codes(src) == []
+
+
 def test_dn802_negative_sync_point_before_mutation():
     """The normal step path: np.asarray(result) syncs before the host-side
     vectors are mutated — exactly why step() is safe without copies."""

@@ -315,7 +315,7 @@ class TestRequestSpanTree:
         step_total = sum(s["dur_us"] for s in steps) / 1e6
         assert share_total == pytest.approx(step_total, rel=1e-6)
 
-    def test_engine_compiles_stay_at_two_with_tracing_on(self, tracing_on):
+    def test_engine_compiles_stay_at_one_with_tracing_on(self, tracing_on):
         fe, eng, cfg = _frontend(seed=3)
         rng = np.random.default_rng(3)
         handles = [
@@ -324,8 +324,7 @@ class TestRequestSpanTree:
         ]
         _drain(fe, handles)
         counts = obs.GLOBAL_WATCHDOG.counts()
-        assert counts.get("ContinuousBatchingEngine.prefill") == 1
-        assert counts.get("ContinuousBatchingEngine.decode") == 1
+        assert counts.get("ContinuousBatchingEngine.step") == 1
 
     def test_intake_rejection_still_gets_a_terminal_root_span(self, tracing_on):
         from paddle_tpu.serving import Overloaded
@@ -382,15 +381,14 @@ class TestTracingOffPath:
         assert obs.GLOBAL_TRACER.records() == []
         assert obs.GLOBAL_TRACER._rng.getstate() == rng_state_before
 
-    def test_watchdog_still_reports_two_compiles_with_rate_zero(self):
+    def test_watchdog_still_reports_one_compile_with_rate_zero(self):
         obs.GLOBAL_WATCHDOG.reset()
         fe, eng, cfg = _frontend(seed=6)
         rng = np.random.default_rng(6)
         hs = [fe.submit(_prompt(rng, cfg), max_new_tokens=3) for _ in range(3)]
         _drain(fe, hs)
         counts = obs.GLOBAL_WATCHDOG.counts()
-        assert counts.get("ContinuousBatchingEngine.prefill") == 1
-        assert counts.get("ContinuousBatchingEngine.decode") == 1
+        assert counts.get("ContinuousBatchingEngine.step") == 1
 
 
 # -- HTTP propagation ---------------------------------------------------------
