@@ -402,6 +402,7 @@ def _main_timed(platform, paddle, cfg, batch, seq, steps, warmup) -> None:
         _bench_shared_prefix_ttft(paddle, platform),
         _bench_engine_fault_recovery(paddle, platform),
         _bench_serving_goodput(paddle, platform),
+        _bench_cluster_goodput(paddle, platform),
         _bench_traced_request_breakdown(paddle, platform),
     ]
     print(
@@ -1037,6 +1038,164 @@ def _bench_serving_goodput(paddle, platform: str) -> dict:
     except Exception as exc:  # noqa: BLE001 - secondary must never kill primary
         return {"metric": "serving_goodput_tokens_per_sec", "error": f"{exc!r}"[:300]}
     finally:
+        paddle.set_flags(prior)
+
+
+def _bench_cluster_goodput(paddle, platform: str) -> dict:
+    """Cluster-scale overload bench (guarded): three ``ServingFrontend``
+    replicas behind the prefix-affinity router, seeded Poisson arrivals at
+    2x the calibrated CLUSTER rate (per-replica sustainable rate x replica
+    count), and ONE REPLICA KILLED MID-STORM through the ``replica.kill``
+    fault site. Reports aggregate goodput, per-class SLO attainment,
+    failover latency p99, salvage/re-dispatch accounting, and the affinity
+    hit rate before vs after the kill (the survivors' rendezvous shares are
+    untouched, so warmth should largely survive the membership change) —
+    with the honesty checks: exactly one compiled signature per engine, and
+    the storm window (kill included) adds ZERO compiles."""
+    from paddle_tpu import observability as obs
+    from paddle_tpu.inference import ContinuousBatchingEngine
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import (
+        Priority,
+        ReplicaCluster,
+        ReplicaRouter,
+        RouterConfig,
+        ServingConfig,
+        ServingFrontend,
+    )
+    from paddle_tpu.serving.loadgen import (
+        TrafficClass,
+        measure_sustainable_rate,
+        poisson_arrivals,
+        run_cluster_open_loop,
+    )
+    from paddle_tpu.testing import faults
+
+    prior = paddle.get_flags(["FLAGS_enable_metrics"])
+    try:
+        if platform == "tpu":
+            cfg = LlamaConfig(
+                vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+                num_hidden_layers=8, num_attention_heads=16,
+                num_key_value_heads=16, max_position_embeddings=1024,
+            )
+            slots, bs, bucket, n_arrivals, calib = 4, 16, 128, 96, 12
+            plen, max_new, slo_s, max_queue = (16, 96), (16, 48), 8.0, 16
+        else:  # tiny CPU smoke: the same machinery with a small budget
+            cfg = LlamaConfig.tiny()
+            slots, bs, bucket, n_arrivals, calib = 2, 4, 16, 24, 6
+            plen, max_new, slo_s, max_queue = (3, 8), (3, 8), 2.0, 8
+        n_replicas, kill_frac = 3, 0.4
+
+        paddle.set_flags({"FLAGS_enable_metrics": True})
+        obs.GLOBAL_METRICS.reset()
+        obs.GLOBAL_WATCHDOG.reset()  # compile ledger counts THESE engines only
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg)
+        if platform == "tpu":
+            model = model.to(dtype="bfloat16")
+        model.eval()
+
+        # replicas share the model object (read-only at inference): identical
+        # weights are what makes failover re-generation deterministic
+        def factory(name):
+            eng = ContinuousBatchingEngine(
+                model, max_slots=slots, block_size=bs, prompt_bucket=bucket
+            )
+            return ServingFrontend(eng, ServingConfig(max_queue=max_queue))
+
+        cluster = ReplicaCluster(factory, [f"r{i}" for i in range(n_replicas)])
+        router = ReplicaRouter(cluster, RouterConfig())
+        # per-replica capacity from ONE replica (they are identical), then
+        # warm the other engines so the storm window adds no compiles
+        rate = measure_sustainable_rate(
+            cluster.replicas["r0"].frontend, calib, seed=7, prompt_len=plen,
+            max_new_tokens=max_new, vocab_size=cfg.vocab_size,
+        )
+        warm_rng = np.random.default_rng(9)
+        for name in list(cluster.names())[1:]:
+            fe = cluster.replicas[name].frontend
+            h = fe.submit(
+                warm_rng.integers(0, cfg.vocab_size, (plen[0],)).astype(np.int32),
+                max_new_tokens=max_new[0],
+            )
+            while not h.finished:
+                fe.pump()
+        obs.GLOBAL_METRICS.reset()  # calibration must not pollute the storm
+
+        mix = [
+            TrafficClass("chat", Priority.INTERACTIVE, 2.0, plen, max_new, slo_s),
+            TrafficClass("app", Priority.STANDARD, 2.0, plen, max_new, slo_s),
+            TrafficClass("batch", Priority.BEST_EFFORT, 1.0, plen, max_new, slo_s),
+        ]
+        offered = 2.0 * n_replicas * rate
+        arrivals = poisson_arrivals(
+            offered, n_arrivals, mix, seed=8, vocab_size=cfg.vocab_size
+        )
+        kill_at_s = arrivals[int(kill_frac * len(arrivals))].t
+        state = {"killed": False, "counters_at_kill": None}
+
+        def mid_storm(router_, now):
+            if not state["killed"] and now >= kill_at_s:
+                state["killed"] = True
+                state["counters_at_kill"] = router_.routing_counters()
+                # the kill goes through the fault SITE: the next replica
+                # probe trips it, so the full death-as-routing-event path
+                # (salvage, re-dispatch, failover accounting) is exercised.
+                # A trigger fires at most once — no uninstall race.
+                faults.install_plan(faults.FaultPlan.single("replica.kill", 0))
+
+        report = run_cluster_open_loop(
+            router, arrivals, max_wall_s=120.0, on_iteration=mid_storm
+        )
+        counters_end = router.routing_counters()
+        before = state["counters_at_kill"] or {}
+        after_delta = {k: counters_end[k] - before.get(k, 0) for k in counters_end}
+
+        def hit_rate(c):
+            tot = sum(c.values())
+            return round(c.get("affinity", 0) / tot, 4) if tot else 0.0
+
+        reg = obs.GLOBAL_METRICS
+        shed_by_reason = {
+            v["labels"]["reason"]: int(v["value"])
+            for v in reg.get("serving_shed_total")._snapshot_values()
+        }
+        dead = [n for n, r in cluster.replicas.items() if r.state == "dead"]
+        return {
+            "metric": "cluster_goodput_tokens_per_sec",
+            "value": report["goodput_tokens_per_sec"],
+            "unit": "tokens/s",
+            "replicas": n_replicas,
+            "offered_rate_rps": round(offered, 2),
+            "sustainable_rate_per_replica_rps": round(rate, 2),
+            "arrivals": n_arrivals,
+            "slo_s": slo_s,
+            "killed_replica": dead[0] if dead else None,
+            "kill_at_s": round(kill_at_s, 3),
+            "slo_attainment": {
+                k: v["slo_attainment"] for k, v in report["per_class"].items()
+            },
+            "affinity_hit_rate": {
+                "before_kill": hit_rate(before),
+                "after_kill": hit_rate(after_delta),
+                "overall": report["affinity_hit_rate"],
+            },
+            "failover_latency_p99_ms": report["failover_latency_p99_ms"],
+            "failovers": report["failovers"],
+            "salvaged": report["salvaged"],
+            "redispatch_sheds": report["router_sheds"],
+            "shed_total_by_reason": shed_by_reason,
+            "replica_states": report["replica_states"],
+            # honesty checks: one program per engine; a replica death is
+            # absorbed by routing, never by a surviving engine recompiling
+            "compiled_signatures": report["compiled_signatures_total"],
+            "compiles_during_storm": sum(report["compiles_during_run"].values()),
+        }
+    except Exception as exc:  # noqa: BLE001 - secondary must never kill primary
+        return {"metric": "cluster_goodput_tokens_per_sec", "error": f"{exc!r}"[:300]}
+    finally:
+        faults.install_plan(None)
         paddle.set_flags(prior)
 
 

@@ -32,10 +32,26 @@ the receiver set, and on those receivers:
 - ``s.recv()`` has no timeout parameter — the socket must have
   ``settimeout(...)`` called on it somewhere in the same file.
 
+**RB503** — unbounded retry discipline. A retry/re-dispatch loop that spins
+until success is a retry storm waiting to happen: when the dependency it
+retries against is *permanently* gone (a dead replica, an exhausted engine,
+a partitioned peer), "retry until it works" means "spin forever while
+holding the request". In the request-serving paths, a ``while True:`` loop
+whose body makes a retry-shaped call (a callee whose name contains
+``retry`` / ``redispatch`` / ``recover`` / ``failover``) must consult a
+bounded budget *inside the loop*: a comparison against an attempt counter /
+max-attempts / deadline / remaining-time name, or an ``expired()`` check.
+Exiting on success alone does not count — success is exactly what the dead
+dependency will never deliver. (The engine's ``step()`` recovery loop is
+the reference shape: ``attempt >= self.max_recoveries`` bounds it.)
+
 - RB501  ``os._exit`` call outside the sanctioned locations (including
          through an ``import os as X`` alias or ``from os import _exit``).
 - RB502  un-timed blocking wait in ``serving/``/``distributed/``/
          ``inference/`` on a tracked Queue/Event/Condition/Thread/socket.
+- RB503  ``while True:`` retry/re-dispatch loop in ``serving/``/
+         ``distributed/``/``inference/`` with no bounded budget referenced
+         in the loop.
 """
 
 from __future__ import annotations
@@ -52,6 +68,15 @@ _ALLOWED_DIR = ("distributed", "launch")
 # directories whose code serves requests / drives collectives: un-timed
 # waits here turn a shed request or a dead peer into a wedged worker
 _TIMED_WAIT_DIRS = ("serving", "distributed", "inference")
+
+# RB503: callee-name markers that make a call "retry-shaped", and the
+# budget-name markers a bounding comparison must reference. Substring match
+# on the lowercased terminal name (``self.recover`` -> "recover",
+# ``redispatch_once`` -> contains "redispatch").
+_RETRY_CALL_MARKERS = ("retry", "redispatch", "re_dispatch", "recover", "failover")
+_BUDGET_NAME_MARKERS = (
+    "attempt", "budget", "deadline", "remaining", "tries", "retries", "max_",
+)
 
 # constructor -> receiver kind;   kind -> {method: min positional args that
 # make the call timed (timeout kwarg always counts)}
@@ -122,6 +147,10 @@ class RobustnessChecker(Checker):
         "RB502": "blocking wait without an explicit timeout in serving/, "
                  "distributed/ or inference/ (an un-timed wait is how a shed "
                  "request wedges a worker forever)",
+        "RB503": "while True: retry/re-dispatch loop without a bounded budget "
+                 "(attempt counter or deadline check) referenced in the loop "
+                 "— a permanently-dead dependency turns it into a retry "
+                 "storm holding the request forever",
     }
 
     def run(self, ctx: FileContext) -> List[Violation]:
@@ -130,6 +159,7 @@ class RobustnessChecker(Checker):
             out.extend(self._check_os_exit(ctx))
         if _is_timed_wait_path(ctx.path):
             out.extend(self._check_untimed_waits(ctx))
+            out.extend(self._check_unbounded_retry(ctx))
         return out
 
     # -- RB501 ---------------------------------------------------------------
@@ -258,4 +288,73 @@ class RobustnessChecker(Checker):
                     + " and handle the expiry",
                 )
             )
+        return out
+
+    # -- RB503 ---------------------------------------------------------------
+    @staticmethod
+    def _terminal_name(node: ast.AST) -> Optional[str]:
+        """``name`` for a Name, ``attr`` for any Attribute chain's last link
+        (``self.max_recoveries`` -> ``max_recoveries``)."""
+        if isinstance(node, ast.Name):
+            return node.id
+        if isinstance(node, ast.Attribute):
+            return node.attr
+        return None
+
+    @staticmethod
+    def _loop_body_walk(loop: ast.While):
+        """Walk the loop body without descending into nested function/class
+        definitions (a closure's retry is that function's loop to bound)."""
+        stack: list = list(loop.body) + list(loop.orelse)
+        while stack:
+            node = stack.pop()
+            yield node
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+            ):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _check_unbounded_retry(self, ctx: FileContext) -> List[Violation]:
+        out: List[Violation] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.While):
+                continue
+            test = node.test
+            infinite = (
+                isinstance(test, ast.Constant) and test.value in (True, 1)
+            )
+            if not infinite:
+                continue  # a conditioned while IS its own bound
+            retry_call = None
+            budgeted = False
+            for sub in self._loop_body_walk(node):
+                if isinstance(sub, ast.Call):
+                    name = (self._terminal_name(sub.func) or "").lower()
+                    if any(m in name for m in _RETRY_CALL_MARKERS):
+                        retry_call = retry_call or sub
+                    if name == "expired":  # req.expired(now): a deadline check
+                        budgeted = True
+                elif isinstance(sub, ast.Compare):
+                    names = [self._terminal_name(sub.left)] + [
+                        self._terminal_name(c) for c in sub.comparators
+                    ]
+                    if any(
+                        n is not None
+                        and any(m in n.lower() for m in _BUDGET_NAME_MARKERS)
+                        for n in names
+                    ):
+                        budgeted = True
+            if retry_call is not None and not budgeted:
+                out.append(
+                    Violation(
+                        ctx.path, node.lineno, node.col_offset, "RB503",
+                        "unbounded retry loop: this while True: makes a "
+                        "retry/re-dispatch call but references no bounded "
+                        "budget — against a permanently-dead dependency it "
+                        "spins forever holding the request; compare an "
+                        "attempt counter or deadline inside the loop "
+                        "(success-exit alone is not a bound)",
+                    )
+                )
         return out

@@ -59,7 +59,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.flags import GLOBAL_FLAGS
-from paddle_tpu.inference.prefix_cache import ChainNode, PrefixCache
+from paddle_tpu.inference.prefix_cache import ChainNode, PrefixCache, chain_digest
 from paddle_tpu.observability import flight_recorder as _flight
 from paddle_tpu.observability import metrics as _obs
 from paddle_tpu.observability import tracing as _tracing
@@ -584,6 +584,30 @@ class ContinuousBatchingEngine:
     def queue_depth(self) -> int:
         """Requests waiting for a slot (what the queue-depth gauge exports)."""
         return len(self._waiting)
+
+    def prefix_chain_hash(
+        self, prompt_ids: Any, max_blocks: Optional[int] = None
+    ) -> str:
+        """Hex digest of the prompt's block-aligned prefix chain — the same
+        rolling blake2b the prefix cache keys chain nodes by, so a router
+        keying on this lands requests sharing a prefix on the replica whose
+        cache already holds that prefix's KV. ``max_blocks`` caps the walk
+        (see :func:`~paddle_tpu.inference.prefix_cache.chain_digest`)."""
+        prompt = np.asarray(
+            prompt_ids._data if hasattr(prompt_ids, "_data") else prompt_ids,
+            np.int32,
+        ).reshape(-1)
+        return chain_digest(prompt, self.block_size, max_blocks).hex()
+
+    def mark_failed(self, why: str = "externally marked failed") -> None:
+        """Administrative seam: flip the engine to PERMANENTLY failed, as if
+        recovery were exhausted — every later ``step()``/intake raises. The
+        cluster layer's ``replica.kill`` fault site models a whole-process
+        replica death through this (the host-side results in
+        ``drain_finished()`` stay salvageable, mirroring the pump-death
+        seam)."""
+        self._broken = True
+        _flight.record_event("engine_marked_failed", why=str(why)[:200])
 
     def live_requests(self) -> List[InferenceRequest]:
         """Requests currently holding a slot (mid-decode), slot order."""

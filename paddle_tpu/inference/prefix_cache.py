@@ -50,9 +50,36 @@ from paddle_tpu.observability import flight_recorder as _flight
 from paddle_tpu.observability import metrics as _obs
 from paddle_tpu.testing.faults import InjectedFault, fault_point
 
-__all__ = ["ChainNode", "MatchResult", "PrefixCache"]
+__all__ = ["ChainNode", "MatchResult", "PrefixCache", "chain_digest"]
 
 _ROOT_DIGEST = b"prefix-cache-root"
+
+
+def chain_digest(
+    prompt: np.ndarray, block_size: int, max_blocks: Optional[int] = None
+) -> bytes:
+    """Rolling content digest of ``prompt``'s block-aligned prefix chain —
+    the SAME ``H(parent_digest, token_bytes)`` recurrence :meth:`PrefixCache
+    .match` walks, so two prompts that would map the same cached chain nodes
+    produce the same digest. This is the cluster router's affinity key:
+    routing by it lands requests sharing a prefix on the replica already
+    holding that prefix's KV chains.
+
+    ``max_blocks`` caps the walk (a router keys on the first few blocks — the
+    shared system prompt — so divergent user tails do not scatter a tenant's
+    traffic). A prompt shorter than one block hashes its raw tokens under the
+    root, so short prompts still spread across replicas."""
+    prompt = np.asarray(prompt, np.int32).reshape(-1)
+    bs = int(block_size)
+    n_full = prompt.size // bs
+    if max_blocks is not None:
+        n_full = min(n_full, int(max_blocks))
+    digest = _ROOT_DIGEST
+    if n_full == 0:
+        return PrefixCache._digest(digest, prompt.tobytes())
+    for i in range(n_full):
+        digest = PrefixCache._digest(digest, prompt[i * bs : (i + 1) * bs].tobytes())
+    return digest
 
 
 def _cache_metrics() -> Dict[str, Any]:

@@ -25,7 +25,7 @@ from typing import Any, Dict
 
 from paddle_tpu.observability import metrics as _metrics
 
-__all__ = ["PRIORITY_NAMES", "priority_name", "serving_metrics"]
+__all__ = ["PRIORITY_NAMES", "priority_name", "router_metrics", "serving_metrics"]
 
 # canonical priority classes (lower = more important); the serving layer
 # re-exports these as Priority.INTERACTIVE / STANDARD / BEST_EFFORT
@@ -110,5 +110,53 @@ def serving_metrics() -> Dict[str, Any]:
             "Engine prefix-cache hit rate (admissions that reused cached "
             "prefix KV / all admissions) since engine construction; 0 when "
             "the prefix cache is disabled.",
+        ),
+    }
+
+
+def router_metrics() -> Dict[str, Any]:
+    """Get-or-create the cluster-router metric families. The ``route``
+    counter is the reconciliation surface: every routing decision — initial
+    dispatch or failover re-dispatch — increments exactly one
+    ``{route}`` cell (``affinity`` / ``spill`` / ``failover`` /
+    ``round_robin``), so the sum over routes equals the number of dispatches
+    the routing log records. Router-originated sheds (``replica_failure``,
+    deadline at failover, ``no_replicas``) account into the shared
+    ``serving_shed_total{reason}`` family — replica-frontend sheds are
+    already counted there by the frontends themselves."""
+    reg = _metrics.GLOBAL_METRICS
+    return {
+        "route": reg.counter(
+            "serving_router_route_total",
+            "Routing decisions by kind: affinity (prefix-hash target), spill "
+            "(affinity target shedding/full -> least-loaded healthy replica), "
+            "failover (re-dispatch off a dead/failed replica), round_robin "
+            "(the A/B baseline policy).",
+            labelnames=("route",),
+        ),
+        "replica_state": reg.gauge(
+            "serving_router_replica_state",
+            "Replica health state per replica: 0 up, 1 degraded, 2 draining, "
+            "3 dead. High-water mark tracked since reset.",
+            labelnames=("replica",),
+        ),
+        "routable": reg.gauge(
+            "serving_router_routable_replicas",
+            "Replicas currently accepting routed intake (UP or DEGRADED).",
+        ),
+        "redispatch": reg.counter(
+            "serving_router_redispatch_total",
+            "Re-dispatch attempts scheduled off dead replicas (bounded per "
+            "request by the router's max_redispatch budget).",
+        ),
+        "salvaged": reg.counter(
+            "serving_router_salvaged_total",
+            "Requests whose results were salvaged from a dead replica's "
+            "drain_finished() buffer and delivered instead of re-dispatched.",
+        ),
+        "failover_latency": reg.histogram(
+            "serving_router_failover_seconds",
+            "Replica death detection -> the victim request re-accepted on a "
+            "healthy replica.",
         ),
     }

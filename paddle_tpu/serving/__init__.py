@@ -8,15 +8,27 @@ The host-side policy stack between clients and ``engine.step()``:
 - :mod:`.scheduler` — :class:`WeightedFairPolicy`, the stride scheduler
   installed as the engine's admission policy;
 - :mod:`.http` — the streaming localhost HTTP endpoint
-  (``start_serving_server``, ``FLAGS_serving_port``);
+  (``start_serving_server``, ``FLAGS_serving_port``); also serves a
+  :class:`ReplicaRouter` for the thin multi-replica mode;
+- :mod:`.cluster` / :mod:`.router` — cluster-scale serving:
+  :class:`ReplicaCluster` (replica lifecycle: UP/DEGRADED/DRAINING/DEAD,
+  kill/revive) and :class:`ReplicaRouter` (rendezvous prefix-affinity
+  routing, health-gated failover with salvage + bounded deadline-aware
+  re-dispatch, drain, cross-replica spill);
 - :mod:`.loadgen` — the open-loop Poisson arrival harness behind bench.py's
-  ``serving_goodput`` record and the overload acceptance tests;
+  ``serving_goodput`` / ``cluster_goodput`` records and the overload
+  acceptance tests;
 - :mod:`.errors` — :class:`Overloaded` (429) and the re-exported typed
   :class:`IntakeError` taxonomy (4xx).
 
-See README "Serving & SLOs" for thresholds, status mapping and flags.
+See README "Serving & SLOs" and "Cluster serving & failover" for
+thresholds, status mapping and flags.
 """
 
+from paddle_tpu.serving.cluster import (  # noqa: F401
+    Replica,
+    ReplicaCluster,
+)
 from paddle_tpu.serving.errors import (  # noqa: F401
     EmptyPromptError,
     IntakeError,
@@ -39,6 +51,11 @@ from paddle_tpu.serving.http import (  # noqa: F401
     start_serving_server,
     stop_serving_server,
 )
+from paddle_tpu.serving.router import (  # noqa: F401
+    ReplicaRouter,
+    RouterConfig,
+    RouterRequest,
+)
 from paddle_tpu.serving.scheduler import WeightedFairPolicy  # noqa: F401
 
 __all__ = [
@@ -50,8 +67,13 @@ __all__ = [
     "OverloadController",
     "Priority",
     "PromptTooLongError",
+    "Replica",
+    "ReplicaCluster",
+    "ReplicaRouter",
     "RequestTooLongError",
     "RequestUnservableError",
+    "RouterConfig",
+    "RouterRequest",
     "ServingConfig",
     "ServingError",
     "ServingFrontend",

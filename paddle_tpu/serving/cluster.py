@@ -1,0 +1,159 @@
+"""Replica lifecycle for cluster-scale serving.
+
+One :class:`~paddle_tpu.serving.frontend.ServingFrontend` is one box; the
+router in :mod:`paddle_tpu.serving.router` serves N of them. This module
+owns what a replica *is* above the single-process serving stack — the
+reference fork's ``fleet``/elastic process-lifecycle layer, shaped for
+in-process replicas:
+
+- **health states** — ``UP`` → ``DEGRADED`` (probe failures or sustained
+  overload; still routable) → ``DEAD`` (engine permanently failed, pump
+  thread died, or probes exhausted; never routable again on this
+  generation). ``DRAINING`` is the administrative sibling: intake stops,
+  live work finishes, the replica's hash-ring share remaps — all without a
+  single shed.
+- **kill** — :meth:`Replica.kill` models a whole-replica death the way the
+  engine's permanent-failure seam does: the engine is marked broken and the
+  frontend fails every live stream explicitly (salvaging results the engine
+  already finished via ``drain_finished()``). The ``replica.kill`` fault
+  site in the router's probe loop drives this path deterministically on CPU
+  CI.
+- **revive** — a DEAD replica's engine lost its KV state for good; revival
+  builds a FRESH frontend through the cluster's factory (a new process in
+  the real deployment), bumping the replica's ``generation`` so stale
+  handles can never be confused with the new instance. The replica's name —
+  and therefore its rendezvous-hash share — is stable across generations.
+
+The router drives all state *transitions* (it owns the probe loop, the
+flight-recorder events and the failover machinery); this module only holds
+the state and the lifecycle verbs.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterable, List, Optional
+
+from paddle_tpu.serving.frontend import ServingFrontend
+
+__all__ = [
+    "REPLICA_DEAD",
+    "REPLICA_DEGRADED",
+    "REPLICA_DRAINING",
+    "REPLICA_UP",
+    "Replica",
+    "ReplicaCluster",
+]
+
+REPLICA_UP = "up"
+REPLICA_DEGRADED = "degraded"
+REPLICA_DRAINING = "draining"
+REPLICA_DEAD = "dead"
+
+# gauge encoding for serving_router_replica_state{replica}
+STATE_CODES = {
+    REPLICA_UP: 0,
+    REPLICA_DEGRADED: 1,
+    REPLICA_DRAINING: 2,
+    REPLICA_DEAD: 3,
+}
+
+
+class Replica:
+    """One named serving replica: a frontend plus router-side health state.
+
+    All mutable fields are owned by the router and mutated only under the
+    router's lock; the frontend beneath does its own locking."""
+
+    def __init__(self, name: str, frontend: ServingFrontend) -> None:
+        self.name = str(name)
+        self.frontend = frontend
+        self.state = REPLICA_UP
+        self.generation = 0
+        # consecutive probe failures (health_snapshot raised) and pump
+        # failures (inline pump raised); reset on any success
+        self.probe_failures = 0
+        self.pump_failures = 0
+        # perf_counter instant the router marked this replica DEAD (the
+        # failover-latency anchor); None while not dead
+        self.death_ts: Optional[float] = None
+        # once-only marker for the replica_drained flight event
+        self.drained_logged = False
+
+    @property
+    def routable(self) -> bool:
+        """New intake may be routed here (DRAINING keeps serving what it
+        already accepted, but takes nothing new)."""
+        return self.state in (REPLICA_UP, REPLICA_DEGRADED)
+
+    @property
+    def alive(self) -> bool:
+        return self.state != REPLICA_DEAD
+
+    def kill(self, why: str = "replica killed") -> None:
+        """Model a whole-replica death: the engine is permanently failed and
+        the frontend salvages/fails every live stream (idempotent). The
+        router's next probe observes ``broken`` and runs the
+        death-as-routing-event path (salvage delivery + re-dispatch)."""
+        self.frontend.engine.mark_failed(why)
+        self.frontend.fail(why)
+
+    def __repr__(self) -> str:
+        return (
+            f"Replica({self.name!r}, state={self.state!r}, "
+            f"gen={self.generation})"
+        )
+
+
+class ReplicaCluster:
+    """A named set of replicas built from one factory.
+
+    ``factory(name)`` must return a fresh :class:`ServingFrontend` (its own
+    engine; replicas must serve the SAME model weights or failover
+    re-generation would not be deterministic). The factory is retained so
+    :meth:`revive` can rebuild a DEAD replica's frontend in place."""
+
+    def __init__(
+        self,
+        factory: Callable[[str], ServingFrontend],
+        names: Iterable[str],
+    ) -> None:
+        self._factory = factory
+        self.replicas: Dict[str, Replica] = {}
+        for name in names:
+            if name in self.replicas:
+                raise ValueError(f"duplicate replica name {name!r}")
+            self.replicas[name] = Replica(name, factory(name))
+        if not self.replicas:
+            raise ValueError("a cluster needs at least one replica")
+
+    def __iter__(self):
+        return iter(self.replicas.values())
+
+    def __len__(self) -> int:
+        return len(self.replicas)
+
+    def get(self, name: str) -> Optional[Replica]:
+        return self.replicas.get(name)
+
+    def names(self) -> List[str]:
+        return list(self.replicas)
+
+    def revive(self, name: str) -> Replica:
+        """Rebuild a DEAD replica's frontend through the factory (a fresh
+        process in a real deployment): same name — same rendezvous share —
+        new generation, state back to UP. Raises on a replica that is not
+        DEAD (live state must never be silently discarded)."""
+        replica = self.replicas[name]
+        if replica.state != REPLICA_DEAD:
+            raise RuntimeError(
+                f"replica {name!r} is {replica.state}, not dead; "
+                "drain it before rebuilding"
+            )
+        replica.frontend = self._factory(name)
+        replica.generation += 1
+        replica.state = REPLICA_UP
+        replica.probe_failures = 0
+        replica.pump_failures = 0
+        replica.death_ts = None
+        replica.drained_logged = False
+        return replica

@@ -645,9 +645,20 @@ class ServingFrontend:
             if not self.engine.has_work():
                 self._stop.wait(timeout=self.config.idle_sleep_s)
 
+    def fail(self, why: str) -> None:
+        """Declare this frontend permanently failed: stop the pump thread,
+        salvage engine-finished results, and fail every other live stream
+        explicitly (``engine_failure``). The cluster layer calls this when a
+        replica is declared DEAD so its in-flight requests reach a terminal
+        state the router can act on (salvage vs re-dispatch); idempotent."""
+        self._stop.set()
+        self._fail_all(why)
+
     def _fail_all(self, why: str) -> None:
         now = time.perf_counter()
         with self._lock:
+            if self._failed is not None:
+                return  # already failed: one death, one dump, one accounting
             self._failed = why
             # the pump thread is dying: black-box line + postmortem dump
             # (safe_dump never raises — failing every stream still happens)
@@ -683,6 +694,30 @@ class ServingFrontend:
                 self._thread = None
 
     # -- introspection -------------------------------------------------------
+    def health_snapshot(self) -> Dict[str, Any]:
+        """Per-replica health view for a cluster router's probe loop: the
+        liveness facts (engine ``broken`` flag, pump-thread liveness, the
+        failure reason) plus the load signals the router's spill decision
+        reads. ``pump_alive`` is None when no pump thread was ever started
+        (inline drivers), so a router never mistakes inline mode for death."""
+        with self._lock:
+            t = self._thread
+            stats = self.engine.pool_stats()
+            live = stats["allocated"] - stats.get("cached_reusable", 0)
+            return {
+                "broken": self.engine.broken,
+                "failed": self._failed,
+                "pump_alive": None if t is None else t.is_alive(),
+                "queue_depth": self.engine.queue_depth(),
+                "max_queue": self.config.max_queue,
+                "live_requests": len(self._live),
+                "level": self.controller.level,
+                "level_name": self.controller.level_name,
+                "kv_utilization": round(
+                    live / stats["total"] if stats["total"] else 0.0, 4
+                ),
+            }
+
     def snapshot(self) -> Dict[str, Any]:
         """Cheap health view (the HTTP /healthz payload)."""
         with self._lock:

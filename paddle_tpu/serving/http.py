@@ -30,6 +30,14 @@ that disconnects mid-stream gets its request cancelled — the engine slot is
 evicted and its KV blocks reclaimed — so an impatient client cannot leak
 pool capacity. Each response counts into
 ``serving_http_responses_total{code}``.
+
+**Multi-replica mode**: pass a
+:class:`~paddle_tpu.serving.router.ReplicaRouter` instead of a frontend —
+it exposes the same ``submit``/``cancel``/``snapshot``/``start``/``stop``
+surface, so the endpoint serves the whole cluster through one port:
+``/healthz`` returns per-replica states plus routing counters, and a
+replica death mid-stream fails over transparently (the handler keeps
+streaming from the same handle).
 """
 
 from __future__ import annotations
@@ -99,7 +107,8 @@ def _parse_body(raw: bytes) -> Dict[str, Any]:
 
 
 class _ServingHandler(BaseHTTPRequestHandler):
-    # set by start_serving_server on the handler subclass
+    # set by start_serving_server on the handler subclass: a ServingFrontend
+    # or a ReplicaRouter (duck-typed: same submit/cancel/snapshot surface)
     frontend: ServingFrontend = None  # type: ignore[assignment]
     stream_timeout_s: float = 60.0
 
@@ -218,7 +227,9 @@ class _ServingHandler(BaseHTTPRequestHandler):
                 200,
                 {
                     "outcome": handle.outcome,
-                    "finish_reason": inner.finish_reason,
+                    # a router handle shed before any replica accepted it
+                    # has no engine-side request to read a reason from
+                    "finish_reason": None if inner is None else inner.finish_reason,
                     "tokens": handle.tokens(),
                     "degraded": handle.degraded,
                 },
@@ -244,7 +255,10 @@ def start_serving_server(
     stream_timeout_s: float = 60.0,
 ) -> Optional[ThreadingHTTPServer]:
     """Serve the generation endpoint on 127.0.0.1 and start the frontend's
-    pump thread. ``port=None`` reads ``FLAGS_serving_port`` (<= 0 → disabled,
+    pump thread. ``frontend`` may also be a
+    :class:`~paddle_tpu.serving.router.ReplicaRouter` (multi-replica mode:
+    per-replica pumps plus the router supervisor are started instead).
+    ``port=None`` reads ``FLAGS_serving_port`` (<= 0 → disabled,
     returns None); an explicit ``port=0`` binds an ephemeral port
     (``server.server_address[1]`` has it). Idempotent for the same port;
     raises when a different port is requested while one is bound."""

@@ -35,7 +35,7 @@ from paddle_tpu.serving.errors import IntakeError, Overloaded
 from paddle_tpu.serving.frontend import Priority, ServingFrontend, ServingRequest
 
 __all__ = ["TrafficClass", "Arrival", "poisson_arrivals", "run_open_loop",
-           "measure_sustainable_rate"]
+           "run_cluster_open_loop", "measure_sustainable_rate"]
 
 
 @dataclass(frozen=True)
@@ -207,6 +207,132 @@ def run_open_loop(
         "goodput_tokens_per_sec": round(total_goodput / wall, 2) if wall else 0.0,
         "tokens_per_sec": round(total_tokens / wall, 2) if wall else 0.0,
         "per_class": per_class,
+        "compiles_during_run": {
+            fn: watchdog_after.get(fn, 0) - watchdog_before.get(fn, 0)
+            for fn in set(watchdog_before) | set(watchdog_after)
+        },
+        "compiled_signatures_total": sum(watchdog_after.values()),
+    }
+
+
+def run_cluster_open_loop(
+    router,
+    arrivals: Sequence[Arrival],
+    max_wall_s: float = 120.0,
+    on_iteration=None,
+) -> Dict[str, Any]:
+    """Cluster-level open-loop bench: replay ``arrivals`` against a
+    :class:`~paddle_tpu.serving.router.ReplicaRouter` (driven inline) and
+    report the numbers a fleet lives on — AGGREGATE goodput and per-class
+    SLO attainment across every replica, plus the cluster-only signals:
+    routing-decision counters (affinity/spill/failover) that reconcile with
+    the monotonic dispatch count, failover latency p99, salvage/re-dispatch
+    accounting,
+    and the recompile ledger (a replica death must be absorbed by ROUTING,
+    never by a surviving engine recompiling).
+
+    ``on_iteration(router, now_s)`` runs after every pump — the kill-mid-
+    storm acceptance test uses it to trip the ``replica.kill`` fault site at
+    a chosen instant and to assert invariants while the storm is live."""
+    from paddle_tpu.serving.router import RouterRequest  # typing/doc only
+
+    watchdog_before = {
+        fn: rec["count"]
+        for fn, rec in GLOBAL_WATCHDOG.report().items()
+        if fn.startswith("ContinuousBatchingEngine.")
+    }
+    stats: Dict[str, _ClassStats] = {}
+    live: List[RouterRequest] = []
+    finished: List[RouterRequest] = []
+
+    def _cls_key(cls: TrafficClass) -> str:
+        return f"{cls.tenant}/{priority_name(cls.priority)}"
+
+    pending = list(arrivals)
+    pending.reverse()  # pop() from the back == chronological order
+    start = time.perf_counter()
+    while pending or router.has_work() or live:
+        now = time.perf_counter() - start
+        if now > max_wall_s:
+            break
+        while pending and pending[-1].t <= now:
+            a = pending.pop()
+            st = stats.setdefault(_cls_key(a.cls), _ClassStats())
+            st.offered += 1
+            try:
+                handle = router.submit(
+                    a.prompt,
+                    max_new_tokens=a.max_new_tokens,
+                    priority=a.cls.priority,
+                    tenant=a.cls.tenant,
+                    ttl_s=a.cls.slo_s,
+                )
+            except (Overloaded, IntakeError):
+                st.rejected += 1
+                continue
+            st.accepted += 1
+            handle._cls_key = _cls_key(a.cls)  # bench-local annotation
+            live.append(handle)
+        for handle in router.pump():
+            if handle in live:
+                live.remove(handle)
+                finished.append(handle)
+        if on_iteration is not None:
+            on_iteration(router, now)
+
+    wall = time.perf_counter() - start
+    for handle in finished:
+        st = stats[handle._cls_key]
+        ntok = len(handle.tokens())
+        st.tokens += ntok
+        if handle.outcome == "ok":
+            if handle.met_deadline:
+                st.ok_in_slo += 1
+                st.goodput_tokens += ntok
+            else:
+                st.ok_late += 1
+        else:
+            st.shed += 1
+
+    watchdog_after = {
+        fn: rec["count"]
+        for fn, rec in GLOBAL_WATCHDOG.report().items()
+        if fn.startswith("ContinuousBatchingEngine.")
+    }
+    per_class = {}
+    for key, st in sorted(stats.items()):
+        per_class[key] = {
+            "offered": st.offered,
+            "accepted": st.accepted,
+            "rejected_at_intake": st.rejected,
+            "finished_in_slo": st.ok_in_slo,
+            "finished_late": st.ok_late,
+            "shed_after_accept": st.shed,
+            "tokens": st.tokens,
+            "goodput_tokens": st.goodput_tokens,
+            "slo_attainment": round(st.ok_in_slo / st.offered, 4) if st.offered else 0.0,
+        }
+    total_goodput = sum(st.goodput_tokens for st in stats.values())
+    total_tokens = sum(st.tokens for st in stats.values())
+    lats = sorted(router.failover_latencies())
+    p99 = lats[min(len(lats) - 1, int(0.99 * len(lats)))] if lats else 0.0
+    routes = router.routing_counters()
+    routed = sum(routes.values())
+    return {
+        "wall_s": round(wall, 3),
+        "arrivals": len(arrivals),
+        "undelivered_arrivals": len(pending) + len(live),  # hit max_wall_s
+        "goodput_tokens_per_sec": round(total_goodput / wall, 2) if wall else 0.0,
+        "tokens_per_sec": round(total_tokens / wall, 2) if wall else 0.0,
+        "per_class": per_class,
+        "routes": routes,
+        "dispatches": router.dispatch_count(),
+        "affinity_hit_rate": round(routes.get("affinity", 0) / routed, 4) if routed else 0.0,
+        "failover_latency_p99_ms": round(p99 * 1e3, 3),
+        "failovers": len(lats),
+        "salvaged": router.salvaged_count(),
+        "router_sheds": router.shed_counters(),
+        "replica_states": {r.name: r.state for r in router.cluster},
         "compiles_during_run": {
             fn: watchdog_after.get(fn, 0) - watchdog_before.get(fn, 0)
             for fn in set(watchdog_before) | set(watchdog_after)

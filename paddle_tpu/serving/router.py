@@ -1,0 +1,993 @@
+"""Prefix-affinity replica router with health-gated failover.
+
+One :class:`~paddle_tpu.serving.frontend.ServingFrontend` is one box; this
+is the layer-7 router that serves N of them and survives any one dying
+mid-storm — the ROADMAP's "Cluster-scale serving" item, the reference
+fork's ``fleet``/elastic stack shaped for in-process replicas:
+
+- **prefix affinity** — requests route by the prompt's prefix-chain hash
+  (the SAME rolling blake2b the engine's prefix cache keys chain nodes by,
+  computed over the first ``affinity_blocks`` block-aligned segments), so a
+  tenant's shared system prompt lands on the replica already holding its KV
+  chains. Replica choice is rendezvous (highest-random-weight) hashing:
+  adding or losing a replica remaps only that replica's share of keys,
+  never reshuffles the survivors' warm caches.
+- **death is a routing event** — a probe loop over each frontend's
+  ``health_snapshot()`` (engine ``broken`` flag, pump liveness, failure
+  reason, queue/overload gauges) drives UP → DEGRADED → DEAD transitions.
+  On DEAD, results the dead engine already finished are salvaged
+  (``drain_finished()`` via the frontend's fail path) and delivered; the
+  rest are re-dispatched to the next replica in the hash ring — bounded
+  retries (``max_redispatch``), exponential backoff, and deadline-aware: a
+  re-dispatched request keeps its ORIGINAL deadline and is shed
+  (``deadline_failover``) the moment it can no longer make it. Exhausted
+  budgets shed with the explicit terminal ``replica_failure`` — under a
+  replica death nothing is ever lost *silently*.
+- **drain** — administrative :meth:`ReplicaRouter.drain` stops intake to a
+  replica (its ring share remaps instantly), lets its live slots finish,
+  and records ``replica_drained`` when empty; :meth:`resume` reopens it.
+- **cross-replica shedding** — an affinity target in SHEDDING (or whose
+  bounded queue rejects) spills to the least-loaded healthy replica rather
+  than queueing, trading cache warmth for latency. Every routing decision
+  increments exactly one ``serving_router_route_total{route}`` cell
+  (``affinity`` / ``spill`` / ``failover`` / ``round_robin``) and one
+  routing-log entry, so the counters reconcile exactly with the monotonic
+  dispatch count (the log itself is a bounded recent window).
+
+Observability: replica state transitions are flight-recorder events and a
+per-replica state gauge; a failed-over request's trace carries a
+``router.failover`` span parented into its root, so the trace shows BOTH
+replicas; "all replicas dead" dumps the black box
+(``router_all_replicas_dead``).
+
+Threading model mirrors the frontend: ``submit``/``cancel`` are
+thread-safe; drive everything inline with :meth:`pump` (tests/bench), or
+:meth:`start` pump threads per replica plus a router supervisor thread
+(probe + failover + token forwarding). Lock order is router → frontend →
+engine, never the reverse. Every blocking wait carries a timeout (RB502)
+and every retry loop consults a bounded budget (RB503).
+
+Replicas must serve the SAME model weights: failover re-dispatch replays
+the prompt on the new replica and relies on greedy-decode determinism to
+regenerate the tokens already streamed (delivery dedups on token count).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from queue import Empty, Queue
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from paddle_tpu.inference.engine import InferenceRequest
+from paddle_tpu.inference.prefix_cache import chain_digest
+from paddle_tpu.observability import flight_recorder as _flight
+from paddle_tpu.observability import tracing as _tracing
+from paddle_tpu.observability.serving import (
+    priority_name,
+    router_metrics,
+    serving_metrics,
+)
+from paddle_tpu.serving.cluster import (
+    REPLICA_DEAD,
+    REPLICA_DEGRADED,
+    REPLICA_DRAINING,
+    REPLICA_UP,
+    STATE_CODES,
+    Replica,
+    ReplicaCluster,
+)
+from paddle_tpu.serving.errors import Overloaded
+from paddle_tpu.serving.frontend import SHEDDING, Priority, ServingRequest
+from paddle_tpu.testing.faults import InjectedFault, fault_point
+
+__all__ = [
+    "ROUTE_AFFINITY",
+    "ROUTE_FAILOVER",
+    "ROUTE_ROUND_ROBIN",
+    "ROUTE_SPILL",
+    "ReplicaRouter",
+    "RouterConfig",
+    "RouterRequest",
+    "rendezvous_rank",
+]
+
+ROUTE_AFFINITY = "affinity"
+ROUTE_SPILL = "spill"
+ROUTE_FAILOVER = "failover"
+ROUTE_ROUND_ROBIN = "round_robin"
+
+
+def rendezvous_rank(key: bytes, names: Sequence[str]) -> List[str]:
+    """Highest-random-weight (rendezvous) order of ``names`` for ``key``:
+    each (key, name) pair hashes to a weight and names sort by it, so every
+    key has its own stable preference list. Removing a name promotes each of
+    its keys to their SECOND choice and changes nothing for keys it did not
+    own — the minimal-remap property that keeps the other replicas' prefix
+    caches warm across membership changes."""
+    return sorted(
+        names,
+        key=lambda n: hashlib.blake2b(
+            key + b"\x00" + n.encode("utf-8"), digest_size=8
+        ).digest(),
+        reverse=True,
+    )
+
+
+@dataclass
+class RouterConfig:
+    """Router policy knobs."""
+
+    # block-aligned prefix segments hashed into the affinity key: the shared
+    # system prompt's span, NOT the whole prompt (divergent user tails must
+    # not scatter a tenant's traffic across replicas)
+    affinity_blocks: int = 2
+    # "affinity" (prefix-hash rendezvous) or "round_robin" (the A/B baseline
+    # the affinity speedup is measured against)
+    policy: str = "affinity"
+    # failover budget: re-dispatch attempts per request before the explicit
+    # replica_failure terminal
+    max_redispatch: int = 2
+    # base re-dispatch backoff; doubles per attempt, always deadline-capped
+    redispatch_backoff_s: float = 0.01
+    # supervisor-thread cadence (threaded mode); inline pump() probes every call
+    probe_interval_s: float = 0.05
+    # consecutive failing probes before a replica is declared DEAD
+    probe_failures_to_dead: int = 3
+    # consecutive inline pump failures before the replica frontend is failed
+    # (mirrors the frontend pump thread's own escalation)
+    pump_failures_to_dead: int = 3
+    # default wait for RouterRequest.stream()/result()
+    default_wait_s: float = 60.0
+    # bounded routing log (reconciliation surface for the route counters)
+    routing_log_size: int = 4096
+
+    def __post_init__(self) -> None:
+        if self.policy not in (ROUTE_AFFINITY, ROUTE_ROUND_ROBIN):
+            raise ValueError(
+                f"policy must be 'affinity' or 'round_robin', got {self.policy!r}"
+            )
+        if self.max_redispatch < 0:
+            raise ValueError("max_redispatch must be >= 0")
+
+
+_END = None  # token-stream terminal sentinel (same protocol as the frontend)
+
+
+class RouterRequest:
+    """Cluster-level request handle: one client-visible stream that survives
+    replica failover. ``outcome`` is ``"ok"``, a frontend shed reason passed
+    through (``deadline_queued`` / ``deadline_decode`` / ...), or a
+    router-originated terminal: ``replica_failure`` (re-dispatch budget
+    exhausted) / ``deadline_failover`` (original deadline unmakeable after a
+    death) / ``cancelled``.
+
+    Failover token continuity: delivery dedups on count — the re-dispatched
+    replica regenerates deterministically and only tokens past what was
+    already streamed are forwarded."""
+
+    def __init__(
+        self,
+        rid: int,
+        prompt: np.ndarray,
+        max_new_tokens: int,
+        eos_token_id: Optional[int],
+        priority: int,
+        tenant: str,
+        deadline: Optional[float],
+        affinity_key: bytes,
+        submit_time: float,
+        default_wait_s: float,
+    ) -> None:
+        self.id = rid
+        self.prompt = prompt
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_token_id = eos_token_id
+        self.priority = int(priority)
+        self.tenant = str(tenant)
+        self.deadline = deadline  # absolute perf_counter instant; ORIGINAL,
+        # carried unchanged across every re-dispatch
+        self.affinity_key = affinity_key
+        self.submit_time = submit_time
+        self.trace_ctx: Optional[_tracing.TraceContext] = None
+        # routing state (mutated only under the router lock)
+        self.replica: Optional[str] = None  # current owner name
+        self.inner: Optional[ServingRequest] = None  # current frontend handle
+        self.redispatches = 0
+        self.routes: List[Tuple[str, str]] = []  # (route_kind, replica_name)
+        self.outcome: Optional[str] = None
+        self.finish_time: Optional[float] = None
+        self.first_token_time: Optional[float] = None
+        # failover bookkeeping
+        self._not_before = 0.0  # backoff gate for the next re-dispatch
+        self._failover_from: Optional[str] = None
+        self._death_ts: Optional[float] = None
+        self._terminal_inner: Optional[InferenceRequest] = None
+        # stream state
+        self._default_wait_s = float(default_wait_s)
+        self._q: Queue = Queue()
+        self._done = threading.Event()
+        self._delivered: List[int] = []
+        self._n_delivered = 0
+
+    @property
+    def finished(self) -> bool:
+        return self._done.is_set()
+
+    @property
+    def degraded(self) -> bool:
+        inner = self.inner
+        return bool(inner is not None and inner.degraded)
+
+    @property
+    def met_deadline(self) -> bool:
+        """Finished normally inside the ORIGINAL deadline (vacuously true
+        with none) — failover never relaxes the SLO."""
+        if self.outcome != "ok":
+            return False
+        if self.deadline is None:
+            return True
+        return self.finish_time is not None and self.finish_time <= self.deadline
+
+    @property
+    def traceparent(self) -> Optional[str]:
+        if self.trace_ctx is None:
+            return None
+        return _tracing.format_traceparent(self.trace_ctx)
+
+    def tokens(self) -> List[int]:
+        """Tokens delivered to this handle (deduped across failover)."""
+        return list(self._delivered)
+
+    def stream(self, timeout: Optional[float] = None) -> Iterator[int]:
+        """Yield tokens as the router forwards them; returns at end of
+        stream (check ``outcome``). ``timeout`` bounds the wait for EACH
+        token — a stalled cluster raises instead of parking a worker."""
+        wait = self._default_wait_s if timeout is None else float(timeout)
+        while True:
+            try:
+                item = self._q.get(timeout=wait)
+            except Empty:
+                raise TimeoutError(
+                    f"request {self.id}: no token within {wait}s "
+                    "(cluster stalled?)"
+                ) from None
+            if item is _END:
+                return
+            yield item
+
+    def result(self, timeout: Optional[float] = None) -> Optional[InferenceRequest]:
+        """Block until terminal; returns the engine-side request of the
+        replica the terminal came from (None only if the request was shed
+        before any replica ever accepted it)."""
+        wait = self._default_wait_s if timeout is None else float(timeout)
+        if not self._done.wait(timeout=wait):
+            raise TimeoutError(f"request {self.id} not finished within {wait}s")
+        return self._terminal_inner
+
+
+class ReplicaRouter:
+    """See module docstring. Construct over a
+    :class:`~paddle_tpu.serving.cluster.ReplicaCluster`."""
+
+    def __init__(
+        self,
+        cluster: ReplicaCluster,
+        config: Optional[RouterConfig] = None,
+    ) -> None:
+        self.cluster = cluster
+        self.config = config or RouterConfig()
+        # affinity keys hash block-aligned segments: all replicas share one
+        # engine geometry, so the first replica's block size is THE block size
+        first = next(iter(cluster))
+        self.block_size = int(first.frontend.engine.block_size)
+        self._lock = threading.RLock()
+        self._live: Dict[int, RouterRequest] = {}
+        self._redispatch_q: List[RouterRequest] = []
+        self._pending_finished: List[RouterRequest] = []
+        self._ids = itertools.count()
+        self._rr_index = 0  # round_robin rotation
+        self._metrics = router_metrics()
+        self._serving_metrics = serving_metrics()
+        # host-side accounting (always on — reconciliation must not depend
+        # on the metrics flag): route counters mirror the metric family
+        self._counters: Dict[str, int] = {
+            ROUTE_AFFINITY: 0, ROUTE_SPILL: 0,
+            ROUTE_FAILOVER: 0, ROUTE_ROUND_ROBIN: 0,
+        }
+        self._shed_counts: Dict[str, int] = {}
+        self._salvaged = 0
+        self._dispatches = 0  # monotonic: the reconciliation surface
+        self._routing_log: deque = deque(maxlen=int(self.config.routing_log_size))
+        self._failover_latencies: deque = deque(maxlen=4096)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        for replica in cluster:
+            self._metrics["replica_state"].labels(replica=replica.name).set(
+                STATE_CODES[replica.state]
+            )
+
+    # -- intake ---------------------------------------------------------------
+    def submit(
+        self,
+        prompt_ids: Any,
+        max_new_tokens: int = 32,
+        eos_token_id: Optional[int] = None,
+        priority: int = Priority.STANDARD,
+        tenant: str = "default",
+        ttl_s: Optional[float] = None,
+        traceparent: Optional[str] = None,
+    ) -> RouterRequest:
+        """Route one request to a replica. Raises a typed ``IntakeError``
+        (malformed input — identical on every replica, so no retry),
+        :class:`Overloaded` when no replica can take it (cluster-wide
+        overload, or ``reason="no_replicas"`` when nothing is routable),
+        and never silently queues on a shedding replica: the affinity
+        target in SHEDDING spills to the least-loaded healthy one."""
+        fault_point("router.dispatch")
+        now = time.perf_counter()
+        trace_ctx = None
+        if _tracing.tracing_enabled():
+            trace_ctx = _tracing.GLOBAL_TRACER.start_trace(traceparent)
+        prompt = np.asarray(
+            prompt_ids._data if hasattr(prompt_ids, "_data") else prompt_ids,
+            np.int32,
+        ).reshape(-1)
+        key = chain_digest(prompt, self.block_size, self.config.affinity_blocks)
+        with self._lock:
+            rr = RouterRequest(
+                next(self._ids), prompt, max_new_tokens, eos_token_id,
+                int(priority), tenant,
+                None if ttl_s is None else now + float(ttl_s),
+                key, now, self.config.default_wait_s,
+            )
+            rr.trace_ctx = trace_ctx
+            try:
+                self._submit_locked(rr, now)
+            except Exception as exc:
+                # a sampled request refused at the door still gets a
+                # terminal root span — a trace must never just vanish
+                # (same invariant as the frontend's shed-at-intake span)
+                self._emit_refused_trace_locked(rr, exc, now)
+                raise
+            self._live[rr.id] = rr
+            self._update_gauges_locked()
+            return rr
+
+    def _submit_locked(self, rr: RouterRequest, now: float) -> None:
+        """Dispatch one fresh request: the routing policy's pick first, the
+        spill target on refusal — resolved LAZILY, so the common accepted
+        path never pays the per-replica load probes."""
+        routable = [r for r in self.cluster if r.routable]
+        if not routable:
+            self._count_shed_locked("no_replicas")
+            raise Overloaded(
+                "no routable replicas (all dead or draining)",
+                retry_after=1.0, reason="no_replicas",
+            )
+        if self.config.policy == ROUTE_ROUND_ROBIN:
+            pick = routable[self._rr_index % len(routable)]
+            self._rr_index += 1
+            plan = [(pick, ROUTE_ROUND_ROBIN)]
+        else:
+            ranked = rendezvous_rank(rr.affinity_key, [r.name for r in routable])
+            primary = {r.name: r for r in routable}[ranked[0]]
+            plan = [(primary, ROUTE_AFFINITY)]
+            if (
+                primary.frontend.controller.level >= SHEDDING
+                and len(routable) > 1
+            ):
+                # the affinity target is shedding: trade cache warmth for
+                # latency rather than queueing behind an overloaded replica
+                spill = self._least_loaded_locked(
+                    [r for r in routable if r is not primary]
+                )
+                if spill is not None:
+                    plan.insert(0, (spill, ROUTE_SPILL))
+        last_overload: Optional[Overloaded] = None
+        idx = 0
+        while idx < len(plan):  # the plan may grow ONE lazy spill candidate
+            replica, route = plan[idx]
+            idx += 1
+            try:
+                self._dispatch_locked(rr, replica, route, now)
+                return
+            except Overloaded as exc:
+                last_overload = exc
+            except RuntimeError as exc:
+                # the replica died between probe and submit: suspect it
+                # (the probe loop will classify) and fall through to spill
+                replica.probe_failures += 1
+                last_overload = Overloaded(
+                    f"replica {replica.name} failed at intake: {exc}",
+                    retry_after=1.0, reason="replica_failure",
+                )
+            if (
+                route == ROUTE_AFFINITY
+                and len(routable) > 1
+                and not any(r2 == ROUTE_SPILL for _, r2 in plan)
+            ):
+                # the primary refused: NOW resolve the spill target (the
+                # uncommon path pays the load probes, not every submit)
+                spill = self._least_loaded_locked(
+                    [r for r in routable if r is not replica]
+                )
+                if spill is not None:
+                    plan.append((spill, ROUTE_SPILL))
+        raise last_overload  # every candidate refused
+
+    def _emit_refused_trace_locked(
+        self, rr: RouterRequest, exc: Exception, now: float
+    ) -> None:
+        ctx = rr.trace_ctx
+        if ctx is None or not ctx.sampled:
+            return
+        reason = getattr(exc, "reason", None) or type(exc).__name__
+        _tracing.GLOBAL_TRACER.add_span(
+            "router.request", trace_id=ctx.trace_id, span_id=ctx.span_id,
+            parent_id=ctx.parent_id, start_s=rr.submit_time, end_s=now,
+            attrs={"req_id": rr.id, "priority": priority_name(rr.priority),
+                   "tenant": rr.tenant, "outcome": f"refused:{reason}"},
+            status=f"shed:{reason}",
+        )
+
+    def _least_loaded_locked(
+        self, replicas: List[Replica]
+    ) -> Optional[Replica]:
+        if not replicas:
+            return None
+        def load(r: Replica) -> Tuple[int, int]:
+            snap = r.frontend.health_snapshot()
+            return (snap["level"], snap["queue_depth"] + snap["live_requests"])
+        return min(replicas, key=load)
+
+    def _dispatch_locked(
+        self, rr: RouterRequest, replica: Replica, route: str, now: float
+    ) -> None:
+        """One accepted routing decision: submit to the replica's frontend
+        and account it exactly once (route counter + routing log)."""
+        ttl = None
+        if rr.deadline is not None:
+            # the ORIGINAL deadline travels: the replica sees only what's left
+            ttl = max(rr.deadline - now, 1e-6)
+        rr.inner = replica.frontend.submit(
+            rr.prompt,
+            max_new_tokens=rr.max_new_tokens,
+            eos_token_id=rr.eos_token_id,
+            priority=rr.priority,
+            tenant=rr.tenant,
+            ttl_s=ttl,
+            traceparent=self._child_traceparent(rr),
+        )
+        if rr.deadline is not None and rr.inner.inner.deadline is not None:
+            # absolute-deadline fidelity: the frontend restamps the ttl from
+            # its own clock, which lands a hair past the original — clamp so
+            # no replica ever honors more than the request's true deadline
+            rr.inner.inner.deadline = min(rr.inner.inner.deadline, rr.deadline)
+        rr.replica = replica.name
+        rr.routes.append((route, replica.name))
+        self._counters[route] += 1
+        self._dispatches += 1
+        self._metrics["route"].labels(route=route).inc()
+        self._routing_log.append(
+            {"req_id": rr.id, "replica": replica.name, "route": route,
+             "redispatch": rr.redispatches}
+        )
+
+    @staticmethod
+    def _child_traceparent(rr: RouterRequest) -> Optional[str]:
+        if rr.trace_ctx is None:
+            return None
+        return _tracing.format_traceparent(rr.trace_ctx)
+
+    # -- lifecycle ------------------------------------------------------------
+    def cancel(self, req_id: int, reason: str = "cancelled") -> bool:
+        """Shed one routed request wherever it lives (on a replica, or
+        pending re-dispatch). Returns False for unknown/terminal ids."""
+        with self._lock:
+            rr = self._live.get(req_id)
+            if rr is None:
+                return False
+            now = time.perf_counter()
+            if rr.inner is not None:
+                replica = self.cluster.get(rr.replica) if rr.replica else None
+                if replica is not None:
+                    replica.frontend.cancel(rr.inner.id, reason=reason)
+                self._forward_locked(rr, now)
+            self._finalize_locked(rr, reason, now, deliver=False)
+            self._update_gauges_locked()
+            return True
+
+    def drain(self, name: str) -> None:
+        """Administrative drain: stop intake to ``name`` (its hash-ring
+        share remaps to the survivors immediately), let its live slots
+        finish, record ``replica_drained`` once empty. No request is shed."""
+        with self._lock:
+            replica = self.cluster.replicas[name]
+            if replica.state == REPLICA_DEAD:
+                raise RuntimeError(f"replica {name!r} is dead; revive, don't drain")
+            if replica.state != REPLICA_DRAINING:
+                self._transition_locked(replica, REPLICA_DRAINING, time.perf_counter())
+
+    def resume(self, name: str) -> None:
+        """Reopen a DRAINING replica for intake."""
+        with self._lock:
+            replica = self.cluster.replicas[name]
+            if replica.state != REPLICA_DRAINING:
+                raise RuntimeError(
+                    f"replica {name!r} is {replica.state}, not draining"
+                )
+            replica.drained_logged = False
+            self._transition_locked(replica, REPLICA_UP, time.perf_counter())
+
+    def revive(self, name: str) -> Replica:
+        """Rebuild a DEAD replica through the cluster factory; it rejoins
+        the ring (reclaiming exactly its old key share) as UP."""
+        with self._lock:
+            replica = self.cluster.revive(name)
+            replica.drained_logged = False
+            _flight.record_event(
+                "replica_state", replica=name,
+                **{"from": REPLICA_DEAD, "to": REPLICA_UP,
+                   "generation": replica.generation},
+            )
+            self._metrics["replica_state"].labels(replica=name).set(
+                STATE_CODES[REPLICA_UP]
+            )
+            if self._thread is not None and self._thread.is_alive():
+                replica.frontend.start()
+            self._update_gauges_locked()
+            return replica
+
+    # -- the pump (inline driver) ---------------------------------------------
+    def pump(self) -> List[RouterRequest]:
+        """One cluster iteration: pump every live replica's frontend, probe
+        health (state transitions, failover), retry pending re-dispatches,
+        forward tokens, finalize terminals. Returns handles that reached a
+        terminal state during this call."""
+        with self._lock:
+            for replica in self.cluster:
+                self._pump_replica_locked(replica)
+            return self._tick_locked()
+
+    def _pump_replica_locked(self, replica: Replica) -> None:
+        if replica.state == REPLICA_DEAD:
+            return
+        try:
+            replica.frontend.pump()
+            replica.pump_failures = 0
+        except Exception as exc:  # classify like the pump thread: transient failures retry, permanent ones fail the replica below
+            replica.pump_failures += 1
+            if (
+                replica.frontend.engine.broken
+                or replica.pump_failures > self.config.pump_failures_to_dead
+            ):
+                # permanent: salvage + explicit terminals now; the probe
+                # pass turns this into the failover routing event
+                replica.frontend.fail(f"{type(exc).__name__}: {exc}")
+
+    def _tick_locked(self) -> List[RouterRequest]:
+        now = time.perf_counter()
+        self._probe_locked(now)
+        self._retry_redispatch_locked(now)
+        for rr in list(self._live.values()):
+            self._forward_locked(rr, now)
+            if rr.inner is not None and rr.inner.finished and not rr.finished:
+                self._on_inner_terminal_locked(rr, now)
+        self._update_gauges_locked()
+        out, self._pending_finished = self._pending_finished, []
+        return out
+
+    # -- health probing -------------------------------------------------------
+    def _probe_locked(self, now: float) -> None:
+        for replica in self.cluster:
+            if replica.state == REPLICA_DEAD:
+                continue
+            try:
+                fault_point("replica.kill")
+            except InjectedFault:
+                # the fault site models a whole-replica death: flip the
+                # frontend to permanent failure; the probe below observes it
+                replica.kill("injected replica.kill")
+            snap = None
+            try:
+                fault_point("router.health_probe")
+                snap = replica.frontend.health_snapshot()
+                replica.probe_failures = 0
+            except Exception:  # a failing probe suspects the replica, never kills the router
+                replica.probe_failures += 1
+            new_state = self._classify_locked(replica, snap)
+            if new_state != replica.state:
+                self._transition_locked(replica, new_state, now)
+            elif (
+                replica.state == REPLICA_DRAINING
+                and snap is not None
+                and snap["live_requests"] == 0
+                and snap["queue_depth"] == 0
+                and not replica.drained_logged
+            ):
+                replica.drained_logged = True
+                _flight.record_event("replica_drained", replica=replica.name)
+
+    def _classify_locked(
+        self, replica: Replica, snap: Optional[Dict[str, Any]]
+    ) -> str:
+        if snap is not None and (
+            snap["broken"]
+            or snap["failed"] is not None
+            or snap["pump_alive"] is False
+        ):
+            return REPLICA_DEAD
+        if snap is None:
+            if replica.probe_failures >= self.config.probe_failures_to_dead:
+                return REPLICA_DEAD
+            # a flaky probe demotes; DRAINING stays draining while suspect
+            return (
+                REPLICA_DRAINING
+                if replica.state == REPLICA_DRAINING
+                else REPLICA_DEGRADED
+            )
+        if replica.state == REPLICA_DRAINING:
+            return REPLICA_DRAINING
+        if snap["level"] >= SHEDDING:
+            return REPLICA_DEGRADED  # sustained overload: routable, reported
+        return REPLICA_UP
+
+    def _transition_locked(self, replica: Replica, to: str, now: float) -> None:
+        frm = replica.state
+        replica.state = to
+        _flight.record_event(
+            "replica_state", replica=replica.name, **{"from": frm, "to": to}
+        )
+        self._metrics["replica_state"].labels(replica=replica.name).set(
+            STATE_CODES[to]
+        )
+        if to == REPLICA_DEAD:
+            replica.death_ts = now
+            self._failover_replica_locked(replica, now)
+            if not any(r.alive for r in self.cluster):
+                # the whole cluster is down: this is the postmortem moment
+                _flight.record_event(
+                    "all_replicas_dead", replicas=len(self.cluster),
+                    live_requests=len(self._live),
+                    pending_redispatch=len(self._redispatch_q),
+                )
+                _flight.safe_dump(
+                    "router_all_replicas_dead",
+                    extra={"replicas": self.cluster.names()},
+                )
+
+    # -- failover -------------------------------------------------------------
+    def _failover_replica_locked(self, replica: Replica, now: float) -> None:
+        """Replica death as a routing event: salvage what its engine already
+        finished, re-dispatch the rest, pass through terminals it reached
+        before dying. Nothing owned by the dead replica is lost silently."""
+        # idempotent: organic deaths already failed themselves; a probed
+        # death (e.g. pump thread gone) still needs salvage + terminals
+        replica.frontend.fail("replica declared dead by router health probe")
+        for rr in list(self._live.values()):
+            if rr.replica != replica.name or rr.finished:
+                continue
+            if rr.inner is None:
+                # pending re-dispatch merely TARGETED at this replica (never
+                # dispatched): it is already queued, and _retry_redispatch
+                # re-picks a routable target at dispatch time — re-enqueueing
+                # it here would double-dispatch one request
+                continue
+            self._forward_locked(rr, now)  # tokens truly generated are kept
+            out = rr.inner.outcome
+            if out == "ok":
+                # the dead engine had finished this one: salvaged delivery
+                self._salvaged += 1
+                self._metrics["salvaged"].inc()
+                self._finalize_locked(rr, "ok", now)
+            elif out in (None, "engine_failure"):
+                self._schedule_redispatch_locked(rr, replica.name, now, now)
+            else:
+                # terminal before the death (deadline/cancel): pass through
+                self._finalize_locked(rr, out, now)
+
+    def _schedule_redispatch_locked(
+        self, rr: RouterRequest, from_name: str, death_ts: float, now: float
+    ) -> None:
+        rr._failover_from = from_name
+        rr._death_ts = death_ts
+        if rr.inner is not None:
+            # keep the dead replica's engine-side request reachable from
+            # result() in case this request sheds before any re-accept
+            rr._terminal_inner = rr.inner.inner
+        rr.inner = None
+        self._backoff_or_shed_locked(rr, now)
+
+    def _backoff_or_shed_locked(self, rr: RouterRequest, now: float) -> None:
+        """Burn one re-dispatch attempt: budget-bounded, deadline-aware."""
+        rr.redispatches += 1
+        self._metrics["redispatch"].inc()
+        if rr.redispatches > self.config.max_redispatch:
+            self._shed_locked(rr, "replica_failure", now)
+            return
+        backoff = self.config.redispatch_backoff_s * (2 ** (rr.redispatches - 1))
+        if rr.deadline is not None and now + backoff >= rr.deadline:
+            # the original deadline is unmakeable: shed now, don't burn a
+            # healthy replica's prefill on a request that cannot land
+            self._shed_locked(rr, "deadline_failover", now)
+            return
+        rr._not_before = now + backoff
+        # ownership invariant: the victim is re-owned by its failover target
+        # immediately (re-validated at dispatch time)
+        target = self._failover_target_locked(rr)
+        rr.replica = target.name if target is not None else None
+        self._redispatch_q.append(rr)
+
+    def _failover_target_locked(self, rr: RouterRequest) -> Optional[Replica]:
+        """The next replica in the hash ring for this request's key (the
+        dead owner is no longer routable, so the ring order IS the failover
+        order); round_robin mode rotates instead."""
+        routable = [r for r in self.cluster if r.routable]
+        if not routable:
+            return None
+        if self.config.policy == ROUTE_ROUND_ROBIN:
+            pick = routable[self._rr_index % len(routable)]
+            self._rr_index += 1
+            return pick
+        ranked = rendezvous_rank(rr.affinity_key, [r.name for r in routable])
+        by_name = {r.name: r for r in routable}
+        return by_name[ranked[0]]
+
+    def _retry_redispatch_locked(self, now: float) -> None:
+        if not self._redispatch_q:
+            return
+        pending, self._redispatch_q = self._redispatch_q, []
+        still: List[RouterRequest] = []
+        for rr in pending:
+            if rr.finished:
+                continue  # cancelled/shed while waiting out the backoff
+            if rr.deadline is not None and now >= rr.deadline:
+                self._shed_locked(rr, "deadline_failover", now)
+                continue
+            if not any(r.alive for r in self.cluster):
+                self._shed_locked(rr, "replica_failure", now)
+                continue
+            if rr._not_before > now:
+                still.append(rr)
+                continue
+            target = self._failover_target_locked(rr)
+            if target is None:
+                # alive but nothing routable (all draining): hold; the
+                # deadline/all-dead gates above bound the wait
+                still.append(rr)
+                continue
+            try:
+                fault_point("router.dispatch")
+                self._dispatch_locked(rr, target, ROUTE_FAILOVER, now)
+            except (Overloaded, InjectedFault, RuntimeError):
+                # refused or died under us: burn one bounded attempt
+                self._backoff_or_shed_locked(rr, now)
+                continue
+            # re-accepted on a healthy replica: failover latency is death
+            # detection -> re-accept (what the bench reports at p99)
+            lat = now - (rr._death_ts if rr._death_ts is not None else now)
+            self._failover_latencies.append(lat)
+            self._metrics["failover_latency"].observe(lat)
+            ctx = rr.trace_ctx
+            if ctx is not None and ctx.sampled:
+                # the failed-over request's trace shows BOTH replicas: the
+                # two frontend span trees plus this bridge span
+                _tracing.GLOBAL_TRACER.add_span(
+                    "router.failover", trace_id=ctx.trace_id,
+                    parent_id=ctx.span_id,
+                    start_s=rr._death_ts if rr._death_ts is not None else now,
+                    end_s=now,
+                    attrs={
+                        "from_replica": rr._failover_from,
+                        "to_replica": target.name,
+                        "redispatch": rr.redispatches,
+                    },
+                )
+        self._redispatch_q.extend(still)
+
+    # -- delivery -------------------------------------------------------------
+    def _forward_locked(self, rr: RouterRequest, now: float) -> None:
+        inner = rr.inner
+        if inner is None:
+            return
+        # append-only list, read without the frontend lock: a torn length is
+        # impossible under the GIL and a short read just forwards next tick.
+        # The length is captured ONCE — re-reading it after the slice could
+        # mark a token appended in between as delivered without forwarding it
+        gen = inner.inner.generated
+        n = len(gen)
+        if n <= rr._n_delivered:
+            return  # nothing new (or a re-dispatch still catching up)
+        fresh = gen[rr._n_delivered:n]
+        if rr.first_token_time is None:
+            rr.first_token_time = now
+        for tok in fresh:
+            rr._q.put(tok)
+            rr._delivered.append(tok)
+        rr._n_delivered = n
+
+    def _on_inner_terminal_locked(self, rr: RouterRequest, now: float) -> None:
+        out = rr.inner.outcome
+        if out == "ok":
+            self._finalize_locked(rr, "ok", now)
+        elif out == "engine_failure":
+            # the replica failed itself (organic pump death) before the
+            # probe saw it: same routing event as a probed death
+            replica = self.cluster.get(rr.replica) if rr.replica else None
+            death_ts = (
+                replica.death_ts
+                if replica is not None and replica.death_ts is not None
+                else now
+            )
+            self._schedule_redispatch_locked(
+                rr, rr.replica or "unknown", death_ts, now
+            )
+        else:
+            # frontend-level terminal (deadline_queued / deadline_decode /
+            # stream_timeout / ...): passes through; the frontend already
+            # counted its shed
+            self._finalize_locked(rr, out, now)
+
+    def _shed_locked(self, rr: RouterRequest, reason: str, now: float) -> None:
+        self._count_shed_locked(reason)
+        self._finalize_locked(rr, reason, now)
+
+    def _count_shed_locked(self, reason: str) -> None:
+        self._shed_counts[reason] = self._shed_counts.get(reason, 0) + 1
+        self._serving_metrics["shed"].labels(reason=reason).inc()
+
+    def _finalize_locked(
+        self, rr: RouterRequest, outcome: str, now: float, deliver: bool = True
+    ) -> None:
+        if rr.finished:
+            return  # terminal exactly once, cluster-wide
+        rr.outcome = outcome
+        rr.finish_time = now
+        if rr.inner is not None:
+            rr._terminal_inner = rr.inner.inner
+        self._live.pop(rr.id, None)
+        rr._done.set()
+        rr._q.put(_END)
+        ctx = rr.trace_ctx
+        if ctx is not None and ctx.sampled:
+            _tracing.GLOBAL_TRACER.add_span(
+                "router.request", trace_id=ctx.trace_id, span_id=ctx.span_id,
+                parent_id=ctx.parent_id, start_s=rr.submit_time, end_s=now,
+                attrs={
+                    "req_id": rr.id,
+                    "routes": [f"{kind}:{name}" for kind, name in rr.routes],
+                    "redispatches": rr.redispatches,
+                    "outcome": outcome,
+                    "priority": priority_name(rr.priority),
+                    "tenant": rr.tenant,
+                    "n_delivered": rr._n_delivered,
+                },
+                status="ok" if outcome == "ok" else f"shed:{outcome}",
+            )
+        if deliver:
+            self._pending_finished.append(rr)
+
+    def _update_gauges_locked(self) -> None:
+        self._metrics["routable"].set(
+            sum(1 for r in self.cluster if r.routable)
+        )
+
+    # -- supervisor thread (threaded mode) ------------------------------------
+    def start(self) -> "ReplicaRouter":
+        """Start every live replica's pump thread plus the router
+        supervisor (probe + failover + forwarding) until :meth:`stop`."""
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return self
+            for replica in self.cluster:
+                if replica.alive:
+                    replica.frontend.start()
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run_loop, daemon=True, name="replica-router"
+            )
+            self._thread.start()
+        return self
+
+    def _run_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                with self._lock:
+                    # replicas pump themselves in threaded mode; the tick's
+                    # finished list is drained here (terminal state already
+                    # landed on the handles)
+                    self._tick_locked()
+            except Exception as exc:  # the supervisor must outlive any single bad tick — a failed probe round is a flight event, not a router death
+                _flight.record_event(
+                    "router_tick_failed",
+                    error=f"{type(exc).__name__}: {exc}"[:200],
+                )
+            self._stop.wait(timeout=self.config.probe_interval_s)
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        with self._lock:
+            t = self._thread
+        if t is not None:
+            t.join(timeout=timeout)
+        with self._lock:
+            if self._thread is t:
+                self._thread = None
+        for replica in self.cluster:
+            replica.frontend.stop()
+
+    # -- introspection --------------------------------------------------------
+    def has_work(self) -> bool:
+        with self._lock:
+            if self._live or self._redispatch_q:
+                return True
+            return any(
+                r.alive and r.frontend.engine.has_work() for r in self.cluster
+            )
+
+    def routing_counters(self) -> Dict[str, int]:
+        """Route-kind counters (affinity/spill/failover/round_robin); their
+        sum equals :meth:`dispatch_count` exactly. The routing LOG is a
+        bounded recent window (``routing_log_size``) — reconcile counters
+        against the monotonic count, not the log length."""
+        with self._lock:
+            return dict(self._counters)
+
+    def dispatch_count(self) -> int:
+        """Monotonic count of accepted routing decisions — what the route
+        counters sum to, regardless of how much log the window retains."""
+        with self._lock:
+            return self._dispatches
+
+    def shed_counters(self) -> Dict[str, int]:
+        """Router-originated sheds by reason (replica-frontend sheds are
+        counted by the frontends)."""
+        with self._lock:
+            return dict(self._shed_counts)
+
+    def salvaged_count(self) -> int:
+        with self._lock:
+            return self._salvaged
+
+    def routing_log(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._routing_log)
+
+    def failover_latencies(self) -> List[float]:
+        with self._lock:
+            return list(self._failover_latencies)
+
+    def live_requests(self) -> List[RouterRequest]:
+        with self._lock:
+            return list(self._live.values())
+
+    def pending_redispatch(self) -> List[RouterRequest]:
+        with self._lock:
+            return list(self._redispatch_q)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Cluster health view (the multi-replica /healthz payload)."""
+        with self._lock:
+            return {
+                "replicas": {
+                    r.name: {
+                        "state": r.state,
+                        "generation": r.generation,
+                        "probe_failures": r.probe_failures,
+                    }
+                    for r in self.cluster
+                },
+                "routable_replicas": sum(1 for r in self.cluster if r.routable),
+                "live_requests": len(self._live),
+                "pending_redispatch": len(self._redispatch_q),
+                "routes": dict(self._counters),
+                "sheds": dict(self._shed_counts),
+                "salvaged": self._salvaged,
+            }

@@ -74,6 +74,17 @@ KNOWN_SITES = (
     # zero-cost-when-empty like block_pool.allocate.
     "prefix_cache.match",
     "prefix_cache.cow",
+    # cluster-router seams (serving/router.py): ``router.dispatch`` fires at
+    # the top of every routing decision (submit and failover re-dispatch);
+    # ``router.health_probe`` fires per replica probe — a probe failure must
+    # degrade the replica, never kill the router; ``replica.kill`` also fires
+    # per replica probe, and a trigger there flips that frontend to PERMANENT
+    # failure, so CPU CI exercises death-as-routing-event (salvage,
+    # re-dispatch, failover accounting) end to end. All three are pinned
+    # zero-cost-when-empty like the existing sites.
+    "router.dispatch",
+    "router.health_probe",
+    "replica.kill",
 )
 
 

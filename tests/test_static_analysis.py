@@ -456,6 +456,112 @@ def test_rb502_suppressible_with_reason():
     assert vs[0].suppressed and vs[0].reason
 
 
+# -- RB503: unbounded retry loops in request-serving paths --------------------
+
+def test_rb503_unbounded_retry_loop_flagged():
+    # success-exit alone is NOT a bound: a permanently-dead dependency
+    # never delivers success
+    src = (
+        "def pump(router):\n"
+        "    while True:\n"
+        "        ok = router.redispatch()\n"
+        "        if ok:\n"
+        "            break\n"
+    )
+    assert codes(src, path=SERVING) == ["RB503"]
+    # recover()-shaped retries too
+    src = "def f(engine):\n    while True:\n        engine.recover()\n"
+    assert codes(src, path=SERVING) == ["RB503"]
+
+
+def test_rb503_attempt_counter_bounds_the_loop():
+    src = (
+        "def f(x, max_attempts):\n"
+        "    attempt = 0\n"
+        "    while True:\n"
+        "        attempt += 1\n"
+        "        if attempt >= max_attempts:\n"
+        "            raise RuntimeError('retries exhausted')\n"
+        "        if retry_step(x):\n"
+        "            return\n"
+    )
+    assert codes(src, path=SERVING) == []
+
+
+def test_rb503_deadline_and_expired_checks_bound_the_loop():
+    src = (
+        "import time\n"
+        "def f(req, deadline):\n"
+        "    while True:\n"
+        "        if time.perf_counter() >= deadline:\n"
+        "            raise TimeoutError()\n"
+        "        recover(req)\n"
+    )
+    assert codes(src, path=SERVING) == []
+    src = (
+        "def f(req):\n"
+        "    while True:\n"
+        "        if req.expired():\n"
+        "            raise TimeoutError()\n"
+        "        redispatch(req)\n"
+    )
+    assert codes(src, path=SERVING) == []
+
+
+def test_rb503_conditioned_while_and_non_retry_loops_ok():
+    # a conditioned while IS its own bound
+    src = (
+        "def f(r, n):\n"
+        "    i = 0\n"
+        "    while i < n:\n"
+        "        r.redispatch()\n"
+        "        i += 1\n"
+    )
+    assert codes(src, path=SERVING) == []
+    # while True without a retry-shaped call is not this checker's business
+    src = (
+        "def f(q):\n"
+        "    while True:\n"
+        "        item = q.get_nowait()\n"
+        "        if item is None:\n"
+        "            break\n"
+    )
+    assert codes(src, path=SERVING) == []
+
+
+def test_rb503_only_in_request_serving_dirs():
+    src = "def f(r):\n    while True:\n        r.redispatch()\n"
+    assert codes(src, path="paddle_tpu/models/x.py") == []
+    for gated in ("serving", "distributed", "inference"):
+        assert codes(src, path=f"paddle_tpu/{gated}/x.py") == ["RB503"]
+
+
+def test_rb503_nested_function_retry_is_not_the_outer_loops_problem():
+    # a closure's retry belongs to that function's own loop discipline
+    src = (
+        "def f(q):\n"
+        "    while True:\n"
+        "        def later():\n"
+        "            retry_op()\n"
+        "        item = q.get_nowait()\n"
+        "        if item is None:\n"
+        "            break\n"
+    )
+    assert codes(src, path=SERVING) == []
+
+
+def test_rb503_suppressible_with_reason():
+    vs = analyze_source(
+        "def f(r):\n"
+        "    # analysis: disable=RB503 bounded by the caller's watchdog\n"
+        "    while True:\n"
+        "        r.redispatch()\n",
+        path=SERVING,
+    )
+    assert [v.code for v in vs] == ["RB503"]
+    assert vs[0].suppressed and vs[0].reason
+
+
 # -- OB: observability discipline --------------------------------------------
 
 def test_ob601_span_opened_without_with_leaks():
