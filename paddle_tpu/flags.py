@@ -155,6 +155,12 @@ def _define_builtin_flags() -> None:
     # block dedup with copy-on-write over the paged pool; read at engine
     # construction (per-engine override via the enable_prefix_cache kwarg)
     d("enable_prefix_cache", bool, True, "Reference-counted content-hash KV block dedup for the continuous-batching engine: shared prompt prefixes are computed once and mapped copy-on-write into every request that repeats them; off = every prompt recomputes from token zero.")
+    # speculative decoding (inference/spec_decode.py): n-gram self-speculation
+    # riding the engine's one compiled mixed ragged step; read at engine
+    # construction (per-engine override via the spec_decode kwarg)
+    d("spec_decode", bool, False, "Self-speculative decoding on the continuous-batching engine: an n-gram prompt-lookup drafter proposes draft tokens per decode slot; drafts ride the SAME [max_slots, prefill_chunk] compiled step as prompt chunks (verification is data — zero new compiled signatures), accepted tokens commit in bulk, the first rejection rewinds the slot's block table. Greedy outputs are byte-identical on or off.")
+    d("spec_decode_ngram", int, 3, "Longest n-gram of the request's prompt+generated history the speculative drafter matches (walks down to 1); read at engine construction.")
+    d("spec_decode_tokens", int, 4, "Max draft tokens proposed per slot per step, capped at prefill_chunk - 1 so the draft plus the mandatory last-token row fit the engine's compiled chunk width.")
 
 
 _define_builtin_flags()

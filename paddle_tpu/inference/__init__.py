@@ -36,6 +36,7 @@ __all__ = [
     "ContinuousBatchingEngine",
     "FIFOAdmission",
     "InferenceRequest",
+    "NGramDrafter",
     "PrefixCache",
     "IntakeError",
     "EmptyPromptError",
@@ -46,6 +47,7 @@ __all__ = [
 ]
 
 from paddle_tpu.inference.prefix_cache import PrefixCache  # noqa: E402
+from paddle_tpu.inference.spec_decode import NGramDrafter  # noqa: E402
 from paddle_tpu.inference.engine import (  # noqa: E402
     AdmissionPolicy,
     ContinuousBatchingEngine,

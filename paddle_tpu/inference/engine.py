@@ -32,7 +32,29 @@ block is copy-on-write: the fork is carried INTO the unified step as data
 (``cow_src``/``cow_dst`` per slot), so CoW adds no compiled signature.
 Eviction is LRU over zero-reference chains only — a live request can never
 lose a block — and the worst-case admission reservation stays honest by
-counting only non-shared blocks.
+counting only non-shared blocks. At request FINISH, full blocks containing
+the request's committed GENERATED tokens are registered into the cache too
+(rewind-safe: speculative rewinds happen at commit time, long before
+release), so a multi-turn conversation's second turn maps its first turn's
+KV instead of recomputing it.
+
+**Speculative decoding**: with ``FLAGS_spec_decode`` (default off), a
+host-side n-gram / prompt-lookup drafter (``inference/spec_decode.py``)
+proposes up to K draft tokens per decode slot; the slot's step row becomes a
+``1 + K``-token chunk (``[last_token, d1..dK]``) with the SAME per-row
+causal ``q_lens`` semantics prompt chunks already use — drafted slots,
+plain-decode slots, and prefill chunks coexist in ONE dispatch of the ONE
+compiled signature (verification is pure data; the recompile watchdog still
+reports exactly 1 compile per engine). The step's per-row argmax is compared
+against the draft left-to-right: accepted tokens commit in bulk (their KV
+was written by the very step that verified them, and the argmax after the
+last accepted draft rides along as a bonus token, so a fully accepted
+K-draft commits K+1 tokens for one dispatch), and the first rejection
+rewinds by block-table truncation through the refcounted pool. Speculation
+may transiently write into a slot's reserved headroom but never past its
+worst-case admission reservation (drafts are capped at the remaining token
+budget), so the admission math is untouched; greedy outputs are
+byte-identical with speculation on or off.
 
 The block allocator is host-side Python (it runs between steps, not inside
 the program); admission reserves a request's worst-case PRIVATE block need
@@ -60,6 +82,7 @@ import numpy as np
 
 from paddle_tpu.flags import GLOBAL_FLAGS
 from paddle_tpu.inference.prefix_cache import ChainNode, PrefixCache, chain_digest
+from paddle_tpu.inference.spec_decode import NGramDrafter, count_accepted
 from paddle_tpu.observability import flight_recorder as _flight
 from paddle_tpu.observability import metrics as _obs
 from paddle_tpu.observability import tracing as _tracing
@@ -175,6 +198,26 @@ def _engine_metrics() -> Dict[str, Any]:
             "engine_prefill_tokens_computed_total",
             "Prompt tokens actually computed by prefill chunks (cache hits "
             "are NOT counted here — the shared-prefix honesty counter).",
+        ),
+        "spec_drafted": reg.counter(
+            "spec_decode_drafted_tokens_total",
+            "Draft tokens proposed by the speculative drafter and scored by "
+            "the unified step.",
+        ),
+        "spec_accepted": reg.counter(
+            "spec_decode_accepted_tokens_total",
+            "Draft tokens the step's greedy argmax agreed with (committed in "
+            "bulk; their KV was written by the verifying step itself).",
+        ),
+        "spec_rejected": reg.counter(
+            "spec_decode_rejected_tokens_total",
+            "Draft tokens discarded at the first disagreement (KV rewound by "
+            "block-table truncation).",
+        ),
+        "spec_accept_rate": reg.histogram(
+            "spec_decode_acceptance_rate",
+            "Per-speculated-step acceptance fraction: accepted / drafted "
+            "(1.0 = the whole draft committed).",
         ),
     }
 
@@ -297,6 +340,7 @@ class ContinuousBatchingEngine:
         admission_policy: Optional[AdmissionPolicy] = None,
         prefill_chunk: Optional[int] = None,
         enable_prefix_cache: Optional[bool] = None,
+        spec_decode: Optional[bool] = None,
     ) -> None:
         from paddle_tpu.incubate.nn.functional import BlockKVCache
 
@@ -343,6 +387,22 @@ class ContinuousBatchingEngine:
             else enable_prefix_cache
         )
         self._cache = self._new_prefix_cache()
+        # speculative decoding: drafts ride the step's chunk axis, so the
+        # draft width is capped at prefill_chunk - 1 (one row is always the
+        # real last token); a 1-wide chunk cannot carry a draft at all
+        self._use_spec = bool(
+            GLOBAL_FLAGS.get("spec_decode") if spec_decode is None else spec_decode
+        )
+        self._spec_k = min(
+            int(GLOBAL_FLAGS.get("spec_decode_tokens")), self.prefill_chunk - 1
+        )
+        if self._spec_k < 1:
+            self._use_spec = False
+        self._drafter = (
+            NGramDrafter(int(GLOBAL_FLAGS.get("spec_decode_ngram")))
+            if self._use_spec
+            else None
+        )
         # ONE global paged pool shared by every layer's sequences would alias
         # writes across layers — each layer owns its [NB, KVH, BS, D] pair,
         # all indexed by the SAME block tables (the reference layout).
@@ -374,6 +434,8 @@ class ContinuousBatchingEngine:
         self.stats = {
             "step_traces": 0, "steps": 0, "admitted": 0, "recoveries": 0,
             "prompt_tokens_computed": 0, "prompt_tokens_reused": 0,
+            "spec_steps": 0, "spec_drafted": 0, "spec_accepted": 0,
+            "spec_rejected": 0, "gen_blocks_registered": 0,
         }
         self._metrics = _engine_metrics()
         self._update_pool_gauges()
@@ -661,7 +723,12 @@ class ContinuousBatchingEngine:
         ``q_lens`` valid new tokens; ``active`` the slot mask; ``cow_*`` the
         copy-on-write fork set (``dst == num_blocks``: no fork). Applies
         pending CoW forks, appends the ragged chunk KV, attends, and returns
-        each slot's next greedy token (read at its last valid row)."""
+        EVERY row's greedy argmax ``[S, C]`` — row ``j`` is the model's next
+        token after the row-``j`` input, which is simultaneously the decode
+        output (a plain slot reads row 0), the prompt-completion output (read
+        at the last valid row), and the speculative verification surface (a
+        drafted slot compares rows ``0..K-1`` against its draft left-to-
+        right). Rows past ``q_lens`` are garbage and never read host-side."""
         import paddle_tpu
         from paddle_tpu.core.tensor import Tensor
         from paddle_tpu.incubate.nn.functional import block_cache_cow_copy
@@ -687,12 +754,9 @@ class ContinuousBatchingEngine:
                     use_cache=True,
                     cache_position=Tensor(lens),
                 )
-            # each slot's next token comes from its LAST valid row
-            idx = jnp.maximum(q_lens - 1, 0)
-            rows = jnp.take_along_axis(
-                logits._data, idx[:, None, None], axis=1
-            )[:, 0]  # [S, V]
-            nxt = jnp.argmax(rows.astype(jnp.float32), axis=-1).astype(jnp.int32)
+            nxt = jnp.argmax(
+                logits._data.astype(jnp.float32), axis=-1
+            ).astype(jnp.int32)  # [S, C] per-row argmax
             return nxt, [(c[0]._data, c[1]._data) for c in new_pkv]
 
     # -- scheduling ----------------------------------------------------------
@@ -854,10 +918,16 @@ class ContinuousBatchingEngine:
         # finished requests are handed back ONLY through step()'s return
         # value (run() accumulates them); the engine keeps no reference, so
         # a long-running step()-driven server never grows host memory
-        if self._cache is not None and self._pending_cow[slot] is not None:
+        # skip chain registration under a pending CoW fork: its device copy
+        # never executed, so that block's content is garbage and must not be
+        # hashed into the cache
+        had_pending_cow = self._pending_cow[slot] is not None
+        if self._cache is not None and had_pending_cow:
             # cancelled before its first step: unpin the CoW source
             self._cache.release_cow_source(self._pending_cow[slot][0])
         self._pending_cow[slot] = None
+        if not had_pending_cow:
+            self._register_finished_chain(slot, req)
         nodes = self._nodes[slot]
         if self._cache is not None and nodes:
             self._cache.release(nodes)
@@ -1042,11 +1112,49 @@ class ContinuousBatchingEngine:
             self._extend_chain(i)
         return nxt
 
+    def _register_finished_chain(self, slot: int, req: InferenceRequest) -> None:
+        """At request FINISH, extend the slot's chain with its full blocks
+        of COMMITTED generated tokens, so a multi-turn conversation's second
+        turn (prompt = first turn's prompt + reply + new text) maps its
+        first turn's KV instead of recomputing it. Rewind-safe by
+        construction: only tokens the block table still covers are hashed —
+        ``_ntok`` is the committed length, and everything a speculative
+        rewind discarded is already gone by commit time, long before this
+        runs. Reuses the in-flight insert machinery, so the release that
+        follows drops only this request's reference and the chain stays
+        warm in the LRU for the next turn's match."""
+        if self._cache is None or self._no_insert[slot]:
+            return
+        valid = int(self._ntok[slot])
+        full = np.concatenate(
+            [req.prompt, np.asarray(req.generated, np.int32)]
+        )
+        bs = self.block_size
+        while True:
+            idx = len(self._nodes[slot])
+            end = (idx + 1) * bs
+            # cap at the emitted stream too: an eos inside an accepted draft
+            # leaves KV past the last emitted token — valid content, but not
+            # part of any prompt a next turn would replay, so never hashed
+            if end > valid or end > full.size or idx >= len(self._blocks[slot]):
+                return
+            parent = self._nodes[slot][-1] if self._nodes[slot] else None
+            node = self._cache.insert(
+                parent, full[idx * bs : end], self._blocks[slot][idx]
+            )
+            if node is None:
+                return  # identical chain already cached; keep ours private
+            self._nodes[slot].append(node)
+            if end > req.prompt.size:
+                self.stats["gen_blocks_registered"] += 1
+
     def _extend_chain(self, slot: int) -> None:
         """Register this slot's freshly COMPLETED full prompt blocks as
         chain nodes (in-flight insertion: later admissions share them the
         moment they are computed). Blocks containing any generated token
-        stay private — the cache stores prompt content only."""
+        stay private until the request finishes — a live tail can still be
+        rewound by speculation, so only :meth:`_register_finished_chain`
+        (which runs after the last commit) ever hashes generated content."""
         if self._cache is None or self._no_insert[slot]:
             return
         req = self._slot_req[slot]
@@ -1073,6 +1181,133 @@ class ContinuousBatchingEngine:
                 return
             self._nodes[slot].append(node)
 
+    # -- speculative decoding ------------------------------------------------
+    def _propose_draft(self, req: InferenceRequest) -> np.ndarray:
+        """Host-side draft for one decode slot. The width is capped THREE
+        ways: the chunk can carry ``prefill_chunk - 1`` draft rows next to
+        the real last token; the request's remaining token budget bounds it
+        at ``max_new - generated - 1`` (so even a fully accepted draft plus
+        its bonus token lands exactly on the budget — KV never grows past
+        the slot's worst-case admission reservation); and the drafter itself
+        returns only what the history supports (possibly nothing — the slot
+        then stays a plain decode row at zero cost)."""
+        budget = req.max_new_tokens - len(req.generated) - 1
+        k_max = min(self._spec_k, budget)
+        if k_max < 1:
+            return np.empty((0,), np.int32)
+        # hand the drafter only the tail it can actually read (its search
+        # window plus the n-gram lookback) — proposals are identical, but a
+        # long generation no longer re-copies its whole O(context) history
+        # per slot per step
+        d = self._drafter
+        need = d.window + d.ngram_max + 1
+        gen = req.generated
+        if len(gen) >= need:
+            ctx = np.asarray(gen[-need:], np.int32)
+        else:
+            # clamp at 0: a start index going negative would wrap and slice
+            # a short suffix instead of the whole prompt
+            start = max(req.prompt.size - (need - len(gen)), 0)
+            ctx = np.concatenate(
+                [req.prompt[start:], np.asarray(gen, np.int32)]
+            )
+        return d.propose(ctx, k_max)
+
+    def _commit_speculation(
+        self,
+        slot: int,
+        req: InferenceRequest,
+        row_argmax: np.ndarray,  # [C] this slot's per-row argmax
+        draft: np.ndarray,
+    ) -> None:
+        """Verify and commit one slot's draft against the step that scored
+        it. Accepted tokens commit in bulk — their KV was written by the
+        very dispatch that verified them — followed by the bonus token (the
+        argmax after the last accepted draft, which plain decode would have
+        produced next anyway); the first rejection rewinds the block table
+        to the committed length. An injected ``spec.verify`` fault degrades
+        the slot to plain decode for this step: accept nothing, keep row
+        0's argmax (computed from committed history only — its value does
+        not depend on the draft), rewind the drafted rows. No tokens are
+        lost and no accounting drifts on that path."""
+        k = int(draft.size)
+        base = int(self._ntok[slot]) - (1 + k)  # committed before this step
+        try:
+            fault_point("spec.verify")
+            accepted = count_accepted(row_argmax, draft)
+        except Exception as exc:  # noqa: BLE001 - degrade, never corrupt
+            _flight.record_event(
+                "spec_verify_degraded", req_id=req.req_id, slot=slot,
+                error=f"{type(exc).__name__}: {exc}"[:120],
+            )
+            accepted = 0
+        # rewind FIRST: _ntok / block-table truth must equal the committed
+        # length before any finish path below releases the slot
+        self._rewind_slot(slot, req, base + 1 + accepted, drafted=k,
+                          accepted=accepted)
+        emit = [int(draft[j]) for j in range(accepted)]
+        emit.append(int(row_argmax[accepted]))  # the bonus token
+        for tok in emit:
+            req.generated.append(tok)
+            self._last_tok[slot] = tok
+            if req.eos_token_id is not None and tok == req.eos_token_id:
+                req.finish_reason = "stop"
+                break
+            if len(req.generated) >= req.max_new_tokens:
+                req.finish_reason = "length"
+                break
+        self.stats["spec_steps"] += 1
+        self.stats["spec_drafted"] += k
+        self.stats["spec_accepted"] += accepted
+        self.stats["spec_rejected"] += k - accepted
+        m = self._metrics
+        m["spec_drafted"].inc(k)
+        m["spec_accepted"].inc(accepted)
+        m["spec_rejected"].inc(k - accepted)
+        m["spec_accept_rate"].observe(accepted / k)
+        if req.finished:
+            self._release(slot, req)
+            self._pending_done.append(req)
+
+    def _rewind_slot(
+        self, slot: int, req: InferenceRequest, target_ntok: int,
+        drafted: int, accepted: int,
+    ) -> None:
+        """Block-table rewind: discard the KV written past ``target_ntok``
+        by truncating the slot's table through the refcounted pool. Chain-
+        owned blocks are never touched — drafts only ever write past the
+        prompt, into request-private blocks — and the stale KV left in the
+        retained partial block is unreadable (every later row's attention is
+        limited to positions below the committed length) and is overwritten
+        in place as the sequence advances."""
+        self._ntok[slot] = target_ntok
+        keep = max(-(-target_ntok // self.block_size), len(self._nodes[slot]))
+        freed = 0
+        while len(self._blocks[slot]) > keep:
+            self._mgr.decref(self._blocks[slot].pop())
+            freed += 1
+        if accepted < drafted:
+            _flight.record_event(
+                "spec_rewind", req_id=req.req_id, slot=slot, drafted=drafted,
+                accepted=accepted, rejected=drafted - accepted,
+                blocks_freed=freed,
+            )
+
+    def spec_decode_stats(self) -> Dict[str, Any]:
+        """Acceptance-rate view for /healthz, the serving goodput record and
+        bench (host counters — valid with metrics off)."""
+        drafted = self.stats["spec_drafted"]
+        return {
+            "enabled": self._use_spec,
+            "drafted_tokens": drafted,
+            "accepted_tokens": self.stats["spec_accepted"],
+            "rejected_tokens": self.stats["spec_rejected"],
+            "acceptance_rate": (
+                self.stats["spec_accepted"] / drafted if drafted else 0.0
+            ),
+            "speculative_steps": self.stats["spec_steps"],
+        }
+
     def _step_attempt(self) -> None:
         """One admit+dispatch pass; finished requests land in
         ``_pending_done`` (never lost to an exception mid-attempt)."""
@@ -1094,6 +1329,10 @@ class ContinuousBatchingEngine:
         q_lens = np.zeros((self.max_slots,), np.int32)
         active = np.zeros((self.max_slots,), bool)
         prefill_tokens = 0
+        # slot -> draft packed into this attempt's chunk rows; LOCAL on
+        # purpose: a failed dispatch retries through a fresh _step_attempt
+        # that re-proposes, so no speculative state can ever go stale
+        drafts: Dict[int, np.ndarray] = {}
         for i in active_slots:
             req = self._slot_req[i]
             plen = req.prompt.size
@@ -1104,9 +1343,16 @@ class ContinuousBatchingEngine:
                 toks[i, :n] = req.prompt[cur : cur + n]
                 q_lens[i] = n
                 prefill_tokens += n
-            else:  # decode row
+            else:  # decode row, with the draft riding as extra chunk rows
                 toks[i, 0] = self._last_tok[i]
                 q_lens[i] = 1
+                if self._drafter is not None and self._pending_cow[i] is None:
+                    draft = self._propose_draft(req)
+                    if draft.size:
+                        k = int(draft.size)
+                        toks[i, 1 : 1 + k] = draft
+                        q_lens[i] = 1 + k
+                        drafts[i] = draft
         t0 = time.perf_counter()
         nxt = self._dispatch(toks, q_lens, active)
         self.stats["steps"] += 1
@@ -1143,7 +1389,10 @@ class ContinuousBatchingEngine:
             req = self._slot_req[i]
             if int(self._ntok[i]) < req.prompt.size:
                 continue  # prompt not fully prefilled yet: no emission
-            tok = int(nxt[i])
+            if i in drafts:
+                self._commit_speculation(i, req, nxt[i], drafts[i])
+                continue
+            tok = int(nxt[i, max(int(q_lens[i]) - 1, 0)])  # last valid row
             if not req.generated:
                 # the prompt just completed: this is the request's FIRST
                 # token (TTFT ends here, not at admission)

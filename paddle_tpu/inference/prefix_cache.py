@@ -5,11 +5,18 @@ few-shot templates), yet a plain paged engine recomputes every prompt from
 token zero. This module is the layer between the scheduler and the pool that
 makes shared prefixes *computed once, mapped by all*:
 
-- **Chain nodes.** A prompt is chunked into block-aligned segments; each
-  full block of prompt tokens is keyed by a rolling content hash
+- **Chain nodes.** A token stream is chunked into block-aligned segments;
+  each full block is keyed by a rolling content hash
   ``digest = H(parent_digest, token_ids)``, so a node identifies not just
   its own tokens but the entire prefix that produced its KV — two blocks
-  with identical tokens under different histories never alias.
+  with identical tokens under different histories never alias. Prompt
+  blocks register IN-FLIGHT (the moment their prefill chunk returns);
+  blocks of GENERATED tokens register at request finish only — a live tail
+  can still be rewound by speculative decoding, so the engine hashes
+  generated content exclusively after the last commit
+  (``engine._register_finished_chain``), which is what makes registration
+  rewind-safe: only committed, verified tokens ever enter the chain. A
+  multi-turn conversation's second turn thereby maps its first turn's KV.
 - **Match + map.** On admission the longest chain of cached nodes matching
   the prompt is mapped straight into the request's block table with
   refcounts bumped — those tokens are never recomputed. Matching is capped
@@ -378,12 +385,14 @@ class PrefixCache:
         tokens: np.ndarray,
         block: int,
     ) -> Optional[ChainNode]:
-        """Register a request's freshly COMPUTED full prompt block as a chain
-        node (in-flight: later admissions match it immediately). The cache
-        becomes a co-owner of the physical block (pool incref); the request
-        keeps its own reference. Returns None when the key already exists —
-        two requests computed the same block concurrently; the caller keeps
-        its copy private and the cache keeps the first."""
+        """Register a request's freshly COMPUTED full block as a chain node
+        (prompt blocks in-flight — later admissions match them immediately;
+        generated-token blocks at request finish, after the last speculative
+        commit, so only verified content is ever hashed). The cache becomes
+        a co-owner of the physical block (pool incref); the request keeps
+        its own reference. Returns None when the key already exists — two
+        requests computed the same block concurrently; the caller keeps its
+        copy private and the cache keeps the first."""
         tokens = np.asarray(tokens, np.int32).reshape(-1)
         if tokens.size != self.block_size:
             raise ValueError(

@@ -1,0 +1,137 @@
+"""Self-speculative decoding: n-gram / prompt-lookup drafting + greedy
+verification for the continuous-batching engine.
+
+Decode is latency-bound at one token per step per slot, but the engine's ONE
+compiled signature — the ``[max_slots, prefill_chunk]`` mixed ragged step —
+can already score K tokens for a slot as a "prompt chunk" with per-row causal
+limits ("Ragged Paged Attention", PAPERS.md). That makes draft *verification*
+architecturally free: a drafted slot packs ``[last_token, d1..dK]`` as a
+(1+K)-row chunk into the SAME dispatch its plain-decode neighbours ride, the
+step writes the drafted KV and returns every row's greedy argmax, and the
+host compares argmax against draft left-to-right:
+
+- row ``j``'s argmax is the model's next token after ``d_j`` (row 0: after
+  ``last_token``), computed with exact causal attention over the cached
+  history plus rows ``0..j`` — identical, bit for bit, to what plain decode
+  would have produced one step at a time;
+- the longest agreeing prefix is ACCEPTED in bulk: its KV was written by the
+  very step that verified it, so a step that accepts ``a`` drafts commits
+  ``a + 1`` tokens (the ``+1`` is the "bonus" argmax after the last accepted
+  draft) for one dispatch;
+- the first disagreement rewinds: the engine truncates the slot's block
+  table back to the committed length (``BlockKVCache`` refcounts make this a
+  host-side pop+decref), and the rejected rows' stale KV is never read —
+  attention limits every later step to positions below the committed length.
+
+The drafter here is the zero-extra-memory variant: **prompt lookup** over the
+request's own prompt + generated history. Repetitive workloads (templated
+prompts, code, multi-turn chats quoting earlier turns, the cyclic tails
+greedy decode settles into) hand it long accepted runs; on incompressible
+text it proposes nothing and the slot stays a plain decode row — speculation
+can never make a step slower than the chunk it already dispatches. A small
+draft *model* sharing the paged pool is the natural follow-on and slots into
+the same propose/verify seam.
+
+Config: ``FLAGS_spec_decode`` (master switch, read at engine construction,
+per-engine ``spec_decode=`` override), ``FLAGS_spec_decode_ngram`` (longest
+history n-gram matched; the drafter walks down to 1), and
+``FLAGS_spec_decode_tokens`` (max draft tokens per slot per step, capped at
+``prefill_chunk - 1`` so draft rows plus the mandatory last-token row fit
+the compiled chunk).
+
+Everything in this module is host-side numpy — drafting and verification are
+data preparation for / bookkeeping after the one compiled step, never part
+of any traced program.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["NGramDrafter", "count_accepted"]
+
+_EMPTY = np.empty((0,), np.int32)
+
+
+class NGramDrafter:
+    """Prompt-lookup drafter: propose the continuation of the most recent
+    earlier occurrence of the context's trailing n-gram.
+
+    ``ngram_max`` is the longest suffix n-gram tried (down to ``ngram_min``);
+    longer matches predict the continuation more specifically and win over
+    shorter ones, recency breaks ties. ``window``/``max_probes`` bound the
+    per-step host cost (the drafter runs for every decode slot every step, so
+    it must stay microseconds): only the last ``window`` context tokens are
+    searched and only the ``max_probes`` most recent last-token anchors are
+    scored — both deterministic truncations, chosen because repetition is
+    local (the cycle the model just entered, the template instance being
+    filled in right now). Stateless — one instance serves every slot."""
+
+    def __init__(
+        self,
+        ngram_max: int = 3,
+        ngram_min: int = 1,
+        window: int = 128,
+        max_probes: int = 32,
+    ) -> None:
+        self.ngram_max = max(int(ngram_max), 1)
+        self.ngram_min = max(int(ngram_min), 1)
+        self.window = max(int(window), 2)
+        self.max_probes = max(int(max_probes), 1)
+        if self.ngram_min > self.ngram_max:
+            raise ValueError(
+                f"ngram_min ({self.ngram_min}) must be <= ngram_max "
+                f"({self.ngram_max})"
+            )
+
+    def propose(self, context: np.ndarray, max_tokens: int) -> np.ndarray:
+        """Up to ``max_tokens`` draft tokens continuing ``context`` (the
+        request's prompt + committed generated tokens), or an empty array
+        when no history n-gram recurs. Anchored on the LAST token: every
+        earlier occurrence of it is a candidate n-gram end; the candidate
+        matching the most preceding tokens (capped at ``ngram_max - 1``)
+        wins, most recent first."""
+        context = np.asarray(context, np.int32).reshape(-1)
+        L = context.size
+        max_tokens = int(max_tokens)
+        if max_tokens < 1 or L < 2:
+            return _EMPTY
+        lo = max(L - 1 - self.window, 0)
+        anchors = np.nonzero(context[lo : L - 1] == context[L - 1])[0]
+        if not anchors.size:
+            return _EMPTY
+        want = min(self.ngram_max, L) - 1  # preceding tokens a full match needs
+        # score = (n-gram length, continuation available): a longer match
+        # predicts better, and among equal matches one with a full
+        # ``max_tokens`` continuation beats a more recent one that would
+        # truncate the draft (in a tight cycle the most recent occurrence is
+        # the suffix's immediate neighbour with almost nothing after it)
+        best, best_j = (-1, -1), -1
+        for j in anchors[::-1][: self.max_probes]:
+            j = int(j) + lo
+            avail = min(L - 1 - j, max_tokens)
+            m = 0
+            while m < want and j - 1 - m >= 0 and context[j - 1 - m] == context[L - 2 - m]:
+                m += 1
+            if (m, avail) > best:
+                best, best_j = (m, avail), j
+                if m >= want and avail >= max_tokens:
+                    break  # longest n-gram, full draft, most recent such
+        if best[0] + 1 < self.ngram_min:
+            return _EMPTY
+        return context[best_j + 1 : best_j + 1 + max_tokens].copy()
+
+
+def count_accepted(row_argmax: np.ndarray, draft: np.ndarray) -> int:
+    """Greedy left-to-right verification: the longest prefix of ``draft``
+    where the step's per-row argmax agrees. ``row_argmax[j]`` is the model's
+    next token given the history plus draft tokens ``0..j-1`` (row 0: given
+    the history alone), so agreement at ``j`` means ``draft[j]`` IS what
+    plain greedy decode would have emitted — accepted tokens are
+    byte-identical to the unspeculated stream by construction."""
+    draft = np.asarray(draft, np.int32).reshape(-1)
+    k = int(draft.size)
+    if k == 0:
+        return 0
+    disagree = np.nonzero(np.asarray(row_argmax, np.int32)[:k] != draft)[0]
+    return int(disagree[0]) if disagree.size else k

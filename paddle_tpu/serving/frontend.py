@@ -724,6 +724,7 @@ class ServingFrontend:
             stats = self.engine.pool_stats()
             live = stats["allocated"] - stats.get("cached_reusable", 0)
             cache = self.engine.prefix_cache_stats()
+            spec = self.engine.spec_decode_stats()
             return {
                 "level": self.controller.level_name,
                 "queue_depth": self.engine.queue_depth(),
@@ -739,5 +740,11 @@ class ServingFrontend:
                     "hit_rate": round(cache.get("hit_rate", 0.0), 4),
                     "tokens_reused": cache.get("tokens_reused", 0),
                     "evictable_blocks": cache.get("evictable_blocks", 0),
+                },
+                "spec_decode": {
+                    "enabled": bool(spec.get("enabled")),
+                    "acceptance_rate": round(spec.get("acceptance_rate", 0.0), 4),
+                    "accepted_tokens": spec.get("accepted_tokens", 0),
+                    "drafted_tokens": spec.get("drafted_tokens", 0),
                 },
             }

@@ -212,6 +212,11 @@ def run_open_loop(
             for fn in set(watchdog_before) | set(watchdog_after)
         },
         "compiled_signatures_total": sum(watchdog_after.values()),
+        # speculative-decoding acceptance over the run (all-zeros when the
+        # engine runs with FLAGS_spec_decode off) — goodput and acceptance
+        # rate belong in the same record: speculation only helps goodput
+        # when the workload actually accepts drafts
+        "spec_decode": frontend.engine.spec_decode_stats(),
     }
 
 

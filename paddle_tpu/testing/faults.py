@@ -85,6 +85,14 @@ KNOWN_SITES = (
     "router.dispatch",
     "router.health_probe",
     "replica.kill",
+    # speculative-decoding seam (inference/engine.py::_commit_speculation):
+    # fires per drafted slot per step, between the dispatch that scored the
+    # draft and the host-side accept/rewind bookkeeping. A trigger degrades
+    # that slot to plain decode for the step — accept nothing, keep row 0's
+    # argmax (independent of the draft), rewind the drafted rows — so no
+    # tokens are lost and no refcount/reservation accounting drifts; pinned
+    # by tests/test_spec_decode.py and zero-cost-when-empty like the rest.
+    "spec.verify",
 )
 
 
