@@ -399,6 +399,7 @@ def _main_timed(platform, paddle, cfg, batch, seq, steps, warmup) -> None:
         _bench_int8_decode(paddle, platform),
         _bench_paged_decode(paddle, platform),
         _bench_engine_decode(paddle, platform),
+        _bench_tp_decode(paddle, platform),
         _bench_shared_prefix_ttft(paddle, platform),
         _bench_spec_decode(paddle, platform),
         _bench_engine_fault_recovery(paddle, platform),
@@ -723,6 +724,7 @@ def _bench_engine_decode(paddle, platform: str) -> dict:
             "requests": n_req,
             "generated_tokens": toks,
             "max_slots": slots,
+            "tp_degree": engine.tp_degree,
             "attention_path": "pallas" if use_pallas else "xla_gather",
             # the watchdog's numbers, not the engine's ad-hoc counter
             "compiled_signatures": sum(wd.values()),
@@ -739,6 +741,121 @@ def _bench_engine_decode(paddle, platform: str) -> dict:
         return {"metric": "engine_decode_tokens_per_sec", "error": f"{exc!r}"[:300]}
     finally:
         paddle.set_flags(prior_flags)
+
+
+def _bench_tp_decode(paddle, platform: str) -> dict:
+    """Tensor-parallel decode throughput (guarded): the same mixed-length
+    request stream through a single-chip engine and a ``tp``-sharded engine
+    over the device mesh (``distributed/tp.py`` — head-parallel attention +
+    per-device KV pool partition, Megatron MLP splits, vocab-sharded
+    lm-head). Skips cleanly with fewer than 2 devices. Records per-chip and
+    aggregate decode tokens/s, the estimated all-reduce time share (from
+    scaling efficiency: ``1 - t1 / (tp * t_tp)`` — the gap between the
+    observed sharded step and perfect linear scaling, which on this
+    model is the per-layer all-reduce plus the lm-head combine), the
+    byte-identity of the sharded outputs, and the 1-compile-per-engine
+    honesty field."""
+    import jax as _jax
+
+    from paddle_tpu import observability as obs
+    from paddle_tpu.inference import ContinuousBatchingEngine
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    metric = "tp_decode_tokens_per_sec"
+    ndev = len(_jax.devices())
+    if ndev < 2:
+        return {"metric": metric, "skipped": f"needs >= 2 devices, have {ndev}"}
+    try:
+        if platform == "tpu":
+            cfg = LlamaConfig(
+                vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+                num_hidden_layers=8, num_attention_heads=16,
+                num_key_value_heads=16, max_position_embeddings=1024,
+            )
+            slots, bs, bucket, n_req, max_new = 8, 16, 128, 24, 64
+        else:
+            cfg = LlamaConfig.tiny()
+            slots, bs, bucket, n_req, max_new = 2, 4, 16, 4, 6
+        # largest power-of-two shard count the KV heads and mesh support
+        tp = 1
+        while (
+            tp * 2 <= min(8, ndev)
+            and cfg.num_key_value_heads % (tp * 2) == 0
+        ):
+            tp *= 2
+        if tp < 2:
+            return {
+                "metric": metric,
+                "skipped": f"kv heads {cfg.num_key_value_heads} not shardable "
+                           f"over {ndev} devices",
+            }
+        obs.GLOBAL_WATCHDOG.reset()
+
+        def build(tp_degree: int):
+            paddle.seed(0)
+            model = LlamaForCausalLM(cfg)
+            if platform == "tpu":
+                model = model.to(dtype="bfloat16")
+            model.eval()
+            return ContinuousBatchingEngine(
+                model, max_slots=slots, block_size=bs, prompt_bucket=bucket,
+                tp=tp_degree,
+            )
+
+        def run(engine) -> tuple:
+            rng = np.random.default_rng(6)
+
+            def submit(n: int) -> list:
+                rids = []
+                for _ in range(n):
+                    plen = int(rng.integers(max(bucket // 4, 1), bucket + 1))
+                    rids.append(engine.add_request(
+                        rng.integers(0, cfg.vocab_size, (plen,)).astype(np.int32),
+                        max_new_tokens=int(rng.integers(max_new // 2, max_new + 1)),
+                    ))
+                return rids
+            submit(2)
+            engine.run()  # warmup: compiles the one step signature
+            rids = submit(n_req)
+            t0 = time.perf_counter()
+            out = engine.run()
+            dt = time.perf_counter() - t0
+            toks = sum(len(r.generated) for r in out.values())
+            streams = [out[r].tokens().tolist() for r in rids]
+            return toks / dt, streams, engine.stats["step_traces"]
+
+        tput1, streams1, compiles1 = run(build(1))
+        tput_tp, streams_tp, compiles_tp = run(build(tp))
+        # the watchdog ledger cross-checks the per-engine counters: exactly
+        # one recorded step compile per engine, and none from anywhere else
+        wd_steps = sum(
+            rec["count"]
+            for fn, rec in obs.GLOBAL_WATCHDOG.report().items()
+            if fn.startswith("ContinuousBatchingEngine.")
+        )
+        speedup = tput_tp / tput1 if tput1 else 0.0
+        # comm share estimate: the shortfall vs perfect linear scaling of
+        # the (compute-bound) sharded step — t1/t_tp == tput_tp/tput1, so
+        # 1 - t1/(tp*t_tp) == 1 - tput_tp/(tp*tput1); 0 at perfect scaling
+        share = max(0.0, min(1.0, 1.0 - tput_tp / (tp * tput1))) if tput1 else 0.0
+        return {
+            "metric": metric,
+            "value": round(tput_tp, 2),
+            "unit": "tokens/s",
+            "tp_degree": tp,
+            "per_chip_tokens_per_sec": round(tput_tp / tp, 2),
+            "tp1_tokens_per_sec": round(tput1, 2),
+            "speedup_vs_tp1": round(speedup, 4),
+            "all_reduce_time_share_est": round(share, 4),
+            "byte_identical_vs_tp1": streams_tp == streams1,
+            # honesty: each engine compiled its unified step exactly once,
+            # and the watchdog ledger agrees (catches stray compiles too)
+            "compiles_tp1_engine": compiles1,
+            "compiles_tp_engine": compiles_tp,
+            "watchdog_step_compiles": wd_steps,
+        }
+    except Exception as exc:  # noqa: BLE001 - secondary must never kill primary
+        return {"metric": metric, "error": f"{exc!r}"[:300]}
 
 
 def _bench_shared_prefix_ttft(paddle, platform: str) -> dict:

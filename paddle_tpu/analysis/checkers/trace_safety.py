@@ -18,6 +18,12 @@ Codes:
          function's parameter (forces device sync / breaks the trace)
 - TS106  ``global`` declaration inside a traced function (trace-time global
          mutation)
+
+Functions handed to ``shard_map(...)`` / ``pjit(...)`` are traced bodies
+too (the tensor-parallel engine's per-shard collective seams): a flag read,
+metrics call or print inside one fires per compile of the PARTITIONED
+program — same recorded-at-trace-time bug class, now multiplied across the
+mesh — so the same codes cover them.
 """
 
 from __future__ import annotations
@@ -36,7 +42,14 @@ from paddle_tpu.analysis.checkers._shared import (
 )
 from paddle_tpu.analysis.core import Checker, FileContext, Violation
 
-_JIT_CHAINS = {"jax.jit", "to_static", "jit.to_static", "paddle_tpu.jit.to_static"}
+_JIT_CHAINS = {
+    "jax.jit", "to_static", "jit.to_static", "paddle_tpu.jit.to_static",
+    # partitioned-program entry points: the callable handed to shard_map /
+    # pjit is a traced body executed once per compile of the SPMD program
+    # (all spellings — the repo itself prefers the modern jax.shard_map)
+    "shard_map", "jax.shard_map", "jax.experimental.shard_map.shard_map",
+    "pjit", "jax.pjit", "jax.experimental.pjit.pjit",
+}
 _SYNC_ATTRS = {"item", "numpy", "tolist"}
 
 

@@ -308,8 +308,76 @@ def all_gather(tensor_list: Optional[List[Any]], tensor: Any, group: Optional[Gr
     )
 
 
-def all_gather_object(object_list: List[Any], obj: Any, group: Optional[Group] = None) -> None:
-    object_list.append(obj)
+# per-process call counter for all_gather_object: the collective contract
+# (every process calls in the same order) makes matching counters a unique
+# per-call key namespace in the shared coordination store
+_ago_calls = [0]
+
+
+@_instrumented
+def all_gather_object(
+    object_list: List[Any],
+    obj: Any,
+    group: Optional[Group] = None,
+    timeout_s: float = 120.0,
+) -> None:
+    """Gather one picklable object from every PROCESS into ``object_list``
+    (reference ``communication/all_gather.py:all_gather_object``), process-
+    rank order. Single-process: appends ``obj`` (the in-process SPMD view —
+    every "rank" already holds the global value).
+
+    Multi-process: the exchange runs over the **jax.distributed coordination
+    service** (the TCPStore analog ``init_parallel_env`` wired up), NOT an
+    XLA computation — so it works on every backend, including CPU where
+    cross-process XLA collectives are unavailable. Each process publishes
+    its pickled payload under a per-call key and blocking-reads every peer's;
+    the collective contract (all processes call in the same order) makes the
+    per-process call counter a consistent key namespace. Only ``group=None``
+    is supported here: a :class:`Group`'s ranks are DEVICE/axis ids, not
+    process ids, and silently reading one namespace as the other would hang
+    the gather — so it raises instead."""
+    if jax.process_count() <= 1:
+        object_list.append(obj)
+        return
+    if group is not None:
+        raise NotImplementedError(
+            "all_gather_object gathers one object per PROCESS; Group ranks "
+            "are device/axis ids, so subgroup gathers are not supported in "
+            "multi-process mode — call with group=None (all processes)"
+        )
+    import base64
+    import pickle
+
+    from jax._src import distributed as _jdist
+
+    client = _jdist.global_state.client
+    if client is None:  # pragma: no cover - initialize() always sets it
+        raise RuntimeError(
+            "all_gather_object needs jax.distributed initialized "
+            "(init_parallel_env) in multi-process mode"
+        )
+    rank = jax.process_index()
+    members = tuple(range(jax.process_count()))
+    n = _ago_calls[0]
+    _ago_calls[0] += 1
+    prefix = f"paddle_tpu/all_gather_object/{n}"
+    payload = base64.b64encode(pickle.dumps(obj)).decode("ascii")
+    client.key_value_set(f"{prefix}/{rank}", payload)
+    timeout_ms = max(int(timeout_s * 1000.0), 1)
+    try:
+        for r in members:
+            raw = client.blocking_key_value_get(f"{prefix}/{r}", timeout_ms)
+            object_list.append(pickle.loads(base64.b64decode(raw)))
+        # every member has read every key past this barrier, so deleting our
+        # payload below cannot strand a healthy peer's read
+        client.wait_at_barrier(f"{prefix}/done", timeout_ms, list(members))
+    finally:
+        # success or not, this process's payload must not outlive the call —
+        # a long-lived process gathering periodically (and a gather aborted
+        # by a dead peer) must not grow the coordinator's store unboundedly;
+        # on the failure path every member is timing out on the same missing
+        # key, so the collective is already failing collectively
+        client.key_value_delete(f"{prefix}/{rank}")
 
 
 @_instrumented

@@ -1,0 +1,180 @@
+"""Tensor-parallel serving seam: the ``tp`` mesh the continuous-batching
+engine shards itself over.
+
+Reference: the fork's layer-7 distributed stack (``fleet``, ``auto_parallel``,
+``ProcessGroup``) — here shaped for single-controller SPMD serving. One
+engine = one shard group over a single-axis ``['tp']`` mesh:
+
+- **Attention heads and the KV block pool partition per device.** The paged
+  caches keep their ``[num_blocks, kv_heads, block_size, head_dim]`` layout
+  and shard the HEAD dim, so a logical block id indexes the same slot in
+  every shard's pool partition — the host-side allocator, block tables,
+  prefix-cache chain hashes and refcounts stay replicated-by-construction
+  (one copy on the host steering all shards), and head-parallel attention
+  needs no communication inside the paged block walk.
+- **MLP and projections split Megatron-style** (column-parallel
+  qkv/gate/up, row-parallel o/down): GSPMD inserts exactly one all-reduce
+  per layer at the row-parallel matmul.
+- **The lm-head shards over vocab**; the greedy path's ``argmax`` over the
+  vocab-sharded logits lowers to a sharded argmax + global max-combine
+  (exact index tiebreak), preserving byte-identical outputs.
+
+The engine stays ONE compiled signature under the mesh: sharding is carried
+by the INPUT placements (committed params/caches), never by the program's
+shapes, so the recompile watchdog still reports exactly one compile.
+
+``tp_shard_context`` is a trace-time seam: the engine arms it around its
+jitted dispatch so the paged-attention functional (which has no mesh
+argument) can wrap the Pallas kernel in ``shard_map`` over the head shard —
+a ``pallas_call`` has no SPMD partitioning rule, so without the wrapper
+GSPMD would replicate the kernel; the XLA fallback path partitions under
+plain GSPMD and needs no context.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Iterator, List, Optional, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = [
+    "COLUMN_PARALLEL_LEAVES",
+    "ROW_PARALLEL_LEAVES",
+    "TP_AXIS",
+    "VOCAB_PARALLEL_EMBEDDINGS",
+    "build_tp_mesh",
+    "current_tp_mesh",
+    "kv_cache_sharding",
+    "replicated",
+    "shard_model_params",
+    "tp_param_spec",
+    "tp_shard_context",
+    "validate_tp",
+]
+
+TP_AXIS = "tp"
+
+# THE Megatron leaf-name classification — the one placement table both the
+# serving policy below and the training policy (models/llama.llama_shard_fn,
+# mp axis) consume, so a new projection name (a fused qkv, an MoE expert
+# linear) added here shards under both.
+# Column-parallel leaves: weight [in, out] shards the OUT dim (their packed
+# outputs are the per-head / per-neuron slices the next layer consumes
+# shard-local); row-parallel leaves shard the IN dim — the one all-reduce
+# per layer lands after their matmul. lm_head [hidden, vocab] shards vocab.
+COLUMN_PARALLEL_LEAVES = (
+    "q_proj", "k_proj", "v_proj", "gate_proj", "up_proj", "lm_head",
+)
+ROW_PARALLEL_LEAVES = ("o_proj", "down_proj")
+# vocab-parallel embedding: weight [vocab, hidden] shards dim 0 — also the
+# tied-embedding lm-head layout (matmul(x, W^T) contracts hidden, vocab
+# stays sharded into the argmax)
+VOCAB_PARALLEL_EMBEDDINGS = ("embed_tokens", "word_embeddings", "wte")
+
+
+def build_tp_mesh(tp: int) -> Mesh:
+    """Single-axis ``['tp']`` mesh over the first ``tp`` visible devices (on
+    TPU, jax's default device order follows the physical ICI torus)."""
+    devices = jax.devices()
+    if tp > len(devices):
+        raise ValueError(
+            f"tp={tp} exceeds the {len(devices)} visible devices"
+        )
+    import numpy as np
+
+    return Mesh(np.asarray(devices[:tp], dtype=object), (TP_AXIS,))
+
+
+def validate_tp(tp: int, num_heads: int, num_kv_heads: int) -> None:
+    """The head-parallel contract: ``tp`` must divide the KV heads (each
+    shard owns whole KV heads of the pool partition) and the query heads
+    (GQA groups follow their KV head onto the same shard)."""
+    if tp < 1:
+        raise ValueError(f"tp must be >= 1, got {tp}")
+    if num_kv_heads % tp != 0:
+        raise ValueError(
+            f"tp={tp} does not divide num_key_value_heads={num_kv_heads}: "
+            "head-parallel attention shards whole KV heads"
+        )
+    if num_heads % tp != 0:
+        raise ValueError(
+            f"tp={tp} does not divide num_attention_heads={num_heads}"
+        )
+
+
+def tp_param_spec(name: str, ndim: int) -> PartitionSpec:
+    """Megatron placement for one named parameter on the ``['tp']`` mesh,
+    by leaf-name convention (``...self_attn.q_proj.weight``). A model may
+    override per-name decisions by defining ``tp_param_spec(name, ndim)``
+    (see :func:`shard_model_params`). Unknown leaves replicate — always
+    correct, GSPMD just keeps them whole on every shard."""
+    parts = name.split(".")
+    leaf = parts[-1]
+    owner = parts[-2] if len(parts) >= 2 else ""
+    if leaf == "weight" and ndim == 2:
+        if owner in COLUMN_PARALLEL_LEAVES:
+            return PartitionSpec(None, TP_AXIS)
+        if owner in ROW_PARALLEL_LEAVES:
+            return PartitionSpec(TP_AXIS, None)
+        if owner in VOCAB_PARALLEL_EMBEDDINGS:
+            return PartitionSpec(TP_AXIS, None)
+    return PartitionSpec(*([None] * ndim))
+
+
+def replicated(mesh: Mesh, ndim: int) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(*([None] * ndim)))
+
+
+def kv_cache_sharding(mesh: Mesh) -> NamedSharding:
+    """The pool partition: ``[num_blocks, kv_heads, block_size, head_dim]``
+    sharded on the HEAD dim — every shard holds the same logical blocks
+    (same ids, same offsets) for its own slice of the heads."""
+    return NamedSharding(mesh, PartitionSpec(None, TP_AXIS, None, None))
+
+
+def shard_model_params(model: Any, mesh: Mesh) -> int:
+    """Commit every named parameter onto the mesh per the Megatron policy
+    (model-provided ``tp_param_spec(name, ndim)`` wins when defined);
+    returns how many params got a genuinely split placement. In-place:
+    serving owns the model — the engine is the unit of deployment."""
+    policy = getattr(model, "tp_param_spec", None) or tp_param_spec
+    n_split = 0
+    for name, p in model.named_parameters():
+        spec = policy(name, p._data.ndim)
+        if any(ax is not None for ax in spec):
+            n_split += 1
+        p._data = jax.device_put(p._data, NamedSharding(mesh, spec))
+    return n_split
+
+
+# -- trace-time context ------------------------------------------------------
+# threading.local, not a contextvar: the serving pump drives each engine from
+# its own thread, and the armed mesh must be visible exactly to the trace
+# running on that thread.
+class _TpState(threading.local):
+    mesh: Optional[Mesh] = None
+
+
+_STATE = _TpState()
+
+
+def current_tp_mesh() -> Optional[Mesh]:
+    """The mesh armed by the innermost :func:`tp_shard_context` on this
+    thread (None = single-chip semantics). Read at TRACE time by the paged-
+    attention functional to decide the shard_map wrapping."""
+    return _STATE.mesh
+
+
+@contextlib.contextmanager
+def tp_shard_context(mesh: Optional[Mesh]) -> Iterator[None]:
+    """Arm ``mesh`` as the tensor-parallel shard group for traces started
+    under this context (re-entrant; restores the previous value)."""
+    prev = _STATE.mesh
+    _STATE.mesh = mesh
+    try:
+        yield
+    finally:
+        _STATE.mesh = prev

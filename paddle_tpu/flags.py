@@ -161,6 +161,10 @@ def _define_builtin_flags() -> None:
     d("spec_decode", bool, False, "Self-speculative decoding on the continuous-batching engine: an n-gram prompt-lookup drafter proposes draft tokens per decode slot; drafts ride the SAME [max_slots, prefill_chunk] compiled step as prompt chunks (verification is data — zero new compiled signatures), accepted tokens commit in bulk, the first rejection rewinds the slot's block table. Greedy outputs are byte-identical on or off.")
     d("spec_decode_ngram", int, 3, "Longest n-gram of the request's prompt+generated history the speculative drafter matches (walks down to 1); read at engine construction.")
     d("spec_decode_tokens", int, 4, "Max draft tokens proposed per slot per step, capped at prefill_chunk - 1 so the draft plus the mandatory last-token row fit the engine's compiled chunk width.")
+    # tensor-parallel serving (distributed/tp.py): shard the engine's one
+    # compiled step over a ['tp'] device mesh; read at engine construction
+    # (per-engine override via the tp kwarg)
+    d("engine_tp_degree", int, 1, "Tensor-parallel degree of the continuous-batching engine: attention heads and the paged KV block pool partition per device along a single-axis ['tp'] mesh, MLP splits Megatron-style (one all-reduce per layer), the lm-head shards over vocab. 1 = single-chip engine (byte-identical to the unsharded path). Must divide the model's KV heads; needs that many visible devices.")
 
 
 _define_builtin_flags()

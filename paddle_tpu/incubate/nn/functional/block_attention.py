@@ -19,6 +19,7 @@ the reference where block tables are produced by the serving scheduler.
 
 from __future__ import annotations
 
+import sys
 import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -27,6 +28,61 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.testing.faults import fault_point as _fault_point
+
+
+def _current_tp_mesh() -> Optional[Any]:
+    """The tensor-parallel shard group armed by the serving engine's
+    dispatch (``distributed/tp.py``), read at TRACE time. Checked through
+    ``sys.modules`` so the single-chip path never imports the distributed
+    package: if no engine ever armed a tp mesh, the module is absent and
+    this is one dict lookup."""
+    mod = sys.modules.get("paddle_tpu.distributed.tp")
+    return mod.current_tp_mesh() if mod is not None else None
+
+
+def _tp_sharded_flash_chunk(
+    q: jax.Array,
+    key_cache: jax.Array,
+    value_cache: jax.Array,
+    block_tables: jax.Array,
+    seq_lens: jax.Array,
+    q_lens: jax.Array,
+    scale: float,
+    mesh: Any,
+    interpret: bool = False,
+) -> jax.Array:
+    """Run the mixed ragged Pallas kernel PER SHARD over the head partition:
+    a ``pallas_call`` has no SPMD partitioning rule, so under a tp mesh the
+    kernel must be shard_mapped — each shard walks its own head slice of its
+    own pool partition (head-parallel attention needs no communication
+    inside the paged block walk; tables/lens are replicated host data).
+    ``interpret`` runs the per-shard kernel in Pallas interpret mode so the
+    shard split itself is testable off-TPU."""
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.distributed.fleet.meta_parallel.spmd_pipeline import shard_map
+    from paddle_tpu.kernels.paged_attention import paged_flash_chunk
+
+    def _shard_chunk_attend(q_l, kc_l, vc_l, tables_l, lens_l, qlens_l):
+        return paged_flash_chunk(
+            q_l, kc_l, vc_l, tables_l, lens_l, qlens_l, scale=scale,
+            interpret=interpret,
+        )
+
+    return shard_map(
+        _shard_chunk_attend,
+        mesh=mesh,
+        in_specs=(
+            P(None, None, "tp", None),  # q [B, C, HQ, D]: heads split
+            P(None, "tp", None, None),  # key_cache [NB, KVH, BS, D]
+            P(None, "tp", None, None),  # value_cache
+            P(None, None),  # block_tables: replicated host truth
+            P(None),  # seq_lens
+            P(None),  # q_lens
+        ),
+        out_specs=P(None, None, "tp", None),
+        check_vma=False,
+    )(q, key_cache, value_cache, block_tables, seq_lens, q_lens)
 
 __all__ = [
     "BlockKVCache",
@@ -438,21 +494,32 @@ def block_multihead_chunk_attention(
         # ragged mixed prefill/decode kernel: one grid walks each sequence's
         # physical blocks once, serving its decode row and its prompt-chunk
         # rows alike; applicability is probed host-side at trace time (a
-        # Mosaic error inside the jitted step is uncatchable at run time)
+        # Mosaic error inside the jitted step is uncatchable at run time).
+        # Under a tensor-parallel mesh the kernel runs shard_mapped over the
+        # head partition, so the probe uses the PER-SHARD geometry.
         from paddle_tpu.kernels.paged_attention import (
             chunk_lowering_supported,
             paged_flash_chunk,
         )
 
         nb, hkv_c, bs, d_c = key_cache.shape
+        tp_mesh = _current_tp_mesh()
+        ntp = tp_mesh.shape["tp"] if tp_mesh is not None else 1
         if chunk_lowering_supported(
-            b, c, hq, hkv_c, d_c, nb, bs, block_tables.shape[1], str(q.dtype)
+            b, c, hq // ntp, hkv_c // ntp, d_c, nb, bs,
+            block_tables.shape[1], str(q.dtype),
         ):
             try:
-                out = paged_flash_chunk(
-                    q, key_cache, value_cache, block_tables,
-                    seq_lens, attend_q, scale=scale,
-                )
+                if tp_mesh is not None:
+                    out = _tp_sharded_flash_chunk(
+                        q, key_cache, value_cache, block_tables,
+                        seq_lens, attend_q, scale, tp_mesh,
+                    )
+                else:
+                    out = paged_flash_chunk(
+                        q, key_cache, value_cache, block_tables,
+                        seq_lens, attend_q, scale=scale,
+                    )
                 return out, key_cache, value_cache
             except Exception as exc:  # noqa: BLE001 - XLA fallback below
                 warn_fallback("paged_flash_chunk", exc)

@@ -60,6 +60,20 @@ The block allocator is host-side Python (it runs between steps, not inside
 the program); admission reserves a request's worst-case PRIVATE block need
 up front so a mid-flight step can never hit pool exhaustion.
 
+**Tensor parallelism**: with ``tp > 1`` (``FLAGS_engine_tp_degree`` or the
+``tp=`` kwarg) the engine shards itself over a single-axis ``['tp']`` device
+mesh (``distributed/tp.py``): attention heads and the paged KV pool
+partition per device along the HEAD dim (one logical block id maps to the
+same slot in every shard's pool partition), projections/MLP split
+Megatron-style with one all-reduce per layer, and the lm-head shards over
+vocab (sharded argmax — byte-identical greedy outputs). Sharding is carried
+entirely by INPUT placements (committed params and caches), so the step
+still compiles exactly ONCE; the scheduler, block tables, prefix-cache
+chain hashes and refcounts are host-side state and stay
+replicated-by-construction — the prefix cache and speculative decoding
+ride along unchanged. ``tp=1`` (the default) takes the exact single-chip
+path.
+
 Fault tolerance: because every request's prompt and generated tokens live on
 the host (``InferenceRequest``), a dispatch failure that consumed the
 donated KV buffers is recoverable — ``step()`` retries with backoff through
@@ -71,6 +85,7 @@ exhausted retries mark the engine permanently failed.
 
 from __future__ import annotations
 
+import contextlib
 import itertools
 import time
 from collections import deque
@@ -341,6 +356,7 @@ class ContinuousBatchingEngine:
         prefill_chunk: Optional[int] = None,
         enable_prefix_cache: Optional[bool] = None,
         spec_decode: Optional[bool] = None,
+        tp: Optional[int] = None,
     ) -> None:
         from paddle_tpu.incubate.nn.functional import BlockKVCache
 
@@ -373,9 +389,46 @@ class ContinuousBatchingEngine:
         self._num_layers = cfg.num_hidden_layers
         dtype = next(iter(model.parameters())).dtype
         # cache geometry, kept so recover() can rebuild identical buffers
-        # (identical shapes/dtypes -> the compiled program is reused)
+        # (identical shapes/dtypes/shardings -> the compiled program is reused)
         self._kvh, self._hd, self._cache_dtype = kvh, hd, dtype
         self._cache_shape = (self.num_blocks, kvh, self.block_size, hd)
+        # tensor parallelism: commit params + caches onto a ['tp'] mesh; the
+        # sharding lives in input PLACEMENTS, never in shapes, so the one
+        # compiled signature (and every host-side invariant) is unchanged
+        self.tp = int(GLOBAL_FLAGS.get("engine_tp_degree") if tp is None else tp)
+        if self.tp < 1:
+            raise ValueError(f"engine tp degree must be >= 1, got {self.tp}")
+        if self.tp > 1:
+            from paddle_tpu.distributed.tp import (
+                build_tp_mesh,
+                kv_cache_sharding,
+                shard_model_params,
+                tp_shard_context,
+                validate_tp,
+            )
+
+            validate_tp(self.tp, cfg.num_attention_heads, kvh)
+            self._tp_mesh = build_tp_mesh(self.tp)
+            self._cache_sharding = kv_cache_sharding(self._tp_mesh)
+            # sharded zeros created directly on-device, each device only its
+            # own shard: the full pool never exists anywhere (not host RAM,
+            # not chip 0) — num_blocks is sized to the AGGREGATE HBM, and
+            # recover() reallocates through this too. One tiny compiled
+            # zeros program reused for every layer's k and v.
+            self._shard_zeros = jax.jit(
+                lambda: jnp.zeros(self._cache_shape, self._cache_dtype),
+                out_shardings=self._cache_sharding,
+            )
+            self._tp_ctx = tp_shard_context
+            # serving owns the model: params are committed onto the shard
+            # group in place (Megatron column/row splits, vocab-parallel
+            # embedding + lm-head)
+            self._tp_split_params = shard_model_params(model, self._tp_mesh)
+        else:
+            self._tp_mesh = None
+            self._cache_sharding = None
+            self._tp_ctx = None
+            self._tp_split_params = 0
         # host-side refcounted block pool; the device pool lives below
         self._mgr = BlockKVCache(
             self.num_blocks, self.block_size, kvh, hd,
@@ -406,10 +459,7 @@ class ContinuousBatchingEngine:
         # ONE global paged pool shared by every layer's sequences would alias
         # writes across layers — each layer owns its [NB, KVH, BS, D] pair,
         # all indexed by the SAME block tables (the reference layout).
-        self._caches = [
-            (jnp.zeros(self._cache_shape, dtype), jnp.zeros(self._cache_shape, dtype))
-            for _ in range(self._num_layers)
-        ]
+        self._caches = [self._new_cache_pair() for _ in range(self._num_layers)]
 
         # per-slot host state (rewritten freely between steps — it is DATA to
         # the compiled step, never part of its shape)
@@ -459,9 +509,78 @@ class ContinuousBatchingEngine:
         # attributes each engine instance's initial trace as first_call
         self._step_recorded = False
         donate = jax.default_backend() != "cpu"  # donation warns (no-op) on cpu
-        self._step_fn = jax.jit(
-            self._step_impl, donate_argnums=(1,) if donate else ()
+        if self._tp_mesh is not None:
+            # pin the OUTPUT shardings: without this the returned caches
+            # carry GSPMD-inferred sharding objects that hash differently
+            # from the device_put-committed inputs, and the second step
+            # would compile a second executable for the same trace — the
+            # silent 2x-compile the 1-compile invariant exists to catch.
+            # argmax output replicated (it is host-synced every step);
+            # caches come back on exactly the pool partition they went in.
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            repl = NamedSharding(self._tp_mesh, PartitionSpec())
+            cs = self._cache_sharding
+            self._step_fn = jax.jit(
+                self._step_impl,
+                donate_argnums=(1,) if donate else (),
+                out_shardings=(repl, [(cs, cs)] * self._num_layers),
+            )
+        else:
+            self._step_fn = jax.jit(
+                self._step_impl, donate_argnums=(1,) if donate else ()
+            )
+
+    def _new_cache_pair(self) -> Tuple[Any, Any]:
+        """One layer's (key, value) pool pair. Under a tp mesh the pair is
+        committed head-sharded (``[NB, KVH/tp, BS, D]`` per shard) — the
+        pool PARTITION: every shard holds the same logical block ids for
+        its own head slice, so the host-side allocator needs no per-shard
+        state. Same shapes/dtypes/shardings on every call, so recover()'s
+        rebuilt pools reuse the compiled program."""
+        if self._cache_sharding is not None:
+            return self._shard_zeros(), self._shard_zeros()
+        kc = jnp.zeros(self._cache_shape, self._cache_dtype)
+        vc = jnp.zeros(self._cache_shape, self._cache_dtype)
+        return kc, vc
+
+    @property
+    def tp_degree(self) -> int:
+        """Tensor-parallel degree (1 = single-chip engine)."""
+        return self.tp
+
+    def tp_stats(self) -> Dict[str, Any]:
+        """Shard-group view for health/observability: the mesh devices and
+        the per-shard slice of the KV pool. Per-shard accounting is
+        BALANCED by construction — every shard holds the same logical
+        blocks over its equal head slice — and this reports the device
+        truth so a test (or a probe) can hold the claim to the buffers."""
+        if self._tp_mesh is None:
+            return {"tp_degree": 1}
+        kc = self._caches[0][0]
+        if getattr(kc, "is_deleted", lambda: False)():
+            # a donating backend's failed dispatch consumed the pools; until
+            # recover() rebuilds them (or forever, once permanently broken)
+            # there is no device truth — /healthz must report, never raise
+            return {
+                "tp_degree": self.tp,
+                "devices": [d.id for d in self._tp_mesh.devices.flat],
+                "split_params": self._tp_split_params,
+                "per_shard_cache_shape": [],
+                "balanced": None,
+                "buffers": "lost",
+            }
+        shards = sorted(
+            (s.device.id, list(s.data.shape)) for s in kc.addressable_shards
         )
+        per_shard = [shape for _, shape in shards]
+        return {
+            "tp_degree": self.tp,
+            "devices": [d.id for d in self._tp_mesh.devices.flat],
+            "split_params": self._tp_split_params,
+            "per_shard_cache_shape": per_shard[0] if per_shard else [],
+            "balanced": all(s == per_shard[0] for s in per_shard),
+        }
 
     def _new_prefix_cache(self) -> Optional[PrefixCache]:
         if not self._use_prefix_cache:
@@ -1073,12 +1192,22 @@ class ContinuousBatchingEngine:
             tables = self._dense_tables()
             fault_point("engine.decode")
             traces_before = self.stats["step_traces"]
-            nxt, self._caches = self._step_fn(
-                self._param_arrays(), self._caches, jnp.asarray(toks),
-                jnp.asarray(tables), jnp.asarray(self._ntok.copy()),
-                jnp.asarray(q_lens), jnp.asarray(active),
-                jnp.asarray(cow_src), jnp.asarray(cow_dst),
+            # arm the tp shard group for the (first-call / recovery) trace:
+            # the paged-attention functional reads it at TRACE time to wrap
+            # the Pallas kernel in shard_map over the head shard; executions
+            # of the already-compiled program never re-enter Python
+            tp_ctx = (
+                self._tp_ctx(self._tp_mesh)
+                if self._tp_mesh is not None
+                else contextlib.nullcontext()
             )
+            with tp_ctx:
+                nxt, self._caches = self._step_fn(
+                    self._param_arrays(), self._caches, jnp.asarray(toks),
+                    jnp.asarray(tables), jnp.asarray(self._ntok.copy()),
+                    jnp.asarray(q_lens), jnp.asarray(active),
+                    jnp.asarray(cow_src), jnp.asarray(cow_dst),
+                )
         except BaseException:
             # roll the per-step allocations back so a transient failure
             # leaves the allocator in lockstep with _ntok (retried steps
@@ -1094,7 +1223,8 @@ class ContinuousBatchingEngine:
             # the watchdog ledger must only count compiles that exist
             GLOBAL_WATCHDOG.record_compile(
                 "ContinuousBatchingEngine.step",
-                signature=f"toks[{self.max_slots},{self.prefill_chunk}]",
+                signature=f"toks[{self.max_slots},{self.prefill_chunk}]"
+                + (f"|tp{self.tp}" if self.tp > 1 else ""),
                 cause=CAUSE_FIRST_CALL
                 if not self._step_recorded
                 else CAUSE_NEW_SHAPE_DTYPE,
@@ -1437,13 +1567,9 @@ class ContinuousBatchingEngine:
             "recovery", live=len(live), queued=len(self._waiting),
             recoveries=self.stats["recoveries"] + 1,
         )
-        self._caches = [
-            (
-                jnp.zeros(self._cache_shape, self._cache_dtype),
-                jnp.zeros(self._cache_shape, self._cache_dtype),
-            )
-            for _ in range(self._num_layers)
-        ]
+        # identical shapes/dtypes/shardings (tp pools come back committed on
+        # the same mesh partition) -> the compiled program is reused
+        self._caches = [self._new_cache_pair() for _ in range(self._num_layers)]
         self._mgr = BlockKVCache(
             self.num_blocks, self.block_size, self._kvh, self._hd,
             self.max_blocks_per_seq, dtype=self._cache_dtype,

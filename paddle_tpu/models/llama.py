@@ -411,6 +411,13 @@ def llama_shard_fn(name: str, sublayer: Any, mesh: Any) -> None:
     from paddle_tpu.distributed.api import apply_placement, build_placements
     from paddle_tpu.distributed.placements import Replicate
 
+    # the one Megatron leaf-name table, shared with the serving-TP policy
+    # (distributed/tp.py tp_param_spec) so the two can never drift
+    from paddle_tpu.distributed.tp import (
+        COLUMN_PARALLEL_LEAVES,
+        ROW_PARALLEL_LEAVES,
+    )
+
     def put(param: Any, placements: List[Any]) -> None:
         apply_placement(param, mesh, placements)
 
@@ -425,12 +432,10 @@ def llama_shard_fn(name: str, sublayer: Any, mesh: Any) -> None:
         # vocab-parallel embedding: shard vocab dim on mp; fsdp shards hidden
         put(sublayer.weight, plc(mp=0, sharding=1))
     elif isinstance(sublayer, nn.Linear):
-        if leaf in ("q_proj", "k_proj", "v_proj", "gate_proj", "up_proj"):
+        if leaf in COLUMN_PARALLEL_LEAVES:  # incl. lm_head: [H, V] shards V
             put(sublayer.weight, plc(mp=1, sharding=0))  # column parallel
-        elif leaf in ("o_proj", "down_proj"):
+        elif leaf in ROW_PARALLEL_LEAVES:
             put(sublayer.weight, plc(mp=0, sharding=1))  # row parallel
-        elif leaf == "lm_head":
-            put(sublayer.weight, plc(mp=1, sharding=0))
         else:
             put(sublayer.weight, plc(sharding=0))
         if getattr(sublayer, "bias", None) is not None:

@@ -62,7 +62,14 @@ class Replica:
     """One named serving replica: a frontend plus router-side health state.
 
     All mutable fields are owned by the router and mutated only under the
-    router's lock; the frontend beneath does its own locking."""
+    router's lock; the frontend beneath does its own locking.
+
+    Under tensor parallelism the replica IS the shard group: its engine owns
+    a whole ``['tp']`` mesh, so the health unit, the kill/revive unit and the
+    failover unit are all ``tp_degree`` chips at once — replica death takes
+    the mesh out of rotation in one routing event, and ``revive`` rebuilds
+    the sharded pools through the factory (``distributed/launch`` + elastic
+    own the real process lifecycle in a multi-host deployment)."""
 
     def __init__(self, name: str, frontend: ServingFrontend) -> None:
         self.name = str(name)
@@ -88,6 +95,11 @@ class Replica:
     @property
     def alive(self) -> bool:
         return self.state != REPLICA_DEAD
+
+    @property
+    def tp_degree(self) -> int:
+        """Chips in this replica's shard group (1 = single-chip engine)."""
+        return getattr(self.frontend.engine, "tp_degree", 1)
 
     def kill(self, why: str = "replica killed") -> None:
         """Model a whole-replica death: the engine is permanently failed and

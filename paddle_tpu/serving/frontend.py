@@ -699,7 +699,12 @@ class ServingFrontend:
         liveness facts (engine ``broken`` flag, pump-thread liveness, the
         failure reason) plus the load signals the router's spill decision
         reads. ``pump_alive`` is None when no pump thread was ever started
-        (inline drivers), so a router never mistakes inline mode for death."""
+        (inline drivers), so a router never mistakes inline mode for death.
+
+        Under tensor parallelism the replica's health unit IS the shard
+        group: one engine = one ``['tp']`` mesh, so a dead replica takes its
+        whole shard group out of rotation at once — ``tp_degree`` rides
+        along so the router's capacity view can weight replicas by chips."""
         with self._lock:
             t = self._thread
             stats = self.engine.pool_stats()
@@ -716,6 +721,7 @@ class ServingFrontend:
                 "kv_utilization": round(
                     live / stats["total"] if stats["total"] else 0.0, 4
                 ),
+                "tp_degree": getattr(self.engine, "tp_degree", 1),
             }
 
     def snapshot(self) -> Dict[str, Any]:
@@ -747,4 +753,10 @@ class ServingFrontend:
                     "accepted_tokens": spec.get("accepted_tokens", 0),
                     "drafted_tokens": spec.get("drafted_tokens", 0),
                 },
+                # the shard-group identity: one engine = one ['tp'] mesh
+                "tensor_parallel": (
+                    self.engine.tp_stats()
+                    if hasattr(self.engine, "tp_stats")
+                    else {"tp_degree": 1}
+                ),
             }

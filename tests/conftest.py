@@ -51,3 +51,34 @@ def _seed():
     import paddle_tpu.distributed.mesh as _mesh
 
     _mesh._global_mesh = None
+
+
+def assert_engine_pool_exact(eng):
+    """The engine pool-accounting churn invariant, shared by every engine
+    suite (engine / spec-decode / prefix-cache / tp): refcount truth —
+    every refcounted block's owner count equals its live mappings (slot
+    tables + pending CoW pins) plus cache chain ownership — exact
+    allocated+free accounting, no live table referencing a freed block,
+    and the cached chain aligned as a prefix of each slot's block table."""
+    s = eng.pool_stats()
+    assert s["allocated"] + s["free"] == s["total"], s
+    expect = {}
+    for slot, req in enumerate(eng._slot_req):
+        if req is not None:
+            for b in eng._blocks[slot]:
+                expect[b] = expect.get(b, 0) + 1
+    for pending in eng._pending_cow:
+        if pending is not None:
+            expect[pending[0].block] = expect.get(pending[0].block, 0) + 1
+    if eng._cache is not None:
+        for node in eng._cache._nodes.values():
+            expect[node.block] = expect.get(node.block, 0) + 1
+    assert eng._mgr.refcounts() == expect
+    free = set(eng._mgr._free)
+    for slot, req in enumerate(eng._slot_req):
+        if req is not None:
+            assert not (set(eng._blocks[slot]) & free), (
+                f"slot {slot} references freed blocks"
+            )
+            for i, node in enumerate(eng._nodes[slot]):
+                assert eng._blocks[slot][i] == node.block

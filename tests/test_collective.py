@@ -239,3 +239,18 @@ class TestInPlaceSemantics:
         out = _run(fn, X64)
         # each rank receives its piece of rank 0's local buffer X64[:N]
         np.testing.assert_allclose(out, X64[:N])
+
+
+class TestAllGatherObject:
+    def test_single_process_appends(self):
+        """Single-process SPMD: every 'rank' already holds the global value,
+        so the gather is the one local object (the historical contract).
+        The multi-process path exchanges through the jax.distributed
+        coordination store and is exercised end-to-end by
+        test_launch.py::TestTwoNodeHandshake."""
+        got = []
+        C.all_gather_object(got, {"rank": 0})
+        assert got == [{"rank": 0}]
+        # repeated calls append independently (no shared state between calls)
+        C.all_gather_object(got, 7)
+        assert got == [{"rank": 0}, 7]

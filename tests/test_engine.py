@@ -24,28 +24,7 @@ def _model(seed=0):
     return m, cfg
 
 
-def _assert_pool_exact(eng):
-    s = eng.pool_stats()
-    assert s["allocated"] + s["free"] == s["total"], s
-    # refcount truth: every refcounted block's owner count equals its live
-    # mappings (slot tables + pending CoW pins) plus cache chain ownership
-    expect = {}
-    for slot, req in enumerate(eng._slot_req):
-        if req is not None:
-            for b in eng._blocks[slot]:
-                expect[b] = expect.get(b, 0) + 1
-    for pending in eng._pending_cow:
-        if pending is not None:
-            expect[pending[0].block] = expect.get(pending[0].block, 0) + 1
-    if eng._cache is not None:
-        for node in eng._cache._nodes.values():
-            expect[node.block] = expect.get(node.block, 0) + 1
-    assert eng._mgr.refcounts() == expect
-    # no live request's table references a freed block
-    free = set(eng._mgr._free)
-    for slot, req in enumerate(eng._slot_req):
-        if req is not None:
-            assert not (set(eng._blocks[slot]) & free)
+from conftest import assert_engine_pool_exact as _assert_pool_exact
 
 
 def _assert_drained(eng):

@@ -36,34 +36,9 @@ def _model(seed=0):
     return m, cfg
 
 
-def _assert_invariants(eng):
-    """The churn contract: refcount truth, exact accounting, no dangling
-    table entries."""
-    s = eng.pool_stats()
-    assert s["allocated"] + s["free"] == s["total"], s
-    expect = {}
-    for slot, req in enumerate(eng._slot_req):
-        if req is not None:
-            for b in eng._blocks[slot]:
-                expect[b] = expect.get(b, 0) + 1
-    for pending in eng._pending_cow:
-        if pending is not None:
-            expect[pending[0].block] = expect.get(pending[0].block, 0) + 1
-    if eng._cache is not None:
-        for node in eng._cache._nodes.values():
-            expect[node.block] = expect.get(node.block, 0) + 1
-    assert eng._mgr.refcounts() == expect
-    free = set(eng._mgr._free)
-    for slot, req in enumerate(eng._slot_req):
-        if req is not None:
-            assert not (set(eng._blocks[slot]) & free), (
-                f"slot {slot} references freed blocks"
-            )
-    # node/table alignment: the cached chain is a prefix of the block table
-    for slot, req in enumerate(eng._slot_req):
-        if req is not None:
-            for i, node in enumerate(eng._nodes[slot]):
-                assert eng._blocks[slot][i] == node.block
+# the churn contract: refcount truth, exact accounting, no dangling table
+# entries, node/table alignment — the shared engine invariant
+from conftest import assert_engine_pool_exact as _assert_invariants
 
 
 class TestChurnProperty:

@@ -28,29 +28,15 @@ def _model(seed=0):
     return m, cfg
 
 
+from conftest import assert_engine_pool_exact
+
+
 def _assert_pool_exact(eng):
-    """Pool refcount truth (the churn invariant): every refcounted block's
-    owner count equals its live mappings (slot tables + pending CoW pins)
-    plus cache chain ownership — across speculative rewinds too."""
-    s = eng.pool_stats()
-    assert s["allocated"] + s["free"] == s["total"], s
-    expect = {}
+    """The shared churn invariant, plus the speculation-specific bound:
+    a rewound table is never shorter than the committed tokens."""
+    assert_engine_pool_exact(eng)
     for slot, req in enumerate(eng._slot_req):
         if req is not None:
-            for b in eng._blocks[slot]:
-                expect[b] = expect.get(b, 0) + 1
-    for pending in eng._pending_cow:
-        if pending is not None:
-            expect[pending[0].block] = expect.get(pending[0].block, 0) + 1
-    if eng._cache is not None:
-        for node in eng._cache._nodes.values():
-            expect[node.block] = expect.get(node.block, 0) + 1
-    assert eng._mgr.refcounts() == expect
-    free = set(eng._mgr._free)
-    for slot, req in enumerate(eng._slot_req):
-        if req is not None:
-            assert not (set(eng._blocks[slot]) & free)
-            # a rewound table is never shorter than the committed tokens
             assert len(eng._blocks[slot]) * eng.block_size >= eng._ntok[slot]
 
 

@@ -67,6 +67,62 @@ def test_ts101_method_passed_to_jax_jit_via_self():
     )
 
 
+def test_ts_shard_map_body_is_traced():
+    """The tensor-parallel collective seam: a function handed to shard_map
+    (the per-shard kernel wrapper in the engine step path) is a traced body
+    — flag reads / metrics / prints inside it fire per compile of the
+    partitioned program, multiplied across the mesh."""
+    assert "TS104" in codes(
+        "from jax.experimental.shard_map import shard_map\n"
+        "from paddle_tpu.observability import GLOBAL_METRICS\n"
+        "def local_step(x):\n"
+        "    GLOBAL_METRICS.counter('c').inc()\n"
+        "    return x\n"
+        "f = shard_map(local_step, mesh, in_specs=(), out_specs=())\n"
+    )
+    assert "TS101" in codes(
+        "import jax\n"
+        "def local_step(x):\n"
+        "    print(x)\n"
+        "    return x\n"
+        "f = jax.experimental.shard_map.shard_map(local_step, mesh,\n"
+        "                                         in_specs=(), out_specs=())\n"
+    )
+    # the modern spelling the repo itself prefers (conftest installs it)
+    assert "TS101" in codes(
+        "import jax\n"
+        "def local_step(x):\n"
+        "    print(x)\n"
+        "    return x\n"
+        "f = jax.shard_map(local_step, mesh=None, in_specs=(), out_specs=())\n"
+    )
+
+
+def test_ts_shard_map_negative_clean_body():
+    # a clean per-shard body (the block_attention wrapper's shape) is fine,
+    # and host code AROUND the shard_map call may do host things
+    assert codes(
+        "from jax.experimental.shard_map import shard_map\n"
+        "def local_step(x):\n"
+        "    return x * 2\n"
+        "def dispatch(mesh, x):\n"
+        "    print('host side is fine')\n"
+        "    return shard_map(local_step, mesh, in_specs=(), out_specs=())(x)\n"
+    ) == []
+
+
+def test_ts_pjit_body_is_traced():
+    assert "TS103" in codes(
+        "import os\n"
+        "from jax.experimental.pjit import pjit\n"
+        "def step(x):\n"
+        "    if os.environ.get('DEBUG'):\n"
+        "        return x\n"
+        "    return x + 1\n"
+        "f = pjit(step)\n"
+    )
+
+
 def test_ts102_time_call():
     src = (
         "import time\n"
