@@ -401,6 +401,7 @@ def _main_timed(platform, paddle, cfg, batch, seq, steps, warmup) -> None:
         _bench_engine_decode(paddle, platform),
         _bench_tp_decode(paddle, platform),
         _bench_shared_prefix_ttft(paddle, platform),
+        _bench_kv_tier_multi_turn(paddle, platform),
         _bench_spec_decode(paddle, platform),
         _bench_engine_fault_recovery(paddle, platform),
         _bench_serving_goodput(paddle, platform),
@@ -980,6 +981,148 @@ def _bench_shared_prefix_ttft(paddle, platform: str) -> dict:
         }
     except Exception as exc:  # noqa: BLE001 - secondary must never kill primary
         return {"metric": "shared_prefix_ttft_speedup", "error": f"{exc!r}"[:300]}
+    finally:
+        paddle.set_flags(prior)
+
+
+def _bench_kv_tier_multi_turn(paddle, platform: str) -> dict:
+    """Hierarchical-KV acceptance bench (guarded): warm TTFT of a seeded
+    multi-turn conversation trace against a DELIBERATELY small device pool
+    — the regime the host tier exists for: the conversations' chains do not
+    fit HBM, so between turns they get evicted, and turn k+1 either
+    recomputes its whole history (tier off) or prefetches it H2D from host
+    RAM (tier on). Reports warm-TTFT p50/p99, prefix hit rate and
+    spill/prefetch/drop counters across a host-cache-size sweep
+    (``FLAGS_kv_host_tier_bytes`` 0 = off, then small, then ample), plus
+    the 1-compile honesty check: spill and prefetch are pure data movement
+    outside the traced step, so the recompile watchdog must still report
+    exactly ONE compile per engine at every sweep point."""
+    from paddle_tpu import observability as obs
+    from paddle_tpu.inference import ContinuousBatchingEngine
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    prior = paddle.get_flags(["FLAGS_enable_metrics"])
+    try:
+        if platform == "tpu":
+            cfg = LlamaConfig(
+                vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+                num_hidden_layers=8, num_attention_heads=16,
+                num_key_value_heads=16, max_position_embeddings=2048,
+            )
+            slots, bs, num_blocks, bucket, max_len = 4, 16, 96, 1024, 1536
+            n_convs, n_turns, turn_tail, max_new = 6, 4, 48, 32
+        else:
+            cfg = LlamaConfig.tiny()
+            slots, bs, num_blocks, bucket, max_len = 2, 4, 12, 40, 56
+            n_convs, n_turns, turn_tail, max_new = 3, 3, 4, 3
+        paddle.set_flags({"FLAGS_enable_metrics": False})
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg)
+        if platform == "tpu":
+            model = model.to(dtype="bfloat16")
+        model.eval()
+        bytes_per_block = (
+            2 * cfg.num_hidden_layers * cfg.num_key_value_heads
+            * (cfg.hidden_size // cfg.num_attention_heads) * bs
+            * (2 if platform == "tpu" else 4)
+        )
+        # the whole trace's chain working set, in blocks — "small" holds
+        # about a third of it, "ample" all of it
+        worst_blocks = n_convs * (
+            (n_turns * (turn_tail + max_new)) // bs + 1
+        )
+        sweep_budgets = [0, (worst_blocks // 3) * bytes_per_block,
+                         worst_blocks * bytes_per_block]
+
+        def drive(tier_bytes):
+            obs.GLOBAL_WATCHDOG.reset()
+            engine = ContinuousBatchingEngine(
+                model, max_slots=slots, block_size=bs, num_blocks=num_blocks,
+                prompt_bucket=bucket, max_model_len=max_len,
+                kv_host_tier_bytes=tier_bytes,
+            )
+            rng = np.random.default_rng(11)
+            streams = {}
+            warm_ttfts = []
+            # warmup: the engine's one compile, off the clock
+            engine.add_request(
+                rng.integers(0, cfg.vocab_size, (4,)).astype(np.int32),
+                max_new_tokens=2,
+            )
+            engine.run()
+            # the seeded trace: conversations interleave round-robin, so a
+            # conversation's chains face the other conversations' pool
+            # pressure between its own turns
+            for turn in range(n_turns):
+                for conv in range(n_convs):
+                    tail = rng.integers(
+                        0, cfg.vocab_size, (turn_tail,)
+                    ).astype(np.int32)
+                    prev = streams.get(conv)
+                    prompt = (
+                        tail if prev is None
+                        else np.concatenate([prev, tail])
+                    )
+                    cap = min(bucket, max_len - max_new - bs)
+                    if prompt.size > cap:
+                        prompt = prompt[-cap:]
+                    rid = engine.add_request(prompt, max_new_tokens=max_new)
+                    out = engine.run()
+                    streams[conv] = out[rid].tokens()
+                    if turn > 0:
+                        warm_ttfts.append(
+                            out[rid].admit_time - out[rid].arrival_time
+                        )
+            warm_ttfts.sort()
+            cache = engine.prefix_cache_stats()
+            tier = engine.kv_tier_stats()
+            wd = {
+                fn: rec["count"]
+                for fn, rec in obs.GLOBAL_WATCHDOG.report().items()
+                if fn.startswith("ContinuousBatchingEngine.")
+            }
+
+            def pct(q):
+                if not warm_ttfts:
+                    return 0.0
+                i = min(len(warm_ttfts) - 1, int(q * len(warm_ttfts)))
+                return warm_ttfts[i]
+
+            lookups = cache["hits"] + cache["misses"]
+            return {
+                "kv_host_tier_bytes": int(tier_bytes),
+                "warm_ttft_ms": {"p50": round(pct(0.5) * 1e3, 3),
+                                 "p99": round(pct(0.99) * 1e3, 3)},
+                "hit_rate": round(cache["hit_rate"], 4),
+                "host_hit_rate": round(
+                    cache["host_hits"] / lookups if lookups else 0.0, 4
+                ),
+                "tokens_reused": cache["tokens_reused"],
+                "spilled_blocks": tier.get("spilled_blocks", 0),
+                "prefetched_blocks": tier.get("prefetched_blocks", 0),
+                "dropped_blocks": tier.get("dropped_blocks", 0),
+                "host_bytes_peak": tier.get("host_bytes", 0),
+                "compiled_signatures": sum(wd.values()),
+            }
+
+        sweep = [drive(b) for b in sweep_budgets]
+        off_p50 = sweep[0]["warm_ttft_ms"]["p50"]
+        best_on = min(pt["warm_ttft_ms"]["p50"] for pt in sweep[1:])
+        return {
+            "metric": "kv_tier_multi_turn_ttft",
+            "value": round(off_p50 / max(best_on, 1e-9), 3),
+            "unit": "x (tier-off warm TTFT p50 / best tier-on p50)",
+            "device_pool_blocks": num_blocks,
+            "trace": {"conversations": n_convs, "turns": n_turns,
+                      "turn_tail_tokens": turn_tail, "max_new": max_new},
+            "sweep": sweep,
+            # honesty: data movement added zero compiled signatures anywhere
+            "compiled_signatures_per_engine": max(
+                pt["compiled_signatures"] for pt in sweep
+            ),
+        }
+    except Exception as exc:  # noqa: BLE001 - secondary must never kill primary
+        return {"metric": "kv_tier_multi_turn_ttft", "error": f"{exc!r}"[:300]}
     finally:
         paddle.set_flags(prior)
 

@@ -73,8 +73,25 @@ class ElasticManager:
         # can never poison the new one
         self._gen = self._read_gen()
 
+    def _key_absent(self, key: str) -> bool:
+        """Non-blocking absence probe for SCAN paths. ``store.get`` has
+        rendezvous semantics — a missing key blocks the full store timeout
+        waiting to appear — so a liveness scan over per-rank keys would
+        stall ``timeout x dead_ranks`` per sweep (minutes with production
+        timeouts) if it went through ``get``. Stores without ``check``
+        (non-TCPStore duck types) fall back to the blocking read."""
+        check = getattr(self._store, "check", None)
+        if check is None:
+            return False
+        try:
+            return not check(key)
+        except Exception:  # probe failure: fall through to the blocking read
+            return False
+
     def _read_gen(self) -> int:
         try:
+            if self._key_absent("elastic/generation"):
+                return 0
             return int(self._store.get("elastic/generation").decode())
         except Exception:  # no generation published yet (fresh store) / store down
             return 0
@@ -137,6 +154,8 @@ class ElasticManager:
 
     def _faulted(self, r: int) -> bool:
         try:
+            if self._key_absent(self._fault_key(r)):
+                return False
             return bool(self._store.get(self._fault_key(r)))
         except Exception:  # missing key / store error both mean "no fault mark"
             return False
@@ -147,6 +166,8 @@ class ElasticManager:
         alive = []
         for r in range(self.max_np):
             try:
+                if self._key_absent(self._beat_key(r)):
+                    continue  # never registered (or prior topology): not alive
                 raw = self._store.get(self._beat_key(r))
                 if now - float(raw.decode()) > self.ttl:
                     continue
@@ -210,6 +231,9 @@ class ElasticManager:
     def load_topology(store: Any) -> Optional[Dict[str, Any]]:
         """Worker side after relaunch: read the published membership."""
         try:
+            check = getattr(store, "check", None)
+            if check is not None and not check("elastic/generation"):
+                return None  # not published: answer now, don't rendezvous
             gen = int(store.get("elastic/generation").decode())
             world = [int(r) for r in store.get("elastic/world").decode().split(",") if r]
         except Exception:  # topology not published (yet): caller falls back to static launch

@@ -155,6 +155,10 @@ def _define_builtin_flags() -> None:
     # block dedup with copy-on-write over the paged pool; read at engine
     # construction (per-engine override via the enable_prefix_cache kwarg)
     d("enable_prefix_cache", bool, True, "Reference-counted content-hash KV block dedup for the continuous-batching engine: shared prompt prefixes are computed once and mapped copy-on-write into every request that repeats them; off = every prompt recomputes from token zero.")
+    # hierarchical KV tier (inference/kv_tier.py): host-RAM spill tier under
+    # the prefix cache; read at engine construction (per-engine override via
+    # the kv_host_tier_bytes kwarg)
+    d("kv_host_tier_bytes", int, 0, "Byte budget of the host-RAM KV spill tier under the prefix cache: LRU-evicted zero-reference chain blocks spill D2H into a bounded host pool instead of dying, and a prefix match against a spilled chain prefetches its blocks H2D asynchronously, overlapped with the chunked prefill of the uncached suffix. 0 (default) disables the tier — evicted chains are simply dropped, today's behavior. Greedy outputs are byte-identical with the tier on or off.")
     # speculative decoding (inference/spec_decode.py): n-gram self-speculation
     # riding the engine's one compiled mixed ragged step; read at engine
     # construction (per-engine override via the spec_decode kwarg)

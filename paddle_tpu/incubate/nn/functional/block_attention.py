@@ -250,6 +250,25 @@ class BlockKVCache:
             self._ref[blk] = 1
             return blk
 
+    def acquire_blocks(self, n: int) -> List[int]:
+        """Atomically take ``n`` physical blocks off the free list, each
+        with refcount 1 — the landing-slot reservation for a host-tier
+        prefetch: either every block of the spilled chain gets a slot in
+        one step or none does (no partial chain to unwind). Raises
+        MemoryError with the free list untouched on a shortfall."""
+        _fault_point("block_pool.allocate")
+        n = int(n)
+        with self._lock:
+            if len(self._free) < n:
+                raise MemoryError(
+                    f"paged KV cache cannot reserve {n} blocks "
+                    f"({len(self._free)} free)"
+                )
+            out = [self._free.pop() for _ in range(n)]
+            for blk in out:
+                self._ref[blk] = 1
+            return out
+
     def incref(self, block: int) -> int:
         """Add one owner to a refcounted block; returns the new count."""
         with self._lock:

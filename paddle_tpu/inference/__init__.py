@@ -36,6 +36,7 @@ __all__ = [
     "ContinuousBatchingEngine",
     "FIFOAdmission",
     "InferenceRequest",
+    "HostKVTier",
     "NGramDrafter",
     "PrefixCache",
     "IntakeError",
@@ -46,6 +47,7 @@ __all__ = [
     "RequestUnservableError",
 ]
 
+from paddle_tpu.inference.kv_tier import HostKVTier  # noqa: E402
 from paddle_tpu.inference.prefix_cache import PrefixCache  # noqa: E402
 from paddle_tpu.inference.spec_decode import NGramDrafter  # noqa: E402
 from paddle_tpu.inference.engine import (  # noqa: E402

@@ -38,6 +38,20 @@ the request's committed GENERATED tokens are registered into the cache too
 release), so a multi-turn conversation's second turn maps its first turn's
 KV instead of recomputing it.
 
+**Hierarchical KV**: with ``FLAGS_kv_host_tier_bytes`` > 0, a bounded
+host-RAM tier (``inference/kv_tier.py``) sits under the prefix cache:
+LRU-evicted zero-ref chain blocks are captured D2H and spilled instead of
+dropped, the match walk continues across the tier boundary (including the
+divergent block's partial, via prefetch-on-write), and matched spilled
+chains prefetch H2D asynchronously into atomically reserved pool slots —
+overlapped with the mixed ragged step through a per-slot gate: a gated
+slot contributes no rows until its copies land (``is_ready`` polling at
+chunk boundaries), so other slots' chunks hide the transfer. Spill and
+prefetch are pure data movement outside the traced step (ONE compiled
+signature holds), greedy outputs are byte-identical with the tier on or
+off, and ``recover()`` drops the in-flight prefetch set while the tier
+itself survives as part of the host truth replay rebuilds from.
+
 **Speculative decoding**: with ``FLAGS_spec_decode`` (default off), a
 host-side n-gram / prompt-lookup drafter (``inference/spec_decode.py``)
 proposes up to K draft tokens per decode slot; the slot's step row becomes a
@@ -96,6 +110,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.flags import GLOBAL_FLAGS
+from paddle_tpu.inference.kv_tier import HostKVTier, HostNode
 from paddle_tpu.inference.prefix_cache import ChainNode, PrefixCache, chain_digest
 from paddle_tpu.inference.spec_decode import NGramDrafter, count_accepted
 from paddle_tpu.observability import flight_recorder as _flight
@@ -237,6 +252,28 @@ def _engine_metrics() -> Dict[str, Any]:
     }
 
 
+def _prefetch_fold(kc, vc, dst, hk, hv):
+    """One prefetched block's H2D landing: write host-tier KV planes into
+    pool slot ``dst`` of one layer's (key, value) pair. Jitted per engine
+    with the committed pool sharding pinned as ``out_shardings`` under tp —
+    ONE tiny compiled signature regardless of how many blocks land, and the
+    dispatch is asynchronous: the host returns immediately and the copy
+    overlaps with other slots' compute already in the device queue. Every
+    later step consumes the returned arrays, so a chunk can never read a
+    block the copy has not reached — the scheduler's prefetch gate is an
+    overlap optimization on top of that ordering, not the correctness.
+
+    The third output is the gate MARKER: a scalar dependent on the updated
+    cache, so its readiness implies this program (and by stream order every
+    earlier fold) has executed. The gate must poll this and never a cache
+    array itself — the caches are donated to the next step (or next fold)
+    on TPU, and polling a consumed buffer raises; the scalar is retained
+    only by the gate, so nothing can ever donate it away."""
+    kc = kc.at[dst].set(hk.astype(kc.dtype))
+    vc = vc.at[dst].set(hv.astype(vc.dtype))
+    return kc, vc, kc[dst, 0, 0, 0]
+
+
 class InferenceRequest:
     """One queued generation request and, after finishing, its result.
 
@@ -357,6 +394,7 @@ class ContinuousBatchingEngine:
         enable_prefix_cache: Optional[bool] = None,
         spec_decode: Optional[bool] = None,
         tp: Optional[int] = None,
+        kv_host_tier_bytes: Optional[int] = None,
     ) -> None:
         from paddle_tpu.incubate.nn.functional import BlockKVCache
 
@@ -438,6 +476,53 @@ class ContinuousBatchingEngine:
             GLOBAL_FLAGS.get("enable_prefix_cache")
             if enable_prefix_cache is None
             else enable_prefix_cache
+        )
+        # hierarchical KV: a bounded host-RAM tier under the prefix cache —
+        # evicted chains spill D2H instead of dying, matches against spilled
+        # chains prefetch H2D overlapped into chunked prefill. 0 = off =
+        # pre-tier behavior; the tier rides the prefix cache, so it is inert
+        # when the cache is disabled. The tier object SURVIVES recover()
+        # (host RAM is not lost with the device pools — it is the host
+        # truth recovery rebuilds from).
+        tier_bytes = int(
+            GLOBAL_FLAGS.get("kv_host_tier_bytes")
+            if kv_host_tier_bytes is None
+            else kv_host_tier_bytes
+        )
+        self._host_tier: Optional[HostKVTier] = None
+        if tier_bytes > 0 and self._use_prefix_cache:
+            self._host_tier = HostKVTier(
+                tier_bytes, self._bytes_per_token() * self.block_size
+            )
+            # the H2D landing copy: one compiled signature per engine
+            # (scalar dst + one block's [KVH, BS, D] planes), kept OFF the
+            # step's watchdog ledger — prefetch is data movement, not a new
+            # step signature. Donation matters on TPU (the pool must not
+            # transiently double); on CPU it is a warning no-op, so skip.
+            fold_kw: Dict[str, Any] = {}
+            if self._cache_sharding is not None:
+                # preserve the committed pool partition: a GSPMD-inferred
+                # output sharding would differ from the committed inputs and
+                # silently compile a SECOND step executable. The scalar gate
+                # marker is replicated (it is host-polled every boundary).
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                fold_kw["out_shardings"] = (
+                    self._cache_sharding, self._cache_sharding,
+                    NamedSharding(self._tp_mesh, PartitionSpec()),
+                )
+            self._fold_fn = jax.jit(
+                _prefetch_fold,
+                donate_argnums=(0, 1) if jax.default_backend() != "cpu" else (),
+                **fold_kw,
+            )
+        # per-slot prefetch gate: (marker_array, n_blocks, tokens) while an
+        # H2D prefetch is in flight — the slot contributes NO rows to the
+        # mixed step until the copies land (correctness is guaranteed by
+        # dataflow either way; the gate is what buys the overlap: other
+        # slots' chunks run while this slot's blocks are still in transit)
+        self._prefetch_wait: List[Optional[Tuple[Any, int, int]]] = (
+            [None] * self.max_slots
         )
         self._cache = self._new_prefix_cache()
         # speculative decoding: drafts ride the step's chunk axis, so the
@@ -582,14 +667,36 @@ class ContinuousBatchingEngine:
             "balanced": all(s == per_shard[0] for s in per_shard),
         }
 
-    def _new_prefix_cache(self) -> Optional[PrefixCache]:
-        if not self._use_prefix_cache:
-            return None
-        bytes_per_token = (
+    def _bytes_per_token(self) -> int:
+        """KV bytes across all layers for one token (sizes the bytes-saved
+        gauge and the host tier's per-block cost)."""
+        return (
             2 * self._num_layers * self._kvh * self._hd
             * jnp.dtype(self._cache_dtype).itemsize
         )
-        return PrefixCache(self._mgr, self.block_size, bytes_per_token)
+
+    def _new_prefix_cache(self) -> Optional[PrefixCache]:
+        if not self._use_prefix_cache:
+            return None
+        return PrefixCache(
+            self._mgr, self.block_size, self._bytes_per_token(),
+            host_tier=self._host_tier,
+            capture_kv=(
+                self._capture_block_kv if self._host_tier is not None else None
+            ),
+        )
+
+    def _capture_block_kv(self, block: int) -> np.ndarray:
+        """D2H capture of one physical block's KV across every layer —
+        ``[layers, 2, KVH, BS, D]`` — for a spill. Synchronous by design:
+        the copy must complete before the block's pool reference drops and
+        the slot can be reallocated and overwritten (the caller holds that
+        ordering). Under tensor parallelism the head shards gather here —
+        the host tier always holds the full-head view."""
+        parts = [
+            jnp.stack((kc[block], vc[block])) for kc, vc in self._caches
+        ]
+        return np.asarray(jnp.stack(parts))
 
     # -- pool accounting -----------------------------------------------------
     def pool_stats(self) -> Dict[str, int]:
@@ -618,6 +725,16 @@ class ContinuousBatchingEngine:
             return {"enabled": False}
         out: Dict[str, Any] = {"enabled": True}
         out.update(self._cache.stats_snapshot())
+        return out
+
+    def kv_tier_stats(self) -> Dict[str, Any]:
+        """Host-tier view for /healthz and bench records (host counters —
+        valid with metrics off; ``{"enabled": False}`` when the tier is
+        off, which is also the ``FLAGS_kv_host_tier_bytes=0`` default)."""
+        if self._host_tier is None:
+            return {"enabled": False}
+        out: Dict[str, Any] = {"enabled": True}
+        out.update(self._host_tier.stats_snapshot())
         return out
 
     def _update_pool_gauges(self) -> None:
@@ -970,7 +1087,6 @@ class ContinuousBatchingEngine:
         cow = result.cow if result is not None else None
         self._nodes[slot] = list(nodes)
         self._blocks[slot] = [n.block for n in nodes]
-        self._matched_blocks[slot] = len(nodes)
         self._no_insert[slot] = False
         self._pending_cow[slot] = None
         if cow is not None:
@@ -982,10 +1098,113 @@ class ContinuousBatchingEngine:
                 src_block=src_node.block, dst_block=dst_block,
                 reused_tokens=partial,
             )
-        self._reserved[slot] = self._blocks_needed(req) - len(nodes)
+        if result is not None and (result.host_nodes or result.host_partial):
+            cached += self._prefetch_spilled(slot, req, result)
+        self._matched_blocks[slot] = len(self._nodes[slot])
+        self._reserved[slot] = self._blocks_needed(req) - len(self._nodes[slot])
         self._ntok[slot] = cached
         req.cached_tokens = cached
         self.stats["prompt_tokens_reused"] += cached
+
+    def _prefetch_spilled(
+        self, slot: int, req: InferenceRequest, result: Any
+    ) -> int:
+        """Land a matched spilled chain back into the pool: reserve slots
+        for every matched host block (full chain nodes + the divergent
+        block's partial source) atomically, issue their asynchronous H2D
+        copies into the per-layer pools, re-register the full blocks as
+        device chain nodes, and gate the slot until the copies land. Returns
+        the prompt tokens this reused (0 on ANY failure — an injected
+        ``kv_tier.prefetch`` fault, allocation shortfall, or a dispatch
+        error all degrade to recomputing the suffix, with the already-mapped
+        device chain untouched and nothing allocated)."""
+        host_nodes: List[HostNode] = list(result.host_nodes)
+        host_partial: Optional[Tuple[HostNode, int]] = result.host_partial
+        n_blocks = len(host_nodes) + (1 if host_partial is not None else 0)
+        blocks: List[int] = []
+        try:
+            try:
+                fault_point("kv_tier.prefetch")
+                blocks = self._cache.alloc_landing_blocks(n_blocks)
+                copies = list(host_nodes)
+                if host_partial is not None:
+                    copies.append(host_partial[0])
+                marker = None
+                for hn, blk in zip(copies, blocks):
+                    dst = jnp.asarray(np.int32(blk))
+                    for li in range(self._num_layers):
+                        kc, vc = self._caches[li]
+                        kc, vc, marker = self._fold_fn(
+                            kc, vc, dst,
+                            jnp.asarray(hn.kv[li, 0]), jnp.asarray(hn.kv[li, 1]),
+                        )
+                        self._caches[li] = (kc, vc)
+            except Exception as exc:  # noqa: BLE001 - degrade to recompute
+                for blk in blocks:  # reserved but never mapped: hand back
+                    self._mgr.decref(blk)
+                _flight.record_event(
+                    "kv_prefetch_failed", req_id=req.req_id, slot=slot,
+                    blocks=n_blocks,
+                    error=f"{type(exc).__name__}: {exc}"[:120],
+                )
+                return 0
+        finally:
+            # pins exist only to bridge match -> copy-issue: once the copies
+            # are in the dispatch queue (jax holds its own reference to the
+            # host planes) or the prefetch is abandoned, the LRU may move
+            self._cache.release_host_pins(result)
+        # commit phase (cannot fail): map the landed blocks into the slot's
+        # table and re-register the full blocks as device chain nodes so
+        # later admissions share them without another prefetch. A key that
+        # re-registered concurrently keeps our copy private (same layout as
+        # the in-flight insert race).
+        tokens = 0
+        parent = self._nodes[slot][-1] if self._nodes[slot] else None
+        registering = True
+        for i, hn in enumerate(host_nodes):
+            blk = blocks[i]
+            self._blocks[slot].append(blk)
+            tokens += self.block_size
+            if registering:
+                node = self._cache.insert(parent, hn.tokens(), blk)
+                if node is None:
+                    registering = False
+                else:
+                    self._nodes[slot].append(node)
+                    parent = node
+        if host_partial is not None:
+            # the divergent block's leading run, prefetched instead of
+            # copy-on-write forked: the whole block landed, the request
+            # overwrites it from the divergence point on — private forever
+            # (its eventual content differs from the spilled source)
+            self._blocks[slot].append(blocks[-1])
+            tokens += host_partial[1]
+        self._host_tier.mark_prefetched(n_blocks)
+        self._cache.record_host_reuse(tokens)
+        self._prefetch_wait[slot] = (marker, n_blocks, tokens)
+        _flight.record_event(
+            "kv_prefetch", req_id=req.req_id, slot=slot, blocks=n_blocks,
+            tokens=tokens,
+        )
+        return tokens
+
+    def _poll_prefetch_gates(self, wait: bool = False) -> None:
+        """Clear the prefetch gate of every slot whose H2D copies have
+        landed (``wait=True`` blocks on them — the escape hatch when gated
+        slots are the only work, so the engine can never stall on its own
+        gate)."""
+        for i in range(self.max_slots):
+            pending = self._prefetch_wait[i]
+            if pending is None:
+                continue
+            marker = pending[0]
+            if wait:
+                jax.block_until_ready(marker)
+                ready = True
+            else:
+                ready = bool(getattr(marker, "is_ready", lambda: True)())
+            if ready:
+                self._prefetch_wait[i] = None
 
     def _admit(self, req: InferenceRequest, slot: int) -> None:
         # the prefill fault site moved host-side with chunked prefill: it
@@ -1026,10 +1245,15 @@ class ContinuousBatchingEngine:
                     self._blocks[slot].pop()
             if self._nodes[slot]:
                 self._cache.release(self._nodes[slot])
+        # prefetched blocks that stayed private (insert race / the partial
+        # arm) sit past the node prefix: hand them back too
+        for blk in self._blocks[slot][len(self._nodes[slot]):]:
+            self._mgr.decref(blk)
         self._nodes[slot] = []
         self._blocks[slot] = []
         self._matched_blocks[slot] = 0
         self._pending_cow[slot] = None
+        self._prefetch_wait[slot] = None
         self._reserved[slot] = 0
         self._ntok[slot] = 0
 
@@ -1056,6 +1280,11 @@ class ContinuousBatchingEngine:
         self._blocks[slot] = []
         self._matched_blocks[slot] = 0
         self._no_insert[slot] = False
+        # a gate left by a released/cancelled slot is dropped, not waited
+        # on: the in-flight copies still execute in dispatch order, and any
+        # reuse of their target blocks happens in LATER dispatches that
+        # consume the folded arrays — ordering keeps them safe
+        self._prefetch_wait[slot] = None
         self._reserved[slot] = 0
         self._slot_req[slot] = None
         self._ntok[slot] = 0
@@ -1451,9 +1680,25 @@ class ContinuousBatchingEngine:
                 self._release(i, req)
                 self._pending_done.append(req)
         self._admit_waiting(self._pending_done)
-        active_slots = [i for i, r in enumerate(self._slot_req) if r is not None]
+        # prefetch gating: a slot whose host-tier blocks are still in H2D
+        # flight contributes no rows this step — its chunks only ride the
+        # mixed step once the copies have landed, and the copies overlap
+        # with the other slots' compute meanwhile. When gated slots are the
+        # ONLY live work there is nothing to overlap with: wait them out so
+        # the engine can never stall on its own gate.
+        self._poll_prefetch_gates()
+        active_slots = [
+            i for i, r in enumerate(self._slot_req)
+            if r is not None and self._prefetch_wait[i] is None
+        ]
         if not active_slots:
-            return
+            if any(w is not None for w in self._prefetch_wait):
+                self._poll_prefetch_gates(wait=True)
+                active_slots = [
+                    i for i, r in enumerate(self._slot_req) if r is not None
+                ]
+            if not active_slots:
+                return
         C = self.prefill_chunk
         toks = np.zeros((self.max_slots, C), np.int32)
         q_lens = np.zeros((self.max_slots,), np.int32)
@@ -1580,6 +1825,12 @@ class ContinuousBatchingEngine:
             self._nodes[i] = []
             self._no_insert[i] = False
             self._pending_cow[i] = None
+            # drop the in-flight prefetch set: its markers reference the
+            # lost buffers. The HOST TIER ITSELF survives (host RAM was not
+            # consumed) — it is part of the host truth this rebuild draws
+            # from, so replayed prompts matching spilled chains prefetch
+            # them into the fresh pools instead of recomputing.
+            self._prefetch_wait[i] = None
         self._matched_blocks[:] = 0
         self._ntok[:] = 0
         self._last_tok[:] = 0

@@ -1,0 +1,329 @@
+"""Host-RAM KV spill tier under the prefix cache (hierarchical KV).
+
+At millions-of-users scale the shared-prefix working set dwarfs one chip's
+HBM: an LRU-evicted zero-reference chain in ``inference/prefix_cache.py``
+used to simply die, and the next request paying for that prompt recomputed
+it from token zero. This module is the second tier: a bounded host-memory
+block store keyed by the SAME rolling ``(parent_digest, token_bytes)`` chain
+keys the device cache uses, so chain digests span tiers seamlessly.
+
+- **Spill.** When the device cache drops a zero-ref chain node under
+  pressure, the engine captures that block's KV D2H and :meth:`HostKVTier
+  .put`\\ s it here instead of discarding it. The tier has its own LRU over
+  its own byte budget (``FLAGS_kv_host_tier_bytes``; 0 = tier off = the old
+  drop-on-evict behavior). Entries are immutable once stored.
+- **Match.** :meth:`PrefixCache.match`'s rolling-digest walk continues into
+  this tier when the device walk runs out of resident nodes
+  (:meth:`lookup_pin`), and the copy-on-write partial arm consults spilled
+  children too (:meth:`best_partial`) — a prompt whose divergent block's
+  source chain was spilled still reuses every token it can.
+- **Prefetch.** Matched host blocks are copied H2D asynchronously into
+  freshly reserved pool slots by the engine, overlapped with the mixed
+  ragged step computing other slots' work; the scheduler gates the slot
+  until the copies land. A prefetch that faults degrades to recompute with
+  zero correctness impact (the tier entry is untouched).
+- **Drop.** The tier's LRU evicts oldest-first under budget pressure and
+  cascade-drops in-tier descendants of a dropped node (a child whose parent
+  digest left the tier is unreachable by any future walk). Pinned entries
+  (a prefetch in flight between match and copy-issue) are never dropped.
+
+Both-tier residency is legal and common — a prefetched chain lives in HBM
+*and* here — because contents are immutable and content-addressed: the same
+digest always names the same KV bytes (pinned by the churn property test).
+
+Fault sites ``kv_tier.spill`` (top of :meth:`put`) and ``kv_tier.prefetch``
+(the engine's prefetch seam) make both failure paths deterministic: an
+injected spill failure drops the chain (old behavior), an injected prefetch
+failure degrades that request to recompute. Both are zero-cost when no
+fault plan is installed.
+
+Thread safety: one internal lock, ordered strictly BELOW the prefix cache's
+(cache -> tier, never the reverse); the tier never calls back into the
+cache or the pool.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from paddle_tpu.observability import metrics as _obs
+from paddle_tpu.testing.faults import fault_point
+
+__all__ = ["HostKVTier", "HostNode", "leading_run"]
+
+
+def leading_run(cand: np.ndarray, remaining: np.ndarray) -> int:
+    """Length of the leading token run ``remaining`` shares with candidate
+    block ``cand`` — THE partial-match rule, shared by the device cache's
+    copy-on-write arm and the host tier's prefetch-on-write arm so the two
+    tiers can never disagree about how much of a divergent block is
+    reusable."""
+    cand = cand[: remaining.size]
+    neq = np.nonzero(cand != remaining)[0]
+    return int(neq[0]) if neq.size else int(remaining.size)
+
+
+def _tier_metrics() -> Dict[str, Any]:
+    """Get-or-create the host-tier metric families (process-global, like the
+    prefix cache's). Recording is a no-op behind the registry's cached-bool
+    gate when ``FLAGS_enable_metrics`` is off."""
+    reg = _obs.GLOBAL_METRICS
+    return {
+        "spilled": reg.counter(
+            "kv_tier_spilled_blocks_total",
+            "Evicted chain blocks spilled D2H into the host tier instead of "
+            "dropped.",
+        ),
+        "prefetched": reg.counter(
+            "kv_tier_prefetched_blocks_total",
+            "Host-tier blocks prefetched H2D into freshly reserved pool "
+            "slots on a prefix match.",
+        ),
+        "dropped": reg.counter(
+            "kv_tier_dropped_blocks_total",
+            "Host-tier blocks dropped by its LRU (budget pressure, "
+            "unreachable-descendant cascade, or an explicit drop).",
+        ),
+        "host_bytes": reg.gauge(
+            "kv_tier_host_bytes",
+            "Bytes of KV currently resident in the host tier.",
+        ),
+    }
+
+
+class HostNode:
+    """One spilled full block of chain KV, resident in host RAM.
+
+    ``key`` is the SAME ``(parent_digest, token_bytes)`` pair the device
+    cache keys its chain nodes by, and ``digest`` the same rolling hash —
+    a match walk crosses the tier boundary without re-deriving anything.
+    ``kv`` is the captured ``[layers, 2, kv_heads, block_size, head_dim]``
+    host array; it is IMMUTABLE once stored (prefetch H2D reads it, the
+    LRU drops the reference — nothing ever writes it, which is what makes
+    both-tier residency safe). ``pins`` guards the window between a match
+    returning this node and the engine issuing its H2D copy."""
+
+    __slots__ = ("key", "digest", "token_bytes", "kv", "pins")
+
+    def __init__(
+        self,
+        key: Tuple[bytes, bytes],
+        digest: bytes,
+        token_bytes: bytes,
+        kv: np.ndarray,
+    ) -> None:
+        self.key = key
+        self.digest = digest
+        self.token_bytes = token_bytes
+        self.kv = kv
+        self.pins = 0
+
+    def tokens(self) -> np.ndarray:
+        return np.frombuffer(self.token_bytes, np.int32)
+
+
+class HostKVTier:
+    """Bounded host-RAM store of spilled prefix-chain blocks.
+
+    ``budget_bytes`` is the hard cap on resident KV bytes (the flag);
+    ``block_nbytes`` the cost of one block across all layers
+    (``2 * layers * kv_heads * block_size * head_dim * itemsize``)."""
+
+    def __init__(self, budget_bytes: int, block_nbytes: int) -> None:
+        self.budget_bytes = int(budget_bytes)
+        self.block_nbytes = int(block_nbytes)
+        self._lock = threading.Lock()
+        # LRU: oldest first; prefetch hits touch to the MRU end
+        self._entries: "OrderedDict[Tuple[bytes, bytes], HostNode]" = OrderedDict()
+        # parent digest -> child keys, for the partial scan + drop cascade
+        self._children: Dict[bytes, List[Tuple[bytes, bytes]]] = {}
+        self._bytes = 0
+        # host-side counters (always on — introspection must not depend on
+        # the metrics flag); the metric families mirror them when enabled
+        self._spilled = 0
+        self._prefetched = 0
+        self._dropped = 0
+        self._refused = 0
+        self._metrics = _tier_metrics()
+
+    # -- introspection -------------------------------------------------------
+    @property
+    def bytes_used(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def __contains__(self, key: Tuple[bytes, bytes]) -> bool:
+        with self._lock:
+            return key in self._entries
+
+    def stats_snapshot(self) -> Dict[str, Any]:
+        """Cheap health view for /healthz and bench records (counters only —
+        this runs on every serving pump tick)."""
+        with self._lock:
+            return {
+                "budget_bytes": self.budget_bytes,
+                "host_bytes": self._bytes,
+                "blocks": len(self._entries),
+                "spilled_blocks": self._spilled,
+                "prefetched_blocks": self._prefetched,
+                "dropped_blocks": self._dropped,
+                "refused_spills": self._refused,
+            }
+
+    # -- spill ---------------------------------------------------------------
+    def put(
+        self,
+        parent_digest: bytes,
+        digest: bytes,
+        token_bytes: bytes,
+        kv: np.ndarray,
+    ) -> bool:
+        """Store one evicted block's captured KV. Returns False when the
+        block cannot fit (budget smaller than one block, or every resident
+        entry is pinned) — the caller then drops the chain, exactly the
+        pre-tier behavior. The fault site at the top models a failed D2H /
+        allocation; an injected fault propagates to the caller's degrade
+        path (chain dies, nothing half-stored)."""
+        fault_point("kv_tier.spill")
+        kv = np.asarray(kv)
+        with self._lock:
+            key = (parent_digest, token_bytes)
+            node = self._entries.get(key)
+            if node is not None:
+                # same digest == same bytes (content-addressed, deterministic
+                # recompute): the resident copy is already correct — touch it
+                self._entries.move_to_end(key)
+                return True
+            if self.block_nbytes > self.budget_bytes:
+                self._refused += 1
+                return False
+            while self._bytes + self.block_nbytes > self.budget_bytes:
+                if not self._evict_one_locked():
+                    self._refused += 1
+                    return False
+            self._entries[key] = HostNode(key, digest, token_bytes, kv)
+            self._children.setdefault(parent_digest, []).append(key)
+            self._bytes += self.block_nbytes
+            self._spilled += 1
+            self._metrics["spilled"].inc()
+            self._metrics["host_bytes"].set(self._bytes)
+            return True
+
+    # -- match ---------------------------------------------------------------
+    def lookup_pin(
+        self, parent_digest: bytes, token_bytes: bytes
+    ) -> Optional[HostNode]:
+        """One step of the cross-tier chain walk: the spilled child of
+        ``parent_digest`` holding exactly ``token_bytes``, pinned against
+        LRU drop until the engine issues (or abandons) its prefetch."""
+        with self._lock:
+            node = self._entries.get((parent_digest, token_bytes))
+            if node is not None:
+                node.pins += 1
+                self._entries.move_to_end(node.key)
+            return node
+
+    def best_partial(
+        self, parent_digest: bytes, remaining: np.ndarray
+    ) -> Optional[Tuple[HostNode, int]]:
+        """The spilled arm of partial-block suffix reuse: among the tier's
+        children of ``parent_digest``, the one sharing the longest leading
+        token run with ``remaining`` (the prompt's first divergent window).
+        Returns ``(node, matched_tokens)`` with the node pinned, or None.
+        This is what keeps the full-cached-blocks-before-the-divergence +
+        partial-of-the-divergent-block match length intact even when the
+        divergent block's source chain was spilled."""
+        remaining = np.asarray(remaining, np.int32).reshape(-1)
+        if remaining.size < 1:
+            return None
+        with self._lock:
+            best_node: Optional[HostNode] = None
+            best = 0
+            for key in self._children.get(parent_digest, ()):
+                node = self._entries.get(key)
+                if node is None:
+                    continue
+                k = leading_run(node.tokens(), remaining)
+                if k > best:
+                    best, best_node = k, node
+            if best_node is None:
+                return None
+            best_node.pins += 1
+            self._entries.move_to_end(best_node.key)
+            return best_node, best
+
+    def unpin(self, nodes: List[HostNode]) -> None:
+        """Release prefetch pins (issue completed, degraded, or abandoned)."""
+        with self._lock:
+            for node in nodes:
+                if node.pins <= 0:
+                    raise RuntimeError("host-tier pin underflow")
+                node.pins -= 1
+
+    def mark_prefetched(self, n_blocks: int) -> None:
+        """Count ``n_blocks`` H2D prefetch copies issued by the engine."""
+        with self._lock:
+            self._prefetched += int(n_blocks)
+        self._metrics["prefetched"].inc(int(n_blocks))
+
+    # -- drop ----------------------------------------------------------------
+    def drop_lru(self, n: int) -> int:
+        """Explicitly drop up to ``n`` LRU entries (tests / external
+        pressure ops); returns how many left, cascades included."""
+        done = 0
+        with self._lock:
+            for _ in range(int(n)):
+                before = len(self._entries)
+                if not self._evict_one_locked():
+                    break
+                done += before - len(self._entries)
+        return done
+
+    def _evict_one_locked(self) -> bool:
+        """Drop the oldest unpinned entry whose in-tier subtree is also
+        unpinned, cascading its descendants (they become unreachable the
+        moment their parent digest leaves the walk). Returns False when
+        nothing is droppable (everything pinned or empty)."""
+        for key in list(self._entries):
+            node = self._entries[key]
+            subtree = self._subtree_keys_locked(node)
+            if any(self._entries[k].pins for k in subtree):
+                continue
+            for k in reversed(subtree):  # leaves first: children lists stay sane
+                self._drop_locked(self._entries[k])
+            return True
+        return False
+
+    def _subtree_keys_locked(
+        self, node: HostNode
+    ) -> List[Tuple[bytes, bytes]]:
+        """``node`` plus every in-tier descendant, parents before children."""
+        out = [node.key]
+        i = 0
+        while i < len(out):
+            digest = self._entries[out[i]].digest
+            out.extend(
+                k for k in self._children.get(digest, ()) if k in self._entries
+            )
+            i += 1
+        return out
+
+    def _drop_locked(self, node: HostNode) -> None:
+        del self._entries[node.key]
+        siblings = self._children.get(node.key[0])
+        if siblings is not None:
+            siblings.remove(node.key)
+            if not siblings:
+                del self._children[node.key[0]]
+        self._bytes -= self.block_nbytes
+        self._dropped += 1
+        self._metrics["dropped"].inc()
+        self._metrics["host_bytes"].set(self._bytes)

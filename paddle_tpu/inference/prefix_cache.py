@@ -42,6 +42,16 @@ thread and intake threads may race engine introspection against admissions.
 Fault sites ``prefix_cache.match`` and ``prefix_cache.cow`` let the fault
 campaign force cache-miss and CoW-failure paths deterministically; both
 degrade to recompute, never to a failed request.
+
+**Hierarchical KV**: with a :class:`~paddle_tpu.inference.kv_tier
+.HostKVTier` attached (``FLAGS_kv_host_tier_bytes`` > 0), an evicted chain
+block is captured D2H and spilled into the host tier instead of dropped,
+and the match walk continues ACROSS the tier boundary: when the device walk
+runs out of resident nodes, the same rolling-digest recurrence keeps
+walking spilled nodes (returned pinned in ``MatchResult.host_nodes`` for
+the engine to prefetch H2D), and the partial arm consults spilled children
+too (``MatchResult.host_partial``). Spill and prefetch failures degrade to
+the pre-tier behavior — drop, and recompute, respectively.
 """
 
 from __future__ import annotations
@@ -49,10 +59,11 @@ from __future__ import annotations
 import hashlib
 import threading
 from collections import OrderedDict
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from paddle_tpu.inference.kv_tier import HostKVTier, HostNode, leading_run
 from paddle_tpu.observability import flight_recorder as _flight
 from paddle_tpu.observability import metrics as _obs
 from paddle_tpu.testing.faults import InjectedFault, fault_point
@@ -97,7 +108,10 @@ def _cache_metrics() -> Dict[str, Any]:
     return {
         "hits": reg.counter(
             "prefix_cache_hits_total",
-            "Admissions that mapped at least one cached prefix block.",
+            "Admissions that mapped at least one cached prefix token, by the "
+            "deepest tier the match reached (hbm = device-resident chain "
+            "only; host = the walk crossed into the host spill tier).",
+            labelnames=("tier",),
         ),
         "misses": reg.counter(
             "prefix_cache_misses_total",
@@ -151,22 +165,31 @@ class MatchResult:
     """Outcome of :meth:`PrefixCache.match` — already reference-held.
 
     ``nodes`` are the matched full-block chain (refs taken); ``cached_tokens``
-    counts every token served from cache including the CoW partial;
-    ``cow`` is ``(src_node, dst_block, partial_len)`` when the first
-    divergent block was forked (refs taken on ``src_node`` until
-    :meth:`PrefixCache.release_cow_source`)."""
+    counts every token served from DEVICE-resident cache including the CoW
+    partial (host-tier reuse is added by the engine only once its prefetch
+    actually lands); ``cow`` is ``(src_node, dst_block, partial_len)`` when
+    the first divergent block was forked (refs taken on ``src_node`` until
+    :meth:`PrefixCache.release_cow_source`). ``host_nodes`` continue the
+    chain walk into the host spill tier (full blocks, pinned against LRU
+    drop until the engine issues or abandons their H2D prefetch) and
+    ``host_partial`` is the spilled divergent-block arm ``(host_node,
+    matched_tokens)`` (also pinned)."""
 
-    __slots__ = ("nodes", "cached_tokens", "cow")
+    __slots__ = ("nodes", "cached_tokens", "cow", "host_nodes", "host_partial")
 
     def __init__(
         self,
         nodes: List[ChainNode],
         cached_tokens: int,
         cow: Optional[Tuple[ChainNode, int, int]],
+        host_nodes: Optional[List[HostNode]] = None,
+        host_partial: Optional[Tuple[HostNode, int]] = None,
     ) -> None:
         self.nodes = nodes
         self.cached_tokens = cached_tokens
         self.cow = cow
+        self.host_nodes = host_nodes or []
+        self.host_partial = host_partial
 
 
 class PrefixCache:
@@ -177,10 +200,22 @@ class PrefixCache:
     layers for one token (2 x layers x kv_heads x head_dim x itemsize).
     """
 
-    def __init__(self, pool: Any, block_size: int, bytes_per_token: int = 0) -> None:
+    def __init__(
+        self,
+        pool: Any,
+        block_size: int,
+        bytes_per_token: int = 0,
+        host_tier: Optional[HostKVTier] = None,
+        capture_kv: Optional[Callable[[int], np.ndarray]] = None,
+    ) -> None:
         self._pool = pool
         self.block_size = int(block_size)
         self.bytes_per_token = int(bytes_per_token)
+        # hierarchical KV: the host spill tier plus the engine-provided D2H
+        # capture of one physical block's KV across all layers; both None =
+        # single-tier (evicted chains die, the pre-tier behavior)
+        self._tier = host_tier
+        self._capture_kv = capture_kv
         self._lock = threading.Lock()
         self._nodes: Dict[Tuple[bytes, bytes], ChainNode] = {}
         # parent digest -> insertion-ordered child keys (partial-match scan)
@@ -198,9 +233,11 @@ class PrefixCache:
         # host-side counters (always on — introspection must not depend on
         # the metrics flag); the metric families mirror them when enabled
         self._hits = 0
+        self._host_hits = 0  # hits whose walk crossed into the host tier
         self._misses = 0
         self._evictions = 0
         self._tokens_reused = 0
+        self._spilled = 0  # evicted blocks saved into the host tier
         self._cow_forks = 0
         self._metrics = _cache_metrics()
 
@@ -240,12 +277,14 @@ class PrefixCache:
             # must never scan the node table under the lock
             return {
                 "hits": self._hits,
+                "host_hits": self._host_hits,
                 "misses": self._misses,
                 "hit_rate": (self._hits / lookups) if lookups else 0.0,
                 "tokens_reused": self._tokens_reused,
                 "bytes_saved": self._tokens_reused * self.bytes_per_token,
                 "cow_forks": self._cow_forks,
                 "evictions": self._evictions,
+                "spilled": self._spilled,
                 "nodes": len(self._nodes),
                 "evictable_blocks": self._dead,
                 "blocks_shared": self._shared,
@@ -283,14 +322,22 @@ class PrefixCache:
         """Map the longest cached prefix chain of ``prompt``; references are
         taken atomically under the cache lock (matched nodes can never be
         evicted between match and use). The fault site at the top models a
-        corrupted/unavailable index — callers degrade to a cold miss."""
+        corrupted/unavailable index — callers degrade to a cold miss.
+
+        The walk is the SAME rolling-digest recurrence across both tiers:
+        device-resident nodes first, then (host tier attached) spilled nodes
+        continuing from the last resident digest — a chain whose tail was
+        evicted to host RAM still matches end to end, and every full cached
+        block before the first divergent block maps regardless of which
+        tier holds it. The partial arm then reuses the leading run of the
+        divergent block from a resident child (copy-on-write) or, failing
+        that, from a spilled child (prefetch-on-write)."""
         fault_point("prefix_cache.match")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         cap = prompt.size - 1  # >= 1 token must be computed for logits
         bs = self.block_size
         with self._lock:
             nodes: List[ChainNode] = []
-            parent: Optional[ChainNode] = None
             parent_digest = _ROOT_DIGEST
             pos = 0
             while pos + bs <= cap:
@@ -300,23 +347,72 @@ class PrefixCache:
                     break
                 nodes.append(node)
                 pos += bs
-                parent = node
                 parent_digest = node.digest
-            cow = self._match_partial_locked(prompt, pos, cap, parent_digest)
+            # cross-tier continuation: keep walking the same recurrence over
+            # spilled nodes (a spilled node never has a resident child, so
+            # the walk never needs to come back to this tier)
+            host_nodes: List[HostNode] = []
+            host_pos = pos
+            if self._tier is not None:
+                while host_pos + bs <= cap:
+                    hn = self._tier.lookup_pin(
+                        parent_digest, prompt[host_pos : host_pos + bs].tobytes()
+                    )
+                    if hn is None:
+                        break
+                    host_nodes.append(hn)
+                    host_pos += bs
+                    parent_digest = hn.digest
+            # the divergent-block arm: resident children fork copy-on-write;
+            # past a host continuation (or with no resident candidate) a
+            # spilled child serves the same partial via prefetch instead
+            cow = None
+            host_partial = None
+            if not host_nodes:
+                cow = self._match_partial_locked(prompt, pos, cap, parent_digest)
+            if cow is None and self._tier is not None:
+                remaining = prompt[host_pos : min(cap, host_pos + bs)]
+                host_partial = self._tier.best_partial(parent_digest, remaining)
             for node in nodes:
                 self._acquire_locked(node)
             cached = pos + (cow[2] if cow is not None else 0)
-            if cached > 0:
+            host_hit = bool(host_nodes or host_partial)
+            if cached > 0 or host_hit:
                 self._hits += 1
+                if host_hit:
+                    self._host_hits += 1
                 self._tokens_reused += cached
-                self._metrics["hits"].inc()
+                self._metrics["hits"].labels(
+                    tier="host" if host_hit else "hbm"
+                ).inc()
                 self._metrics["saved"].set(
                     self._tokens_reused * self.bytes_per_token
                 )
             else:
                 self._misses += 1
                 self._metrics["misses"].inc()
-            return MatchResult(nodes, cached, cow)
+            return MatchResult(nodes, cached, cow, host_nodes, host_partial)
+
+    def record_host_reuse(self, tokens: int) -> None:
+        """Fold successfully prefetched host-tier tokens into the reuse
+        accounting (the engine calls this only once the H2D copies are
+        issued — a degraded prefetch never inflates the savings)."""
+        with self._lock:
+            self._tokens_reused += int(tokens)
+            self._metrics["saved"].set(
+                self._tokens_reused * self.bytes_per_token
+            )
+
+    def release_host_pins(self, result: MatchResult) -> None:
+        """Drop the prefetch pins a :meth:`match` took on host-tier nodes
+        (after the engine issued the copies, or on any degrade path)."""
+        if self._tier is None:
+            return
+        pinned = list(result.host_nodes)
+        if result.host_partial is not None:
+            pinned.append(result.host_partial[0])
+        if pinned:
+            self._tier.unpin(pinned)
 
     def _match_partial_locked(
         self,
@@ -341,9 +437,7 @@ class PrefixCache:
             node = self._nodes.get(key)
             if node is None:
                 continue
-            cand = np.frombuffer(node.token_bytes, np.int32)[: remaining.size]
-            neq = np.nonzero(cand != remaining)[0]
-            k = int(neq[0]) if neq.size else int(remaining.size)
+            k = leading_run(np.frombuffer(node.token_bytes, np.int32), remaining)
             if k > best:
                 best, src = k, node
         if src is None:
@@ -466,8 +560,40 @@ class PrefixCache:
             _flight.record_event("prefix_evict", blocks=done)
         return done
 
+    def _try_spill_locked(self, node: ChainNode) -> None:
+        """Spill an about-to-drop node's KV D2H into the host tier (runs
+        BEFORE the pool reference is dropped, so the block cannot be
+        reallocated and overwritten under the capture). Any failure —
+        including an injected ``kv_tier.spill`` fault — degrades to the
+        pre-tier behavior: the chain simply dies.
+
+        The capture is a synchronous device read under the cache lock —
+        deliberate: eviction happens mid-allocation (`_alloc_block_locked`
+        pressure), and the freed block can be handed to a NEW owner inside
+        the same critical section, whose writes would race a deferred
+        capture. The cost is one small D2H per evicted block, serialized
+        against intake-thread match()/stats calls only (the engine itself
+        is driven by one pump thread)."""
+        if self._tier is None or self._capture_kv is None:
+            return
+        try:
+            ok = self._tier.put(
+                node.key[0], node.digest, node.token_bytes,
+                self._capture_kv(node.block),
+            )
+        except Exception as exc:  # noqa: BLE001 - spill failure = plain drop
+            _flight.record_event(
+                "kv_spill_failed", block=node.block,
+                error=f"{type(exc).__name__}: {exc}"[:120],
+            )
+            return
+        if ok:
+            self._spilled += 1
+            _flight.record_event("kv_spill", block=node.block)
+
     def _drop_node_locked(self, node: ChainNode) -> None:
         self._dead -= 1  # only dead nodes ever reach the eviction walk
+        self._try_spill_locked(node)
         del self._nodes[node.key]
         siblings = self._children.get(node.key[0])
         if siblings is not None:
@@ -499,6 +625,22 @@ class PrefixCache:
         seam, so cache retention can never starve live requests."""
         with self._lock:
             return self._alloc_block_locked()
+
+    def alloc_landing_blocks(self, n: int) -> List[int]:
+        """Reserve ``n`` pool slots for prefetched host-tier blocks to land
+        in, all-or-nothing: zero-ref cached chains are evicted (spilling in
+        turn) until the pool can hand out all ``n`` atomically, and a
+        shortfall raises MemoryError with NOTHING allocated — the prefetch
+        degrade path never has partial state to unwind."""
+        n = int(n)
+        with self._lock:
+            while self._pool.free_blocks < n:
+                if self._evict_locked(1) == 0:
+                    raise MemoryError(
+                        f"cannot reserve {n} landing blocks: pool has "
+                        f"{self._pool.free_blocks} free and nothing evictable"
+                    )
+            return self._pool.acquire_blocks(n)
 
     def update_shared_gauge(self) -> None:
         """Refresh the blocks-shared gauge (cheap; engine calls it at

@@ -753,6 +753,13 @@ class ServingFrontend:
                     "accepted_tokens": spec.get("accepted_tokens", 0),
                     "drafted_tokens": spec.get("drafted_tokens", 0),
                 },
+                # hierarchical KV: the host-RAM spill tier under the prefix
+                # cache (enabled: False == FLAGS_kv_host_tier_bytes=0)
+                "kv_tier": (
+                    self.engine.kv_tier_stats()
+                    if hasattr(self.engine, "kv_tier_stats")
+                    else {"enabled": False}
+                ),
                 # the shard-group identity: one engine = one ['tp'] mesh
                 "tensor_parallel": (
                     self.engine.tp_stats()

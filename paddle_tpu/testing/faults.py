@@ -85,6 +85,16 @@ KNOWN_SITES = (
     "router.dispatch",
     "router.health_probe",
     "replica.kill",
+    # hierarchical-KV seams (inference/kv_tier.py + engine._prefetch_spilled):
+    # ``kv_tier.spill`` fires at the top of HostKVTier.put, per evicted
+    # chain block being spilled D2H — an injected failure drops the chain
+    # (the pre-tier behavior, nothing half-stored); ``kv_tier.prefetch``
+    # fires per admission that matched a spilled chain, before any landing
+    # slot is reserved — an injected failure degrades that request to
+    # recomputing its suffix (device-resident matches stay mapped). Both
+    # are pinned zero-cost-when-empty by tests/test_kv_tier.py.
+    "kv_tier.spill",
+    "kv_tier.prefetch",
     # speculative-decoding seam (inference/engine.py::_commit_speculation):
     # fires per drafted slot per step, between the dispatch that scored the
     # draft and the host-side accept/rewind bookkeeping. A trigger degrades

@@ -33,6 +33,14 @@ class Store:
     def wait(self, key: str) -> None:
         raise NotImplementedError
 
+    def check(self, key: str) -> bool:
+        """Non-blocking existence probe. ``get``/``wait`` are RENDEZVOUS
+        primitives — a missing key blocks the full store timeout waiting to
+        appear — which is wrong for liveness scans (elastic membership, a
+        watch loop polling per-rank keys): there, a missing key is an
+        answer, not something to wait for."""
+        raise NotImplementedError
+
 
 class _PyMaster:
     """Pure-python master fallback (same wire behavior, in-process only)."""
@@ -61,6 +69,10 @@ class _PyMaster:
             self._kv[key] = str(v).encode()
             self._cond.notify_all()
             return v
+
+    def check(self, key: str) -> bool:
+        with self._cond:
+            return key in self._kv
 
 
 class TCPStore(Store):
@@ -143,6 +155,20 @@ class TCPStore(Store):
         if v < 0 and amount >= 0:
             raise RuntimeError(f"TCPStore.add({key!r}) failed")
         return int(v)
+
+    def check(self, key: str) -> bool:
+        """Existence probe that returns promptly whether or not the key is
+        there. The wire protocol has no dedicated probe, but the server's
+        wait handler evaluates its predicate immediately on entry, so a
+        1 ms wait IS the probe (``timeout_ms == 0`` means wait FOREVER on
+        the server — never pass that here). The 1 ms bound is SERVER-side
+        only — how long the server waits for an absent key to appear; the
+        client then blocks on the reply read like every other op, so
+        network RTT can delay the answer but never flip a present key to
+        absent or desync the connection."""
+        if self._py is not None:
+            return self._py.check(key)
+        return self._lib.tcpstore_wait(self._fd, key.encode(), 1) == 0
 
     def wait(self, key: str) -> None:
         if self._py is not None:

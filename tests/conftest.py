@@ -82,3 +82,31 @@ def assert_engine_pool_exact(eng):
             )
             for i, node in enumerate(eng._nodes[slot]):
                 assert eng._blocks[slot][i] == node.block
+
+
+def assert_kv_tier_exact(eng):
+    """The hierarchical-KV churn invariant, shared by the tier suites:
+    host-tier bytes stay within budget (and equal blocks x block_nbytes),
+    and no block is live in BOTH tiers under the same chain key with
+    mismatched contents — a device-resident chain node whose key also
+    lives in the host tier must hold byte-identical KV (content-addressed
+    immutability is what makes dual residency safe)."""
+    import numpy as np
+
+    tier = eng._host_tier
+    if tier is None:
+        return
+    s = tier.stats_snapshot()
+    assert s["host_bytes"] <= s["budget_bytes"], s
+    assert s["host_bytes"] == len(tier) * tier.block_nbytes, s
+    if eng._cache is None:
+        return
+    for node in list(eng._cache._nodes.values()):
+        host = tier._entries.get(node.key)
+        if host is None:
+            continue
+        assert host.digest == node.digest
+        dev = eng._capture_block_kv(node.block)
+        assert np.array_equal(np.asarray(dev), np.asarray(host.kv)), (
+            f"block {node.block} resident in both tiers with mismatched KV"
+        )

@@ -118,6 +118,23 @@ class TestTCPStoreEdgeCases:
             store.get("never-set")
         assert time.time() - t0 < 5
 
+    def test_check_probe_is_nonblocking_for_missing_keys(self):
+        """``check`` answers immediately for absent keys — unlike ``get``,
+        which has rendezvous semantics and blocks the full store timeout.
+        This is what keeps the elastic manager's liveness scans O(ms) per
+        dead rank instead of O(store timeout)."""
+        import time
+
+        store = TCPStore("127.0.0.1", 0, is_master=True, timeout=30.0)
+        t0 = time.time()
+        assert store.check("never-set") is False
+        assert time.time() - t0 < 2  # probe, not a 30s rendezvous wait
+        store.set("present", b"1")
+        assert store.check("present") is True
+        client = TCPStore("127.0.0.1", store.port, is_master=False, timeout=30.0)
+        assert client.check("present") is True
+        assert client.check("never-set") is False
+
     def test_hostname_resolution(self):
         store = TCPStore("127.0.0.1", 0, is_master=True)
         store.set("h", b"1")

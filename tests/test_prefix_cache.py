@@ -102,6 +102,82 @@ class TestChurnProperty:
         s = eng.pool_stats()
         assert s["free"] + s["cached_blocks"] == s["total"]
 
+    def test_200_op_churn_with_host_tier_spill_prefetch_drop(self):
+        """The churn property test extended with the hierarchical-KV ops:
+        submit (with multi-turn re-submissions that land on spilled chains),
+        step, cancel, device-evict (which now SPILLS), and host-tier drop.
+        After EVERY op: pool refcounts exact (the shared engine invariant),
+        host-tier bytes <= budget, and no block live in both tiers under the
+        same digest with mismatched contents."""
+        from conftest import assert_kv_tier_exact
+
+        m, cfg = _model(seed=52)
+        rng = np.random.default_rng(52)
+        bpb = 2 * cfg.num_hidden_layers * cfg.num_key_value_heads * \
+            (cfg.hidden_size // cfg.num_attention_heads) * 4 * 4  # f32, bs=4
+        eng = ContinuousBatchingEngine(
+            m, max_slots=3, block_size=4, num_blocks=20, prompt_bucket=24,
+            max_model_len=40, kv_host_tier_bytes=6 * bpb,
+        )
+        families = [
+            rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+            for n in (9, 12)
+        ]
+        finished_streams = []
+
+        def make_prompt():
+            # half the prompts replay a finished request's stream (the
+            # multi-turn shape that matches spilled generated-token chains)
+            if finished_streams and rng.random() < 0.5:
+                base = finished_streams[int(rng.integers(0, len(finished_streams)))]
+            else:
+                base = families[int(rng.integers(0, len(families)))]
+            tail_n = int(rng.integers(0, 4))
+            tail = rng.integers(0, cfg.vocab_size, (tail_n,)).astype(np.int32)
+            return np.concatenate([base, tail])[:20]
+
+        submitted, done = {}, {}
+        for _op in range(200):
+            r = rng.random()
+            if r < 0.35 and len(eng._waiting) < 6:
+                rid = eng.add_request(
+                    make_prompt(), max_new_tokens=int(rng.integers(1, 6))
+                )
+                submitted[rid] = True
+            elif r < 0.80:
+                if eng.has_work():
+                    for req in eng.step():
+                        assert req.req_id not in done, "delivered twice"
+                        done[req.req_id] = req
+                        if len(finished_streams) < 6:
+                            finished_streams.append(req.tokens())
+            elif r < 0.88:
+                live = [q.req_id for q in eng.live_requests()] + [
+                    q.req_id for q in eng._waiting
+                ]
+                if live:
+                    rid = int(rng.choice(live))
+                    req = eng.cancel_request(rid)
+                    assert req is not None and req.finished
+                    done[rid] = req
+            elif r < 0.96:
+                eng._cache.evict_blocks(1)  # device pressure -> SPILL
+            else:
+                eng._host_tier.drop_lru(1)  # host pressure -> DROP
+            _assert_invariants(eng)
+            assert_kv_tier_exact(eng)
+        while eng.has_work():
+            for req in eng.step():
+                assert req.req_id not in done
+                done[req.req_id] = req
+            _assert_invariants(eng)
+            assert_kv_tier_exact(eng)
+        assert set(done) == set(submitted)  # exactly once, nobody lost
+        t = eng.kv_tier_stats()
+        assert t["spilled_blocks"] > 0, t  # the churn actually spilled
+        assert t["prefetched_blocks"] > 0, t  # ... and prefetched
+        assert t["dropped_blocks"] > 0, t  # ... and dropped
+
     def test_churn_with_cache_disabled_matches_invariants_too(self):
         """The same machinery with FLAGS_enable_prefix_cache off: pure
         refcounted private blocks, zero cache state."""
@@ -330,6 +406,109 @@ class TestEviction:
         assert cache.insert(None, toks, b2) is None  # caller keeps b2 private
         assert pool.refcount(b1) == 2  # owner + cache
         assert pool.refcount(b2) == 1  # owner only
+
+
+class TestPartialBlockSuffixReuse:
+    """The match-length contract (PR 10 follow-on): a prompt diverging
+    mid-chain maps EVERY full cached block before the first divergent block
+    — even when the divergent block itself is partial (a ragged prompt
+    tail) — plus the divergent block's leading run via copy-on-write. The
+    same lengths must hold when the chain's tail has been spilled to the
+    host tier (prefetch instead of CoW). The oracle for every case:
+    ``cached == min(lcp, prompt_len - 1)`` and
+    ``full_blocks_mapped == cached // block_size``."""
+
+    def _cached_chain(self, seed, n_tokens=16):
+        m, cfg = _model(seed=seed)
+        rng = np.random.default_rng(seed)
+        x = rng.integers(0, cfg.vocab_size, (n_tokens,)).astype(np.int32)
+        eng = ContinuousBatchingEngine(
+            m, max_slots=2, block_size=4, num_blocks=64, prompt_bucket=32,
+            max_model_len=48, kv_host_tier_bytes=1 << 20,
+        )
+        r = eng.add_request(x, max_new_tokens=2)
+        out = eng.run()
+        return eng, cfg, rng, x, out[r].tokens()
+
+    def test_mid_chain_divergence_with_partial_divergent_block(self):
+        """x cached (4 full blocks); y = x[:13] diverging at position 10 —
+        inside y's PARTIAL third block. Full blocks 0 and 1 must both map
+        (8 tokens) plus the 2-token leading run of the divergent block."""
+        eng, cfg, rng, x, _ = self._cached_chain(seed=53)
+        y = x[:13].copy()
+        y[10:] = (y[10:] + 1) % cfg.vocab_size
+        res = eng._cache.match(y)
+        assert len(res.nodes) == 2  # every full block before the divergence
+        assert res.cow is not None and res.cow[2] == 2
+        assert res.cached_tokens == 10  # == lcp, the oracle maximum
+        eng._cache.release(res.nodes)
+        eng._cache.release_cow_source(res.cow[0])
+        eng._mgr.decref(res.cow[1])
+
+    def test_divergence_at_partial_block_start_maps_all_preceding(self):
+        eng, cfg, rng, x, _ = self._cached_chain(seed=54)
+        y = x[:11].copy()
+        y[8:] = (y[8:] + 1) % cfg.vocab_size  # diverges at its block's row 0
+        res = eng._cache.match(y)
+        assert len(res.nodes) == 2 and res.cow is None
+        assert res.cached_tokens == 8
+        eng._cache.release(res.nodes)
+
+    def test_exact_prefix_ending_mid_block_maps_all_full_blocks(self):
+        """y is an exact 14-token prefix of the cached stream: all 3 full
+        blocks map and the partial fourth reuses 1 token via CoW — the
+        held-back final token is the only one recomputed."""
+        eng, cfg, rng, x, _ = self._cached_chain(seed=55)
+        y = x[:14]
+        res = eng._cache.match(y)
+        assert len(res.nodes) == 3
+        assert res.cow is not None and res.cow[2] == 1
+        assert res.cached_tokens == 13  # min(lcp, plen-1)
+        eng._cache.release(res.nodes)
+        eng._cache.release_cow_source(res.cow[0])
+        eng._mgr.decref(res.cow[1])
+
+    def test_same_lengths_when_the_chain_tail_is_spilled(self):
+        """The cross-tier half of the contract: spill the whole chain, then
+        the SAME divergent-partial prompt must reuse the same token count —
+        full blocks via H2D prefetch, the divergent block's leading run via
+        prefetch-on-write — and decode byte-identically to a cold engine."""
+        eng, cfg, rng, x, _ = self._cached_chain(seed=56)
+        y = x[:13].copy()
+        y[10:] = (y[10:] + 1) % cfg.vocab_size
+        eng._cache.evict_blocks(16)
+        assert eng._cache.node_count == 0
+        ry = eng.add_request(y, max_new_tokens=3)
+        out = eng.run()
+        assert out[ry].cached_tokens == 10  # same oracle across tiers
+        assert eng.kv_tier_stats()["prefetched_blocks"] == 3  # 2 full + partial
+        eng_off = ContinuousBatchingEngine(
+            eng.model, max_slots=2, block_size=4, prompt_bucket=32,
+            max_model_len=48, enable_prefix_cache=False,
+        )
+        r_off = eng_off.add_request(y, max_new_tokens=3)
+        out_off = eng_off.run()
+        np.testing.assert_array_equal(out[ry].tokens(), out_off[r_off].tokens())
+        _assert_invariants(eng)
+
+    def test_multi_turn_divergence_inside_generated_chain(self):
+        """Turn-2 prompt = turn-1 stream + new text: the divergence (where
+        the new text begins) is mid-block, and every full block of the
+        registered prompt+generated chain before it must map."""
+        eng, cfg, rng, x, stream = self._cached_chain(seed=57)
+        tail = rng.integers(0, cfg.vocab_size, (5,)).astype(np.int32)
+        y = np.concatenate([stream, tail])
+        # the final generated token is emitted, never appended to KV, so the
+        # chain registers full blocks of the first stream.size - 1 tokens
+        registered = ((stream.size - 1) // 4) * 4
+        res = eng._cache.match(y)
+        got = len(res.nodes) * 4 + (res.cow[2] if res.cow else 0)
+        assert len(res.nodes) == registered // 4
+        assert res.cached_tokens == got
+        eng._cache.release(res.nodes)
+        if res.cow is not None:
+            eng._cache.release_cow_source(res.cow[0])
+            eng._mgr.decref(res.cow[1])
 
 
 class TestFaultSites:
