@@ -89,14 +89,22 @@ def _fail_json(error: str, backend_down: bool = False) -> None:
     """One parseable failure line on stdout — the driver records stdout
     verbatim, so every exit path must leave a JSON record. ``backend_down``
     tags backend-init failures explicitly so the retry wrapper never has to
-    guess from message text."""
+    guess from message text.
+
+    ``status`` is the machine-readable trichotomy every record carries:
+    ``"measured"`` (a real number), ``"error"`` (the bench itself failed),
+    ``"infra_down"`` (the backend never came up — the number is NOT a
+    measured zero and must be excluded from vs_baseline/trajectory math,
+    hence ``vs_baseline: null`` here)."""
+    status = "infra_down" if backend_down else "error"
     print(
         json.dumps(
             {
                 "metric": "llama_train_tokens_per_sec_per_chip",
                 "value": 0.0,
                 "unit": "tokens/s/chip",
-                "vs_baseline": 0.0,
+                "vs_baseline": None if backend_down else 0.0,
+                "status": status,
                 "error": error[:500],
                 "backend_down": backend_down,
             }
@@ -408,6 +416,17 @@ def _main_timed(platform, paddle, cfg, batch, seq, steps, warmup) -> None:
         _bench_cluster_goodput(paddle, platform),
         _bench_traced_request_breakdown(paddle, platform),
     ]
+    # explicit machine-readable status on EVERY record: a secondary that
+    # returned an "error" field (or skipped itself, e.g. tp under 2
+    # devices) did not measure anything — trajectory tooling must never
+    # average its value as a real zero
+    for rec in secondary:
+        rec.setdefault(
+            "status",
+            "error" if "error" in rec
+            else "skipped" if "skipped" in rec
+            else "measured",
+        )
     print(
         json.dumps(
             {
@@ -415,6 +434,7 @@ def _main_timed(platform, paddle, cfg, batch, seq, steps, warmup) -> None:
                 "value": round(tokens_per_sec, 1),
                 "unit": "tokens/s/chip",
                 "vs_baseline": round(tokens_per_sec / BASELINE_TOKENS_PER_SEC_PER_CHIP, 4),
+                "status": "measured",
                 "mfu": round(mfu, 4),
                 "fused_loss": fused_loss,
                 "secondary": secondary,
@@ -1448,7 +1468,16 @@ def _bench_cluster_goodput(paddle, platform: str) -> dict:
     hit rate before vs after the kill (the survivors' rendezvous shares are
     untouched, so warmth should largely survive the membership change) —
     with the honesty checks: exactly one compiled signature per engine, and
-    the storm window (kill included) adds ZERO compiles."""
+    the storm window (kill included) adds ZERO compiles.
+
+    The fleet observability layer rides along: a ClusterObserver drives the
+    SLO burn-rate monitor from the router's probe loop, and the record
+    carries the monitor's state timeline (time-in-WARN/PAGE across the
+    kill) plus the 1-compile-per-engine proof that the whole observability
+    layer — replica-scoped metrics, burn-rate sampling, incident snapshots —
+    adds ZERO compiled signatures."""
+    import tempfile as _tempfile
+
     from paddle_tpu import observability as obs
     from paddle_tpu.inference import ContinuousBatchingEngine
     from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
@@ -1503,6 +1532,19 @@ def _bench_cluster_goodput(paddle, platform: str) -> dict:
 
         cluster = ReplicaCluster(factory, [f"r{i}" for i in range(n_replicas)])
         router = ReplicaRouter(cluster, RouterConfig())
+        # fleet observability riding the probe loop: the burn-rate monitor's
+        # windows are sized to the storm (the kill must register as
+        # sustained within the run), the TTFT target is the workload SLO
+        observer = obs.ClusterObserver(
+            router,
+            slo_config=obs.SLOConfig(
+                ttft_p99_target_s=slo_s, goodput_target=0.9,
+                shed_budget=0.1, failover_budget=0.1,
+                fast_window_s=1.0, slow_window_s=4.0, min_terminals=4,
+            ),
+            incident_dir=_tempfile.mkdtemp(prefix="paddle_tpu_bench_incidents_"),
+            incident_cooldown_s=5.0,
+        )
         # per-replica capacity from ONE replica (they are identical), then
         # warm the other engines so the storm window adds no compiles
         rate = measure_sustainable_rate(
@@ -1554,11 +1596,15 @@ def _bench_cluster_goodput(paddle, platform: str) -> dict:
             return round(c.get("affinity", 0) / tot, 4) if tot else 0.0
 
         reg = obs.GLOBAL_METRICS
-        shed_by_reason = {
-            v["labels"]["reason"]: int(v["value"])
-            for v in reg.get("serving_shed_total")._snapshot_values()
-        }
+        # sum across the replica-scoped cells AND the router's unscoped
+        # ones: one reason may now have one cell per replica
+        shed_by_reason: dict = {}
+        for v in reg.family("serving_shed_total")._snapshot_values():
+            reason = v["labels"]["reason"]
+            shed_by_reason[reason] = shed_by_reason.get(reason, 0) + int(v["value"])
         dead = [n for n, r in cluster.replicas.items() if r.state == "dead"]
+        slo_time = observer.monitor.time_in_states()
+        compiled_total = report["compiled_signatures_total"]
         return {
             "metric": "cluster_goodput_tokens_per_sec",
             "value": report["goodput_tokens_per_sec"],
@@ -1584,10 +1630,28 @@ def _bench_cluster_goodput(paddle, platform: str) -> dict:
             "redispatch_sheds": report["router_sheds"],
             "shed_total_by_reason": shed_by_reason,
             "replica_states": report["replica_states"],
+            # the SLO monitor's view of the storm: burn-rate state timeline
+            # and how long the kill held the fleet in WARN/PAGE
+            "slo_monitor": {
+                "final_state": observer.monitor.state_name,
+                "time_in_warn_s": slo_time.get("warn", 0.0),
+                "time_in_page_s": slo_time.get("page", 0.0),
+                "transitions": [
+                    {k: e[k] for k in ("from", "to", "signal", "burn")}
+                    for e in observer.monitor.timeline
+                ],
+            },
+            "incidents_written": len(observer.incidents),
             # honesty checks: one program per engine; a replica death is
-            # absorbed by routing, never by a surviving engine recompiling
-            "compiled_signatures": report["compiled_signatures_total"],
+            # absorbed by routing, never by a surviving engine recompiling —
+            # and the whole fleet observability layer (scoped metrics,
+            # burn-rate sampling, incident snapshots) adds ZERO signatures
+            "compiled_signatures": compiled_total,
             "compiles_during_storm": sum(report["compiles_during_run"].values()),
+            "one_compile_per_engine": bool(
+                compiled_total == n_replicas
+                and sum(report["compiles_during_run"].values()) == 0
+            ),
         }
     except Exception as exc:  # noqa: BLE001 - secondary must never kill primary
         return {"metric": "cluster_goodput_tokens_per_sec", "error": f"{exc!r}"[:300]}

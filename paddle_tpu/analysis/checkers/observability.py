@@ -27,15 +27,36 @@ unrelated objects are never confused for tracer calls:
   the module-level shorthand) or ``<recv>.record(...)`` where the
   receiver's last component contains ``flight`` or ``recorder``.
 
+**OB602** — metric-name drift. Aggregation/healthz/snapshot consumers read
+metric families back from the registry BY NAME (``registry.family("...")``,
+``GLOBAL_METRICS.get("...")``); the definitions live at the instrumented
+components. A typo'd read name silently reads zeros (``get``) or only fails
+at runtime on the consumer path (``family``) — this check closes the drift
+statically: every literal name at a registry read site must resolve to a
+family registered somewhere in the package (any ``<registry>.counter(
+"name", ...)`` / ``.gauge`` / ``.histogram`` call — the package-wide
+definition universe is scanned once and cached). Read-site detection is
+receiver-shaped so ``dict.get("...")`` never false-positives:
+
+- ``<anything>.family("lit")`` — the method name is the strict-read API,
+  distinctive by construction;
+- ``<recv>.get("lit")`` where the receiver's last component is
+  ``GLOBAL_METRICS``, contains ``registry`` (any case), or is a
+  ``get_registry()`` call.
+
 - OB601  tracer span opened outside ``with``, or tracer/flight-recorder
          emission inside a traced (``@jax.jit``/``to_static``) function or
          Pallas kernel body / index map.
+- OB602  metric family name read through the registry does not resolve to
+         any registered family (silent-zero drift).
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Dict, List, Optional, Set, Tuple
+from functools import lru_cache
+from pathlib import Path
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
 
 from paddle_tpu.analysis.checkers._shared import attr_chain, body_walk
 from paddle_tpu.analysis.checkers.pallas_purity import _KernelCollector
@@ -58,6 +79,76 @@ def _is_tracer_span_open(node: ast.Call) -> bool:
     return "tracer" in _last_component(attr_chain(recv))
 
 
+_FAMILY_DEF_METHODS = ("counter", "gauge", "histogram")
+
+
+def _collect_family_definitions(tree: ast.AST) -> Set[str]:
+    """Family names registered in one module: any ``<recv>.counter("name",
+    ...)`` / ``.gauge`` / ``.histogram`` call with a literal first
+    argument. Over-collection only loosens the check (an unrelated
+    ``.counter()`` call can add a name, never hide a read)."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _FAMILY_DEF_METHODS
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            out.add(node.args[0].value)
+    return out
+
+
+@lru_cache(maxsize=1)
+def _package_family_universe() -> FrozenSet[str]:
+    """Every family name registered anywhere in the ``paddle_tpu`` package
+    (the canonical universe, like the FD checker's always-scanned
+    flags.py — definitions are spread across engine/serving/kv_tier/...).
+    Parsed once per process and cached."""
+    root = Path(__file__).resolve().parents[2]
+    names: Set[str] = set()
+    for path in sorted(root.rglob("*.py")):
+        try:
+            names |= _collect_family_definitions(
+                ast.parse(path.read_text(encoding="utf-8", errors="replace"))
+            )
+        except (OSError, SyntaxError):
+            continue  # a broken module surfaces as its own GEN001 elsewhere
+    return frozenset(names)
+
+
+def _registry_read_name(node: ast.Call) -> Optional[str]:
+    """The literal family name if ``node`` is a registry read-by-name
+    (``.family("lit")`` anywhere; ``.get("lit")`` on a registry-shaped
+    receiver), else None."""
+    fn = node.func
+    if not isinstance(fn, ast.Attribute):
+        return None
+    if not (
+        node.args
+        and isinstance(node.args[0], ast.Constant)
+        and isinstance(node.args[0].value, str)
+    ):
+        return None
+    if fn.attr == "family":
+        return node.args[0].value
+    if fn.attr != "get":
+        return None
+    recv = fn.value
+    if isinstance(recv, ast.Call):
+        return (
+            node.args[0].value
+            if _last_component(attr_chain(recv.func)) == "get_registry"
+            else None
+        )
+    last = _last_component(attr_chain(recv))
+    if last == "global_metrics" or "registry" in last:
+        return node.args[0].value
+    return None
+
+
 def _is_flight_emit(node: ast.Call) -> bool:
     fn = node.func
     if isinstance(fn, ast.Name) and fn.id == "record_event":
@@ -78,9 +169,46 @@ class ObservabilityChecker(Checker):
                  "or tracer/flight-recorder emission inside a traced "
                  "function or Pallas kernel (fires per compile, not per "
                  "call)",
+        "OB602": "metric family name read through the registry does not "
+                 "resolve to any registered family (a typo'd name silently "
+                 "reads zeros)",
     }
 
     def run(self, ctx: FileContext) -> List[Violation]:
+        out = self._run_ob601(ctx)
+        out.extend(self._run_ob602(ctx))
+        return out
+
+    def _run_ob602(self, ctx: FileContext) -> List[Violation]:
+        reads: List[Tuple[ast.Call, str]] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                name = _registry_read_name(node)
+                if name is not None:
+                    reads.append((node, name))
+        if not reads:
+            return []
+        # the universe: the package-wide canonical scan plus this file's own
+        # definitions (fixture snippets define-and-read in one tree)
+        universe = _package_family_universe() | _collect_family_definitions(
+            ctx.tree
+        )
+        out: List[Violation] = []
+        for node, name in reads:
+            if name in universe:
+                continue
+            out.append(
+                Violation(
+                    ctx.path, node.lineno, node.col_offset, "OB602",
+                    f"metric family name '{name}' does not resolve to any "
+                    "registered family (reg.counter/gauge/histogram call) — "
+                    "a typo'd read silently returns zeros to the "
+                    "aggregation/healthz consumer",
+                )
+            )
+        return out
+
+    def _run_ob601(self, ctx: FileContext) -> List[Violation]:
         device_nodes: Dict[int, Tuple[str, str]] = {}  # node id -> (kind, label)
         for fn in _TracedFunctions().resolve(ctx.tree):
             label = getattr(fn, "name", "<lambda>")

@@ -169,6 +169,21 @@ def _define_builtin_flags() -> None:
     # compiled step over a ['tp'] device mesh; read at engine construction
     # (per-engine override via the tp kwarg)
     d("engine_tp_degree", int, 1, "Tensor-parallel degree of the continuous-batching engine: attention heads and the paged KV block pool partition per device along a single-axis ['tp'] mesh, MLP splits Megatron-style (one all-reduce per layer), the lm-head shards over vocab. 1 = single-chip engine (byte-identical to the unsharded path). Must divide the model's KV heads; needs that many visible devices.")
+    # fleet observability (observability/slo.py + aggregate.py): the SLO
+    # burn-rate monitor riding the cluster router's probe loop, and the
+    # coordinated incident snapshots it (and the death seams) write. Read
+    # when an SLOConfig / ClusterObserver is constructed, never per tick.
+    d("slo_ttft_p99_target_s", float, 1.0, "SLO target for the cluster-level TTFT p99 (seconds): the burn-rate monitor's ttft signal is the observed windowed p99 divided by this.")
+    d("slo_goodput_target", float, 0.9, "SLO target fraction of terminals that finish ok INSIDE their deadline; the monitor's slo-violation burn rate is the windowed violation fraction divided by the remaining error budget (1 - target).")
+    d("slo_shed_budget", float, 0.1, "Error budget for the shed rate: fraction of terminals allowed to end in any non-ok outcome before the shed burn rate reads 1.0.")
+    d("slo_failover_budget", float, 0.1, "Error budget for the failover rate: re-dispatch attempts per routing dispatch allowed before the failover burn rate reads 1.0.")
+    d("slo_fast_window_s", float, 5.0, "Fast burn-rate window (seconds). A state escalates only when BOTH the fast and slow windows burn past a threshold — the fast window catches the onset, the slow window proves it is sustained.")
+    d("slo_slow_window_s", float, 60.0, "Slow burn-rate window (seconds); see slo_fast_window_s.")
+    d("slo_warn_burn", float, 1.0, "Burn-rate threshold that latches WARN (hysteresis: releases at half this value). Burn 1.0 = consuming the error budget exactly as fast as allowed.")
+    d("slo_page_burn", float, 4.0, "Burn-rate threshold that latches PAGE (hysteresis: releases at half this value); entering PAGE writes a coordinated incident snapshot.")
+    d("slo_min_terminals", int, 8, "Minimum terminals inside a window before its budget-based burn rates are trusted (the ttft signal is exempt); prevents paging on the first failed request of a quiet cluster.")
+    d("incident_dir", str, "", "Directory for coordinated cluster incident snapshots (observability/aggregate.py): one sub-directory per incident with every replica's flight ring, the router's routing log, sampled spans and the cluster health view. Empty = flight_recorder_dir, else the system temp dir.")
+    d("incident_cooldown_s", float, 30.0, "Minimum seconds between two incident snapshots for the SAME reason (a flapping replica must not fill the disk with identical postmortems).")
 
 
 _define_builtin_flags()

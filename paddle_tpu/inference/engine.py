@@ -524,6 +524,13 @@ class ContinuousBatchingEngine:
         self._prefetch_wait: List[Optional[Tuple[Any, int, int]]] = (
             [None] * self.max_slots
         )
+        # replica observability scope: unscoped by default (single-engine
+        # processes record exactly as before); a cluster replica re-binds
+        # via set_replica_scope() at replica construction. Set BEFORE the
+        # prefix cache exists — _new_prefix_cache() consults the scope.
+        self._flight = _flight.GLOBAL_FLIGHT_RECORDER
+        self._metrics_scope: Optional[_obs.MetricScope] = None
+        self.replica_name: Optional[str] = None
         self._cache = self._new_prefix_cache()
         # speculative decoding: drafts ride the step's chunk axis, so the
         # draft width is capped at prefill_chunk - 1 (one row is always the
@@ -678,13 +685,17 @@ class ContinuousBatchingEngine:
     def _new_prefix_cache(self) -> Optional[PrefixCache]:
         if not self._use_prefix_cache:
             return None
-        return PrefixCache(
+        cache = PrefixCache(
             self._mgr, self.block_size, self._bytes_per_token(),
             host_tier=self._host_tier,
             capture_kv=(
                 self._capture_block_kv if self._host_tier is not None else None
             ),
         )
+        if self._metrics_scope is not None:
+            # recover() rebuilds a fresh cache: replica attribution survives
+            cache.set_replica_scope(self._metrics_scope, self._flight)
+        return cache
 
     def _capture_block_kv(self, block: int) -> np.ndarray:
         """D2H capture of one physical block's KV across every layer —
@@ -905,7 +916,7 @@ class ContinuousBatchingEngine:
         ``drain_finished()`` stay salvageable, mirroring the pump-death
         seam)."""
         self._broken = True
-        _flight.record_event("engine_marked_failed", why=str(why)[:200])
+        self._flight.record("engine_marked_failed", why=str(why)[:200])
 
     def live_requests(self) -> List[InferenceRequest]:
         """Requests currently holding a slot (mid-decode), slot order."""
@@ -914,6 +925,33 @@ class ContinuousBatchingEngine:
     def set_admission_policy(self, policy: AdmissionPolicy) -> None:
         """Swap the admission policy (takes effect at the next boundary)."""
         self._policy = policy
+
+    def set_replica_scope(
+        self,
+        name: str,
+        scope: Optional[Any] = None,
+        flight: Optional[Any] = None,
+    ) -> None:
+        """Re-bind this engine's observability to a replica scope, resolved
+        ONCE here: every ``engine_*``/``spec_decode_*``/``prefix_cache_*``/
+        ``kv_tier_*`` series it records from now on carries a
+        ``replica=name`` label (rolling up into the same process-global
+        families), and flight events land in a per-replica child ring teed
+        into the global black box. Called by the cluster layer at replica
+        construction; the per-record cost is unchanged (the same one
+        cached-bool read on the metrics-off path)."""
+        if scope is None:
+            scope = _obs.GLOBAL_METRICS.scope(replica=name)
+        if flight is None:
+            flight = _flight.GLOBAL_FLIGHT_RECORDER.child(replica=name)
+        self.replica_name = str(name)
+        self._metrics_scope = scope
+        self._metrics = scope.bind_all(_engine_metrics())
+        self._flight = flight
+        if self._cache is not None:
+            self._cache.set_replica_scope(scope, flight)
+        if self._host_tier is not None:
+            self._host_tier.set_replica_scope(scope)
 
     def cancel_request(
         self, req_id: int, reason: str = "cancelled"
@@ -930,7 +968,7 @@ class ContinuousBatchingEngine:
                 self._waiting.remove(req)
                 req.finish_reason = reason
                 req.finish_wall = time.perf_counter()
-                _flight.record_event(
+                self._flight.record(
                     "shed_queued", req_id=req.req_id, reason=reason
                 )
                 self._metrics["finished"].labels(reason=reason).inc()
@@ -1034,7 +1072,7 @@ class ContinuousBatchingEngine:
             self._waiting.remove(req)
             req.finish_reason = "deadline"
             req.finish_wall = now
-            _flight.record_event(
+            self._flight.record(
                 "shed_queued", req_id=req.req_id, reason="deadline"
             )
             self._metrics["finished"].labels(reason="deadline").inc()
@@ -1078,7 +1116,7 @@ class ContinuousBatchingEngine:
             try:
                 result = self._cache.match(req.prompt)
             except Exception as exc:  # noqa: BLE001 - lookup must never kill admission
-                _flight.record_event(
+                self._flight.record(
                     "prefix_match_failed", req_id=req.req_id,
                     error=f"{type(exc).__name__}: {exc}"[:120],
                 )
@@ -1093,7 +1131,7 @@ class ContinuousBatchingEngine:
             src_node, dst_block, partial = cow
             self._blocks[slot].append(dst_block)
             self._pending_cow[slot] = cow
-            _flight.record_event(
+            self._flight.record(
                 "cow_fork", req_id=req.req_id, slot=slot,
                 src_block=src_node.block, dst_block=dst_block,
                 reused_tokens=partial,
@@ -1142,7 +1180,7 @@ class ContinuousBatchingEngine:
             except Exception as exc:  # noqa: BLE001 - degrade to recompute
                 for blk in blocks:  # reserved but never mapped: hand back
                     self._mgr.decref(blk)
-                _flight.record_event(
+                self._flight.record(
                     "kv_prefetch_failed", req_id=req.req_id, slot=slot,
                     blocks=n_blocks,
                     error=f"{type(exc).__name__}: {exc}"[:120],
@@ -1182,7 +1220,7 @@ class ContinuousBatchingEngine:
         self._host_tier.mark_prefetched(n_blocks)
         self._cache.record_host_reuse(tokens)
         self._prefetch_wait[slot] = (marker, n_blocks, tokens)
-        _flight.record_event(
+        self._flight.record(
             "kv_prefetch", req_id=req.req_id, slot=slot, blocks=n_blocks,
             tokens=tokens,
         )
@@ -1225,7 +1263,7 @@ class ContinuousBatchingEngine:
         self._slot_req[slot] = req
         self._last_tok[slot] = 0
         self.stats["admitted"] += 1
-        _flight.record_event(
+        self._flight.record(
             "admit", req_id=req.req_id, slot=slot,
             prompt_len=int(req.prompt.size), cached_tokens=int(req.cached_tokens),
             queue_depth=len(self._waiting),
@@ -1290,7 +1328,7 @@ class ContinuousBatchingEngine:
         self._ntok[slot] = 0
         self._last_tok[slot] = 0
         req.finish_wall = time.perf_counter()
-        _flight.record_event(
+        self._flight.record(
             "evict", req_id=req.req_id, slot=slot,
             reason=req.finish_reason or "unknown",
             n_generated=len(req.generated),
@@ -1357,13 +1395,13 @@ class ContinuousBatchingEngine:
         recorder's recent-event ring to disk so the postmortem has a
         timeline. safe_dump never raises — the original exception is what
         the caller must see."""
-        _flight.record_event(
+        self._flight.record(
             "engine_permanent_failure",
             error=f"{type(exc).__name__}: {exc}"[:200],
             live=sum(r is not None for r in self._slot_req),
             queued=len(self._waiting),
         )
-        _flight.safe_dump(
+        self._flight.safe_dump(
             "engine_permanent_failure",
             extra={
                 "error": f"{type(exc).__name__}: {exc}"[:200],
@@ -1595,7 +1633,7 @@ class ContinuousBatchingEngine:
             fault_point("spec.verify")
             accepted = count_accepted(row_argmax, draft)
         except Exception as exc:  # noqa: BLE001 - degrade, never corrupt
-            _flight.record_event(
+            self._flight.record(
                 "spec_verify_degraded", req_id=req.req_id, slot=slot,
                 error=f"{type(exc).__name__}: {exc}"[:120],
             )
@@ -1646,7 +1684,7 @@ class ContinuousBatchingEngine:
             self._mgr.decref(self._blocks[slot].pop())
             freed += 1
         if accepted < drafted:
-            _flight.record_event(
+            self._flight.record(
                 "spec_rewind", req_id=req.req_id, slot=slot, drafted=drafted,
                 accepted=accepted, rejected=drafted - accepted,
                 blocks_freed=freed,
@@ -1808,7 +1846,7 @@ class ContinuousBatchingEngine:
             i: int(min(self._ntok[i], req.prompt.size)) for i, req in live
         }
         t_recover = time.perf_counter()
-        _flight.record_event(
+        self._flight.record(
             "recovery", live=len(live), queued=len(self._waiting),
             recoveries=self.stats["recoveries"] + 1,
         )

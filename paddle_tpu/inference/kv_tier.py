@@ -150,6 +150,11 @@ class HostKVTier:
         self._refused = 0
         self._metrics = _tier_metrics()
 
+    def set_replica_scope(self, scope: Any) -> None:
+        """Re-bind the ``kv_tier_*`` families to a replica scope (see the
+        engine's ``set_replica_scope``); resolved once."""
+        self._metrics = scope.bind_all(_tier_metrics())
+
     # -- introspection -------------------------------------------------------
     @property
     def bytes_used(self) -> int:

@@ -240,6 +240,14 @@ class PrefixCache:
         self._spilled = 0  # evicted blocks saved into the host tier
         self._cow_forks = 0
         self._metrics = _cache_metrics()
+        self._flight = _flight.GLOBAL_FLIGHT_RECORDER
+
+    def set_replica_scope(self, scope: Any, flight: Any) -> None:
+        """Re-bind metrics/flight events to a replica scope (see the
+        engine's ``set_replica_scope``); resolved once, per-record cost
+        unchanged."""
+        self._metrics = scope.bind_all(_cache_metrics())
+        self._flight = flight
 
     # -- hashing -------------------------------------------------------------
     @staticmethod
@@ -448,7 +456,7 @@ class PrefixCache:
             dst = self._alloc_block_locked()
         except (InjectedFault, MemoryError) as exc:
             # CoW failure degrades to recompute — never to a failed request
-            _flight.record_event(
+            self._flight.record(
                 "cow_fork_failed", error=f"{type(exc).__name__}: {exc}"[:120]
             )
             return None
@@ -557,7 +565,7 @@ class PrefixCache:
         if done:
             self._evictions += done
             self._metrics["evictions"].inc(done)
-            _flight.record_event("prefix_evict", blocks=done)
+            self._flight.record("prefix_evict", blocks=done)
         return done
 
     def _try_spill_locked(self, node: ChainNode) -> None:
@@ -582,14 +590,14 @@ class PrefixCache:
                 self._capture_kv(node.block),
             )
         except Exception as exc:  # noqa: BLE001 - spill failure = plain drop
-            _flight.record_event(
+            self._flight.record(
                 "kv_spill_failed", block=node.block,
                 error=f"{type(exc).__name__}: {exc}"[:120],
             )
             return
         if ok:
             self._spilled += 1
-            _flight.record_event("kv_spill", block=node.block)
+            self._flight.record("kv_spill", block=node.block)
 
     def _drop_node_locked(self, node: ChainNode) -> None:
         self._dead -= 1  # only dead nodes ever reach the eviction walk

@@ -53,10 +53,21 @@ from paddle_tpu.observability.metrics import (  # noqa: F401
     Counter,
     Gauge,
     Histogram,
+    MetricScope,
     MetricsRegistry,
     GLOBAL_METRICS,
     get_registry,
     metrics_enabled,
+)
+from paddle_tpu.observability.slo import (  # noqa: F401
+    BurnRateMonitor,
+    SLOConfig,
+    SLO_STATE_NAMES,
+)
+from paddle_tpu.observability.aggregate import (  # noqa: F401
+    ClusterObserver,
+    FLEET_COUNTER_FAMILIES,
+    INCIDENT_SCHEMA,
 )
 from paddle_tpu.observability.recompile import (  # noqa: F401
     CAUSE_FIRST_CALL,
@@ -69,6 +80,7 @@ from paddle_tpu.observability.recompile import (  # noqa: F401
 )
 from paddle_tpu.observability.exporters import (  # noqa: F401
     drain_trace_events,
+    render_exposition,
     start_metrics_server,
     stop_metrics_server,
     write_snapshot_jsonl,
@@ -100,10 +112,17 @@ __all__ = [
     "Counter",
     "Gauge",
     "Histogram",
+    "MetricScope",
     "MetricsRegistry",
     "GLOBAL_METRICS",
     "get_registry",
     "metrics_enabled",
+    "BurnRateMonitor",
+    "SLOConfig",
+    "SLO_STATE_NAMES",
+    "ClusterObserver",
+    "FLEET_COUNTER_FAMILIES",
+    "INCIDENT_SCHEMA",
     "CAUSE_FIRST_CALL",
     "CAUSE_MODE_FLIP",
     "CAUSE_NEW_SHAPE_DTYPE",
@@ -112,6 +131,7 @@ __all__ = [
     "RecompileWatchdog",
     "get_watchdog",
     "drain_trace_events",
+    "render_exposition",
     "start_metrics_server",
     "stop_metrics_server",
     "write_snapshot_jsonl",

@@ -1,0 +1,348 @@
+"""Cluster aggregation: the fleet's one observability surface.
+
+PRs 11–14 built the cluster (router, tp shard groups, host KV tier) but
+its evidence stayed per-process: metric families mixed every replica into
+one unscoped soup, ``/healthz`` knew one frontend, and a failover incident
+scattered its story across N flight rings and a span buffer that nothing
+correlated. This module joins them:
+
+- :class:`ClusterObserver` rides the router's probe loop (attached via
+  ``router.attach_observer``): it feeds the
+  :class:`~paddle_tpu.observability.slo.BurnRateMonitor` cluster-truth
+  samples every tick, serves the fleet ``/metrics`` (replica-labeled text
+  exposition — the scoped cells from ``MetricScope``) and the cluster
+  ``/healthz`` (router state + per-replica UP/DEGRADED/DEAD/DRAINING,
+  tp_degree, kv-tier, spec acceptance, the SLO block), and reconciles
+  fleet sums over the replica-scoped series (:meth:`fleet_counters` —
+  every family name it reads is a literal validated by analyzer check
+  OB602 and resolved through the strict ``registry.family()``).
+- **coordinated incident snapshots**: entering PAGE, any replica death
+  (which is how a pump death surfaces at cluster level), and
+  all-replicas-dead each dump ONE incident directory under a versioned
+  schema (``paddle_tpu.incident/v1``): every replica's own flight ring,
+  the process-global ring, the router's recent routing decisions, the
+  sampled span buffer, and the cluster health view — rendered as a single
+  cross-replica timeline by ``python -m paddle_tpu.observability.dump
+  <dir>`` (including a failed-over request's spans from BOTH replicas
+  assembled into one tree by trace_id). Writes are best-effort by the
+  flight-recorder contract (an incident writer that raises into the probe
+  loop would *be* an incident) and rate-limited per reason
+  (``FLAGS_incident_cooldown_s``).
+
+Import discipline: this module must not import the serving package at
+module scope (``serving`` imports ``observability`` first) — replicas and
+the router are duck-typed.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import shutil
+import tempfile
+import time
+from typing import Any, Dict, List, Optional
+
+from paddle_tpu.flags import GLOBAL_FLAGS
+from paddle_tpu.observability import flight_recorder as _flight
+from paddle_tpu.observability import metrics as _metrics
+from paddle_tpu.observability import tracing as _tracing
+from paddle_tpu.observability.slo import PAGE, BurnRateMonitor, SLOConfig
+
+__all__ = [
+    "FLEET_COUNTER_FAMILIES",
+    "INCIDENT_SCHEMA",
+    "ClusterObserver",
+]
+
+INCIDENT_SCHEMA = "paddle_tpu.incident/v1"
+
+# every fleet-aggregated counter family, by its registered name — read
+# back through the strict registry.family() (OB602 validates these
+# literals against the package's registered families; a family absent at
+# runtime — e.g. kv_tier_* with the tier off — reports as "unregistered"
+# rather than silently reading zeros)
+FLEET_COUNTER_FAMILIES = (
+    "engine_requests_admitted_total",
+    "engine_requests_finished_total",
+    "engine_slots_evicted_total",
+    "engine_recoveries_total",
+    "engine_requests_replayed_total",
+    "engine_prefill_tokens_computed_total",
+    "spec_decode_drafted_tokens_total",
+    "spec_decode_accepted_tokens_total",
+    "spec_decode_rejected_tokens_total",
+    "prefix_cache_hits_total",
+    "prefix_cache_misses_total",
+    "prefix_cache_evictions_total",
+    "kv_tier_spilled_blocks_total",
+    "kv_tier_prefetched_blocks_total",
+    "kv_tier_dropped_blocks_total",
+    "serving_requests_total",
+    "serving_shed_total",
+    "serving_tokens_total",
+    "serving_goodput_tokens_total",
+)
+
+
+class ClusterObserver:
+    """See the module docstring. Construct over a
+    :class:`~paddle_tpu.serving.router.ReplicaRouter`; attaches itself.
+
+    ``on_tick_locked``/``on_transition_locked`` are called by the router
+    UNDER the router lock (lock order router -> frontend -> engine holds
+    for everything they touch); the HTTP-facing reads (:meth:`healthz`,
+    :meth:`render_metrics`, :meth:`fleet_counters`) take no router lock
+    themselves beyond what ``router.snapshot()`` does."""
+
+    def __init__(
+        self,
+        router: Any,
+        slo_config: Optional[SLOConfig] = None,
+        incident_dir: Optional[str] = None,
+        incident_cooldown_s: Optional[float] = None,
+    ) -> None:
+        self.router = router
+        # replica scoping is anchored to the process-global registry
+        # (set_replica_scope resolves scopes there), so the fleet reads
+        # must be too — a parallel registry would silently read empty
+        self.registry = _metrics.GLOBAL_METRICS
+        self.monitor = BurnRateMonitor(slo_config)
+        self._incident_dir = incident_dir
+        self._cooldown = (
+            float(GLOBAL_FLAGS.get("incident_cooldown_s"))
+            if incident_cooldown_s is None
+            else float(incident_cooldown_s)
+        )
+        self._incident_seq = itertools.count()
+        self._pending_tmp: Optional[str] = None  # staging dir of an in-flight write
+        self._last_incident: Dict[str, float] = {}
+        self.incidents: List[str] = []  # paths of written incident dirs
+        # the TTFT p99 the router samples must age on the monitor's slow
+        # window, or a storm's latencies would hold WARN/PAGE on a quiet
+        # cluster long after recovery
+        router.set_ttft_window(self.monitor.config.slow_window_s)
+        router.attach_observer(self)
+
+    # -- probe-loop seams (called under the router lock) ----------------------
+    def on_tick_locked(self, now: float) -> None:
+        if not self.monitor.would_accept(now):
+            return  # don't build the sample the rate bound would drop
+        prev = self.monitor.state
+        state = self.monitor.observe(now, self.router._slo_sample_locked(now))
+        if state == PAGE and prev != PAGE:
+            self._maybe_incident_locked("slo_page", now)
+
+    def on_transition_locked(
+        self, replica: Any, frm: str, to: str, now: float
+    ) -> None:
+        if to != "dead":
+            return
+        # a pump death is observed by the probe as a DEAD transition, so
+        # this one seam coordinates both; all-dead gets its own reason
+        reason = (
+            "all_replicas_dead"
+            if not any(r.alive for r in self.router.cluster)
+            else f"replica_death_{replica.name}"
+        )
+        self._maybe_incident_locked(reason, now)
+
+    def _maybe_incident_locked(self, reason: str, now: float) -> None:
+        last = self._last_incident.get(reason)
+        if last is not None and now - last < self._cooldown:
+            return
+        path = self.write_incident(reason)
+        if path is not None:
+            # the cooldown limits successful duplicate postmortems; a FAILED
+            # write (full disk, bad dir) must not suppress the next attempt
+            # at capturing first evidence — retry frequency is naturally
+            # bounded by the triggers (state/replica transitions)
+            self._last_incident[reason] = now
+            self.incidents.append(path)
+
+    # -- fleet endpoints ------------------------------------------------------
+    def render_metrics(self) -> str:
+        """The fleet ``/metrics`` body: the whole registry's text
+        exposition — replica-scoped cells render with their ``replica=``
+        label next to the unscoped ones, so one scrape shows every
+        replica's series AND the process-level families. The single-process
+        ``start_metrics_server`` serves the SAME exposition (one renderer,
+        two ports — the formats agree by construction)."""
+        from paddle_tpu.observability.exporters import render_exposition
+
+        return render_exposition(self.registry)
+
+    def healthz(self) -> Dict[str, Any]:
+        """The cluster ``/healthz`` payload: router truth, per-replica
+        state + capability blocks, the SLO monitor block."""
+        replicas: Dict[str, Any] = {}
+        for r in self.router.cluster:
+            entry: Dict[str, Any] = {
+                "state": r.state,
+                "generation": r.generation,
+                "tp_degree": r.tp_degree,
+            }
+            try:
+                snap = r.frontend.snapshot()
+                entry.update(
+                    {
+                        "level": snap.get("level"),
+                        "queue_depth": snap.get("queue_depth"),
+                        "live_requests": snap.get("live_requests"),
+                        "kv_utilization": snap.get("kv_utilization"),
+                        "kv_tier": snap.get("kv_tier"),
+                        "spec_decode": snap.get("spec_decode"),
+                        "tensor_parallel": snap.get("tensor_parallel"),
+                    }
+                )
+            except Exception as exc:  # noqa: BLE001 - a dead replica's snapshot must not kill the fleet healthz
+                entry["error"] = f"{type(exc).__name__}: {exc}"[:200]
+            replicas[r.name] = entry
+        return {
+            "cluster": self.router.snapshot(),
+            "replicas": replicas,
+            "slo": self.monitor.snapshot(),
+        }
+
+    def fleet_counters(self) -> Dict[str, Any]:
+        """Fleet roll-up of every :data:`FLEET_COUNTER_FAMILIES` family:
+        per-replica scoped totals, their fleet sum, and the unscoped total
+        (router-level recordings). The churn property test reconciles these
+        against cluster truth after every operation."""
+        out: Dict[str, Any] = {}
+        for name in FLEET_COUNTER_FAMILIES:
+            try:
+                fam = self.registry.family(name)
+            except KeyError:
+                # registered only when its subsystem is on (e.g. kv_tier_*);
+                # named explicitly so a typo can never hide as "off"
+                out[name] = {"unregistered": True}
+                continue
+            per_replica = {
+                scope[0]: fam.scope_total(scope) for scope in fam.scopes()
+            }
+            out[name] = {
+                "per_replica": per_replica,
+                "fleet": sum(per_replica.values()),
+                "unscoped": fam.total(),
+            }
+        return out
+
+    # -- coordinated incident snapshots ---------------------------------------
+    def _incident_base(self) -> str:
+        if self._incident_dir:
+            return self._incident_dir
+        configured = str(GLOBAL_FLAGS.get("incident_dir"))
+        if configured:
+            return configured
+        flight_dir = str(GLOBAL_FLAGS.get("flight_recorder_dir"))
+        if flight_dir:
+            return flight_dir
+        return os.path.join(tempfile.gettempdir(), "paddle_tpu_incidents")
+
+    def write_incident(self, reason: str, base_dir: Optional[str] = None) -> Optional[str]:
+        """Write ONE correlated incident directory; returns its path, or
+        None on any failure — the incident writer runs on the probe loop
+        and on death seams, where raising would compound the failure it is
+        documenting (the flight recorder's ``safe_dump`` contract).
+
+        Runs synchronously (and, from the probe seams, under the router
+        lock): incidents are rare and cooldown-limited, and the evidence is
+        captured at the moment of the trigger — the routing stall is one
+        bounded multi-file write, a deliberate trade against snapshotting
+        state that keeps mutating while an async writer catches up."""
+        self._pending_tmp = None
+        try:
+            return self._write_incident(reason, base_dir)
+        except Exception:  # noqa: BLE001 - best-effort by contract on failure seams
+            tmp = self._pending_tmp
+            if tmp is not None:
+                # a failed write is retried on the next trigger (no
+                # cooldown); it must not accrete torn .tmp staging dirs
+                shutil.rmtree(tmp, ignore_errors=True)
+            return None
+        finally:
+            self._pending_tmp = None
+
+    def _write_incident(self, reason: str, base_dir: Optional[str]) -> str:
+        base = base_dir or self._incident_base()
+        os.makedirs(base, exist_ok=True)
+        safe_reason = "".join(
+            c if c.isalnum() or c in "-_" else "_" for c in reason
+        )[:64]
+        n = next(self._incident_seq)
+        final = os.path.join(
+            base, f"incident_{os.getpid()}_{n}_{safe_reason}"
+        )
+        # uniquify against another observer (or PID reuse in a persistent
+        # incident dir): a name collision must never drop the evidence
+        suffix = 0
+        while os.path.exists(final):
+            suffix += 1
+            final = os.path.join(
+                base, f"incident_{os.getpid()}_{n}_{safe_reason}_{suffix}"
+            )
+        tmp = tempfile.mkdtemp(prefix=os.path.basename(final) + ".tmp.", dir=base)
+        self._pending_tmp = tmp  # cleaned up by write_incident on failure
+        files: Dict[str, Any] = {"flight": [], "spans": None, "routing": "routing.json"}
+        # 1) every replica's own flight ring (dead ones included: their ring
+        # is exactly the evidence), each a standard flight dump file
+        for r in self.router.cluster:
+            rec = getattr(r.frontend, "flight", None)
+            if rec is None or rec is _flight.GLOBAL_FLIGHT_RECORDER:
+                continue  # unscoped frontend: its events are in the global ring
+            fname = f"flight_{r.name}.json"
+            rec.dump(
+                reason, path=os.path.join(tmp, fname),
+                extra={"replica": r.name, "generation": r.generation,
+                       "state": r.state},
+            )
+            files["flight"].append(fname)
+        # 2) the process-global ring (router events + anything unscoped)
+        _flight.GLOBAL_FLIGHT_RECORDER.dump(
+            reason, path=os.path.join(tmp, "flight_global.json"),
+            extra={"scope": "global"},
+        )
+        files["flight"].append("flight_global.json")
+        # 3) the router's recent routing decisions + accounting
+        routing = {
+            "log": self.router.routing_log(),
+            "counters": self.router.routing_counters(),
+            "dispatches": self.router.dispatch_count(),
+            "sheds": self.router.shed_counters(),
+            "salvaged": self.router.salvaged_count(),
+        }
+        with open(os.path.join(tmp, "routing.json"), "w") as f:
+            json.dump(routing, f, indent=1, default=str)
+        # 4) the sampled span buffer (cross-replica failover trees live here)
+        n_spans = _tracing.GLOBAL_TRACER.export_jsonl(
+            os.path.join(tmp, "spans.jsonl")
+        )
+        if n_spans:
+            files["spans"] = "spans.jsonl"
+        else:
+            os.remove(os.path.join(tmp, "spans.jsonl"))
+        # 5) the manifest LAST (a dir without incident.json is visibly torn),
+        # then the atomic directory commit
+        manifest = {
+            "schema": INCIDENT_SCHEMA,
+            "reason": reason,
+            "pid": os.getpid(),
+            "walltime": time.time(),
+            "replicas": [r.name for r in self.router.cluster],
+            "files": files,
+            "healthz": self.healthz(),
+        }
+        with open(os.path.join(tmp, "incident.json"), "w") as f:
+            json.dump(manifest, f, indent=1, default=str)
+            f.flush()
+            os.fsync(f.fileno())
+        for attempt in range(8):  # racing writer grabbed the name: re-uniquify
+            try:
+                os.rename(tmp, final)
+                return final
+            except OSError:
+                final = f"{final}_{attempt}"
+        os.rename(tmp, final)
+        return final

@@ -1,4 +1,4 @@
-"""CLI: ``python -m paddle_tpu.observability.dump [--to-chrome OUT] file``
+"""CLI: ``python -m paddle_tpu.observability.dump [--to-chrome OUT] path``
 
 Postmortem reader for the observability artifacts:
 
@@ -8,9 +8,18 @@ Postmortem reader for the observability artifacts:
   newest event;
 - a **span JSONL** (``Tracer.export_jsonl`` output) is summarized per
   trace, or converted to a chrome-trace JSON with ``--to-chrome OUT``
-  (load it in ``chrome://tracing`` / Perfetto).
+  (load it in ``chrome://tracing`` / Perfetto);
+- an **incident directory** (``incident_*/``, schema
+  ``paddle_tpu.incident/v1`` — written by
+  ``observability.aggregate.ClusterObserver``) is rendered as ONE
+  cross-replica timeline: every replica's flight ring plus the global ring
+  merged by timestamp with a source column, the router's recent routing
+  decisions, the SLO state timeline, and the sampled span trees — a
+  failed-over request's spans from BOTH replicas assemble into one tree by
+  trace_id, each span annotated with the replica that emitted it.
 
-Exit status: 0 on success, 2 on a missing, empty or corrupt file — the
+Exit status: 0 on success, 2 on a missing, empty or corrupt file or
+incident directory (including a manifest referencing a missing ring) — the
 same no-vacuous-pass discipline as the analyzer CLI: a typo'd path in a
 postmortem script must fail loudly, never print an empty timeline.
 """
@@ -23,6 +32,7 @@ import os
 import sys
 from typing import Any, Dict, List, Optional
 
+from paddle_tpu.observability.aggregate import INCIDENT_SCHEMA
 from paddle_tpu.observability.flight_recorder import DUMP_SCHEMA
 from paddle_tpu.observability.tracing import Tracer
 
@@ -96,26 +106,51 @@ def _print_spans(records: List[Dict[str, Any]]) -> None:
         traces.setdefault(str(s.get("trace_id")), []).append(s)
     print(f"{len(spans)} spans, {len(events)} events, {len(traces)} traces")
     for tid, group in traces.items():
-        group.sort(key=lambda s: s["ts_us"])
-        print(f"trace {tid}:")
-        by_id = {s.get("span_id"): s for s in group}
-        for s in group:
-            depth = 0
-            cur = s
-            seen = set()  # a corrupt cyclic parent chain must not hang us
-            while (
-                cur is not None
-                and cur.get("parent_id") in by_id
-                and id(cur) not in seen
-            ):
-                seen.add(id(cur))
-                depth += 1
-                cur = by_id[cur["parent_id"]]
-            dur_ms = float(s.get("dur_us", 0.0)) / 1e3
-            print(
-                f"  {'  ' * depth}{s['name']}  {dur_ms:.3f} ms"
-                f"  [{s.get('status', 'ok')}]"
-            )
+        _print_trace_tree(tid, group)
+
+
+def _span_replicas(group: List[Dict[str, Any]]) -> List[str]:
+    """Every replica named by a trace's spans (the ``replica`` attr the
+    scoped frontends stamp, plus the router.failover bridge's endpoints)."""
+    out: List[str] = []
+    for s in group:
+        attrs = s.get("attrs") or {}
+        for key in ("replica", "from_replica", "to_replica"):
+            v = attrs.get(key)
+            if v is not None and str(v) not in out:
+                out.append(str(v))
+    return out
+
+
+def _print_trace_tree(tid: str, group: List[Dict[str, Any]]) -> None:
+    group.sort(key=lambda s: s["ts_us"])
+    replicas = _span_replicas(group)
+    tag = f"  [replicas: {', '.join(replicas)}]" if len(replicas) > 1 else ""
+    print(f"trace {tid}:{tag}")
+    by_id = {s.get("span_id"): s for s in group}
+    for s in group:
+        depth = 0
+        cur = s
+        seen = set()  # a corrupt cyclic parent chain must not hang us
+        while (
+            cur is not None
+            and cur.get("parent_id") in by_id
+            and id(cur) not in seen
+        ):
+            seen.add(id(cur))
+            depth += 1
+            cur = by_id[cur["parent_id"]]
+        dur_ms = float(s.get("dur_us", 0.0)) / 1e3
+        attrs = s.get("attrs") or {}
+        note = ""
+        if attrs.get("replica") is not None:
+            note = f"  @{attrs['replica']}"
+        elif attrs.get("from_replica") is not None:
+            note = f"  @{attrs['from_replica']}->{attrs.get('to_replica')}"
+        print(
+            f"  {'  ' * depth}{s['name']}  {dur_ms:.3f} ms"
+            f"  [{s.get('status', 'ok')}]{note}"
+        )
 
 
 def _to_chrome(records: List[Dict[str, Any]], out: str) -> int:
@@ -131,13 +166,167 @@ def _to_chrome(records: List[Dict[str, Any]], out: str) -> int:
     return len(events)
 
 
+class _CorruptIncident(ValueError):
+    pass
+
+
+def _load_incident(dirpath: str) -> Dict[str, Any]:
+    """Validate + load an incident directory; raises ``_CorruptIncident``
+    on anything short of a complete, schema-correct incident — a partial
+    dir must fail the postmortem script, never render a partial story."""
+    manifest_path = os.path.join(dirpath, "incident.json")
+    if not os.path.isfile(manifest_path):
+        raise _CorruptIncident("no incident.json manifest (torn or not an incident dir)")
+    try:
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+    except ValueError as exc:
+        raise _CorruptIncident(f"incident.json is not valid JSON: {exc}") from exc
+    if not isinstance(manifest, dict) or manifest.get("schema") != INCIDENT_SCHEMA:
+        raise _CorruptIncident(
+            f"manifest schema is {manifest.get('schema')!r}, expected {INCIDENT_SCHEMA!r}"
+        )
+    files = manifest.get("files") or {}
+    rings: Dict[str, Dict[str, Any]] = {}
+    for fname in files.get("flight", []):
+        ring_path = os.path.join(dirpath, fname)
+        if not os.path.isfile(ring_path):
+            raise _CorruptIncident(f"manifest references missing ring file {fname}")
+        try:
+            with open(ring_path) as f:
+                ring = json.load(f)
+        except ValueError as exc:
+            raise _CorruptIncident(f"{fname} is not valid JSON: {exc}") from exc
+        if not isinstance(ring, dict) or ring.get("schema") != DUMP_SCHEMA:
+            raise _CorruptIncident(f"{fname} is not a flight dump")
+        rings[fname] = ring
+    spans: List[Dict[str, Any]] = []
+    span_file = files.get("spans")
+    if span_file:
+        span_path = os.path.join(dirpath, span_file)
+        if not os.path.isfile(span_path):
+            raise _CorruptIncident(f"manifest references missing span file {span_file}")
+        with open(span_path) as f:
+            for lineno, line in enumerate(f, 1):
+                if not line.strip():
+                    continue
+                try:
+                    spans.append(json.loads(line))
+                except ValueError as exc:
+                    raise _CorruptIncident(
+                        f"{span_file} line {lineno} is not valid JSON: {exc}"
+                    ) from exc
+    routing: Optional[Dict[str, Any]] = None
+    routing_file = files.get("routing")
+    if routing_file:
+        routing_path = os.path.join(dirpath, routing_file)
+        if not os.path.isfile(routing_path):
+            # same fail-loud contract as the rings: a manifest-referenced
+            # artifact that is gone means a torn copy, not an empty section
+            raise _CorruptIncident(
+                f"manifest references missing routing file {routing_file}"
+            )
+        try:
+            with open(routing_path) as f:
+                routing = json.load(f)
+        except ValueError as exc:
+            raise _CorruptIncident(f"{routing_file} is not valid JSON: {exc}") from exc
+    return {"manifest": manifest, "rings": rings, "spans": spans, "routing": routing}
+
+
+def _ring_source(fname: str, ring: Dict[str, Any]) -> str:
+    scope = ring.get("scope") or {}
+    if scope.get("replica"):
+        return str(scope["replica"])
+    if fname == "flight_global.json":
+        return "global"
+    return fname.replace("flight_", "").replace(".json", "")
+
+
+def _print_incident(incident: Dict[str, Any]) -> None:
+    manifest = incident["manifest"]
+    print(f"incident — reason: {manifest.get('reason', '?')}")
+    print(
+        f"pid {manifest.get('pid', '?')}, walltime {manifest.get('walltime', '?')}, "
+        f"replicas: {', '.join(manifest.get('replicas', []))}"
+    )
+    healthz = manifest.get("healthz") or {}
+    replicas = healthz.get("replicas") or {}
+    if replicas:
+        states = ", ".join(f"{n}={e.get('state')}" for n, e in sorted(replicas.items()))
+        print(f"replica states: {states}")
+    slo = healthz.get("slo") or {}
+    if slo:
+        print(f"slo state: {slo.get('state')}  burn: {json.dumps(slo.get('burn', {}))}")
+        for e in slo.get("timeline", []):
+            print(
+                f"  slo {e.get('from')} -> {e.get('to')} "
+                f"(signal={e.get('signal')}, burn={e.get('burn')})"
+            )
+    # ONE cross-replica timeline: every ring's events, tagged + merged.
+    # The global ring holds the tagged tee of every replica event plus the
+    # untagged router/process events — dedup by identity (seq, ts, source)
+    merged: List[Dict[str, Any]] = []
+    seen = set()
+    for fname, ring in sorted(incident["rings"].items()):
+        source = _ring_source(fname, ring)
+        for e in ring.get("events", []):
+            src = str(e.get("replica", source if source != "global" else "process"))
+            key = (e.get("seq"), e.get("ts_us"), src, e.get("kind"))
+            if key in seen:
+                continue
+            seen.add(key)
+            merged.append({**e, "_source": src})
+    merged.sort(key=lambda e: float(e.get("ts_us", 0.0)))
+    print(f"\ncross-replica timeline ({len(merged)} events):")
+    if merged:
+        newest = max(float(e.get("ts_us", 0.0)) for e in merged)
+        print(f"{'t-rel':>10}  {'source':<10} {'kind':<24} fields")
+        for e in merged:
+            rel = (float(e.get("ts_us", 0.0)) - newest) / 1e6
+            fields = {
+                k: v for k, v in e.items()
+                if k not in ("seq", "ts_us", "walltime", "kind", "_source", "replica")
+            }
+            print(
+                f"{rel:>+9.3f}s  {e['_source']:<10} "
+                f"{str(e.get('kind', '?')):<24} {json.dumps(fields, default=str)}"
+            )
+    routing = incident.get("routing")
+    if routing:
+        log = routing.get("log", [])
+        print(
+            f"\nrouting: {routing.get('dispatches', 0)} dispatches, "
+            f"counters {json.dumps(routing.get('counters', {}))}, "
+            f"sheds {json.dumps(routing.get('sheds', {}))}, "
+            f"salvaged {routing.get('salvaged', 0)}"
+        )
+        for entry in log[-20:]:
+            print(f"  {json.dumps(entry, default=str)}")
+    spans = [r for r in incident["spans"] if r.get("kind", "span") == "span"]
+    if spans:
+        traces: Dict[str, List[Dict[str, Any]]] = {}
+        for s in spans:
+            traces.setdefault(str(s.get("trace_id")), []).append(s)
+        # cross-replica traces first: the failover story is the headline
+        def cross(tid: str) -> int:
+            return -len(_span_replicas(traces[tid]))
+
+        print(f"\nspan trees ({len(spans)} spans, {len(traces)} traces):")
+        for tid in sorted(traces, key=cross):
+            _print_trace_tree(tid, traces[tid])
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m paddle_tpu.observability.dump",
         description="Pretty-print a flight-recorder dump, or summarize / "
         "convert a tracer span JSONL.",
     )
-    ap.add_argument("path", help="flight-recorder dump (.json) or span JSONL")
+    ap.add_argument(
+        "path",
+        help="flight-recorder dump (.json), span JSONL, or incident directory",
+    )
     ap.add_argument(
         "--to-chrome",
         metavar="OUT",
@@ -145,6 +334,30 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     args = ap.parse_args(argv)
 
+    if os.path.isdir(args.path):
+        try:
+            incident = _load_incident(args.path)
+        except (_CorruptIncident, OSError) as exc:
+            print(
+                f"error: cannot read incident dir {args.path}: {exc}",
+                file=sys.stderr,
+            )
+            return 2
+        if args.to_chrome:
+            # convert the incident's sampled span buffer (an explicitly
+            # requested conversion must never be silently dropped)
+            if not incident["spans"]:
+                print(
+                    f"error: incident {args.path} carries no span buffer "
+                    "to convert",
+                    file=sys.stderr,
+                )
+                return 2
+            n = _to_chrome(incident["spans"], args.to_chrome)
+            print(f"wrote {n} traceEvents to {args.to_chrome}")
+            return 0
+        _print_incident(incident)
+        return 0
     if not os.path.isfile(args.path):
         print(f"error: no such file: {args.path}", file=sys.stderr)
         return 2

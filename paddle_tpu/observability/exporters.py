@@ -27,11 +27,23 @@ from paddle_tpu.flags import GLOBAL_FLAGS
 from . import metrics as _metrics
 
 __all__ = [
+    "render_exposition",
     "write_snapshot_jsonl",
     "drain_trace_events",
     "start_metrics_server",
     "stop_metrics_server",
 ]
+
+
+def render_exposition(registry: Optional["_metrics.MetricsRegistry"] = None) -> str:
+    """THE text-exposition renderer: every ``/metrics`` endpoint — the
+    process-level ``start_metrics_server`` and the fleet endpoint on the
+    multi-replica serving server — goes through this one function, so the
+    formats agree by construction. Replica-scoped cells (``MetricScope``)
+    render with their ``replica="..."`` label next to the unscoped
+    process-level cells; in a multi-replica process there is no ambiguous
+    unscoped mix — each replica's series is attributable."""
+    return (registry or _metrics.GLOBAL_METRICS).render_prometheus()
 
 _trace_events: List[Dict[str, Any]] = []
 _trace_lock = threading.Lock()
@@ -88,7 +100,7 @@ class _MetricsHandler(BaseHTTPRequestHandler):
         if self.path.split("?", 1)[0] != "/metrics":
             self.send_error(404, "try /metrics")
             return
-        body = _metrics.GLOBAL_METRICS.render_prometheus().encode()
+        body = render_exposition().encode()
         self.send_response(200)
         self.send_header("Content-Type", "text/plain; version=0.0.4; charset=utf-8")
         self.send_header("Content-Length", str(len(body)))

@@ -82,9 +82,21 @@ def _redact(obj: Any) -> Any:
 
 
 class FlightRecorder:
-    """Bounded ring of recent structured events + crash-consistent dumps."""
+    """Bounded ring of recent structured events + crash-consistent dumps.
 
-    def __init__(self, capacity: Optional[int] = None) -> None:
+    A recorder can have scoped CHILD rings (:meth:`child`): one ring per
+    replica, each event tagged with the scope fields and teed into the
+    parent — the process-global black box stays complete (the three
+    permanent-failure dump seams still capture everything) while the
+    cluster incident writer can dump each replica's own ring as a separate,
+    attributable file."""
+
+    def __init__(
+        self,
+        capacity: Optional[int] = None,
+        scope: Optional[Dict[str, str]] = None,
+        parent: Optional["FlightRecorder"] = None,
+    ) -> None:
         cap = int(
             GLOBAL_FLAGS.get("flight_recorder_size") if capacity is None else capacity
         )
@@ -94,21 +106,45 @@ class FlightRecorder:
         self._seq = itertools.count()
         self._dump_seq = itertools.count()
         self._lock = threading.Lock()  # dumps only; record() never takes it
+        self._scope = dict(scope) if scope else None
+        self._parent = parent
+
+    def child(self, **scope: Any) -> "FlightRecorder":
+        """A scoped ring (e.g. ``recorder.child(replica="r0")``). Events
+        recorded through the child land in the child's own ring AND —
+        tagged with the scope fields — in this recorder. One level deep:
+        a child of a child tees only into its immediate parent."""
+        if not scope:
+            raise ValueError("a child flight recorder needs at least one scope field")
+        return FlightRecorder(
+            # analysis: disable=CC701 maxlen is an immutable deque attribute — no ring state is read
+            capacity=self._events.maxlen,
+            scope={k: str(v) for k, v in scope.items()},
+            parent=self,
+        )
 
     def record(self, kind: str, **fields: Any) -> None:
         """Record one event. Lock-free (deque.append is atomic), always on —
         this is the per-admit/per-evict cost, so it stays one small dict
-        build + one append. Callers must not pass prompt content."""
+        build + one append (two when scoped: the tee into the parent ring).
+        Callers must not pass prompt content."""
+        if self._scope is not None:
+            # explicit fields win: a router event that already names its
+            # replica is never clobbered by the ring's own scope tag
+            fields = {**self._scope, **fields}
+        event = {
+            "seq": next(self._seq),
+            "ts_us": time.perf_counter() * 1e6,
+            "walltime": time.time(),
+            "kind": kind,
+            **fields,
+        }
         # analysis: disable=CC701 lock-free by design: deque.append is atomic and snapshot() copies defensively with bounded retry
-        self._events.append(
-            {
-                "seq": next(self._seq),
-                "ts_us": time.perf_counter() * 1e6,
-                "walltime": time.time(),
-                "kind": kind,
-                **fields,
-            }
-        )
+        self._events.append(event)
+        if self._parent is not None:
+            # the same dict object lands in both rings (events are written
+            # once and never mutated); the parent keeps its own capacity
+            self._parent._events.append(event)
 
     def snapshot(self) -> List[Dict[str, Any]]:
         # record() is deliberately lock-free, so copy defensively: a
@@ -171,6 +207,7 @@ class FlightRecorder:
                 "reason": reason,
                 "pid": os.getpid(),
                 "walltime": time.time(),
+                "scope": dict(self._scope) if self._scope else None,
                 "extra": _redact(dict(extra) if extra else {}),
                 "events": [_redact(e) for e in self.snapshot()],
             }

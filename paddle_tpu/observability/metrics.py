@@ -15,6 +15,17 @@ dict lookup. Metric *definition* is always allowed; only recording is gated.
 Histograms use fixed log-scale buckets (``start * factor**i``), the shape
 that keeps decode-latency percentiles meaningful across four orders of
 magnitude without per-request allocation.
+
+Scoping (fleet observability): a :class:`MetricScope` is a set of label
+pairs — ``registry.scope(replica="r0")`` — resolved ONCE; binding a family
+through it (``scope.bind(family)`` / ``scope.bind_all(families)``) returns
+a handle with the same recording API whose cells carry the scope labels
+appended, so every ``engine_*``/``serving_*`` series a replica records is
+attributable per replica while still rolling up into the ONE process-global
+family (exposition renders scoped cells with ``replica="..."`` labels next
+to the unscoped ones). Per-record cost of a scoped handle is identical to
+an unscoped one: the same single cached-bool read on the off path, the same
+one family-lock acquisition when recording.
 """
 
 from __future__ import annotations
@@ -29,6 +40,7 @@ __all__ = [
     "Counter",
     "Gauge",
     "Histogram",
+    "MetricScope",
     "MetricsRegistry",
     "GLOBAL_METRICS",
     "get_registry",
@@ -70,7 +82,11 @@ def _fmt_labels(names: Sequence[str], key: Sequence[str], extra: str = "") -> st
 
 
 class _Metric:
-    """Base: a named family of cells keyed by label-value tuples."""
+    """Base: a named family of cells keyed by label-value tuples.
+
+    Scoped cells (see :class:`MetricScope`) live beside the unscoped ones,
+    keyed by the scope's label-value tuple: one family, one lock, one name —
+    the scope labels only appear at exposition time."""
 
     kind = "untyped"
 
@@ -80,6 +96,10 @@ class _Metric:
         self.labelnames = tuple(labelnames)
         self._lock = threading.Lock()
         self._cells: Dict[Tuple[str, ...], Any] = {}
+        # scope label NAMES are family-wide (first registration wins, a
+        # conflicting second scope raises); cells per scope VALUE tuple
+        self._scope_labelnames: Tuple[str, ...] = ()
+        self._scoped: Dict[Tuple[str, ...], Dict[Tuple[str, ...], Any]] = {}
 
     def _label_key(self, kv: Dict[str, Any]) -> Tuple[str, ...]:
         if set(kv) != set(self.labelnames):
@@ -88,30 +108,91 @@ class _Metric:
             )
         return tuple(str(kv[n]) for n in self.labelnames)
 
+    def _register_scope(self, names: Tuple[str, ...], values: Tuple[str, ...]) -> None:
+        with self._lock:
+            if self._scope_labelnames and self._scope_labelnames != names:
+                raise ValueError(
+                    f"metric '{self.name}' already scoped by "
+                    f"{self._scope_labelnames}, cannot also scope by {names}"
+                )
+            if not self._scope_labelnames:
+                if set(names) & set(self.labelnames):
+                    raise ValueError(
+                        f"scope labels {names} collide with metric "
+                        f"'{self.name}' labels {self.labelnames}"
+                    )
+                self._scope_labelnames = names
+            self._scoped.setdefault(values, {})
+
+    def _cells_for(self, scope: Optional[Tuple[str, ...]]) -> Dict[Tuple[str, ...], Any]:
+        # caller holds self._lock
+        if scope is None:
+            return self._cells
+        cells = self._scoped.get(scope)
+        if cells is None:
+            cells = self._scoped.setdefault(scope, {})
+        return cells
+
     def reset(self) -> None:
         with self._lock:
             self._cells.clear()
+            for cells in self._scoped.values():
+                cells.clear()
 
     @staticmethod
     def _copy_cell(cell: Any) -> Any:
         return cell  # Counter cells are plain floats; mutable kinds override
 
-    def _sorted_cells(self) -> List[Tuple[Tuple[str, ...], Any]]:
-        # copy mutable cell state while holding the lock: a scrape/snapshot
-        # concurrent with recording must never see a half-applied update
-        # (e.g. a histogram bucket bumped but its count not yet)
+    def _all_sorted_cells(self) -> List[Tuple[Optional[Tuple[str, ...]], Tuple[str, ...], Any]]:
+        """Every cell as ``(scope_values_or_None, label_key, copied_cell)``,
+        unscoped first — the exposition/snapshot surface. Cell state is
+        copied while holding the lock: a scrape/snapshot concurrent with
+        recording must never see a half-applied update (e.g. a histogram
+        bucket bumped but its count not yet)."""
         with self._lock:
-            return sorted((k, self._copy_cell(c)) for k, c in self._cells.items())
+            out: List[Tuple[Optional[Tuple[str, ...]], Tuple[str, ...], Any]] = [
+                (None, k, self._copy_cell(c)) for k, c in sorted(self._cells.items())
+            ]
+            for sv in sorted(self._scoped):
+                out.extend(
+                    (sv, k, self._copy_cell(c))
+                    for k, c in sorted(self._scoped[sv].items())
+                )
+            return out
+
+    def _full_labels(
+        self, scope: Optional[Tuple[str, ...]], key: Tuple[str, ...]
+    ) -> Tuple[Tuple[str, ...], Tuple[str, ...]]:
+        """(labelnames, labelvalues) with the scope labels prepended."""
+        if scope is None:
+            return self.labelnames, key
+        return self._scope_labelnames + self.labelnames, scope + key
+
+    def _has_cells(self) -> bool:
+        with self._lock:
+            return bool(self._cells) or any(self._scoped.values())
+
+    def scope_labelnames(self) -> Tuple[str, ...]:
+        with self._lock:
+            return self._scope_labelnames
+
+    def scopes(self) -> List[Tuple[str, ...]]:
+        """Registered scope value tuples (e.g. ``[("r0",), ("r1",)]``)."""
+        with self._lock:
+            return sorted(self._scoped)
 
 
 class _BoundCounter:
-    __slots__ = ("_m", "_key")
+    __slots__ = ("_m", "_key", "_scope")
 
-    def __init__(self, m: "Counter", key: Tuple[str, ...]) -> None:
-        self._m, self._key = m, key
+    def __init__(
+        self, m: "Counter", key: Tuple[str, ...],
+        scope: Optional[Tuple[str, ...]] = None,
+    ) -> None:
+        self._m, self._key, self._scope = m, key, scope
 
     def inc(self, n: float = 1.0) -> None:
-        self._m._inc(self._key, n)
+        self._m._inc(self._key, n, self._scope)
 
 
 class Counter(_Metric):
@@ -125,7 +206,10 @@ class Counter(_Metric):
     def inc(self, n: float = 1.0) -> None:
         self._inc((), n)
 
-    def _inc(self, key: Tuple[str, ...], n: float) -> None:
+    def _inc(
+        self, key: Tuple[str, ...], n: float,
+        scope: Optional[Tuple[str, ...]] = None,
+    ) -> None:
         if n < 0:
             # validate before the enabled gate so a buggy call site fails in
             # metrics-off test runs, not first in a metrics-on production serve
@@ -133,7 +217,8 @@ class Counter(_Metric):
         if not _ENABLED[0]:
             return
         with self._lock:
-            self._cells[key] = self._cells.get(key, 0.0) + n
+            cells = self._cells_for(scope)
+            cells[key] = cells.get(key, 0.0) + n
 
     def value(self, **kv: Any) -> float:
         key = self._label_key(kv)
@@ -144,31 +229,45 @@ class Counter(_Metric):
         with self._lock:
             return float(sum(self._cells.values()))
 
+    def scope_value(self, scope: Tuple[str, ...], **kv: Any) -> float:
+        key = self._label_key(kv)
+        with self._lock:
+            return float(self._cells_for(tuple(scope)).get(key, 0.0))
+
+    def scope_total(self, scope: Tuple[str, ...]) -> float:
+        with self._lock:
+            return float(sum(self._cells_for(tuple(scope)).values()))
+
     def _render(self, lines: List[str]) -> None:
-        for key, v in self._sorted_cells():
-            lines.append(f"{self.name}{_fmt_labels(self.labelnames, key)} {_fmt_value(v)}")
+        for sv, key, v in self._all_sorted_cells():
+            names, vals = self._full_labels(sv, key)
+            lines.append(f"{self.name}{_fmt_labels(names, vals)} {_fmt_value(v)}")
 
     def _snapshot_values(self) -> List[Dict[str, Any]]:
-        return [
-            {"labels": dict(zip(self.labelnames, key)), "value": v}
-            for key, v in self._sorted_cells()
-        ]
+        out = []
+        for sv, key, v in self._all_sorted_cells():
+            names, vals = self._full_labels(sv, key)
+            out.append({"labels": dict(zip(names, vals)), "value": v})
+        return out
 
 
 class _BoundGauge:
-    __slots__ = ("_m", "_key")
+    __slots__ = ("_m", "_key", "_scope")
 
-    def __init__(self, m: "Gauge", key: Tuple[str, ...]) -> None:
-        self._m, self._key = m, key
+    def __init__(
+        self, m: "Gauge", key: Tuple[str, ...],
+        scope: Optional[Tuple[str, ...]] = None,
+    ) -> None:
+        self._m, self._key, self._scope = m, key, scope
 
     def set(self, v: float) -> None:
-        self._m._set(self._key, v)
+        self._m._set(self._key, v, self._scope)
 
     def inc(self, n: float = 1.0) -> None:
-        self._m._add(self._key, n)
+        self._m._add(self._key, n, self._scope)
 
     def dec(self, n: float = 1.0) -> None:
-        self._m._add(self._key, -n)
+        self._m._add(self._key, -n, self._scope)
 
 
 class Gauge(_Metric):
@@ -193,20 +292,28 @@ class Gauge(_Metric):
     def dec(self, n: float = 1.0) -> None:
         self._add((), -n)
 
-    def _set(self, key: Tuple[str, ...], v: float) -> None:
+    def _set(
+        self, key: Tuple[str, ...], v: float,
+        scope: Optional[Tuple[str, ...]] = None,
+    ) -> None:
         if not _ENABLED[0]:
             return
         v = float(v)
         with self._lock:
-            cell = self._cells.setdefault(key, {"value": 0.0, "max": v})
+            cells = self._cells_for(scope)
+            cell = cells.setdefault(key, {"value": 0.0, "max": v})
             cell["value"] = v
             cell["max"] = max(cell["max"], v)
 
-    def _add(self, key: Tuple[str, ...], n: float) -> None:
+    def _add(
+        self, key: Tuple[str, ...], n: float,
+        scope: Optional[Tuple[str, ...]] = None,
+    ) -> None:
         if not _ENABLED[0]:
             return
         with self._lock:
-            cell = self._cells.setdefault(key, {"value": 0.0, "max": 0.0})
+            cells = self._cells_for(scope)
+            cell = cells.setdefault(key, {"value": 0.0, "max": 0.0})
             cell["value"] += float(n)
             cell["max"] = max(cell["max"], cell["value"])
 
@@ -222,27 +329,40 @@ class Gauge(_Metric):
             cell = self._cells.get(key)
             return float(cell["max"]) if cell else 0.0
 
+    def scope_value(self, scope: Tuple[str, ...], **kv: Any) -> float:
+        key = self._label_key(kv)
+        with self._lock:
+            cell = self._cells_for(tuple(scope)).get(key)
+            return float(cell["value"]) if cell else 0.0
+
     def _render(self, lines: List[str]) -> None:
-        for key, cell in self._sorted_cells():
+        for sv, key, cell in self._all_sorted_cells():
+            names, vals = self._full_labels(sv, key)
             lines.append(
-                f"{self.name}{_fmt_labels(self.labelnames, key)} {_fmt_value(cell['value'])}"
+                f"{self.name}{_fmt_labels(names, vals)} {_fmt_value(cell['value'])}"
             )
 
     def _snapshot_values(self) -> List[Dict[str, Any]]:
-        return [
-            {"labels": dict(zip(self.labelnames, key)), "value": cell["value"], "max": cell["max"]}
-            for key, cell in self._sorted_cells()
-        ]
+        out = []
+        for sv, key, cell in self._all_sorted_cells():
+            names, vals = self._full_labels(sv, key)
+            out.append(
+                {"labels": dict(zip(names, vals)), "value": cell["value"], "max": cell["max"]}
+            )
+        return out
 
 
 class _BoundHistogram:
-    __slots__ = ("_m", "_key")
+    __slots__ = ("_m", "_key", "_scope")
 
-    def __init__(self, m: "Histogram", key: Tuple[str, ...]) -> None:
-        self._m, self._key = m, key
+    def __init__(
+        self, m: "Histogram", key: Tuple[str, ...],
+        scope: Optional[Tuple[str, ...]] = None,
+    ) -> None:
+        self._m, self._key, self._scope = m, key, scope
 
     def observe(self, v: float) -> None:
-        self._m._observe(self._key, v)
+        self._m._observe(self._key, v, self._scope)
 
 
 class Histogram(_Metric):
@@ -280,23 +400,29 @@ class Histogram(_Metric):
     def observe(self, v: float) -> None:
         self._observe((), v)
 
-    def _observe(self, key: Tuple[str, ...], v: float) -> None:
+    def _observe(
+        self, key: Tuple[str, ...], v: float,
+        scope: Optional[Tuple[str, ...]] = None,
+    ) -> None:
         if not _ENABLED[0]:
             return
         v = float(v)
         i = bisect.bisect_left(self.bounds, v)  # first bound >= v (le semantics)
         with self._lock:
-            cell = self._cells.get(key)
+            cells = self._cells_for(scope)
+            cell = cells.get(key)
             if cell is None:
-                cell = self._cells[key] = self._new_cell()
+                cell = cells[key] = self._new_cell()
             cell["counts"][i] += 1
             cell["sum"] += v
             cell["count"] += 1
 
-    def _cell(self, kv: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    def _cell(
+        self, kv: Dict[str, Any], scope: Optional[Tuple[str, ...]] = None
+    ) -> Optional[Dict[str, Any]]:
         key = self._label_key(kv)
         with self._lock:
-            cell = self._cells.get(key)
+            cell = self._cells_for(scope).get(key)
             return self._copy_cell(cell) if cell is not None else None
 
     def count(self, **kv: Any) -> int:
@@ -314,9 +440,11 @@ class Histogram(_Metric):
     def quantile(self, q: float, **kv: Any) -> float:
         """Estimate the q-quantile (0..1). Empty histogram -> 0.0; mass in
         the +Inf bucket resolves to the largest finite bound."""
+        return self._quantile_of_cell(self._cell(kv), q)
+
+    def _quantile_of_cell(self, cell: Optional[Dict[str, Any]], q: float) -> float:
         if not 0.0 <= q <= 1.0:
             raise ValueError(f"q must be in [0, 1], got {q}")
-        cell = self._cell(kv)
         if cell is None or cell["count"] == 0:
             return 0.0
         target = q * cell["count"]
@@ -333,21 +461,23 @@ class Histogram(_Metric):
         return self.bounds[-1]
 
     def _render(self, lines: List[str]) -> None:
-        for key, cell in self._sorted_cells():
+        for sv, key, cell in self._all_sorted_cells():
+            names, vals = self._full_labels(sv, key)
             cum = 0
             for bound, c in zip(self.bounds, cell["counts"]):
                 cum += c
-                le = _fmt_labels(self.labelnames, key, extra=f'le="{_fmt_value(bound)}"')
+                le = _fmt_labels(names, vals, extra=f'le="{_fmt_value(bound)}"')
                 lines.append(f"{self.name}_bucket{le} {cum}")
-            le = _fmt_labels(self.labelnames, key, extra='le="+Inf"')
+            le = _fmt_labels(names, vals, extra='le="+Inf"')
             lines.append(f"{self.name}_bucket{le} {cell['count']}")
-            base = _fmt_labels(self.labelnames, key)
+            base = _fmt_labels(names, vals)
             lines.append(f"{self.name}_sum{base} {_fmt_value(cell['sum'])}")
             lines.append(f"{self.name}_count{base} {cell['count']}")
 
     def _snapshot_values(self) -> List[Dict[str, Any]]:
         out = []
-        for key, cell in self._sorted_cells():
+        for sv, key, cell in self._all_sorted_cells():
+            names, vals = self._full_labels(sv, key)
             cum, buckets = 0, {}
             for bound, c in zip(self.bounds, cell["counts"]):
                 cum += c
@@ -355,13 +485,140 @@ class Histogram(_Metric):
             buckets["+Inf"] = cell["count"]
             out.append(
                 {
-                    "labels": dict(zip(self.labelnames, key)),
+                    "labels": dict(zip(names, vals)),
                     "count": cell["count"],
                     "sum": cell["sum"],
                     "buckets": buckets,
                 }
             )
         return out
+
+
+class _ScopedCounter:
+    """Scope-bound view of a :class:`Counter`: same recording API, cells
+    carry the scope labels. Reads return the SCOPE's cells only."""
+
+    __slots__ = ("_f", "_scope")
+    kind = "counter"
+
+    def __init__(self, family: Counter, scope: Tuple[str, ...]) -> None:
+        self._f, self._scope = family, scope
+
+    @property
+    def name(self) -> str:
+        return self._f.name
+
+    def labels(self, **kv: Any) -> _BoundCounter:
+        return _BoundCounter(self._f, self._f._label_key(kv), self._scope)
+
+    def inc(self, n: float = 1.0) -> None:
+        self._f._inc((), n, self._scope)
+
+    def value(self, **kv: Any) -> float:
+        return self._f.scope_value(self._scope, **kv)
+
+    def total(self) -> float:
+        return self._f.scope_total(self._scope)
+
+
+class _ScopedGauge:
+    __slots__ = ("_f", "_scope")
+    kind = "gauge"
+
+    def __init__(self, family: Gauge, scope: Tuple[str, ...]) -> None:
+        self._f, self._scope = family, scope
+
+    @property
+    def name(self) -> str:
+        return self._f.name
+
+    def labels(self, **kv: Any) -> _BoundGauge:
+        return _BoundGauge(self._f, self._f._label_key(kv), self._scope)
+
+    def set(self, v: float) -> None:
+        self._f._set((), v, self._scope)
+
+    def inc(self, n: float = 1.0) -> None:
+        self._f._add((), n, self._scope)
+
+    def dec(self, n: float = 1.0) -> None:
+        self._f._add((), -n, self._scope)
+
+    def value(self, **kv: Any) -> float:
+        return self._f.scope_value(self._scope, **kv)
+
+
+class _ScopedHistogram:
+    __slots__ = ("_f", "_scope")
+    kind = "histogram"
+
+    def __init__(self, family: Histogram, scope: Tuple[str, ...]) -> None:
+        self._f, self._scope = family, scope
+
+    @property
+    def name(self) -> str:
+        return self._f.name
+
+    def labels(self, **kv: Any) -> _BoundHistogram:
+        return _BoundHistogram(self._f, self._f._label_key(kv), self._scope)
+
+    def observe(self, v: float) -> None:
+        self._f._observe((), v, self._scope)
+
+    def count(self, **kv: Any) -> int:
+        cell = self._f._cell(kv, self._scope)
+        return int(cell["count"]) if cell else 0
+
+    def sum(self, **kv: Any) -> float:
+        cell = self._f._cell(kv, self._scope)
+        return float(cell["sum"]) if cell else 0.0
+
+    def quantile(self, q: float, **kv: Any) -> float:
+        return self._f._quantile_of_cell(self._f._cell(kv, self._scope), q)
+
+
+class MetricScope:
+    """One resolved label scope (e.g. ``replica="r0"``) — see the module
+    docstring. Construct via :meth:`MetricsRegistry.scope`; bind whole family
+    dicts at replica construction with :meth:`bind_all` so the per-record
+    path never re-resolves anything."""
+
+    __slots__ = ("labelnames", "labelvalues")
+
+    _WRAPPERS = {}  # kind class -> scoped class; filled below
+
+    def __init__(self, **labels: Any) -> None:
+        if not labels:
+            raise ValueError("a metric scope needs at least one label")
+        names = tuple(sorted(labels))
+        self.labelnames = names
+        self.labelvalues = tuple(str(labels[n]) for n in names)
+
+    def bind(self, family: Any) -> Any:
+        """Scope-bound view of one family (Counter/Gauge/Histogram)."""
+        for cls, wrapper in self._WRAPPERS.items():
+            if isinstance(family, cls):
+                family._register_scope(self.labelnames, self.labelvalues)
+                return wrapper(family, self.labelvalues)
+        raise TypeError(f"cannot scope a {type(family).__name__}")
+
+    def bind_all(self, families: Dict[str, Any]) -> Dict[str, Any]:
+        """Scope-bound copy of a ``{short_name: family}`` dict (the shape
+        every instrumented component resolves at construction)."""
+        return {k: self.bind(f) for k, f in families.items()}
+
+    def __repr__(self) -> str:
+        pairs = ", ".join(
+            f"{n}={v!r}" for n, v in zip(self.labelnames, self.labelvalues)
+        )
+        return f"MetricScope({pairs})"
+
+
+MetricScope._WRAPPERS = {
+    Counter: _ScopedCounter,
+    Gauge: _ScopedGauge,
+    Histogram: _ScopedHistogram,
+}
 
 
 class MetricsRegistry:
@@ -415,6 +672,25 @@ class MetricsRegistry:
         with self._lock:
             return self._metrics.get(name)
 
+    def family(self, name: str) -> _Metric:
+        """Strict read-by-name: the registered family, or ``KeyError``.
+        Aggregation/healthz/snapshot consumers must use this (not
+        :meth:`get`) so a typo'd family name fails loudly instead of
+        silently reading zeros — analyzer check OB602 statically validates
+        every literal name passed here against the package's registered
+        families."""
+        with self._lock:
+            m = self._metrics.get(name)
+        if m is None:
+            raise KeyError(f"no metric family named '{name}' is registered")
+        return m
+
+    def scope(self, **labels: Any) -> MetricScope:
+        """Resolve a label scope once (e.g. ``registry.scope(replica="r0")``
+        at replica construction); bind families through it for replica-
+        attributed recording."""
+        return MetricScope(**labels)
+
     def names(self) -> List[str]:
         with self._lock:
             return sorted(self._metrics)
@@ -442,7 +718,7 @@ class MetricsRegistry:
         with self._lock:
             metrics = [self._metrics[n] for n in sorted(self._metrics)]
         for m in metrics:
-            if not m._cells:
+            if not m._has_cells():
                 continue
             if m.help:
                 lines.append(f"# HELP {m.name} {m.help}")

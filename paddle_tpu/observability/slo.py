@@ -1,0 +1,345 @@
+"""Continuous SLO burn-rate monitor for the serving fleet.
+
+The overload controller (PR 7) reacts to *instantaneous* pressure —
+queue depth, pool utilization — and protects the process. Nothing watches
+the *service level* continuously: a cluster can sit at a comfortable queue
+depth while quietly burning its error budget (sheds trickling, failovers
+chewing deadlines, TTFT p99 drifting past target), and the first human
+signal is a user complaint. This module is the standard SRE answer,
+shaped for the router's probe loop:
+
+- **error budgets**: each signal has a budget (``SLOConfig``) — the
+  fraction of requests allowed to miss. The **burn rate** is the observed
+  windowed bad fraction divided by the budget: burn 1.0 consumes the
+  budget exactly as fast as allowed, burn 4.0 exhausts it 4x faster.
+- **multi-window**: each burn rate is evaluated over a FAST and a SLOW
+  window and the effective value is ``min(fast, slow)`` — a state
+  escalates only when the violation is both *currently happening* (fast)
+  and *sustained* (slow), the classic defense against paging on a blip.
+- **hysteresis**: OK → WARN → PAGE transitions latch through the PR 7
+  :class:`~paddle_tpu.serving.frontend.Hysteresis` gates (distinct
+  start/stop thresholds: latched at ``warn_burn``/``page_burn``, released
+  at half), so a burn hovering at a threshold cannot flap the state —
+  and the PAGE-entry incident snapshot — every probe tick.
+
+Signals, all computed from **cluster truth** (the router's host-side
+terminal accounting — valid with metrics off, same discipline as the
+overload controller):
+
+- ``slo``: fraction of terminals NOT finishing ok-inside-deadline, over
+  budget ``1 - goodput_target``;
+- ``shed``: fraction of terminals with any non-ok outcome, over
+  ``shed_budget``;
+- ``failover``: re-dispatch attempts per routing dispatch, over
+  ``failover_budget``;
+- ``ttft``: the sampled cluster TTFT p99 over ``ttft_p99_target_s`` (a
+  target ratio, not a budget burn — TTFT has no per-request error
+  accounting at the router). Its two windows are disjoint so the min is
+  meaningful: the "now" half is the max over the fast window, the
+  "sustained" half the max over the slow window EXCLUDING the fast one —
+  one bad sample can never latch a state by itself.
+
+State transitions emit ``slo_state_transitions_total{to=...}`` + the
+``slo_state`` gauge and a ``slo_state`` flight event; the bounded
+``timeline`` is what the cluster bench reports as time-in-WARN/PAGE.
+Driven by :class:`~paddle_tpu.observability.aggregate.ClusterObserver`
+from the router's probe loop; also usable standalone by feeding
+:meth:`BurnRateMonitor.observe` cumulative samples.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Deque, Dict, List, Optional, Tuple
+
+from paddle_tpu.flags import GLOBAL_FLAGS
+from paddle_tpu.observability import flight_recorder as _flight
+from paddle_tpu.observability import metrics as _metrics
+
+__all__ = [
+    "OK",
+    "PAGE",
+    "SLO_STATE_NAMES",
+    "WARN",
+    "BurnRateMonitor",
+    "SLOConfig",
+]
+
+OK, WARN, PAGE = 0, 1, 2
+SLO_STATE_NAMES = {OK: "ok", WARN: "warn", PAGE: "page"}
+
+# the monitored signal keys, in reporting order
+SIGNALS = ("slo", "shed", "failover", "ttft")
+
+
+def _flag(name: str) -> Any:
+    return GLOBAL_FLAGS.get(name)
+
+
+@dataclass
+class SLOConfig:
+    """Targets/budgets/windows; defaults seed from the ``FLAGS_slo_*``
+    flags at construction time (never re-read per tick)."""
+
+    ttft_p99_target_s: float = field(
+        default_factory=lambda: float(_flag("slo_ttft_p99_target_s"))
+    )
+    goodput_target: float = field(
+        default_factory=lambda: float(_flag("slo_goodput_target"))
+    )
+    shed_budget: float = field(
+        default_factory=lambda: float(_flag("slo_shed_budget"))
+    )
+    failover_budget: float = field(
+        default_factory=lambda: float(_flag("slo_failover_budget"))
+    )
+    fast_window_s: float = field(
+        default_factory=lambda: float(_flag("slo_fast_window_s"))
+    )
+    slow_window_s: float = field(
+        default_factory=lambda: float(_flag("slo_slow_window_s"))
+    )
+    warn_burn: float = field(default_factory=lambda: float(_flag("slo_warn_burn")))
+    page_burn: float = field(default_factory=lambda: float(_flag("slo_page_burn")))
+    min_terminals: int = field(
+        default_factory=lambda: int(_flag("slo_min_terminals"))
+    )
+    timeline_size: int = 256
+
+    def __post_init__(self) -> None:
+        if not 0.0 < self.goodput_target < 1.0:
+            raise ValueError(f"goodput_target must be in (0, 1), got {self.goodput_target}")
+        if self.shed_budget <= 0 or self.failover_budget <= 0:
+            raise ValueError("shed/failover budgets must be > 0")
+        if self.fast_window_s <= 0 or self.slow_window_s < self.fast_window_s:
+            raise ValueError(
+                f"need 0 < fast_window_s ({self.fast_window_s}) <= "
+                f"slow_window_s ({self.slow_window_s})"
+            )
+        if self.warn_burn <= 0 or self.page_burn < self.warn_burn:
+            raise ValueError(
+                f"need 0 < warn_burn ({self.warn_burn}) <= page_burn ({self.page_burn})"
+            )
+        if self.ttft_p99_target_s <= 0:
+            raise ValueError("ttft_p99_target_s must be > 0")
+        if self.min_terminals < 1:
+            # the trust gate doubles as the division guard: a window must
+            # hold at least ONE terminal before its fractions are computed
+            raise ValueError(
+                f"min_terminals must be >= 1, got {self.min_terminals}"
+            )
+
+
+def _slo_metrics() -> Dict[str, Any]:
+    reg = _metrics.GLOBAL_METRICS
+    return {
+        "state": reg.gauge(
+            "slo_state",
+            "SLO burn-rate monitor state: 0 ok, 1 warn, 2 page. High-water "
+            "mark tracked since reset.",
+        ),
+        "transitions": reg.counter(
+            "slo_state_transitions_total",
+            "SLO monitor state transitions, by the state entered "
+            "(ok / warn / page).",
+            labelnames=("to",),
+        ),
+        "burn": reg.gauge(
+            "slo_burn_rate",
+            "Effective (min of fast/slow window) burn rate per signal: "
+            "slo (goodput violations), shed, failover, ttft (p99 / target).",
+            labelnames=("signal",),
+        ),
+    }
+
+
+class BurnRateMonitor:
+    """See the module docstring. Feed cumulative samples via
+    :meth:`observe`; read :attr:`state` / :attr:`last` / :attr:`timeline`.
+
+    A sample is the dict shape ``ReplicaRouter.slo_sample()`` returns:
+    cumulative ``terminals`` / ``ok`` / ``ok_in_slo`` / ``dispatches`` /
+    ``redispatches`` plus the instantaneous ``ttft_p99_s``. Not
+    thread-safe by itself — the caller (the router probe loop, under the
+    router lock) serializes observe()."""
+
+    def __init__(self, config: Optional[SLOConfig] = None) -> None:
+        # lazy: the serving layer imports observability at module load;
+        # importing it back at module scope here would cycle the packages
+        from paddle_tpu.serving.frontend import Hysteresis
+
+        self.config = config or SLOConfig()
+        cfg = self.config
+        self._warn_gate = Hysteresis(cfg.warn_burn, cfg.warn_burn * 0.5)
+        self._page_gate = Hysteresis(cfg.page_burn, cfg.page_burn * 0.5)
+        self.state = OK
+        self._samples: Deque[Tuple[float, Dict[str, float]]] = deque()
+        # (t, from_state, to_state, dominant_signal, burn) transitions; the
+        # bench's time-in-WARN/PAGE timeline reads this
+        self.timeline: Deque[Dict[str, Any]] = deque(maxlen=int(cfg.timeline_size))
+        self.last: Dict[str, Any] = {}  # most recent burn computation
+        self._metrics = _slo_metrics()
+        self._flight = _flight.GLOBAL_FLIGHT_RECORDER
+        self._state_since: Optional[float] = None
+        self._last_now: Optional[float] = None
+        self._time_in: Dict[int, float] = {OK: 0.0, WARN: 0.0, PAGE: 0.0}
+
+    @property
+    def state_name(self) -> str:
+        return SLO_STATE_NAMES[self.state]
+
+    # -- sampling -------------------------------------------------------------
+    def would_accept(self, now: float) -> bool:
+        """Whether :meth:`observe` at ``now`` would ingest (the rate bound
+        below). Callers for whom *building* the sample is the expensive
+        part — the router tick holds the router lock — check this first."""
+        return (
+            self._last_now is None
+            or now - self._last_now >= self.config.fast_window_s / 64.0
+        )
+
+    def observe(self, now: float, sample: Dict[str, float]) -> int:
+        """Ingest one cumulative sample at monotonic instant ``now``;
+        returns the (possibly new) state.
+
+        Rate-bounded: observe() rides the router pump, which inline drivers
+        call in a tight loop — ingesting every tick would retain
+        pump_rate x slow_window samples and scan them all per tick under
+        the router lock. Samples closer than ``fast_window_s / 64`` to the
+        previous one are dropped (one float compare), bounding both the
+        deque and the per-tick scan regardless of pump rate."""
+        if self._state_since is None:
+            self._state_since = now
+        if not self.would_accept(now):
+            return self.state
+        self._last_now = now
+        self._samples.append((float(now), dict(sample)))
+        horizon = now - self.config.slow_window_s
+        # keep ONE sample older than the slow window as the delta baseline
+        while len(self._samples) > 2 and self._samples[1][0] <= horizon:
+            self._samples.popleft()
+        fast, fast_ok = self._window_burns(now, self.config.fast_window_s)
+        slow, slow_ok = self._window_burns(now, self.config.slow_window_s)
+        # ttft is max-based, so its slow window must EXCLUDE the fast one
+        # (a superset max would always equal the fast value and the min
+        # would degenerate to single-window alerting)
+        t_now, t_sustained = self._ttft_maxes(now)
+        fast["ttft"] = t_now / self.config.ttft_p99_target_s
+        slow["ttft"] = t_sustained / self.config.ttft_p99_target_s
+        effective: Dict[str, float] = {}
+        for s in SIGNALS:
+            if s == "ttft" or (fast_ok and slow_ok):
+                # both windows populated: escalation needs the violation to
+                # be both happening now AND sustained
+                effective[s] = min(fast[s], slow[s])
+            elif slow_ok or fast_ok:
+                # an under-populated window must DEFER to the trusted one,
+                # never inject 0 into the min — a low-traffic cluster in
+                # total failure still has to page off its slow window
+                effective[s] = slow[s] if slow_ok else fast[s]
+            else:
+                effective[s] = 0.0
+        dominant = max(SIGNALS, key=lambda s: effective[s])
+        overall = effective[dominant]
+        self.last = {
+            "fast": fast, "slow": slow, "effective": effective,
+            "dominant": dominant, "overall": round(overall, 4),
+        }
+        if _metrics.metrics_enabled():
+            for s in SIGNALS:
+                self._metrics["burn"].labels(signal=s).set(effective[s])
+        warn = self._warn_gate.update(overall)
+        page = self._page_gate.update(overall)
+        new_state = PAGE if page else WARN if warn else OK
+        if new_state != self.state:
+            self._transition(new_state, dominant, overall, now)
+        return self.state
+
+    def _ttft_maxes(self, now: float) -> Tuple[float, float]:
+        """(max sampled p99 over the fast window, max over the slow window
+        EXCLUDING the fast window) — the disjoint halves of the ttft
+        signal's now/sustained split."""
+        fast_start = now - self.config.fast_window_s
+        slow_start = now - self.config.slow_window_s
+        t_now = t_sustained = 0.0
+        for t, s in self._samples:
+            v = s.get("ttft_p99_s", 0.0)
+            if t >= fast_start:
+                t_now = max(t_now, v)
+            elif t >= slow_start:
+                t_sustained = max(t_sustained, v)
+        return t_now, t_sustained
+
+    def _window_burns(
+        self, now: float, window_s: float
+    ) -> Tuple[Dict[str, float], bool]:
+        """Budget burns over ``[now - window_s, now]`` (cumulative deltas
+        between the newest sample and the newest sample at-or-before the
+        window start, or the oldest retained), plus whether the window held
+        enough terminals for its fractions to be trusted. The ttft signal
+        is computed separately (:meth:`_ttft_maxes`)."""
+        newest = self._samples[-1][1]
+        start = now - window_s
+        base = self._samples[0][1]
+        for t, s in self._samples:
+            if t <= start:
+                base = s
+            else:
+                break  # samples are time-ordered: the base is found
+        cfg = self.config
+        d_term = newest["terminals"] - base["terminals"]
+        d_ok = newest["ok"] - base["ok"]
+        d_in_slo = newest["ok_in_slo"] - base["ok_in_slo"]
+        d_disp = newest["dispatches"] - base["dispatches"]
+        d_re = newest["redispatches"] - base["redispatches"]
+        out: Dict[str, float] = {}
+        if d_term < cfg.min_terminals:
+            # too little traffic to trust a fraction: the caller defers to
+            # the other window (reading 0 into min() would blind the
+            # monitor on exactly the low-traffic outage it must page on)
+            out.update({"slo": 0.0, "shed": 0.0, "failover": 0.0})
+            return out, False
+        out["slo"] = ((d_term - d_in_slo) / d_term) / (1.0 - cfg.goodput_target)
+        out["shed"] = ((d_term - d_ok) / d_term) / cfg.shed_budget
+        out["failover"] = (d_re / d_disp) / cfg.failover_budget if d_disp else 0.0
+        return out, True
+
+    def _transition(self, to: int, signal: str, burn: float, now: float) -> None:
+        frm = self.state
+        if self._state_since is not None:
+            self._time_in[frm] += now - self._state_since
+        self._state_since = now
+        self.state = to
+        self.timeline.append(
+            {"t": now, "from": SLO_STATE_NAMES[frm], "to": SLO_STATE_NAMES[to],
+             "signal": signal, "burn": round(burn, 4)}
+        )
+        self._metrics["transitions"].labels(to=SLO_STATE_NAMES[to]).inc()
+        self._metrics["state"].set(to)
+        self._flight.record(
+            "slo_state", **{"from": SLO_STATE_NAMES[frm],
+                            "to": SLO_STATE_NAMES[to],
+                            "signal": signal, "burn": round(burn, 4)},
+        )
+
+    # -- reporting ------------------------------------------------------------
+    def time_in_states(self, now: Optional[float] = None) -> Dict[str, float]:
+        """Seconds spent in each state so far (current state accrued up to
+        ``now``, defaulting to the last observed instant)."""
+        out = dict(self._time_in)
+        if self._state_since is not None:
+            if now is None:
+                now = self._last_now if self._last_now is not None else self._state_since
+            out[self.state] += max(now, self._state_since) - self._state_since
+        return {SLO_STATE_NAMES[k]: round(v, 6) for k, v in out.items()}
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The /healthz ``slo`` block."""
+        return {
+            "state": self.state_name,
+            "burn": dict(self.last.get("effective", {})),
+            "dominant": self.last.get("dominant"),
+            "timeline": [dict(e) for e in list(self.timeline)[-16:]],
+            "time_in_states": self.time_in_states(),
+        }

@@ -134,9 +134,17 @@ class ReplicaCluster:
         for name in names:
             if name in self.replicas:
                 raise ValueError(f"duplicate replica name {name!r}")
-            self.replicas[name] = Replica(name, factory(name))
+            self.replicas[name] = Replica(name, self._build(name))
         if not self.replicas:
             raise ValueError("a cluster needs at least one replica")
+
+    def _build(self, name: str) -> ServingFrontend:
+        """Build one replica frontend and bind its observability scope —
+        resolved exactly once here, so every metric series, flight event and
+        sampled span the replica ever emits is attributable to ``name``."""
+        frontend = self._factory(name)
+        frontend.set_replica_scope(name)
+        return frontend
 
     def __iter__(self):
         return iter(self.replicas.values())
@@ -161,7 +169,7 @@ class ReplicaCluster:
                 f"replica {name!r} is {replica.state}, not dead; "
                 "drain it before rebuilding"
             )
-        replica.frontend = self._factory(name)
+        replica.frontend = self._build(name)
         replica.generation += 1
         replica.state = REPLICA_UP
         replica.probe_failures = 0

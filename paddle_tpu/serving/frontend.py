@@ -301,6 +301,10 @@ class ServingFrontend:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._failed: Optional[str] = None  # set when the engine died for good
+        # replica observability scope: unscoped until the cluster layer
+        # calls set_replica_scope() at replica construction
+        self._flight = _flight.GLOBAL_FLIGHT_RECORDER
+        self.replica_name: Optional[str] = None
 
     # -- intake --------------------------------------------------------------
     def submit(
@@ -370,6 +374,32 @@ class ServingFrontend:
             ).inc()
             self._update_gauges()
             return handle
+
+    def set_replica_scope(self, name: str) -> None:
+        """Bind this frontend (and its engine, prefix cache and KV tier) to
+        a replica observability scope, resolved ONCE: every ``serving_*``/
+        ``engine_*`` series records with a ``replica=name`` label, flight
+        events land in one per-replica child ring teed into the global
+        black box, and sampled spans carry a ``replica`` attribute (the
+        cross-replica failover tree is assembled from those). Called by
+        :class:`~paddle_tpu.serving.cluster.ReplicaCluster` at replica
+        construction and again on revive."""
+        from paddle_tpu.observability.metrics import GLOBAL_METRICS
+
+        with self._lock:
+            scope = GLOBAL_METRICS.scope(replica=name)
+            flight = _flight.GLOBAL_FLIGHT_RECORDER.child(replica=name)
+            self.replica_name = str(name)
+            self._metrics = scope.bind_all(serving_metrics())
+            self._flight = flight
+            self.engine.set_replica_scope(name, scope=scope, flight=flight)
+
+    @property
+    def flight(self) -> _flight.FlightRecorder:
+        """This frontend's flight ring (the replica's own ring when scoped,
+        else the process-global recorder) — the incident writer dumps it."""
+        with self._lock:
+            return self._flight
 
     def _tenant_label(self, tenant: str) -> str:
         """Metric-label view of a tenant, bounded in cardinality: scheduling
@@ -564,17 +594,23 @@ class ServingFrontend:
             "request.stream_out", trace_id=tid, parent_id=root,
             start_s=fin, end_s=now, attrs={"tokens": handle._n_pushed},
         )
+        attrs = {
+            "req_id": handle.id,
+            "priority": priority_name(handle.priority),
+            "tenant": handle.tenant,
+            "outcome": handle.outcome,
+            "finish_reason": inner.finish_reason,
+            "n_generated": len(inner.generated),
+        }
+        if self.replica_name is not None:
+            # replica attribution: a failed-over request's trace contains
+            # one such span per replica that served it — the incident dump
+            # CLI assembles them into one cross-replica tree by trace_id
+            attrs["replica"] = self.replica_name
         t.add_span(
             "request", trace_id=tid, span_id=root, parent_id=ctx.parent_id,
             start_s=sub, end_s=now,
-            attrs={
-                "req_id": handle.id,
-                "priority": priority_name(handle.priority),
-                "tenant": handle.tenant,
-                "outcome": handle.outcome,
-                "finish_reason": inner.finish_reason,
-                "n_generated": len(inner.generated),
-            },
+            attrs=attrs,
             status="ok" if handle.outcome == "ok" else f"shed:{handle.outcome}",
         )
 
@@ -597,7 +633,7 @@ class ServingFrontend:
         if level != prev:
             # overload transitions are rare and postmortem-critical: the
             # black box shows what pressure looked like before a death
-            _flight.record_event(
+            self._flight.record(
                 "overload_level",
                 **{"from": _LEVEL_NAMES[prev], "to": _LEVEL_NAMES[level],
                    "queue_frac": round(queue_frac, 4), "util": round(util, 4)},
@@ -662,11 +698,11 @@ class ServingFrontend:
             self._failed = why
             # the pump thread is dying: black-box line + postmortem dump
             # (safe_dump never raises — failing every stream still happens)
-            _flight.record_event(
+            self._flight.record(
                 "pump_death", why=why[:200], live=len(self._live),
                 queue_depth=self.engine.queue_depth(),
             )
-            _flight.safe_dump("serving_pump_death", extra={"why": why[:200]})
+            self._flight.safe_dump("serving_pump_death", extra={"why": why[:200]})
             # salvage results the engine already finished but never delivered
             salvaged = {r.req_id for r in self.engine.drain_finished()}
             for rid, handle in list(self._live.items()):

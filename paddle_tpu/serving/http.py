@@ -14,7 +14,15 @@ Routes:
   ``{"done": true, "outcome": ..., "tokens": n}`` line; without it, one JSON
   object after the request finishes.
 - ``GET /healthz`` — the frontend's :meth:`snapshot` (overload level, queue
-  depth, pool utilization).
+  depth, pool utilization). In multi-replica mode with a
+  :class:`~paddle_tpu.observability.aggregate.ClusterObserver` attached to
+  the router, this is the observer's fleet view instead (router state,
+  per-replica lifecycle + tp_degree + kv-tier + spec acceptance, the SLO
+  burn-rate block).
+- ``GET /metrics`` — the same replica-labeled Prometheus text exposition
+  ``observability.start_metrics_server`` serves (one shared renderer,
+  ``render_exposition`` — single- and multi-replica formats agree by
+  construction).
 
 Tracing: a ``traceparent`` request header (W3C shape, see
 ``observability.tracing``) continues the caller's trace through this hop;
@@ -134,10 +142,43 @@ class _ServingHandler(BaseHTTPRequestHandler):
 
     # -- routes --------------------------------------------------------------
     def do_GET(self) -> None:  # noqa: N802 - http.server API
-        if self.path.split("?", 1)[0] == "/healthz":
-            self._send_json(200, self.frontend.snapshot())
+        path = self.path.split("?", 1)[0]
+        if path == "/healthz":
+            # multi-replica mode with a ClusterObserver attached: the fleet
+            # view (router state, per-replica lifecycle + capability blocks,
+            # the SLO monitor); otherwise the frontend/router snapshot
+            observer = getattr(self.frontend, "observer", None)
+            self._send_json(
+                200,
+                observer.healthz() if observer is not None
+                else self.frontend.snapshot(),
+            )
             return
-        self._send_json(404, {"error": "try POST /v1/generate or GET /healthz"})
+        if path == "/metrics":
+            # the SAME replica-labeled exposition as start_metrics_server:
+            # one renderer, so single- and multi-replica formats agree. An
+            # attached observer may carry a non-default registry — honor it.
+            from paddle_tpu.observability.exporters import render_exposition
+
+            observer = getattr(self.frontend, "observer", None)
+            body = (
+                observer.render_metrics()
+                if observer is not None
+                else render_exposition()
+            ).encode()
+            self._count(200)
+            self.send_response(200)
+            self.send_header(
+                "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+            )
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        self._send_json(
+            404,
+            {"error": "try POST /v1/generate, GET /healthz or GET /metrics"},
+        )
 
     def do_POST(self) -> None:  # noqa: N802 - http.server API
         if self.path.split("?", 1)[0] != "/v1/generate":

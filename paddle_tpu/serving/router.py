@@ -306,6 +306,23 @@ class ReplicaRouter:
         self._dispatches = 0  # monotonic: the reconciliation surface
         self._routing_log: deque = deque(maxlen=int(self.config.routing_log_size))
         self._failover_latencies: deque = deque(maxlen=4096)
+        # cluster-truth SLO accounting (always on — the burn-rate monitor
+        # must work with metrics off, like the overload controller): every
+        # terminal counts exactly once in _finalize_locked
+        self._terminals = 0
+        self._ok = 0
+        self._ok_in_slo = 0
+        self._redispatch_count = 0
+        # recent cluster-level TTFTs as (instant, value): bounded by count
+        # AND pruned by age at sample time — a storm's latencies must age
+        # out of the p99 on the clock, not only after 512 fresh requests
+        # displace them (a quiet cluster would otherwise hold WARN/PAGE for
+        # many multiples of the monitor's slow window after recovery)
+        self._ttfts: deque = deque(maxlen=512)
+        self._ttft_window_s = 60.0  # the observer aligns this to its config
+        # fleet observer (observability.aggregate.ClusterObserver): driven
+        # from this probe loop; None = PR 11 behavior exactly
+        self._observer: Optional[Any] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         for replica in cluster:
@@ -580,6 +597,11 @@ class ReplicaRouter:
             self._forward_locked(rr, now)
             if rr.inner is not None and rr.inner.finished and not rr.finished:
                 self._on_inner_terminal_locked(rr, now)
+        if self._observer is not None:
+            # the fleet observer rides the probe loop: burn-rate sampling
+            # and PAGE-entry incident snapshots happen here, after this
+            # tick's terminals have been accounted
+            self._observer.on_tick_locked(now)
         self._update_gauges_locked()
         out, self._pending_finished = self._pending_finished, []
         return out
@@ -662,6 +684,10 @@ class ReplicaRouter:
                     "router_all_replicas_dead",
                     extra={"replicas": self.cluster.names()},
                 )
+        if self._observer is not None:
+            # after the failover machinery ran, so an incident snapshot on a
+            # death transition captures the salvage/re-dispatch state too
+            self._observer.on_transition_locked(replica, frm, to, now)
 
     # -- failover -------------------------------------------------------------
     def _failover_replica_locked(self, replica: Replica, now: float) -> None:
@@ -708,6 +734,7 @@ class ReplicaRouter:
     def _backoff_or_shed_locked(self, rr: RouterRequest, now: float) -> None:
         """Burn one re-dispatch attempt: budget-bounded, deadline-aware."""
         rr.redispatches += 1
+        self._redispatch_count += 1
         self._metrics["redispatch"].inc()
         if rr.redispatches > self.config.max_redispatch:
             self._shed_locked(rr, "replica_failure", now)
@@ -808,6 +835,7 @@ class ReplicaRouter:
         fresh = gen[rr._n_delivered:n]
         if rr.first_token_time is None:
             rr.first_token_time = now
+            self._ttfts.append((now, now - rr.submit_time))
         for tok in fresh:
             rr._q.put(tok)
             rr._delivered.append(tok)
@@ -850,6 +878,11 @@ class ReplicaRouter:
             return  # terminal exactly once, cluster-wide
         rr.outcome = outcome
         rr.finish_time = now
+        self._terminals += 1
+        if outcome == "ok":
+            self._ok += 1
+            if rr.met_deadline:
+                self._ok_in_slo += 1
         if rr.inner is not None:
             rr._terminal_inner = rr.inner.inner
         self._live.pop(rr.id, None)
@@ -922,6 +955,53 @@ class ReplicaRouter:
                 self._thread = None
         for replica in self.cluster:
             replica.frontend.stop()
+
+    # -- fleet observer -------------------------------------------------------
+    def attach_observer(self, observer: Any) -> None:
+        """Attach a fleet observer (``observability.aggregate.
+        ClusterObserver``): its ``on_tick_locked(now)`` runs every probe
+        tick and ``on_transition_locked(replica, frm, to, now)`` on every
+        replica state transition — both UNDER the router lock (lock order
+        router -> frontend -> engine still holds for anything they read).
+        One observer at a time; detach with None."""
+        with self._lock:
+            self._observer = observer
+
+    @property
+    def observer(self) -> Optional[Any]:
+        with self._lock:
+            return self._observer
+
+    def set_ttft_window(self, window_s: float) -> None:
+        """Age horizon for the TTFT p99 the SLO monitor samples (the
+        observer aligns it to its slow burn window at attach)."""
+        with self._lock:
+            self._ttft_window_s = float(window_s)
+
+    def slo_sample(self) -> Dict[str, float]:
+        """Cumulative cluster-truth counters for the burn-rate monitor (the
+        public form; the observer reads the locked form from the probe
+        loop). Host-side accounting — valid with metrics off."""
+        with self._lock:
+            return self._slo_sample_locked(time.perf_counter())
+
+    def _slo_sample_locked(self, now: float) -> Dict[str, float]:
+        horizon = now - self._ttft_window_s
+        while self._ttfts and self._ttfts[0][0] < horizon:
+            self._ttfts.popleft()
+        if self._ttfts:
+            ordered = sorted(v for _, v in self._ttfts)
+            p99 = ordered[min(len(ordered) - 1, int(0.99 * len(ordered)))]
+        else:
+            p99 = 0.0
+        return {
+            "terminals": float(self._terminals),
+            "ok": float(self._ok),
+            "ok_in_slo": float(self._ok_in_slo),
+            "dispatches": float(self._dispatches),
+            "redispatches": float(self._redispatch_count),
+            "ttft_p99_s": float(p99),
+        }
 
     # -- introspection --------------------------------------------------------
     def has_work(self) -> bool:

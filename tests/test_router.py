@@ -802,3 +802,13 @@ def test_bench_cluster_goodput_cpu_smoke():
     assert rec["offered_rate_rps"] == pytest.approx(
         2 * 3 * rec["sustainable_rate_per_replica_rps"], rel=0.02
     )
+    # fleet observability rides the storm: the monitor's state timeline is
+    # part of the record, and the whole layer adds zero compiled signatures
+    assert rec["one_compile_per_engine"] is True
+    mon = rec["slo_monitor"]
+    assert mon["final_state"] in ("ok", "warn", "page")
+    assert {"time_in_warn_s", "time_in_page_s", "transitions"} <= set(mon)
+    # the kill produces failovers/sheds: the monitor must have left OK at
+    # some point during the storm
+    assert any(e["to"] in ("warn", "page") for e in mon["transitions"]), mon
+    assert rec["incidents_written"] >= 1

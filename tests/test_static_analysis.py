@@ -707,6 +707,60 @@ def test_ob601_suppressible_with_reason():
     assert vs[0].suppressed and vs[0].reason
 
 
+def test_ob602_typo_in_registry_read_fires():
+    # .family() is the strict-read API: any receiver counts
+    assert codes('fam = registry.family("bogus_family_name_total")\n') == ["OB602"]
+    # .get() on a registry-shaped receiver
+    assert codes('fam = GLOBAL_METRICS.get("bogus_family_name_total")\n') == ["OB602"]
+    assert codes('fam = self._registry.get("bogus_family_name_total")\n') == ["OB602"]
+    assert codes('fam = get_registry().get("bogus_family_name_total")\n') == ["OB602"]
+
+
+def test_ob602_registered_names_resolve():
+    # a name defined in the SAME snippet resolves
+    src = (
+        'c = reg.counter("snippet_family_total", "help")\n'
+        'back = GLOBAL_METRICS.get("snippet_family_total")\n'
+    )
+    assert codes(src) == []
+    # a real package family resolves through the canonical package scan
+    assert codes(
+        'fam = registry.family("engine_requests_admitted_total")\n'
+    ) == []
+    assert codes('fam = registry.family("serving_shed_total")\n') == []
+
+
+def test_ob602_non_registry_receivers_not_confused():
+    # dict/config .get with a literal is NOT a registry read
+    assert codes('v = cfg.get("whatever_key")\n') == []
+    assert codes('v = self._metrics.get("shed")\n') == []
+    assert codes('v = os.environ.get("PATH")\n') == []
+    # dynamic names are out of static scope (runtime family() raises)
+    assert codes("fam = registry.family(name)\n") == []
+
+
+def test_ob602_suppressible_with_reason():
+    vs = analyze_source(
+        "# analysis: disable=OB602 family registered by an optional plugin\n"
+        'fam = registry.family("plugin_only_family_total")\n'
+    )
+    assert [v.code for v in vs] == ["OB602"]
+    assert vs[0].suppressed and vs[0].reason
+
+
+def test_ob602_fleet_family_list_resolves():
+    # the aggregation module's whole literal list must resolve: the drift
+    # this checker exists for is exactly a rename desynchronizing these
+    from paddle_tpu.analysis.checkers.observability import (
+        _package_family_universe,
+    )
+    from paddle_tpu.observability.aggregate import FLEET_COUNTER_FAMILIES
+
+    universe = _package_family_universe()
+    missing = [n for n in FLEET_COUNTER_FAMILIES if n not in universe]
+    assert not missing, f"fleet families not registered anywhere: {missing}"
+
+
 # -- suppressions ------------------------------------------------------------
 
 def test_suppression_with_reason():
