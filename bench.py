@@ -407,6 +407,7 @@ def _main_timed(platform, paddle, cfg, batch, seq, steps, warmup) -> None:
         _bench_int8_decode(paddle, platform),
         _bench_paged_decode(paddle, platform),
         _bench_engine_decode(paddle, platform),
+        _bench_fused_decode_layer(paddle, platform),
         _bench_tp_decode(paddle, platform),
         _bench_shared_prefix_ttft(paddle, platform),
         _bench_kv_tier_multi_turn(paddle, platform),
@@ -762,6 +763,112 @@ def _bench_engine_decode(paddle, platform: str) -> dict:
         return {"metric": "engine_decode_tokens_per_sec", "error": f"{exc!r}"[:300]}
     finally:
         paddle.set_flags(prior_flags)
+
+
+def _bench_fused_decode_layer(paddle, platform: str) -> dict:
+    """Decode-step megakernel (``FLAGS_use_fused_decode_layer``): per-layer
+    dispatch count fused vs unfused from the trace-time probe (the python of
+    the jitted step runs once per compile, so each armed site counts once
+    per signature), byte-identity of the two token streams (the PR's
+    correctness acceptance — a mismatch is recorded as an error, never as a
+    throughput number), and the estimated all-reduce share of one tp decode
+    layer (analytic: row-parallel collective bytes vs MXU time at peak —
+    labelled as an estimate; a measured share needs >= 2 chips and lives in
+    the tp record)."""
+    from paddle_tpu.inference import ContinuousBatchingEngine
+    from paddle_tpu.kernels.fused import arm_dispatch_probe, disarm_dispatch_probe
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    flag = "FLAGS_use_fused_decode_layer"
+    prior = paddle.get_flags([flag])
+    metric = "fused_decode_layer_dispatches_per_layer"
+    try:
+        if platform == "tpu":
+            cfg = LlamaConfig(
+                vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+                num_hidden_layers=8, num_attention_heads=16,
+                num_key_value_heads=16, max_position_embeddings=1024,
+            )
+            slots, bs, bucket, n_req, max_new = 8, 16, 128, 16, 48
+        else:
+            cfg = LlamaConfig.tiny()
+            slots, bs, bucket, n_req, max_new = 2, 4, 16, 4, 6
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg)
+        if platform == "tpu":
+            model = model.to(dtype="bfloat16")
+        model.eval()
+        rng = np.random.default_rng(11)
+        prompts = [
+            rng.integers(0, cfg.vocab_size, (int(rng.integers(max(bucket // 4, 1), bucket + 1)),)).astype(np.int32)
+            for _ in range(n_req)
+        ]
+        budgets = [int(rng.integers(max_new // 2, max_new + 1)) for _ in range(n_req)]
+
+        def run(fused: bool):
+            paddle.set_flags({flag: fused})
+            eng = ContinuousBatchingEngine(
+                model, max_slots=slots, block_size=bs, prompt_bucket=bucket
+            )
+            rids = [
+                eng.add_request(p, max_new_tokens=t)
+                for p, t in zip(prompts, budgets)
+            ]
+            arm_dispatch_probe()
+            try:
+                t0 = time.perf_counter()
+                out = eng.run()
+                dt = time.perf_counter() - t0
+            finally:
+                sites = disarm_dispatch_probe()
+            toks = [out[r].tokens().tolist() for r in rids]
+            ntoks = sum(len(out[r].generated) for r in rids)
+            return sites, toks, ntoks / dt, eng.stats["step_traces"]
+
+        sites_f, toks_f, tps_f, traces_f = run(True)
+        sites_u, toks_u, tps_u, traces_u = run(False)
+        if toks_f != toks_u:
+            return {
+                "metric": metric,
+                "error": "fused/unfused token streams diverge — fusion is broken",
+            }
+
+        n_layers = cfg.num_hidden_layers
+        step_f = ("fused:embed_norm", "fused:rope_gather")
+        step_u = ("unfused:embed", "unfused:final_norm")
+        per_layer_f = sum(v for k, v in sites_f.items() if k not in step_f) / n_layers
+        per_layer_u = sum(v for k, v in sites_u.items() if k not in step_u) / n_layers
+
+        # analytic tp all-reduce share of one decode layer per token:
+        # row-parallel o_proj + down_proj each all-reduce [1, H] activations
+        # over ICI while the column/row matmuls run on the MXU
+        itemsize = 2 if platform == "tpu" else 4
+        h, inter = cfg.hidden_size, cfg.intermediate_size
+        ar_bytes = 2 * h * itemsize
+        mm_flops = 2 * (4 * h * h + 3 * h * inter)
+        t_ar = ar_bytes / 45e9  # v5e ICI ~45 GB/s per link
+        t_mm = mm_flops / (197e12 if platform == "tpu" else 1e12)
+        return {
+            "metric": metric,
+            "value": round(per_layer_f, 2),
+            "unit": "dispatch sites/layer/step",
+            "unfused_dispatches_per_layer": round(per_layer_u, 2),
+            "dispatch_sites": {"fused": sites_f, "unfused": sites_u},
+            "tokens_per_sec": {
+                "fused": round(tps_f, 2), "unfused": round(tps_u, 2)
+            },
+            "byte_identical_fused_on_off": True,
+            "compiled_signatures": {"fused": traces_f, "unfused": traces_u},
+            "allreduce_share": {
+                "value": round(t_ar / (t_ar + t_mm), 4),
+                "method": "analytic_estimate",
+                "model": "2*H*itemsize bytes over ICI vs layer matmul FLOPs at peak",
+            },
+        }
+    except Exception as exc:  # noqa: BLE001 - secondary must never kill primary
+        return {"metric": metric, "error": f"{exc!r}"[:300]}
+    finally:
+        paddle.set_flags(prior)
 
 
 def _bench_tp_decode(paddle, platform: str) -> dict:

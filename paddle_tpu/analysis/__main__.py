@@ -30,7 +30,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         prog="python -m paddle_tpu.analysis",
         description="AST static analysis: trace-safety (TS), Pallas purity (PK), "
         "flag discipline (FD), exception hygiene (EH), robustness (RB), "
-        "observability (OB), concurrency (CC), donation/lifetime (DN).",
+        "observability (OB), concurrency (CC), donation/lifetime (DN), "
+        "tape backward discipline (TB).",
     )
     ap.add_argument("paths", nargs="*", help="files or directories to analyze")
     ap.add_argument("--format", choices=("text", "json", "sarif"), default="text")

@@ -13,6 +13,7 @@ from paddle_tpu.analysis.checkers.flag_discipline import FlagDisciplineChecker
 from paddle_tpu.analysis.checkers.observability import ObservabilityChecker
 from paddle_tpu.analysis.checkers.pallas_purity import PallasPurityChecker
 from paddle_tpu.analysis.checkers.robustness import RobustnessChecker
+from paddle_tpu.analysis.checkers.tape_backward import TapeBackwardChecker
 from paddle_tpu.analysis.checkers.trace_safety import TraceSafetyChecker
 from paddle_tpu.analysis.core import Checker
 
@@ -27,6 +28,7 @@ CHECKER_CLASSES: List[Type[Checker]] = [
     ObservabilityChecker,
     ConcurrencyChecker,
     DonationChecker,
+    TapeBackwardChecker,
 ]
 
 

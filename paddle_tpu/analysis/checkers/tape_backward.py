@@ -1,0 +1,143 @@
+"""TB — tape backward discipline checker.
+
+The train path differentiates ops through the explicit tape: each fused op
+records a GradNode whose ``vjp_fn`` runs a STANDALONE adjoint kernel. Running
+``jax.grad``/``jax.vjp``/``jax.value_and_grad`` over a function that lowers a
+``pallas_call`` instead asks jax to differentiate through the kernel — Mosaic
+kernels carry no AD rule, so this either crashes at trace time or silently
+falls back to a transposed program XLA cannot fuse. The sanctioned escape
+hatch is ``jax.custom_vjp`` (the kernel pair defines its own rule); functions
+protected that way are exempt.
+
+Detection is resolved-name based and deliberately conservative:
+
+1. a function TAINTS if its body calls ``pallas_call`` directly, or calls a
+   same-file function that does (one hop — matching how this codebase wraps
+   kernels in a single ``*_call`` builder);
+2. ``jax.custom_vjp`` protection is honoured as a decorator, as the
+   ``core = jax.custom_vjp(fn)`` assignment form (both ``fn`` and ``core``
+   become exempt), and for factory functions that wire ``custom_vjp``
+   around their nested kernels anywhere in their body;
+3. only first arguments that RESOLVE are flagged: a Name bound to a tainted
+   def, or a Lambda whose body calls one (or lowers ``pallas_call`` inline).
+   A bare parameter passed through generic dispatch is unresolvable by
+   design — the tape's own ``jax.vjp(fn, ...)`` over a caller-supplied pure
+   function must stay clean.
+
+Codes:
+
+- TB901  jax autodiff applied over a function containing pallas_call
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from paddle_tpu.analysis.checkers._shared import attr_chain, body_walk
+from paddle_tpu.analysis.core import Checker, FileContext, Violation
+
+_AD_NAMES = {"grad", "vjp", "value_and_grad"}
+
+
+def _last(chain: str) -> str:
+    return chain.split(".")[-1]
+
+
+class TapeBackwardChecker(Checker):
+    name = "tape-backward"
+    codes = {
+        "TB901": "jax autodiff applied over a function containing pallas_call",
+    }
+
+    def run(self, ctx: FileContext) -> List[Violation]:
+        tree = ctx.tree
+        contains: Set[str] = set()  # defs lowering pallas_call directly
+        calls_of: Dict[str, Set[str]] = {}  # def name -> called Names
+        protected: Set[str] = set()  # custom_vjp-protected names
+
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                called = calls_of.setdefault(node.name, set())
+                for sub in body_walk(node):
+                    # a factory that wires custom_vjp around its nested
+                    # kernels (decorator or call form) owns its AD rule
+                    if _last(attr_chain(sub) or "") == "custom_vjp":
+                        protected.add(node.name)
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    chain = attr_chain(sub.func) or ""
+                    if _last(chain) == "pallas_call":
+                        contains.add(node.name)
+                    elif isinstance(sub.func, ast.Name):
+                        called.add(sub.func.id)
+                for dec in node.decorator_list:
+                    target = dec.func if isinstance(dec, ast.Call) else dec
+                    if _last(attr_chain(target) or "") == "custom_vjp":
+                        protected.add(node.name)
+            elif isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                if _last(attr_chain(node.value.func) or "") == "custom_vjp":
+                    for a in node.value.args:
+                        if isinstance(a, ast.Name):
+                            protected.add(a.id)
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            protected.add(t.id)
+
+        # protection propagates one call hop too: a kernel factory that hands
+        # its engines to a custom_vjp-wiring shell is covered by the shell
+        for name, called in list(calls_of.items()):
+            if called & protected:
+                protected.add(name)
+        kernels = contains - protected
+        tainted = set(kernels)
+        for name, called in calls_of.items():
+            if called & kernels:
+                tainted.add(name)
+        tainted -= protected
+
+        # `from jax import grad` aliases count the same as `jax.grad`
+        ad_aliases: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom) and node.module == "jax":
+                for alias in node.names:
+                    if alias.name in _AD_NAMES:
+                        ad_aliases.add(alias.asname or alias.name)
+
+        out: List[Violation] = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            chain = attr_chain(node.func) or ""
+            parts = chain.split(".")
+            is_ad = (
+                len(parts) == 2 and parts[0] == "jax" and parts[1] in _AD_NAMES
+            ) or (isinstance(node.func, ast.Name) and node.func.id in ad_aliases)
+            if not is_ad:
+                continue
+            hit = self._resolve_target(node.args[0], tainted)
+            if hit is not None:
+                out.append(
+                    Violation(
+                        ctx.path, node.lineno, node.col_offset, "TB901",
+                        f"{_last(chain)}() over '{hit}' which lowers pallas_call: "
+                        "kernels have no AD rule — record a tape GradNode with a "
+                        "standalone adjoint kernel (or protect with jax.custom_vjp)",
+                    )
+                )
+        return out
+
+    @staticmethod
+    def _resolve_target(target: ast.AST, tainted: Set[str]):
+        if isinstance(target, ast.Name) and target.id in tainted:
+            return target.id
+        if isinstance(target, ast.Lambda):
+            for sub in ast.walk(target.body):
+                if not isinstance(sub, ast.Call):
+                    continue
+                chain = attr_chain(sub.func) or ""
+                if _last(chain) == "pallas_call":
+                    return "<lambda>"
+                if isinstance(sub.func, ast.Name) and sub.func.id in tainted:
+                    return sub.func.id
+        return None

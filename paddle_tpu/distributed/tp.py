@@ -49,6 +49,7 @@ __all__ = [
     "current_tp_mesh",
     "kv_cache_sharding",
     "replicated",
+    "row_parallel_overlap_matmul",
     "shard_model_params",
     "tp_param_spec",
     "tp_shard_context",
@@ -166,6 +167,39 @@ def current_tp_mesh() -> Optional[Mesh]:
     thread (None = single-chip semantics). Read at TRACE time by the paged-
     attention functional to decide the shard_map wrapping."""
     return _STATE.mesh
+
+
+def row_parallel_overlap_matmul(x: Any, weight: Any, tiles: int = 2) -> Any:
+    """A row-parallel matmul (o_proj/down_proj: weight shards the IN dim)
+    split into ``tiles`` independent token-row tiles — the "Tile-Level
+    Activation Overlap" schedule. Under GSPMD each tile's partial matmul ends
+    in its OWN all-reduce, so while tile t's collective is on the ICI wire,
+    tile t+1's matmul (and the consumer of tile t-1's already-reduced rows)
+    runs on the MXU — the per-layer all-reduce stops serializing against the
+    whole layer. Per-row contraction is untouched by the split, so the
+    result is byte-identical to the plain matmul (tile boundaries only
+    partition the BATCH rows; each output row's reduction order is
+    unchanged).
+
+    ``x`` is ``[..., rows, in]`` with the leading dims flattened into rows;
+    falls back to one tile when the row count doesn't split evenly (serving
+    batches are padded to the slot count, which divides)."""
+    import jax.numpy as jnp
+
+    lead = x.shape[:-1]
+    rows = 1
+    for s in lead:
+        rows *= s
+    x2 = x.reshape(rows, x.shape[-1])
+    tiles = int(tiles)
+    if tiles <= 1 or rows % tiles != 0:
+        out = jnp.matmul(x2, weight)
+        return out.reshape(*lead, weight.shape[-1])
+    step = rows // tiles
+    parts = [
+        jnp.matmul(x2[t * step : (t + 1) * step], weight) for t in range(tiles)
+    ]
+    return jnp.concatenate(parts, axis=0).reshape(*lead, weight.shape[-1])
 
 
 @contextlib.contextmanager

@@ -29,6 +29,7 @@ __all__ = [
     "masked_multihead_attention",
     "block_multihead_attention",
     "block_multihead_chunk_attention",
+    "block_multihead_chunk_attention_fused",
     "block_cache_prefill",
     "block_cache_append",
     "block_cache_append_chunk",
@@ -45,6 +46,7 @@ from paddle_tpu.incubate.nn.functional.block_attention import (  # noqa: E402,F4
     block_cache_prefill,
     block_multihead_attention,
     block_multihead_chunk_attention,
+    block_multihead_chunk_attention_fused,
 )
 from paddle_tpu.incubate.nn.functional.fused_moe import fused_moe  # noqa: E402,F401
 
@@ -486,3 +488,285 @@ def fused_softmax_mask_upper_triangle(x: Any) -> Any:
 
 
 __all__ += ["fused_softmax_mask", "fused_softmax_mask_upper_triangle"]
+
+
+# -- fused residual-add + norm: the decode layer's epilogue pairs ------------
+#
+# One transformer layer's epilogue is two HBM round-trips — ``r = x +
+# residual`` then ``y = norm(r)`` — issued twice per layer (post-attention
+# and pre-next-layer). These entries collapse each pair into ONE Pallas
+# dispatch behind the usual gate, with the XLA fallback running the EXACT op
+# composition the unfused path runs (x + residual, then ``rms_norm``'s
+# upcast/rsqrt/downcast/weight order, or ``layer_norm``'s no-upcast order) —
+# which is what keeps fused on/off byte-identical per backend. Backward is
+# the PR 9 explicit tape-GradNode pattern: a standalone adjoint kernel that
+# recomputes rstd from the saved residual stream, with no jax AD transform
+# ever applied over a ``pallas_call``.
+
+
+def _rms_res_fwd_array(x, residual, weight, eps):
+    from paddle_tpu.kernels.select import pallas_enabled, warn_fallback
+
+    if (
+        weight.dtype == x.dtype
+        and x.shape[-1] % 128 == 0
+        and pallas_enabled("use_pallas_fused")
+    ):
+        try:
+            from paddle_tpu.kernels.fused import fused_rms_norm_residual_pallas
+
+            return fused_rms_norm_residual_pallas(x, residual, weight, eps)
+        except Exception as exc:  # pragma: no cover - TPU-only path
+            warn_fallback("fused_rms_norm_residual", exc)
+    r = x + residual
+    xf = r.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    out = xf * jax.lax.rsqrt(var + eps)
+    out = out.astype(r.dtype)
+    return out * weight, r
+
+
+def _rms_res_bwd_array(g, r, weight, eps):
+    from paddle_tpu.kernels.select import pallas_enabled, warn_fallback
+
+    if (
+        weight.dtype == g.dtype
+        and g.shape[-1] % 128 == 0
+        and pallas_enabled("use_pallas_fused")
+    ):
+        try:
+            from paddle_tpu.kernels.fused import rms_norm_residual_adjoint_pallas
+
+            return rms_norm_residual_adjoint_pallas(g, r, weight, eps)
+        except Exception as exc:  # pragma: no cover - TPU-only path
+            warn_fallback("fused_rms_norm_residual_bwd", exc)
+    r32 = r.astype(jnp.float32)
+    g32 = g.astype(jnp.float32)
+    rstd = jax.lax.rsqrt(jnp.mean(jnp.square(r32), axis=-1, keepdims=True) + eps)
+    xhat = r32 * rstd
+    gw = g32 * weight.astype(jnp.float32)
+    dot = jnp.mean(gw * xhat, axis=-1, keepdims=True)
+    dx = (rstd * (gw - xhat * dot)).astype(g.dtype)
+    dw = jnp.sum((g32 * xhat).reshape(-1, r.shape[-1]), axis=0).astype(weight.dtype)
+    return dx, dw
+
+
+def _ln_res_fwd_array(x, residual, weight, bias, eps):
+    from paddle_tpu.kernels.select import pallas_enabled, warn_fallback
+
+    if (
+        weight.dtype == x.dtype
+        and x.shape[-1] % 128 == 0
+        and pallas_enabled("use_pallas_fused")
+    ):
+        try:
+            from paddle_tpu.kernels.fused import fused_layer_norm_residual_pallas
+
+            return fused_layer_norm_residual_pallas(x, residual, weight, bias, eps)
+        except Exception as exc:  # pragma: no cover - TPU-only path
+            warn_fallback("fused_layer_norm_residual", exc)
+    # the exact nn.functional.common.layer_norm composition: stats in the IO
+    # dtype (no upcast), weight multiply then bias add only when present
+    r = x + residual
+    mean = jnp.mean(r, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(r - mean), axis=-1, keepdims=True)
+    out = (r - mean) * jax.lax.rsqrt(var + eps)
+    out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out, r
+
+
+def _ln_res_bwd_array(g, r, weight, eps):
+    from paddle_tpu.kernels.select import pallas_enabled, warn_fallback
+
+    if (
+        weight.dtype == g.dtype
+        and g.shape[-1] % 128 == 0
+        and pallas_enabled("use_pallas_fused")
+    ):
+        try:
+            from paddle_tpu.kernels.fused import layer_norm_residual_adjoint_pallas
+
+            return layer_norm_residual_adjoint_pallas(g, r, weight, eps)
+        except Exception as exc:  # pragma: no cover - TPU-only path
+            warn_fallback("fused_layer_norm_residual_bwd", exc)
+    r32 = r.astype(jnp.float32)
+    g32 = g.astype(jnp.float32)
+    mu = jnp.mean(r32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(r32 - mu), axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    xhat = (r32 - mu) * rstd
+    gw = g32 * weight.astype(jnp.float32)
+    m1 = jnp.mean(gw, axis=-1, keepdims=True)
+    m2 = jnp.mean(gw * xhat, axis=-1, keepdims=True)
+    dx = (rstd * (gw - m1 - xhat * m2)).astype(g.dtype)
+    h = r.shape[-1]
+    dw = jnp.sum((g32 * xhat).reshape(-1, h), axis=0).astype(weight.dtype)
+    db = jnp.sum(g32.reshape(-1, h), axis=0).astype(weight.dtype)
+    return dx, dw, db
+
+
+def _residual_norm_entry(name, x, norm_weight, norm_bias, residual, eps, is_rms):
+    """Shared tape-GradNode plumbing for the two residual+norm entries.
+
+    Outputs ``(y, residual_out)`` as Tensors. The residual add's adjoint is
+    the identity, so the node hands ``d_r = norm_adjoint(dy) + d_residual_out``
+    to BOTH x and residual; weight (and bias) cotangents come from the same
+    standalone adjoint kernel. ``create_graph`` re-differentiation traces the
+    pure-XLA ``closed`` composition — never a pallas_call.
+    """
+    from paddle_tpu.core import autograd as _ag
+    from paddle_tpu.core import dispatch as _dispatch
+    from paddle_tpu.core.tensor import Tensor
+
+    inputs = [x, norm_weight, norm_bias, residual]
+    arrays = [
+        (t._data if isinstance(t, Tensor) else (None if t is None else jnp.asarray(t)))
+        for t in inputs
+    ]
+    from paddle_tpu.amp.auto_cast import amp_cast_inputs, amp_enabled
+
+    if amp_enabled():
+        present = [i for i, a in enumerate(arrays) if a is not None]
+        cast = amp_cast_inputs(name, [arrays[i] for i in present])
+        for i, a in zip(present, cast):
+            arrays[i] = a
+    xa, wa, ba, ra = arrays
+    if is_rms:
+        y, r = _rms_res_fwd_array(xa, ra, wa, eps)
+    else:
+        y, r = _ln_res_fwd_array(xa, ra, wa, ba, eps)
+    out_arrays = [y, r]
+
+    def _diff(t: Any) -> bool:
+        return (
+            isinstance(t, Tensor)
+            and not t.stop_gradient
+            and jnp.issubdtype(jnp.dtype(t.dtype), jnp.inexact)
+        )
+
+    record = _ag.is_grad_enabled() and any(_diff(t) for t in inputs)
+    node = None
+    if record:
+        diff_pos = [i for i, t in enumerate(inputs) if _diff(t)]
+        diff_tensors = [inputs[i] for i in diff_pos]
+        out_avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in out_arrays]
+        _flat, out_treedef = jax.tree_util.tree_flatten(tuple(out_arrays))
+        consts = list(arrays)
+
+        def vjp_fn(cots: Any) -> Tuple[Any, ...]:
+            gy, gr = cots
+            if gy is None:
+                gy = jnp.zeros(out_avals[0].shape, out_avals[0].dtype)
+            if is_rms:
+                dr, dw = _rms_res_bwd_array(gy, r, wa, eps)
+                db = None
+            else:
+                dr, dw, db = _ln_res_bwd_array(gy, r, wa, eps)
+            if gr is not None:
+                dr = dr + gr.astype(dr.dtype)
+            by_pos = {0: dr, 1: dw, 2: db, 3: dr}
+            return tuple(by_pos[p] for p in diff_pos)
+
+        def closed(*diff_arrays: Any) -> Tuple[Any, ...]:
+            vals = list(consts)
+            for p, arr in zip(diff_pos, diff_arrays):
+                vals[p] = arr
+            rr = vals[0] + vals[3]
+            if is_rms:
+                xf = rr.astype(jnp.float32)
+                var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+                out = (xf * jax.lax.rsqrt(var + eps)).astype(rr.dtype) * vals[1]
+            else:
+                mu = jnp.mean(rr, axis=-1, keepdims=True)
+                var = jnp.mean(jnp.square(rr - mu), axis=-1, keepdims=True)
+                out = (rr - mu) * jax.lax.rsqrt(var + eps) * vals[1]
+                if vals[2] is not None:
+                    out = out + vals[2]
+            return out, rr
+
+        node = _ag.GradNode(
+            name, vjp_fn, diff_tensors, out_avals,
+            fwd_fn=closed, out_treedef=out_treedef,
+        )
+
+    if _dispatch._NAN_CHECK[0]:
+        _dispatch._check_nan_inf(name, out_arrays)
+    if _dispatch.op_stats_hook is not None:  # amp.debugging operator stats
+        _dispatch.op_stats_hook(name, out_arrays)
+    result = []
+    for j, arr in enumerate(out_arrays):
+        t = Tensor(arr, stop_gradient=(node is None))
+        if node is not None:
+            t._grad_node = node
+            t._grad_output_index = j
+        result.append(t)
+    return tuple(result)
+
+
+def fused_rms_norm_residual(
+    x: Any, norm_weight: Any, residual: Any, epsilon: float = 1e-6
+) -> Tuple[Any, Any]:
+    """``r = x + residual; y = rms_norm(r, norm_weight)`` as ONE dispatch with
+    an explicit tape backward (standalone adjoint kernel — no jax AD over the
+    pallas_call). Returns ``(y, r)``; ``r`` feeds the next residual hop."""
+    return _residual_norm_entry(
+        "fused_rms_norm_residual", x, norm_weight, None, residual,
+        float(epsilon), True,
+    )
+
+
+def fused_layer_norm_residual(
+    x: Any, norm_weight: Any, norm_bias: Any, residual: Any,
+    epsilon: float = 1e-5,
+) -> Tuple[Any, Any]:
+    """``r = x + residual; y = layer_norm(r, norm_weight, norm_bias)`` as ONE
+    dispatch with an explicit tape backward. Returns ``(y, r)``."""
+    return _residual_norm_entry(
+        "fused_layer_norm_residual", x, norm_weight, norm_bias, residual,
+        float(epsilon), False,
+    )
+
+
+def fused_embed_rms_norm(
+    input_ids: Any, embed_weight: Any, norm_weight: Any, epsilon: float = 1e-6
+) -> Tuple[Any, Any]:
+    """Chunk-step entry fusion: token-id gather + embedding lookup + first
+    decoder layer's pre-attention RMSNorm in ONE dispatch (the scalar-
+    prefetched ids steer the embedding-row BlockSpec). Inference-only — the
+    serving step never differentiates; training embeds through the regular
+    op. Returns ``(emb, y)`` Tensors: the raw rows (residual stream seed) and
+    their normed form."""
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.kernels.select import pallas_enabled, warn_fallback
+
+    ids = input_ids._data if isinstance(input_ids, Tensor) else jnp.asarray(input_ids)
+    table = embed_weight._data if isinstance(embed_weight, Tensor) else jnp.asarray(embed_weight)
+    w = norm_weight._data if isinstance(norm_weight, Tensor) else jnp.asarray(norm_weight)
+    eps = float(epsilon)
+    if (
+        w.dtype == table.dtype
+        and table.shape[-1] % 128 == 0
+        and pallas_enabled("use_pallas_fused")
+    ):
+        try:
+            from paddle_tpu.kernels.fused import fused_embed_rms_norm_pallas
+
+            emb, y = fused_embed_rms_norm_pallas(ids, table, w, eps)
+            return Tensor(emb, stop_gradient=True), Tensor(y, stop_gradient=True)
+        except Exception as exc:  # pragma: no cover - TPU-only path
+            warn_fallback("fused_embed_norm", exc)
+    # exact unfused composition: XLA gather, then rms_norm's op order
+    emb = table[ids]
+    xf = emb.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    y = (xf * jax.lax.rsqrt(var + eps)).astype(emb.dtype) * w
+    return Tensor(emb, stop_gradient=True), Tensor(y, stop_gradient=True)
+
+
+__all__ += [
+    "fused_rms_norm_residual",
+    "fused_layer_norm_residual",
+    "fused_embed_rms_norm",
+]

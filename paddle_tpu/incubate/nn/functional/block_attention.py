@@ -84,10 +84,56 @@ def _tp_sharded_flash_chunk(
         check_vma=False,
     )(q, key_cache, value_cache, block_tables, seq_lens, q_lens)
 
+def _tp_sharded_flash_chunk_fused(
+    q: jax.Array,
+    cos: jax.Array,
+    sin: jax.Array,
+    key_cache: jax.Array,
+    value_cache: jax.Array,
+    block_tables: jax.Array,
+    seq_lens: jax.Array,
+    q_lens: jax.Array,
+    scale: float,
+    mesh: Any,
+    interpret: bool = False,
+) -> jax.Array:
+    """:func:`_tp_sharded_flash_chunk` for the rope-fused kernel: the rope
+    rows are position data shared by every head, so they ride replicated
+    while q/caches split over the head partition."""
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.distributed.fleet.meta_parallel.spmd_pipeline import shard_map
+    from paddle_tpu.kernels.paged_attention import paged_flash_chunk_fused
+
+    def _shard_chunk_attend(q_l, cos_l, sin_l, kc_l, vc_l, tables_l, lens_l, qlens_l):
+        return paged_flash_chunk_fused(
+            q_l, cos_l, sin_l, kc_l, vc_l, tables_l, lens_l, qlens_l,
+            scale=scale, interpret=interpret,
+        )
+
+    return shard_map(
+        _shard_chunk_attend,
+        mesh=mesh,
+        in_specs=(
+            P(None, None, "tp", None),  # q [B, C, HQ, D]: heads split
+            P(None, None, None),  # cos [B, C, D]: replicated position data
+            P(None, None, None),  # sin
+            P(None, "tp", None, None),  # key_cache [NB, KVH, BS, D]
+            P(None, "tp", None, None),  # value_cache
+            P(None, None),  # block_tables: replicated host truth
+            P(None),  # seq_lens
+            P(None),  # q_lens
+        ),
+        out_specs=P(None, None, "tp", None),
+        check_vma=False,
+    )(q, cos, sin, key_cache, value_cache, block_tables, seq_lens, q_lens)
+
+
 __all__ = [
     "BlockKVCache",
     "block_multihead_attention",
     "block_multihead_chunk_attention",
+    "block_multihead_chunk_attention_fused",
     "block_cache_prefill",
     "block_cache_append",
     "block_cache_append_chunk",
@@ -547,6 +593,90 @@ def block_multihead_chunk_attention(
                 "paged_flash_chunk",
                 RuntimeError("Mosaic lowering unsupported for geometry"),
             )
+    out = _gather_chunk_attend(
+        q, key_cache, value_cache, block_tables, seq_lens, attend_q, scale
+    )
+    return out, key_cache, value_cache
+
+
+def block_multihead_chunk_attention_fused(
+    q: jax.Array,  # [B, C, HQ, D] PRE-rope ragged chunk of new tokens
+    k: jax.Array,  # [B, C, HKV, D] PRE-rope new keys
+    v: jax.Array,
+    cos: jax.Array,  # [B, C, 1, D] offset-gathered rope rows (model layout)
+    sin: jax.Array,
+    key_cache: jax.Array,  # [NB, HKV, BS, D]
+    value_cache: jax.Array,
+    block_tables: jax.Array,  # [B, MBS] int32
+    seq_lens: jax.Array,  # [B] tokens already cached (EXCLUDING this chunk)
+    q_lens: jax.Array,  # [B] valid new tokens this step (1 = decode row)
+    scale: Optional[float] = None,
+    slot_mask: Optional[jax.Array] = None,  # [B] bool; False = padded slot
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """:func:`block_multihead_chunk_attention` with RoPE folded in — the
+    fused decode layer's attention entry (``FLAGS_use_fused_decode_layer``).
+
+    Takes PRE-rope q/k plus the per-slot rope rows and collapses the layer's
+    rope pass + attention to one kernel dispatch: k is rotated by the same
+    XLA elementwise composition the unfused path uses (it fuses into the
+    cache-append scatter), while q's rotation moves INSIDE the paged kernel's
+    block walk. The XLA fallback stays in lockstep by applying the identical
+    ``_rope_apply_xla`` to q before the shared dense-gather attention — so on
+    a backend without the kernel (CPU reference), fused on/off execute the
+    SAME op composition and outputs are byte-identical by construction.
+    """
+    from paddle_tpu.incubate.nn.functional import _rope_apply_xla
+
+    b, c, hq, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d**0.5)
+    k = _rope_apply_xla(k, sin, cos, True)
+    key_cache, value_cache = block_cache_append_chunk(
+        key_cache, value_cache, k, v, block_tables, seq_lens, q_lens,
+        slot_mask=slot_mask,
+    )
+    attend_q = q_lens
+    if slot_mask is not None:
+        attend_q = jnp.where(slot_mask, attend_q, 0)
+    from paddle_tpu.kernels.select import pallas_enabled, warn_fallback
+
+    if pallas_enabled("use_pallas_paged_attention"):
+        from paddle_tpu.kernels.paged_attention import (
+            chunk_fused_lowering_supported,
+            paged_flash_chunk_fused,
+        )
+
+        nb, hkv_c, bs, d_c = key_cache.shape
+        tp_mesh = _current_tp_mesh()
+        ntp = tp_mesh.shape["tp"] if tp_mesh is not None else 1
+        cos3 = cos.reshape(b, c, d)
+        sin3 = sin.reshape(b, c, d)
+        if chunk_fused_lowering_supported(
+            b, c, hq // ntp, hkv_c // ntp, d_c, nb, bs,
+            block_tables.shape[1], str(q.dtype),
+        ):
+            try:
+                if tp_mesh is not None:
+                    out = _tp_sharded_flash_chunk_fused(
+                        q, cos3, sin3, key_cache, value_cache, block_tables,
+                        seq_lens, attend_q, scale, tp_mesh,
+                    )
+                else:
+                    out = paged_flash_chunk_fused(
+                        q, cos3, sin3, key_cache, value_cache, block_tables,
+                        seq_lens, attend_q, scale=scale,
+                    )
+                return out, key_cache, value_cache
+            except Exception as exc:  # noqa: BLE001 - XLA fallback below
+                warn_fallback("paged_flash_chunk_fused", exc)
+        else:
+            warn_fallback(
+                "paged_flash_chunk_fused",
+                RuntimeError("Mosaic lowering unsupported for geometry"),
+            )
+    # lockstep fallback: the SAME rope composition the unfused path applies,
+    # then the shared dense-gather attention
+    q = _rope_apply_xla(q, sin, cos, True)
     out = _gather_chunk_attend(
         q, key_cache, value_cache, block_tables, seq_lens, attend_q, scale
     )

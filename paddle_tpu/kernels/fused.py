@@ -20,7 +20,55 @@ from jax.experimental.pallas import tpu as pltpu
 
 from paddle_tpu.kernels.select import _CompilerParams
 
-__all__ = ["fused_rms_norm_pallas", "fused_rope_pallas", "rope_adjoint_pallas"]
+__all__ = [
+    "fused_rms_norm_pallas",
+    "fused_rope_pallas",
+    "rope_adjoint_pallas",
+    "fused_rms_norm_residual_pallas",
+    "rms_norm_residual_adjoint_pallas",
+    "fused_layer_norm_residual_pallas",
+    "layer_norm_residual_adjoint_pallas",
+    "fused_embed_rms_norm_pallas",
+    "arm_dispatch_probe",
+    "disarm_dispatch_probe",
+    "count_dispatch",
+]
+
+
+# ---------------------------------------------------------------------------
+# Trace-time dispatch probe
+# ---------------------------------------------------------------------------
+#
+# The fused-decode-layer work exists to cut dispatches per layer per step, so
+# the win must be observable: model code calls ``count_dispatch(site)`` at
+# every kernel-dispatch site of the paged serving path (both the fused and
+# the unfused variants). The calls run at TRACE time only — the Python body
+# of a jitted step executes once per compile, the same property the engine's
+# ``step_traces`` counter rides — so an armed probe records exactly one count
+# per dispatch site per compiled program, and a disarmed probe costs one
+# ``is None`` check. Tests and bench.py arm it around an engine's first step.
+
+_DISPATCH_PROBE: Optional[dict] = None
+
+
+def arm_dispatch_probe() -> None:
+    """Start recording dispatch sites (clears any previous counts)."""
+    global _DISPATCH_PROBE
+    _DISPATCH_PROBE = {}
+
+
+def disarm_dispatch_probe() -> dict:
+    """Stop recording; returns {site: count} seen since arming."""
+    global _DISPATCH_PROBE
+    out = _DISPATCH_PROBE or {}
+    _DISPATCH_PROBE = None
+    return out
+
+
+def count_dispatch(site: str) -> None:
+    """Record one dispatch-site hit (no-op unless the probe is armed)."""
+    if _DISPATCH_PROBE is not None:
+        _DISPATCH_PROBE[site] = _DISPATCH_PROBE.get(site, 0) + 1
 
 
 def _rms_fwd_kernel(x_ref, w_ref, y_ref, rstd_ref, *, eps):
@@ -270,3 +318,352 @@ def rope_adjoint_pallas(
     run = _make_rope_runner(b * h, s, d, bool(interpret))
     dx = run(_rope_bwd_kernel, gh, cos2, sin2)
     return jnp.moveaxis(dx.reshape(b, h, s, d), 1, 2)
+
+
+# ---------------------------------------------------------------------------
+# Fused residual-add + norm epilogues (decode-layer fusion)
+# ---------------------------------------------------------------------------
+#
+# The decode step's per-layer epilogue is `r = x + residual; y = norm(r)` —
+# two bandwidth-bound HBM round-trips that these kernels collapse into one
+# (read x/residual once, write y and the new residual stream once). Numerics
+# are LOCKSTEP with the XLA composition the flag-off path runs: the residual
+# add happens in the IO dtype, rms_norm accumulates fp32 and multiplies by
+# the weight AFTER the downcast (exactly ``nn.functional.common.rms_norm``'s
+# order). The backward is a STANDALONE adjoint kernel (rstd/mean recomputed
+# from the saved residual stream) that the incubate entries' explicit tape
+# GradNode calls directly — no jax AD ever sees these pallas_calls.
+
+
+def _rms_res_fwd_kernel(x_ref, res_ref, w_ref, y_ref, r_ref, *, eps):
+    r = x_ref[0] + res_ref[0]  # residual add in the IO dtype (XLA lockstep)
+    r_ref[0] = r
+    xf = r.astype(jnp.float32)
+    w = w_ref[...].astype(jnp.float32)
+    ms = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(ms + eps)
+    # fp32 weight multiply BEFORE the downcast — the same order as
+    # _rms_fwd_kernel, so fused on/off stay bitwise-matched on TPU where the
+    # unfused path runs that kernel
+    y_ref[0] = (xf * rstd * w[None, :]).astype(y_ref.dtype)
+
+
+def _rms_res_bwd_kernel(r_ref, w_ref, g_ref, dx_ref, dw_ref, *, eps):
+    r = r_ref[0].astype(jnp.float32)
+    w = w_ref[...].astype(jnp.float32)
+    g = g_ref[0].astype(jnp.float32)
+    ms = jnp.mean(r * r, axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(ms + eps)
+    xhat = r * rstd
+    gw = g * w[None, :]
+    dot = jnp.mean(gw * xhat, axis=-1, keepdims=True)
+    dx_ref[0] = (rstd * (gw - xhat * dot)).astype(dx_ref.dtype)
+
+    # dw accumulates into ONE [1, h] block across the sequential grid (the
+    # same rule as _rms_bwd_kernel: a per-block partial would need an
+    # illegal (1, h) sublane tile)
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        dw_ref[0] = jnp.zeros_like(dw_ref[0])
+
+    dw_ref[0] += jnp.sum(g * xhat, axis=0)
+
+
+def _ln_res_fwd_kernel(x_ref, res_ref, w_ref, b_ref, y_ref, r_ref, *, eps):
+    r = x_ref[0] + res_ref[0]
+    r_ref[0] = r
+    xf = r.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mu), axis=-1, keepdims=True)
+    y = (xf - mu) * jax.lax.rsqrt(var + eps)
+    y = y * w_ref[...].astype(jnp.float32)[None, :] + b_ref[...].astype(jnp.float32)[None, :]
+    y_ref[0] = y.astype(y_ref.dtype)
+
+
+def _ln_res_bwd_kernel(r_ref, w_ref, g_ref, dx_ref, dw_ref, db_ref, *, eps):
+    r = r_ref[0].astype(jnp.float32)
+    w = w_ref[...].astype(jnp.float32)
+    g = g_ref[0].astype(jnp.float32)
+    mu = jnp.mean(r, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(r - mu), axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    xhat = (r - mu) * rstd
+    gw = g * w[None, :]
+    m1 = jnp.mean(gw, axis=-1, keepdims=True)
+    m2 = jnp.mean(gw * xhat, axis=-1, keepdims=True)
+    dx_ref[0] = (rstd * (gw - m1 - xhat * m2)).astype(dx_ref.dtype)
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        dw_ref[0] = jnp.zeros_like(dw_ref[0])
+        db_ref[0] = jnp.zeros_like(db_ref[0])
+
+    dw_ref[0] += jnp.sum(g * xhat, axis=0)
+    db_ref[0] += jnp.sum(g, axis=0)
+
+
+def _row_block(kernel: str, rows: int, h: int, dtype) -> int:
+    """Benchmark-pick the row block for a residual+norm kernel at this shape
+    (same candidate set the plain rms_norm tune sweeps); 128 when tuning is
+    off. Registered per kernel name so the fwd and adjoint shapes tune
+    independently of the plain fused_rms_norm entry."""
+    from paddle_tpu.kernels.autotune import autotune
+
+    key = (rows, h, str(dtype))
+
+    def build(blk):
+        pad = (-rows) % blk
+        if kernel.endswith("_bwd"):
+            def run():
+                g = jnp.zeros((1, rows + pad, h), dtype)
+                r = jnp.zeros((1, rows + pad, h), dtype)
+                w = jnp.zeros((h,), dtype)
+                if kernel.startswith("fused_rms"):
+                    return _rms_res_adjoint_call(g, r, w, 1e-6, blk, False)
+                return _ln_res_adjoint_call(g, r, w, 1e-6, blk, False)
+            return run
+
+        def run():
+            x = jnp.zeros((1, rows + pad, h), dtype)
+            w = jnp.zeros((h,), dtype)
+            if kernel.startswith("fused_rms"):
+                return _rms_res_fwd_call(x, x, w, 1e-6, blk, False)
+            return _ln_res_fwd_call(x, x, w, jnp.zeros((h,), dtype), 1e-6, blk, False)
+        return run
+
+    return int(autotune(kernel, key, (128, 256, 512, 1024), build, default=128))
+
+
+def _rms_res_fwd_call(x2, res2, w, eps, blk, interpret):
+    rows, h = x2.shape[1], x2.shape[2]
+    spec = pl.BlockSpec((1, blk, h), lambda i: (0, i, 0))
+    return pl.pallas_call(
+        functools.partial(_rms_res_fwd_kernel, eps=eps),
+        grid=(rows // blk,),
+        compiler_params=_CompilerParams(dimension_semantics=("parallel",)),
+        in_specs=[spec, spec, pl.BlockSpec((h,), lambda i: (0,))],
+        out_specs=[spec, spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, rows, h), x2.dtype),
+            jax.ShapeDtypeStruct((1, rows, h), x2.dtype),
+        ],
+        interpret=interpret,
+    )(x2, res2, w)
+
+
+def _rms_res_adjoint_call(g2, r2, w, eps, blk, interpret):
+    rows, h = g2.shape[1], g2.shape[2]
+    spec = pl.BlockSpec((1, blk, h), lambda i: (0, i, 0))
+    return pl.pallas_call(
+        functools.partial(_rms_res_bwd_kernel, eps=eps),
+        grid=(rows // blk,),
+        # dw accumulates across the grid: sequential, never megacore-split
+        compiler_params=_CompilerParams(dimension_semantics=("arbitrary",)),
+        in_specs=[spec, pl.BlockSpec((h,), lambda i: (0,)), spec],
+        out_specs=[spec, pl.BlockSpec((1, h), lambda i: (0, 0))],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, rows, h), g2.dtype),
+            jax.ShapeDtypeStruct((1, h), jnp.float32),
+        ],
+        interpret=interpret,
+    )(r2, w, g2)
+
+
+def _ln_res_fwd_call(x2, res2, w, b, eps, blk, interpret):
+    rows, h = x2.shape[1], x2.shape[2]
+    spec = pl.BlockSpec((1, blk, h), lambda i: (0, i, 0))
+    wspec = pl.BlockSpec((h,), lambda i: (0,))
+    return pl.pallas_call(
+        functools.partial(_ln_res_fwd_kernel, eps=eps),
+        grid=(rows // blk,),
+        compiler_params=_CompilerParams(dimension_semantics=("parallel",)),
+        in_specs=[spec, spec, wspec, wspec],
+        out_specs=[spec, spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, rows, h), x2.dtype),
+            jax.ShapeDtypeStruct((1, rows, h), x2.dtype),
+        ],
+        interpret=interpret,
+    )(x2, res2, w, b)
+
+
+def _ln_res_adjoint_call(g2, r2, w, eps, blk, interpret):
+    rows, h = g2.shape[1], g2.shape[2]
+    spec = pl.BlockSpec((1, blk, h), lambda i: (0, i, 0))
+    return pl.pallas_call(
+        functools.partial(_ln_res_bwd_kernel, eps=eps),
+        grid=(rows // blk,),
+        compiler_params=_CompilerParams(dimension_semantics=("arbitrary",)),
+        in_specs=[spec, pl.BlockSpec((h,), lambda i: (0,)), spec],
+        out_specs=[
+            spec,
+            pl.BlockSpec((1, h), lambda i: (0, 0)),
+            pl.BlockSpec((1, h), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, rows, h), g2.dtype),
+            jax.ShapeDtypeStruct((1, h), jnp.float32),
+            jax.ShapeDtypeStruct((1, h), jnp.float32),
+        ],
+        interpret=interpret,
+    )(r2, w, g2)
+
+
+def _pad_rows(x, rows, pad):
+    x2 = x.reshape(1, rows, x.shape[-1])
+    if pad:
+        x2 = jnp.pad(x2, ((0, 0), (0, pad), (0, 0)))
+    return x2
+
+
+def fused_rms_norm_residual_pallas(
+    x: jax.Array, residual: jax.Array, weight: jax.Array,
+    epsilon: float = 1e-6, interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """``r = x + residual; y = rms_norm(r, weight)`` in ONE kernel.
+    Returns ``(y, r)``; any leading shape, norm over the last axis."""
+    h = x.shape[-1]
+    lead = x.shape[:-1]
+    rows = 1
+    for s in lead:
+        rows *= s
+    blk = _row_block("fused_rms_norm_residual", rows, h, x.dtype)
+    pad = (-rows) % blk
+    y, r = _rms_res_fwd_call(
+        _pad_rows(x, rows, pad), _pad_rows(residual, rows, pad), weight,
+        float(epsilon), blk, bool(interpret),
+    )
+    return y[0, :rows].reshape(*lead, h), r[0, :rows].reshape(*lead, h)
+
+
+def rms_norm_residual_adjoint_pallas(
+    g: jax.Array, r: jax.Array, weight: jax.Array,
+    epsilon: float = 1e-6, interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Adjoint of the norm half of :func:`fused_rms_norm_residual_pallas`
+    w.r.t. its pre-norm input ``r`` (the saved residual stream) as ONE
+    standalone kernel: ``(d_r, d_weight)`` given the y-cotangent ``g``.
+    The residual add's adjoint is the identity, so the caller's tape node
+    forwards ``d_r`` (plus any residual-stream cotangent) to both x and
+    residual. rstd is recomputed from ``r`` — nothing but forward outputs is
+    saved, and no jax AD transform ever touches the pallas_call."""
+    h = g.shape[-1]
+    lead = g.shape[:-1]
+    rows = 1
+    for s in lead:
+        rows *= s
+    blk = _row_block("fused_rms_norm_residual_bwd", rows, h, g.dtype)
+    pad = (-rows) % blk
+    dx, dw = _rms_res_adjoint_call(
+        _pad_rows(g, rows, pad), _pad_rows(r, rows, pad), weight,
+        float(epsilon), blk, bool(interpret),
+    )
+    return dx[0, :rows].reshape(*lead, h), dw[0].astype(weight.dtype)
+
+
+def fused_layer_norm_residual_pallas(
+    x: jax.Array, residual: jax.Array, weight: jax.Array,
+    bias: Optional[jax.Array] = None, epsilon: float = 1e-5,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """``r = x + residual; y = layer_norm(r, weight, bias)`` in ONE kernel
+    (fp32 accumulation). Returns ``(y, r)``."""
+    h = x.shape[-1]
+    lead = x.shape[:-1]
+    rows = 1
+    for s in lead:
+        rows *= s
+    if bias is None:
+        bias = jnp.zeros((h,), x.dtype)
+    blk = _row_block("fused_layer_norm_residual", rows, h, x.dtype)
+    pad = (-rows) % blk
+    y, r = _ln_res_fwd_call(
+        _pad_rows(x, rows, pad), _pad_rows(residual, rows, pad), weight, bias,
+        float(epsilon), blk, bool(interpret),
+    )
+    return y[0, :rows].reshape(*lead, h), r[0, :rows].reshape(*lead, h)
+
+
+def layer_norm_residual_adjoint_pallas(
+    g: jax.Array, r: jax.Array, weight: jax.Array,
+    epsilon: float = 1e-5, interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Standalone adjoint of :func:`fused_layer_norm_residual_pallas`'s norm
+    half: ``(d_r, d_weight, d_bias)`` given the y-cotangent (mean/var
+    recomputed from the saved residual stream; same tape contract as
+    :func:`rms_norm_residual_adjoint_pallas`)."""
+    h = g.shape[-1]
+    lead = g.shape[:-1]
+    rows = 1
+    for s in lead:
+        rows *= s
+    blk = _row_block("fused_layer_norm_residual_bwd", rows, h, g.dtype)
+    pad = (-rows) % blk
+    dx, dw, db = _ln_res_adjoint_call(
+        _pad_rows(g, rows, pad), _pad_rows(r, rows, pad), weight,
+        float(epsilon), blk, bool(interpret),
+    )
+    return (
+        dx[0, :rows].reshape(*lead, h),
+        dw[0].astype(weight.dtype),
+        db[0].astype(weight.dtype),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Fused token-gather + embedding lookup + first-layer norm (chunk-step entry)
+# ---------------------------------------------------------------------------
+
+
+def _embed_rms_kernel(ids_ref, row_ref, w_ref, emb_ref, y_ref, *, eps):
+    # ids_ref is the scalar-prefetched token vector that already steered this
+    # grid cell's row_ref block onto the right embedding row — the gather IS
+    # the BlockSpec index map, so the dense [N, V] one-hot / XLA gather
+    # round-trip never materializes. One cell = one token row.
+    row = row_ref[...]  # [1, H] embedding row, table dtype
+    emb_ref[...] = row.astype(emb_ref.dtype)
+    xf = row.astype(jnp.float32)
+    w = w_ref[...].astype(jnp.float32)
+    ms = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    # same op order as _rms_fwd_kernel (bitwise-matched vs the unfused path)
+    y_ref[...] = (xf * jax.lax.rsqrt(ms + eps) * w[None, :]).astype(y_ref.dtype)
+
+
+def fused_embed_rms_norm_pallas(
+    ids: jax.Array,  # [B, C] int32 token ids
+    table: jax.Array,  # [V, H] embedding table
+    weight: jax.Array,  # [H] first-layer rms_norm weight
+    epsilon: float = 1e-6,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Chunk-step entry fusion: token-id gather + embedding row load + the
+    first decoder layer's pre-attention RMSNorm in ONE dispatch. The
+    scalar-prefetched ids steer the BlockSpec index map (the same trick the
+    paged-attention block table plays), so each grid cell streams exactly its
+    token's [1, H] row HBM -> VMEM and writes the raw embedding (the layer
+    loop's residual stream) plus its normed form. Returns ``(emb, y)``, both
+    ``[B, C, H]`` in the table dtype. Inference-only (the serving step) —
+    there is no backward; training embeds through the regular op."""
+    b, c = ids.shape
+    v, h = table.shape
+    n = b * c
+    flat = jnp.clip(ids.reshape(n).astype(jnp.int32), 0, v - 1)
+    row_spec = pl.BlockSpec((1, h), lambda i, ids: (ids[i], 0))
+    out_spec = pl.BlockSpec((1, h), lambda i, ids: (i, 0))
+    emb, y = pl.pallas_call(
+        functools.partial(_embed_rms_kernel, eps=float(epsilon)),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(n,),
+            in_specs=[row_spec, pl.BlockSpec((h,), lambda i, ids: (0,))],
+            out_specs=[out_spec, out_spec],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((n, h), table.dtype),
+            jax.ShapeDtypeStruct((n, h), table.dtype),
+        ],
+        # token cells are independent: megacore-splittable
+        compiler_params=_CompilerParams(dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(flat, table, weight)
+    return emb.reshape(b, c, h), y.reshape(b, c, h)

@@ -365,3 +365,368 @@ def paged_flash_chunk(
     )
     # [B, HKV, C*G, D] -> [B, C, HQ, D]
     return out.reshape(b, hkv, c, g, d).transpose(0, 2, 1, 3, 4).reshape(b, c, hq, d)
+
+
+# ---------------------------------------------------------------------------
+# Fused-epilogue variants: q-RoPE folded into the block walk
+# ---------------------------------------------------------------------------
+#
+# The decode step's unfused path ropes q in a separate XLA elementwise pass —
+# one extra HBM round-trip over [B, C, HQ, D] per layer just to feed the
+# attention kernel. The *_fused kernels take the per-slot cos/sin rows
+# (already offset-gathered, the per-batch tables the XLA path uses) as two
+# extra VMEM inputs and apply the rotation to the q block in-register before
+# the first dot. Numerics are LOCKSTEP with the unfused TPU path: the
+# rotation is computed in q's dtype (exactly ``_rope_apply_xla`` with
+# tables cast to x.dtype) and only THEN cast fp32 and scaled — so fused
+# on/off stay byte-identical. KV is roped before the cache append (cache
+# holds roped keys) in both modes; only q's rope moves into the kernel.
+
+
+def _rope_rows(q, c, s, half):
+    # neox rotate-half in q.dtype: q*cos + concat(-q2, q1)*sin
+    q1 = q[..., :half]
+    q2 = q[..., half:]
+    rot = jnp.concatenate([-q2, q1], axis=-1)
+    return q * c + rot * s
+
+
+def _decode_fused_kernel(
+    tables_ref,  # scalar prefetch: [B, MBS] int32
+    lens_ref,  # scalar prefetch: [B] int32 (length INCLUDING current token)
+    q_ref,  # [1, 1, G, D] pre-rope q
+    cos_ref,  # [1, 1, D] this slot's rope row
+    sin_ref,
+    k_ref,  # [1, 1, BS, D]
+    v_ref,
+    o_ref,  # [1, 1, G, D]
+    m_ref,
+    l_ref,
+    acc_ref,
+    *,
+    scale: float,
+    block_size: int,
+    num_blocks: int,
+):
+    bi = pl.program_id(0)
+    i = pl.program_id(2)
+
+    @pl.when(i == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(i * block_size < lens_ref[bi])
+    def _attend():
+        d = q_ref.shape[-1]
+        g_rows = q_ref.shape[2]
+        # materialize the [G, D] rope rows BEFORE the arithmetic — the same
+        # op order the chunk kernel and the XLA rope composition lower to
+        # (a [1, D] broadcast operand contracts differently and costs bitwise
+        # parity with the unfused path)
+        c = jnp.broadcast_to(cos_ref[0], (g_rows, d)).astype(q_ref.dtype)
+        s_t = jnp.broadcast_to(sin_ref[0], (g_rows, d)).astype(q_ref.dtype)
+        q = _rope_rows(q_ref[0, 0], c, s_t, d // 2)  # [G, D] in q.dtype
+        q = q.astype(jnp.float32) * scale
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        pos = i * block_size + jax.lax.broadcasted_iota(jnp.int32, (1, block_size), 1)
+        valid = pos < lens_ref[bi]
+        s = jnp.where(valid, s, NEG_INF)
+
+        m_prev = m_ref[...]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new) * valid.astype(jnp.float32)
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_ref[...] = m_new
+
+    @pl.when(i == num_blocks - 1)
+    def _finish():
+        denom = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0, 0] = (acc_ref[...] / denom).astype(o_ref.dtype)
+
+
+@functools.lru_cache(maxsize=64)
+def decode_fused_lowering_supported(b: int, hq: int, hkv: int, d: int, nb: int,
+                                    bs: int, mbs: int, dtype: str) -> bool:
+    """Static Mosaic-lowering probe for the rope-fused decode kernel (the
+    lane-dim concat split can fail lowering for some D — same routing rule
+    as :func:`lowering_supported`)."""
+    import numpy as np
+
+    q = jax.ShapeDtypeStruct((b, hq, d), np.dtype(dtype))
+    cs = jax.ShapeDtypeStruct((b, 1, d), np.dtype(dtype))
+    kc = jax.ShapeDtypeStruct((nb, hkv, bs, d), np.dtype(dtype))
+    tb = jax.ShapeDtypeStruct((b, mbs), np.int32)
+    ln = jax.ShapeDtypeStruct((b,), np.int32)
+    try:
+        jax.export.export(
+            jax.jit(
+                lambda q, c, s, kc, vc, t, l: paged_flash_decode_fused(
+                    q, c, s, kc, vc, t, l
+                )
+            ),
+            platforms=["tpu"],
+        )(q, cs, cs, kc, kc, tb, ln)
+        return True
+    except Exception:  # noqa: BLE001 - any lowering failure means "don't"
+        return False
+
+
+def paged_flash_decode_fused(
+    q: jax.Array,  # [B, HQ, D] PRE-rope queries
+    cos: jax.Array,  # [B, 1, D] offset-gathered rope rows
+    sin: jax.Array,
+    key_cache: jax.Array,  # [NB, HKV, BS, D] (keys already roped on append)
+    value_cache: jax.Array,
+    block_tables: jax.Array,
+    seq_lens: jax.Array,
+    scale: Optional[float] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """:func:`paged_flash_decode` with q-RoPE folded into the block walk —
+    one dispatch replaces the rope pass + attention pair."""
+    b, hq, d = q.shape
+    nb, hkv, bs, _ = key_cache.shape
+    mbs = block_tables.shape[1]
+    if hq % hkv != 0:
+        raise ValueError(f"q heads {hq} not a multiple of kv heads {hkv}")
+    g = hq // hkv
+    if scale is None:
+        scale = 1.0 / (d**0.5)
+    qg = q.reshape(b, hkv, g, d)
+
+    kernel = functools.partial(
+        _decode_fused_kernel, scale=float(scale), block_size=bs, num_blocks=mbs
+    )
+
+    def _kv_index(bi, hi, i, tables, lens):
+        last = jnp.maximum((lens[bi] + bs - 1) // bs - 1, 0)
+        return (tables[bi, jnp.minimum(i, last)], hi, 0, 0)
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, hkv, mbs),
+            in_specs=[
+                pl.BlockSpec((1, 1, g, d), lambda bi, hi, i, tables, lens: (bi, hi, 0, 0)),
+                pl.BlockSpec((1, 1, d), lambda bi, hi, i, tables, lens: (bi, 0, 0)),
+                pl.BlockSpec((1, 1, d), lambda bi, hi, i, tables, lens: (bi, 0, 0)),
+                pl.BlockSpec((1, 1, bs, d), _kv_index),
+                pl.BlockSpec((1, 1, bs, d), _kv_index),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, g, d), lambda bi, hi, i, tables, lens: (bi, hi, 0, 0)
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((g, 1), jnp.float32),
+                pltpu.VMEM((g, 1), jnp.float32),
+                pltpu.VMEM((g, d), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, hkv, g, d), q.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(
+        block_tables.astype(jnp.int32),
+        seq_lens.astype(jnp.int32),
+        qg,
+        cos,
+        sin,
+        key_cache,
+        value_cache,
+    )
+    return out.reshape(b, hq, d)
+
+
+def _chunk_fused_kernel(
+    tables_ref,  # scalar prefetch: [B, MBS] int32
+    lens_ref,  # scalar prefetch: [B] int32 tokens cached BEFORE the chunk
+    qlens_ref,  # scalar prefetch: [B] int32 valid new tokens
+    q_ref,  # [1, 1, C*G, D] chunk-major packed PRE-rope rows
+    cos_ref,  # [1, C, D] this slot's offset-gathered rope rows
+    sin_ref,
+    k_ref,
+    v_ref,
+    o_ref,
+    m_ref,
+    l_ref,
+    acc_ref,
+    *,
+    scale: float,
+    block_size: int,
+    num_blocks: int,
+    group: int,
+):
+    bi = pl.program_id(0)
+    i = pl.program_id(2)
+    rows = q_ref.shape[2]
+
+    @pl.when(i == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(i * block_size < lens_ref[bi] + qlens_ref[bi])
+    def _attend():
+        d = q_ref.shape[-1]
+        c_dim = rows // group
+        # expand [C, D] rope rows to the packed [C*G, D] row layout (row =
+        # j*G + g shares token j's rotation across its G query heads)
+        c = jnp.broadcast_to(
+            cos_ref[0][:, None, :], (c_dim, group, d)
+        ).reshape(rows, d).astype(q_ref.dtype)
+        s_t = jnp.broadcast_to(
+            sin_ref[0][:, None, :], (c_dim, group, d)
+        ).reshape(rows, d).astype(q_ref.dtype)
+        q = _rope_rows(q_ref[0, 0], c, s_t, d // 2)  # [C*G, D] in q.dtype
+        q = q.astype(jnp.float32) * scale
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        pos = i * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (rows, block_size), 1
+        )
+        row_j = jax.lax.broadcasted_iota(jnp.int32, (rows, block_size), 0) // group
+        valid = (pos < lens_ref[bi] + row_j + 1) & (row_j < qlens_ref[bi])
+        s = jnp.where(valid, s, NEG_INF)
+
+        m_prev = m_ref[...]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new) * valid.astype(jnp.float32)
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_ref[...] = m_new
+
+    @pl.when(i == num_blocks - 1)
+    def _finish():
+        denom = jnp.maximum(l_ref[...], 1e-30)
+        out = acc_ref[...] / denom
+        row_j = jax.lax.broadcasted_iota(jnp.int32, (rows, 1), 0) // group
+        out = jnp.where(row_j < qlens_ref[bi], out, 0.0)
+        o_ref[0, 0] = out.astype(o_ref.dtype)
+
+
+@functools.lru_cache(maxsize=64)
+def chunk_fused_lowering_supported(b: int, c: int, hq: int, hkv: int, d: int,
+                                   nb: int, bs: int, mbs: int, dtype: str) -> bool:
+    """Static Mosaic-lowering probe for the rope-fused mixed kernel, cached
+    per geometry (same rule as :func:`chunk_lowering_supported`)."""
+    import numpy as np
+
+    q = jax.ShapeDtypeStruct((b, c, hq, d), np.dtype(dtype))
+    cs = jax.ShapeDtypeStruct((b, c, d), np.dtype(dtype))
+    kc = jax.ShapeDtypeStruct((nb, hkv, bs, d), np.dtype(dtype))
+    tb = jax.ShapeDtypeStruct((b, mbs), np.int32)
+    ln = jax.ShapeDtypeStruct((b,), np.int32)
+    try:
+        jax.export.export(
+            jax.jit(
+                lambda q, c, s, kc, vc, t, l, ql: paged_flash_chunk_fused(
+                    q, c, s, kc, vc, t, l, ql
+                )
+            ),
+            platforms=["tpu"],
+        )(q, cs, cs, kc, kc, tb, ln, ln)
+        return True
+    except Exception:  # noqa: BLE001 - any lowering failure means "don't"
+        return False
+
+
+def paged_flash_chunk_fused(
+    q: jax.Array,  # [B, C, HQ, D] PRE-rope ragged chunk
+    cos: jax.Array,  # [B, C, D] offset-gathered rope rows per chunk token
+    sin: jax.Array,
+    key_cache: jax.Array,  # [NB, HKV, BS, D] (keys already roped on append)
+    value_cache: jax.Array,
+    block_tables: jax.Array,
+    seq_lens: jax.Array,  # [B] tokens cached BEFORE the chunk
+    q_lens: jax.Array,  # [B] valid new tokens (0 = inactive slot)
+    scale: Optional[float] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """:func:`paged_flash_chunk` with q-RoPE folded into the block walk —
+    the decode layer's rope pass + attention collapse to ONE dispatch."""
+    b, c, hq, d = q.shape
+    nb, hkv, bs, _ = key_cache.shape
+    mbs = block_tables.shape[1]
+    if hq % hkv != 0:
+        raise ValueError(f"q heads {hq} not a multiple of kv heads {hkv}")
+    g = hq // hkv
+    if scale is None:
+        scale = 1.0 / (d**0.5)
+    qg = q.reshape(b, c, hkv, g, d).transpose(0, 2, 1, 3, 4).reshape(b, hkv, c * g, d)
+
+    kernel = functools.partial(
+        _chunk_fused_kernel, scale=float(scale), block_size=bs, num_blocks=mbs,
+        group=g,
+    )
+
+    def _kv_index(bi, hi, i, tables, lens, qlens):
+        last = jnp.maximum((lens[bi] + qlens[bi] + bs - 1) // bs - 1, 0)
+        return (tables[bi, jnp.minimum(i, last)], hi, 0, 0)
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=(b, hkv, mbs),
+            in_specs=[
+                pl.BlockSpec(
+                    (1, 1, c * g, d),
+                    lambda bi, hi, i, tables, lens, qlens: (bi, hi, 0, 0),
+                ),
+                pl.BlockSpec(
+                    (1, c, d), lambda bi, hi, i, tables, lens, qlens: (bi, 0, 0)
+                ),
+                pl.BlockSpec(
+                    (1, c, d), lambda bi, hi, i, tables, lens, qlens: (bi, 0, 0)
+                ),
+                pl.BlockSpec((1, 1, bs, d), _kv_index),
+                pl.BlockSpec((1, 1, bs, d), _kv_index),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, c * g, d),
+                lambda bi, hi, i, tables, lens, qlens: (bi, hi, 0, 0),
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((c * g, 1), jnp.float32),
+                pltpu.VMEM((c * g, 1), jnp.float32),
+                pltpu.VMEM((c * g, d), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, hkv, c * g, d), q.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(
+        block_tables.astype(jnp.int32),
+        seq_lens.astype(jnp.int32),
+        q_lens.astype(jnp.int32),
+        qg,
+        cos,
+        sin,
+        key_cache,
+        value_cache,
+    )
+    return out.reshape(b, hkv, c, g, d).transpose(0, 2, 1, 3, 4).reshape(b, c, hq, d)

@@ -29,8 +29,19 @@ from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.flags import GLOBAL_FLAGS
 from paddle_tpu.generation import GenerationMixin
 from paddle_tpu.incubate.nn.functional import fused_rotary_position_embedding
+from paddle_tpu.kernels.fused import count_dispatch
 from paddle_tpu.ops.creation import arange
 from paddle_tpu.ops.manipulation import concat, reshape
+
+
+def _armed_tp_mesh() -> Any:
+    """The serving engine's tensor-parallel mesh, if one is armed on this
+    thread (``sys.modules`` gate so the single-chip path never imports the
+    distributed package — same rule as block_attention's)."""
+    import sys
+
+    mod = sys.modules.get("paddle_tpu.distributed.tp")
+    return mod.current_tp_mesh() if mod is not None else None
 
 
 @dataclass
@@ -170,7 +181,9 @@ class LlamaAttention(nn.Layer):
             lens_t = lens if isinstance(lens, _T) else _T(lens)
             lens_arr = lens_t._data
             cos, sin = self.rotary_emb(s, lens_t)  # ragged: [B, s, 1, D]
+            count_dispatch("unfused:rope_gather")
             q, k, _ = fused_rotary_position_embedding(q, k, None, sin=sin, cos=cos)
+            count_dispatch("unfused:rope_apply")
             mask_arr = slot_mask._data if isinstance(slot_mask, _T) else slot_mask
             if q_lens is not None:
                 out_a, kc2, vc2 = block_multihead_chunk_attention(
@@ -195,7 +208,9 @@ class LlamaAttention(nn.Layer):
                     lens_arr,
                     slot_mask=mask_arr,
                 )
+            count_dispatch("unfused:attend")
             out = self.o_proj(reshape(_T(out_a), [b, s, self.num_heads * self.head_dim]))
+            count_dispatch("unfused:o_proj")
             if not use_cache:
                 return out
             new_past = (_T(kc2), _T(vc2), tables, lens)
@@ -255,6 +270,55 @@ class LlamaAttention(nn.Layer):
             return out, new_cache
         return out
 
+    def forward_paged_fused(
+        self,
+        hidden_states: Tensor,  # pre-normed [B, s, H] (norm fused upstream)
+        past_key_value: Tuple[Any, ...],  # the engine's 6-tuple paged past
+        cos: Tensor,  # [B, s, 1, D] offset-gathered rope rows (shared by
+        sin: Tensor,  # every layer — gathered ONCE per step by the caller)
+    ) -> Tuple[Tensor, Tuple[Any, ...]]:
+        """The fused decode layer's attention half: qkv projections feed the
+        rope-fused paged kernel (q's rotation runs inside the block walk, k's
+        fuses into the cache-append scatter), so the per-layer rope pass +
+        attention collapse to one dispatch. Under an armed tp mesh o_proj
+        runs the tile-split row-parallel matmul so its all-reduce overlaps
+        the next tile's compute."""
+        from paddle_tpu.core.tensor import Tensor as _T
+        from paddle_tpu.incubate.nn.functional import (
+            block_multihead_chunk_attention_fused,
+        )
+
+        b, s, _ = hidden_states.shape
+        q = reshape(self.q_proj(hidden_states), [b, s, self.num_heads, self.head_dim])
+        k = reshape(self.k_proj(hidden_states), [b, s, self.num_kv_heads, self.head_dim])
+        v = reshape(self.v_proj(hidden_states), [b, s, self.num_kv_heads, self.head_dim])
+        kc, vc, tables, lens, slot_mask, q_lens = past_key_value
+        out_a, kc2, vc2 = block_multihead_chunk_attention_fused(
+            q._data,
+            k._data,
+            v._data,
+            cos._data if isinstance(cos, _T) else cos,
+            sin._data if isinstance(sin, _T) else sin,
+            kc._data if isinstance(kc, _T) else kc,
+            vc._data if isinstance(vc, _T) else vc,
+            tables._data if isinstance(tables, _T) else tables,
+            lens._data if isinstance(lens, _T) else lens,
+            q_lens._data if isinstance(q_lens, _T) else q_lens,
+            slot_mask=slot_mask._data if isinstance(slot_mask, _T) else slot_mask,
+        )
+        count_dispatch("fused:attend")
+        out_t = reshape(_T(out_a), [b, s, self.num_heads * self.head_dim])
+        mesh = _armed_tp_mesh()
+        if mesh is None:
+            out = self.o_proj(out_t)
+        else:
+            from paddle_tpu.distributed.tp import row_parallel_overlap_matmul
+
+            out = _T(row_parallel_overlap_matmul(out_t._data, self.o_proj.weight._data))
+        count_dispatch("fused:o_proj")
+        new_past = (_T(kc2), _T(vc2), tables, lens, slot_mask, q_lens)
+        return out, new_past
+
 
 class LlamaMLP(nn.Layer):
     def __init__(self, config: LlamaConfig) -> None:
@@ -285,15 +349,21 @@ class LlamaDecoderLayer(nn.Layer):
     ) -> Any:
         residual = hidden_states
         h = self.input_layernorm(hidden_states)
+        count_dispatch("unfused:input_norm")
         attn_out = self.self_attn(
             h, startend_row_indices, past_key_value, use_cache, cache_position
         )
         if use_cache:
             attn_out, cache = attn_out
         h = residual + attn_out
+        count_dispatch("unfused:attn_residual_add")
         residual = h
         h = self.post_attention_layernorm(h)
-        h = residual + self.mlp(h)
+        count_dispatch("unfused:post_attn_norm")
+        h = self.mlp(h)
+        count_dispatch("unfused:mlp")
+        h = residual + h
+        count_dispatch("unfused:mlp_residual_add")
         if use_cache:
             return h, cache
         return h
@@ -315,7 +385,21 @@ class LlamaModel(nn.Layer):
         use_cache: bool = False,
         cache_position: Optional[Tensor] = None,
     ) -> Any:
+        if (
+            cache_position is not None
+            and startend_row_indices is None
+            and past_key_values is not None
+            and GLOBAL_FLAGS.get("use_fused_decode_layer")
+            and len(past_key_values) == len(self.layers)
+            and all(p is not None and len(p) == 6 for p in past_key_values)
+        ):
+            # the continuous-batching engine's one-signature mixed ragged
+            # step (6-tuple paged past): run the FUSED decode layer loop —
+            # same math, fewer dispatches. generate_paged's 4/5-tuple pasts
+            # and every train/prefill path stay on the layer modules below.
+            return self._forward_paged_fused(input_ids, past_key_values, use_cache)
         h = self.embed_tokens(input_ids)
+        count_dispatch("unfused:embed")
         new_caches = [] if use_cache else None
         use_recompute = (
             self.config.recompute
@@ -335,6 +419,97 @@ class LlamaModel(nn.Layer):
                 h, cache = h
                 new_caches.append(cache)
         h = self.norm(h)
+        count_dispatch("unfused:final_norm")
+        if use_cache:
+            return h, new_caches
+        return h
+
+    def _forward_paged_fused(
+        self,
+        input_ids: Tensor,
+        past_key_values: Any,
+        use_cache: bool,
+    ) -> Any:
+        """The decode step's FUSED layer loop (``FLAGS_use_fused_decode_layer``).
+
+        The unfused step issues ~9 dispatches per layer (input norm, rope
+        gather, rope apply, attend, o_proj, two residual adds, post-attention
+        norm, mlp). Here the epilogues pair up into single kernels:
+
+        - entry: token gather + embedding lookup + layer 0's input RMSNorm
+          fuse into one scalar-prefetch kernel seeding BOTH the residual
+          stream and the normed hidden;
+        - rope rows gather ONCE per step (every layer's rotary buffers hold
+          identical values — the unfused per-layer gathers are redundant);
+        - per layer: the rope-fused paged-attention kernel (q rotates inside
+          the block walk), then residual-add + post-attention norm as ONE
+          kernel, the MLP, and residual-add + the NEXT layer's input norm as
+          ONE kernel — the last layer pairs with the model's final norm, so
+          the loop returns ``h`` already normed;
+        - under an armed tp mesh the row-parallel matmuls (o_proj/down_proj)
+          split into token tiles so each tile's all-reduce overlaps the next
+          tile's compute (byte-identical: the split only partitions rows).
+
+        Byte-identity with the unfused loop holds per backend: every fused
+        op's XLA fallback is the exact unfused composition, residual adds
+        commute bitwise under IEEE, and the Pallas kernels replicate the
+        unfused kernels' op order.
+        """
+        from paddle_tpu.core.tensor import Tensor as _T
+        from paddle_tpu.incubate.nn.functional import (
+            fused_embed_rms_norm,
+            fused_rms_norm_residual,
+        )
+
+        layers = list(self.layers)
+        first = layers[0]
+        residual, h = fused_embed_rms_norm(
+            input_ids,
+            self.embed_tokens.weight,
+            first.input_layernorm.weight,
+            first.input_layernorm.epsilon,
+        )
+        count_dispatch("fused:embed_norm")
+        s = input_ids.shape[1]
+        lens = past_key_values[0][3]
+        lens_t = lens if isinstance(lens, _T) else _T(lens)
+        cos, sin = first.self_attn.rotary_emb(s, lens_t)  # once per STEP
+        count_dispatch("fused:rope_gather")
+        mesh = _armed_tp_mesh()
+        new_caches = [] if use_cache else None
+        n = len(layers)
+        for i, layer in enumerate(layers):
+            attn_out, cache = layer.self_attn.forward_paged_fused(
+                h, past_key_values[i], cos, sin
+            )
+            h, residual = fused_rms_norm_residual(
+                attn_out,
+                layer.post_attention_layernorm.weight,
+                residual,
+                layer.post_attention_layernorm.epsilon,
+            )
+            count_dispatch("fused:residual_norm")
+            if mesh is None:
+                mlp_out = layer.mlp(h)
+            else:
+                from paddle_tpu.distributed.tp import row_parallel_overlap_matmul
+
+                inner = F.swiglu(layer.mlp.gate_proj(h), layer.mlp.up_proj(h))
+                mlp_out = _T(
+                    row_parallel_overlap_matmul(
+                        inner._data, layer.mlp.down_proj.weight._data
+                    )
+                )
+            count_dispatch("fused:mlp")
+            next_norm = layers[i + 1].input_layernorm if i + 1 < n else self.norm
+            h, residual = fused_rms_norm_residual(
+                mlp_out, next_norm.weight, residual, next_norm.epsilon
+            )
+            count_dispatch("fused:residual_norm")
+            if use_cache:
+                new_caches.append(cache)
+        # h left the loop already final-normed (the last pairing used
+        # self.norm's weight)
         if use_cache:
             return h, new_caches
         return h

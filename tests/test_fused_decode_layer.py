@@ -1,0 +1,460 @@
+"""Decode-step megakernel tests (``FLAGS_use_fused_decode_layer``).
+
+Pins the PR's acceptance invariants:
+
+- the NEW fused-epilogue kernels (residual+norm, embed+norm, rope-fused
+  paged attention) match their unfused compositions — bitwise where the
+  backend contract promises it (same-jit, same op order), allclose for the
+  adjoints vs ``jax.grad`` of the composition;
+- the engine emits BYTE-IDENTICAL token streams fused on vs off across
+  chunked prefill, decode, prefix-cache CoW forks, and spec-decode rewinds;
+- both flag settings keep the one-signature invariant (``step_traces == 1``
+  each — the flag is read at trace time, so each setting gets its own
+  engine);
+- the trace-time dispatch probe shows the fused layer loop issuing FEWER
+  dispatch sites per layer than the unfused one — the perf claim's CPU-
+  checkable proxy;
+- GPT / ERNIE flag-gated epilogue fusion is byte-identical with matching
+  grads, and the tp overlap matmul is byte-identical to the plain matmul.
+"""
+
+import contextlib
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.inference import ContinuousBatchingEngine
+from paddle_tpu.kernels.fused import (
+    arm_dispatch_probe,
+    disarm_dispatch_probe,
+    fused_embed_rms_norm_pallas,
+    fused_layer_norm_residual_pallas,
+    fused_rms_norm_pallas,
+    fused_rms_norm_residual_pallas,
+    layer_norm_residual_adjoint_pallas,
+    rms_norm_residual_adjoint_pallas,
+)
+from paddle_tpu.kernels.paged_attention import (
+    paged_flash_chunk,
+    paged_flash_chunk_fused,
+    paged_flash_decode,
+    paged_flash_decode_fused,
+)
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+BS = 16  # tokens per physical block (the kernel tile)
+
+
+@contextlib.contextmanager
+def _fused_flag(value):
+    prior = paddle.get_flags(["FLAGS_use_fused_decode_layer"])[
+        "FLAGS_use_fused_decode_layer"
+    ]
+    paddle.set_flags({"FLAGS_use_fused_decode_layer": value})
+    try:
+        yield
+    finally:
+        paddle.set_flags({"FLAGS_use_fused_decode_layer": prior})
+
+
+def _model(seed=0):
+    paddle.seed(seed)
+    cfg = LlamaConfig.tiny()
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m, cfg
+
+
+# -- kernel numerics (interpret mode) ----------------------------------------
+
+class TestResidualNormKernels:
+    def test_rms_residual_fwd_matches_unfused_kernel_bitwise(self):
+        """The fused kernel's op order is the EXISTING ``_rms_fwd_kernel``'s
+        (f32 weight multiply before downcast) applied to ``x + residual`` —
+        the on-TPU unfused composition, bitwise."""
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal((2, 8, 128)), jnp.float32)
+        res = jnp.asarray(rng.standard_normal((2, 8, 128)), jnp.float32)
+        w = jnp.asarray(rng.standard_normal(128), jnp.float32)
+        y, r = fused_rms_norm_residual_pallas(x, res, w, interpret=True)
+        ref_y = fused_rms_norm_pallas(x + res, w, interpret=True)
+        np.testing.assert_array_equal(np.asarray(r), np.asarray(x + res))
+        np.testing.assert_array_equal(np.asarray(y), np.asarray(ref_y))
+
+    def test_rms_residual_adjoint_matches_jax_grad(self):
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.standard_normal((2, 4, 128)), jnp.float32)
+        res = jnp.asarray(rng.standard_normal((2, 4, 128)), jnp.float32)
+        w = jnp.asarray(rng.standard_normal(128), jnp.float32)
+        g = jnp.asarray(rng.standard_normal((2, 4, 128)), jnp.float32)
+        r = x + res
+
+        def comp(r_, w_):
+            xf = r_.astype(jnp.float32)
+            rstd = jax.lax.rsqrt(jnp.mean(xf * xf, -1, keepdims=True) + 1e-6)
+            return jnp.sum((xf * rstd * w_) * g)
+
+        dr_ref = jax.grad(comp, argnums=0)(r, w)
+        dw_ref = jax.grad(comp, argnums=1)(r, w)
+        dx, dw = rms_norm_residual_adjoint_pallas(g, r, w, 1e-6, interpret=True)
+        np.testing.assert_allclose(np.asarray(dx), np.asarray(dr_ref), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(dw), np.asarray(dw_ref), atol=1e-4)
+
+    def test_ln_residual_fwd_and_adjoint(self):
+        rng = np.random.default_rng(2)
+        x = jnp.asarray(rng.standard_normal((3, 128)), jnp.float32)
+        res = jnp.asarray(rng.standard_normal((3, 128)), jnp.float32)
+        w = jnp.asarray(rng.standard_normal(128), jnp.float32)
+        b = jnp.asarray(rng.standard_normal(128), jnp.float32)
+        g = jnp.asarray(rng.standard_normal((3, 128)), jnp.float32)
+        y, r = fused_layer_norm_residual_pallas(x, res, w, b, interpret=True)
+        np.testing.assert_array_equal(np.asarray(r), np.asarray(x + res))
+
+        def comp(r_, w_, b_):
+            mu = jnp.mean(r_, -1, keepdims=True)
+            var = jnp.mean((r_ - mu) ** 2, -1, keepdims=True)
+            return (r_ - mu) * jax.lax.rsqrt(var + 1e-5) * w_ + b_
+
+        np.testing.assert_allclose(
+            np.asarray(y), np.asarray(comp(r, w, b)), atol=1e-5
+        )
+        dr_ref, dw_ref, db_ref = jax.grad(
+            lambda r_, w_, b_: jnp.sum(comp(r_, w_, b_) * g), argnums=(0, 1, 2)
+        )(r, w, b)
+        dx, dw, db = layer_norm_residual_adjoint_pallas(g, r, w, interpret=True)
+        np.testing.assert_allclose(np.asarray(dx), np.asarray(dr_ref), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(dw), np.asarray(dw_ref), atol=1e-4)
+        np.testing.assert_allclose(np.asarray(db), np.asarray(db_ref), atol=1e-4)
+
+    def test_embed_rms_gather_exact(self):
+        rng = np.random.default_rng(3)
+        table = jnp.asarray(rng.standard_normal((32, 128)), jnp.float32)
+        w = jnp.asarray(rng.standard_normal(128), jnp.float32)
+        ids = jnp.asarray(rng.integers(0, 32, (2, 5)), jnp.int32)
+        emb, y = fused_embed_rms_norm_pallas(ids, table, w, interpret=True)
+        np.testing.assert_array_equal(np.asarray(emb), np.asarray(table[ids]))
+        ref_y = fused_rms_norm_pallas(table[ids], w, interpret=True)
+        np.testing.assert_array_equal(np.asarray(y), np.asarray(ref_y))
+
+
+def _neox_rope(x, cos, sin):
+    """cos/sin broadcast against x's head dim; x.dtype arithmetic — the
+    kernel's in-block op order."""
+    c = cos.astype(x.dtype)
+    s = sin.astype(x.dtype)
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    return x * c + jnp.concatenate([-x2, x1], axis=-1) * s
+
+
+class TestRopeFusedPagedAttention:
+    """Fused in-kernel q-rope vs XLA-rope-then-unfused-kernel, compared
+    INSIDE one jit — the real engine's one-jit step — where the two are
+    bitwise identical (an eager boundary would reintroduce FMA-contraction
+    diffs)."""
+
+    def _chunk_args(self, seed=0, b=3, c=4, hq=4, hkv=4, d=64, mbs=4, nb=16):
+        rng = np.random.default_rng(seed)
+        q = jnp.asarray(rng.normal(size=(b, c, hq, d)), jnp.float32)
+        cos = jnp.asarray(np.cos(rng.normal(size=(b, c, d))), jnp.float32)
+        sin = jnp.asarray(np.sin(rng.normal(size=(b, c, d))), jnp.float32)
+        kc = jnp.asarray(rng.normal(size=(nb, hkv, BS, d)), jnp.float32)
+        vc = jnp.asarray(rng.normal(size=(nb, hkv, BS, d)), jnp.float32)
+        tables = jnp.asarray(
+            rng.permutation(nb)[: b * mbs].reshape(b, mbs), jnp.int32
+        )
+        lens = jnp.asarray(rng.integers(c, mbs * BS - c, (b,)), jnp.int32)
+        q_lens = jnp.asarray([1, c, 0][:b], jnp.int32)
+        return q, cos, sin, kc, vc, tables, lens, q_lens
+
+    def test_chunk_fused_bitwise_same_jit(self):
+        q, cos, sin, kc, vc, tables, lens, q_lens = self._chunk_args()
+
+        @jax.jit
+        def fused(q, cos, sin):
+            return paged_flash_chunk_fused(
+                q, cos, sin, kc, vc, tables, lens, q_lens, interpret=True
+            )
+
+        @jax.jit
+        def unfused(q, cos, sin):
+            qr = _neox_rope(q, cos[:, :, None, :], sin[:, :, None, :])
+            return paged_flash_chunk(qr, kc, vc, tables, lens, q_lens, interpret=True)
+
+        np.testing.assert_array_equal(
+            np.asarray(fused(q, cos, sin)), np.asarray(unfused(q, cos, sin))
+        )
+
+    def test_chunk_fused_gqa(self):
+        q, cos, sin, kc, vc, tables, lens, q_lens = self._chunk_args(
+            seed=1, hq=8, hkv=2
+        )
+
+        @jax.jit
+        def fused(q, cos, sin):
+            return paged_flash_chunk_fused(
+                q, cos, sin, kc, vc, tables, lens, q_lens, interpret=True
+            )
+
+        @jax.jit
+        def unfused(q, cos, sin):
+            qr = _neox_rope(q, cos[:, :, None, :], sin[:, :, None, :])
+            return paged_flash_chunk(qr, kc, vc, tables, lens, q_lens, interpret=True)
+
+        np.testing.assert_array_equal(
+            np.asarray(fused(q, cos, sin)), np.asarray(unfused(q, cos, sin))
+        )
+
+    def _decode_pair(self, hq, hkv, seed=2, b=3, d=64, mbs=4, nb=16):
+        rng = np.random.default_rng(seed)
+        q = jnp.asarray(rng.normal(size=(b, hq, d)), jnp.float32)
+        cos = jnp.asarray(np.cos(rng.normal(size=(b, 1, d))), jnp.float32)
+        sin = jnp.asarray(np.sin(rng.normal(size=(b, 1, d))), jnp.float32)
+        kc = jnp.asarray(rng.normal(size=(nb, hkv, BS, d)), jnp.float32)
+        vc = jnp.asarray(rng.normal(size=(nb, hkv, BS, d)), jnp.float32)
+        tables = jnp.asarray(
+            rng.permutation(nb)[: b * mbs].reshape(b, mbs), jnp.int32
+        )
+        lens = jnp.asarray(rng.integers(1, mbs * BS + 1, (b,)), jnp.int32)
+
+        @jax.jit
+        def fused(q, cos, sin):
+            return paged_flash_decode_fused(
+                q, cos, sin, kc, vc, tables, lens, interpret=True
+            )
+
+        @jax.jit
+        def unfused(q, cos, sin):
+            qr = _neox_rope(q, cos, sin)
+            return paged_flash_decode(qr, kc, vc, tables, lens, interpret=True)
+
+        return np.asarray(fused(q, cos, sin)), np.asarray(unfused(q, cos, sin))
+
+    def test_decode_fused_gqa_bitwise_same_jit(self):
+        a, b = self._decode_pair(hq=8, hkv=2)
+        np.testing.assert_array_equal(a, b)
+
+    def test_decode_fused_mha_single_row_allclose(self):
+        """g=1 puts a [1, D] row through the in-kernel rope; XLA's FMA
+        selection is shape-dependent for single-row elementwise chains, so
+        MHA decode is exact math but not bitwise vs the outer-rope lowering
+        (~1 ulp). The engine's one-signature step uses the CHUNK kernel
+        (bitwise above); this kernel serves generate_paged/bench."""
+        a, b = self._decode_pair(hq=4, hkv=4)
+        np.testing.assert_allclose(a, b, atol=1e-6, rtol=1e-6)
+
+
+# -- engine byte-identity + one signature ------------------------------------
+
+class TestEngineFusedParity:
+    def _run(self, m, cfg, prompts, budgets, fused, **eng_kw):
+        with _fused_flag(fused):
+            eng = ContinuousBatchingEngine(
+                m, max_slots=2, block_size=4, prompt_bucket=32,
+                prefill_chunk=8, max_model_len=128, **eng_kw
+            )
+            rids = [
+                eng.add_request(p, max_new_tokens=t)
+                for p, t in zip(prompts, budgets)
+            ]
+            out = eng.run()
+        return eng, [out[r].tokens() for r in rids]
+
+    def test_mixed_workload_byte_identical_and_one_signature_each(self):
+        """Chunked prefill + decode, staggered budgets, more requests than
+        slots: same stream fused on/off, ONE compiled signature each."""
+        m, cfg = _model(seed=3)
+        rng = np.random.default_rng(7)
+        prompts = [
+            rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+            for n in (5, 12, 3, 9)
+        ]
+        budgets = [6, 4, 8, 5]
+        eng_off, toks_off = self._run(m, cfg, prompts, budgets, fused=False)
+        eng_on, toks_on = self._run(m, cfg, prompts, budgets, fused=True)
+        for a, b in zip(toks_off, toks_on):
+            np.testing.assert_array_equal(a, b)
+        assert eng_off.stats["step_traces"] == 1
+        assert eng_on.stats["step_traces"] == 1
+        if hasattr(eng_on._step_fn, "_cache_size"):
+            assert eng_on._step_fn._cache_size() == 1
+
+    def test_cow_fork_warm_hit_byte_identical(self):
+        """Prefix-cache CoW fork (cold, then warm with a forked partial
+        block) under the fused layer loop matches the unfused stream."""
+        m, cfg = _model(seed=42)
+        rng = np.random.default_rng(42)
+        prompt = rng.integers(0, cfg.vocab_size, (12,)).astype(np.int32)
+
+        with _fused_flag(True):
+            eng = ContinuousBatchingEngine(
+                m, max_slots=2, block_size=4, prompt_bucket=16
+            )
+            r_cold = eng.add_request(prompt, max_new_tokens=6)
+            out_cold = eng.run()
+            r_warm = eng.add_request(prompt, max_new_tokens=6)
+            out_warm = eng.run()
+            assert out_warm[r_warm].cached_tokens > 0
+            assert eng.prefix_cache_stats()["cow_forks"] >= 1
+            np.testing.assert_array_equal(
+                out_cold[r_cold].tokens(), out_warm[r_warm].tokens()
+            )
+        with _fused_flag(False):
+            eng_off = ContinuousBatchingEngine(
+                m, max_slots=2, block_size=4, prompt_bucket=16
+            )
+            r_off = eng_off.add_request(prompt, max_new_tokens=6)
+            out_off = eng_off.run()
+        np.testing.assert_array_equal(
+            out_cold[r_cold].tokens(), out_off[r_off].tokens()
+        )
+
+    def test_spec_decode_rewinds_byte_identical(self):
+        """Speculative drafts + rewinds ride the fused layer loop: fused+spec
+        matches unfused+spec token-for-token and still speculates."""
+        m, cfg = _model(seed=5)
+        rng = np.random.default_rng(5)
+        template = rng.integers(0, cfg.vocab_size, (6,)).astype(np.int32)
+        fill = rng.integers(0, cfg.vocab_size, (2,)).astype(np.int32)
+        rep = np.concatenate([template, fill, template, fill])[:16]
+        prompts = [rep, rng.integers(0, cfg.vocab_size, (5,)).astype(np.int32)]
+        budgets = [20, 8]
+        eng_on, toks_on = self._run(
+            m, cfg, prompts, budgets, fused=True, spec_decode=True
+        )
+        eng_off, toks_off = self._run(
+            m, cfg, prompts, budgets, fused=False, spec_decode=True
+        )
+        for a, b in zip(toks_off, toks_on):
+            np.testing.assert_array_equal(a, b)
+        assert eng_on.stats["spec_drafted"] > 0
+        assert eng_on.stats["step_traces"] == 1
+
+
+class TestDispatchReduction:
+    """The perf claim's CPU-checkable proxy: the fused layer loop issues
+    fewer epilogue dispatch sites per layer per traced step."""
+
+    def _probe(self, fused):
+        m, cfg = _model(seed=9)
+        rng = np.random.default_rng(9)
+        prompt = rng.integers(0, cfg.vocab_size, (5,)).astype(np.int32)
+        with _fused_flag(fused):
+            eng = ContinuousBatchingEngine(
+                m, max_slots=2, block_size=4, prompt_bucket=16
+            )
+            eng.add_request(prompt, max_new_tokens=3)
+            arm_dispatch_probe()
+            try:
+                eng.run()
+            finally:
+                sites = disarm_dispatch_probe()
+        return sites, cfg.num_hidden_layers
+
+    def test_fused_layer_issues_fewer_sites(self):
+        fused_sites, n_layers = self._probe(True)
+        unfused_sites, _ = self._probe(False)
+        assert fused_sites and all(k.startswith("fused:") for k in fused_sites)
+        assert unfused_sites and all(
+            k.startswith("unfused:") for k in unfused_sites
+        )
+        # the probe fires once per site per TRACE (python runs at trace only)
+        per_layer_fused = sum(
+            v for k, v in fused_sites.items()
+            if k not in ("fused:embed_norm", "fused:rope_gather")
+        ) / n_layers
+        per_layer_unfused = sum(
+            v for k, v in unfused_sites.items()
+            if k not in ("unfused:embed", "unfused:final_norm")
+        ) / n_layers
+        assert per_layer_fused < per_layer_unfused, (
+            fused_sites, unfused_sites
+        )
+        # rope tables gather once per STEP fused, once per LAYER unfused
+        assert fused_sites["fused:rope_gather"] == 1
+        assert unfused_sites["unfused:rope_gather"] >= n_layers
+
+
+# -- GPT / ERNIE epilogue fusion ---------------------------------------------
+
+class TestGptErnieFusion:
+    def test_gpt_forward_byte_identical_and_grads_close(self):
+        from paddle_tpu.models.gpt import GPTConfig, GPTModel
+
+        paddle.seed(0)
+        g = GPTModel(GPTConfig.tiny())
+        ids = paddle.to_tensor(
+            np.random.default_rng(0).integers(0, 128, (2, 16)).astype(np.int64)
+        )
+
+        def loss_and_grads():
+            for _, p in g.named_parameters():
+                p.clear_grad()
+            loss = (g(ids) ** 2).sum()
+            loss.backward()
+            return float(loss), {
+                n: np.asarray(p.grad._data).copy()
+                for n, p in g.named_parameters()
+                if p.grad is not None
+            }
+
+        with _fused_flag(True):
+            y_on = np.asarray(g(ids)._data)
+            l_on, g_on = loss_and_grads()
+        with _fused_flag(False):
+            y_off = np.asarray(g(ids)._data)
+            l_off, g_off = loss_and_grads()
+        np.testing.assert_array_equal(y_on, y_off)
+        assert l_on == l_off
+        assert set(g_on) == set(g_off)
+        for k in g_off:
+            np.testing.assert_allclose(g_on[k], g_off[k], atol=1e-5)
+
+    def test_ernie_forward_byte_identical(self):
+        from paddle_tpu.models.ernie import ErnieConfig, ErnieModel
+
+        paddle.seed(1)
+        e = ErnieModel(ErnieConfig.tiny())
+        e.eval()
+        ids = paddle.to_tensor(
+            np.random.default_rng(1).integers(0, 128, (2, 12)).astype(np.int64)
+        )
+        with _fused_flag(True):
+            s_on, p_on = e(ids)
+        with _fused_flag(False):
+            s_off, p_off = e(ids)
+        np.testing.assert_array_equal(
+            np.asarray(s_on._data), np.asarray(s_off._data)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(p_on._data), np.asarray(p_off._data)
+        )
+
+
+# -- tp overlap matmul --------------------------------------------------------
+
+class TestRowParallelOverlapMatmul:
+    def test_tiled_byte_identical_to_plain(self):
+        from paddle_tpu.distributed.tp import row_parallel_overlap_matmul
+
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal((4, 6, 32)), jnp.float32)
+        w = jnp.asarray(rng.standard_normal((32, 16)), jnp.float32)
+        ref = np.asarray(jnp.matmul(x.reshape(24, 32), w).reshape(4, 6, 16))
+        for tiles in (1, 2, 3, 4):
+            out = row_parallel_overlap_matmul(x, w, tiles=tiles)
+            assert out.shape == (4, 6, 16)
+            np.testing.assert_array_equal(np.asarray(out), ref)
+
+    def test_uneven_rows_fall_back_to_one_tile(self):
+        from paddle_tpu.distributed.tp import row_parallel_overlap_matmul
+
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.standard_normal((5, 8)), jnp.float32)
+        w = jnp.asarray(rng.standard_normal((8, 4)), jnp.float32)
+        out = row_parallel_overlap_matmul(x, w, tiles=2)  # 5 % 2 != 0
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(jnp.matmul(x, w)))

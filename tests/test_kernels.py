@@ -272,3 +272,111 @@ class TestRopeTrainStepFallbackFlat:
         assert not any(rope_deltas.values()), (
             f"fused_rope fell back to XLA during the train step: {rope_deltas}"
         )
+
+
+class TestFusedDecodeEpilogueFallbackFlat:
+    """Satellite pin: the NEW fused decode-layer epilogues are counted in
+    ``paddle_tpu_kernel_fallbacks_total`` per kernel label, and the CPU
+    REFERENCE path (pallas ineligible by backend, so the XLA composition is
+    the intended route, not a degradation) keeps every one of those counters
+    flat — fwd AND tape backward."""
+
+    LABELS = (
+        "fused_rms_norm_residual",
+        "fused_rms_norm_residual_bwd",
+        "fused_layer_norm_residual",
+        "fused_layer_norm_residual_bwd",
+        "fused_embed_norm",
+        "paged_flash_chunk_fused",
+        "paged_flash_decode_fused",
+    )
+
+    @staticmethod
+    def _fallback_counts():
+        """Flatten ``paddle_tpu_kernel_fallbacks_total`` to
+        ``{kernel_label: value}``."""
+        from paddle_tpu.observability import get_registry
+
+        out = {}
+        for name, data in get_registry().snapshot().items():
+            if "fallbacks" not in str(name) or not isinstance(data, dict):
+                continue
+            for row in data.get("values", []):
+                labels = row.get("labels") or {}
+                out[labels.get("kernel", str(labels))] = row.get("value", 0)
+        return out
+
+    def test_cpu_reference_path_counters_flat(self):
+        from paddle_tpu.incubate.nn.functional import (
+            fused_embed_rms_norm,
+            fused_layer_norm_residual,
+            fused_rms_norm_residual,
+        )
+
+        prior = paddle.get_flags(["FLAGS_enable_metrics"])["FLAGS_enable_metrics"]
+        paddle.set_flags({"FLAGS_enable_metrics": True})
+        try:
+            before = self._fallback_counts()
+            rng = np.random.default_rng(0)
+            x = paddle.to_tensor(rng.standard_normal((2, 4, 64)).astype(np.float32))
+            res = paddle.to_tensor(rng.standard_normal((2, 4, 64)).astype(np.float32))
+            w = paddle.to_tensor(np.ones(64, np.float32))
+            b = paddle.to_tensor(np.zeros(64, np.float32))
+            for t in (x, res, w, b):
+                t.stop_gradient = False
+
+            y, r = fused_rms_norm_residual(x, w, res)
+            (y.sum() + r.sum()).backward()
+            y2, r2 = fused_layer_norm_residual(x, w, b, res)
+            (y2.sum() + r2.sum()).backward()
+
+            ids = paddle.to_tensor(rng.integers(0, 16, (2, 4)).astype(np.int32))
+            table = paddle.to_tensor(rng.standard_normal((16, 64)).astype(np.float32))
+            emb, normed = fused_embed_rms_norm(ids, table, w.detach())
+            assert emb.shape == [2, 4, 64] and normed.shape == [2, 4, 64]
+
+            after = self._fallback_counts()
+        finally:
+            paddle.set_flags({"FLAGS_enable_metrics": prior})
+        deltas = {
+            k: after.get(k, 0) - before.get(k, 0)
+            for k in set(before) | set(after)
+            if k in self.LABELS
+        }
+        assert not any(deltas.values()), (
+            f"CPU reference path incremented fused-epilogue fallback counters: {deltas}"
+        )
+
+    def test_enabled_but_failing_kernel_increments_counter(self, monkeypatch):
+        """The counter is live, not vestigial: force-enable pallas for the
+        fused epilogues on CPU — the kernel path raises off-TPU, warn_fallback
+        fires, and the per-kernel label moves."""
+        import paddle_tpu.kernels.fused as fused
+        import paddle_tpu.kernels.select as sel
+        from paddle_tpu.incubate.nn.functional import fused_rms_norm_residual
+
+        orig_enabled = sel.pallas_enabled
+        monkeypatch.setattr(
+            sel, "pallas_enabled",
+            lambda flag: flag == "use_pallas_fused" or orig_enabled(flag),
+        )
+
+        def boom(*a, **kw):
+            raise RuntimeError("no TPU in this test")
+
+        monkeypatch.setattr(fused, "fused_rms_norm_residual_pallas", boom)
+        prior = paddle.get_flags(["FLAGS_enable_metrics"])["FLAGS_enable_metrics"]
+        paddle.set_flags({"FLAGS_enable_metrics": True})
+        try:
+            before = self._fallback_counts()
+            rng = np.random.default_rng(1)
+            x = paddle.to_tensor(rng.standard_normal((2, 128)).astype(np.float32))
+            res = paddle.to_tensor(rng.standard_normal((2, 128)).astype(np.float32))
+            w = paddle.to_tensor(np.ones(128, np.float32))
+            fused_rms_norm_residual(x, w, res)
+            after = self._fallback_counts()
+        finally:
+            paddle.set_flags({"FLAGS_enable_metrics": prior})
+        assert after.get("fused_rms_norm_residual", 0) > before.get(
+            "fused_rms_norm_residual", 0
+        ), "warn_fallback never incremented the fused_rms_norm_residual label"

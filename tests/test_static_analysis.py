@@ -1403,6 +1403,105 @@ def test_dn_engine_module_is_clean():
     assert [v for v in vs if not v.suppressed] == []
 
 
+# -- TB: tape backward discipline ---------------------------------------------
+
+def test_tb901_grad_over_kernel_function():
+    src = """
+import jax
+from jax.experimental import pallas as pl
+
+def my_op(x):
+    return pl.pallas_call(lambda r, o: None, out_shape=x)(x)
+
+g = jax.grad(my_op)(1.0)
+"""
+    assert codes(src) == ["TB901"]
+
+
+def test_tb901_vjp_over_one_hop_wrapper_and_lambda():
+    src = """
+import jax
+from jax.experimental import pallas as pl
+
+def my_op(x):
+    return pl.pallas_call(lambda r, o: None, out_shape=x)(x)
+
+def wrapper(x):
+    return my_op(x) * 2.0
+
+h = jax.vjp(wrapper, 1.0)
+i = jax.value_and_grad(lambda x: my_op(x))(1.0)
+"""
+    assert codes(src) == ["TB901", "TB901"]
+
+
+def test_tb901_from_jax_import_alias():
+    src = """
+from jax import grad
+from jax.experimental import pallas as pl
+
+def my_op(x):
+    return pl.pallas_call(lambda r, o: None, out_shape=x)(x)
+
+g = grad(my_op)(1.0)
+"""
+    assert codes(src) == ["TB901"]
+
+
+def test_tb901_negative_custom_vjp_forms():
+    """Decorator, assignment, and factory-shell wiring all define their own
+    AD rule — none may fire."""
+    src = """
+import jax
+from jax.experimental import pallas as pl
+
+@jax.custom_vjp
+def decorated(x):
+    return pl.pallas_call(lambda r, o: None, out_shape=x)(x)
+
+def assigned_raw(x):
+    return pl.pallas_call(lambda r, o: None, out_shape=x)(x)
+
+core = jax.custom_vjp(assigned_raw)
+
+def shell(engine_fwd):
+    @jax.custom_vjp
+    def inner(x):
+        return engine_fwd(x)
+    return inner
+
+def factory(x):
+    def engine_fwd(x):
+        return pl.pallas_call(lambda r, o: None, out_shape=x)(x)
+    return shell(engine_fwd)
+
+j = jax.grad(decorated)(1.0)
+k = jax.grad(core)(1.0)
+m = jax.vjp(factory, 1.0)
+"""
+    assert codes(src) == []
+
+
+def test_tb901_negative_generic_dispatch_parameter():
+    """The tape's own ``jax.vjp(fn, ...)`` over a caller-supplied function is
+    unresolvable by design and stays clean."""
+    src = """
+import jax
+
+def generic(fn, *arrays):
+    out, vjp_fn = jax.vjp(fn, *arrays)
+    return out, vjp_fn
+"""
+    assert codes(src) == []
+
+
+def test_tb901_kernel_package_self_run_clean():
+    """The fused-op modules differentiate through tape GradNodes or
+    custom_vjp only — the kernels package passes TB as written."""
+    vs = analyze_paths([str(PKG / "kernels")], select=["TB"])
+    assert [v for v in vs if not v.suppressed] == []
+
+
 # -- SARIF + baseline ---------------------------------------------------------
 
 def test_sarif_output_shape_and_rule_ids():
