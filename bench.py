@@ -771,16 +771,18 @@ def _bench_fused_decode_layer(paddle, platform: str) -> dict:
     the jitted step runs once per compile, so each armed site counts once
     per signature), byte-identity of the two token streams (the PR's
     correctness acceptance — a mismatch is recorded as an error, never as a
-    throughput number), and the estimated all-reduce share of one tp decode
-    layer (analytic: row-parallel collective bytes vs MXU time at peak —
-    labelled as an estimate; a measured share needs >= 2 chips and lives in
-    the tp record)."""
+    throughput number), and the comm/compute story both ways: the analytic
+    all-reduce share of one tp decode layer (``comm_share_analytic`` —
+    row-parallel collective bytes vs MXU time at peak) NEXT TO the devprof
+    measurement (``comm_share_measured`` from a profiled tp=2 fused engine,
+    skipped cleanly on 1 device; ``host_bubble_fraction`` from the fused
+    run's sampled steps)."""
     from paddle_tpu.inference import ContinuousBatchingEngine
     from paddle_tpu.kernels.fused import arm_dispatch_probe, disarm_dispatch_probe
     from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
 
     flag = "FLAGS_use_fused_decode_layer"
-    prior = paddle.get_flags([flag])
+    prior = paddle.get_flags([flag, "FLAGS_devprof_sample_rate"])
     metric = "fused_decode_layer_dispatches_per_layer"
     try:
         if platform == "tpu":
@@ -806,7 +808,9 @@ def _bench_fused_decode_layer(paddle, platform: str) -> dict:
         budgets = [int(rng.integers(max_new // 2, max_new + 1)) for _ in range(n_req)]
 
         def run(fused: bool):
-            paddle.set_flags({flag: fused})
+            # every step device-profiled: the fused record carries a
+            # MEASURED host-bubble fraction next to the analytic comm share
+            paddle.set_flags({flag: fused, "FLAGS_devprof_sample_rate": 1.0})
             eng = ContinuousBatchingEngine(
                 model, max_slots=slots, block_size=bs, prompt_bucket=bucket
             )
@@ -823,10 +827,45 @@ def _bench_fused_decode_layer(paddle, platform: str) -> dict:
                 sites = disarm_dispatch_probe()
             toks = [out[r].tokens().tolist() for r in rids]
             ntoks = sum(len(out[r].generated) for r in rids)
-            return sites, toks, ntoks / dt, eng.stats["step_traces"]
+            return (
+                sites, toks, ntoks / dt, eng.stats["step_traces"],
+                eng.devprof_stats(),
+            )
 
-        sites_f, toks_f, tps_f, traces_f = run(True)
-        sites_u, toks_u, tps_u, traces_u = run(False)
+        sites_f, toks_f, tps_f, traces_f, devprof_f = run(True)
+        sites_u, toks_u, tps_u, traces_u, _devprof_u = run(False)
+
+        # measured comm share: a devprof-profiled tp=2 fused engine over a
+        # small slice of the same stream (skipped cleanly on 1 device —
+        # there is no collective to measure). Under GSPMD the all-reduces
+        # are compiler-inserted, so comm_source reports how the share was
+        # attributed (wrapper timing vs cost-model prior).
+        import jax as _jax
+
+        ndev = len(_jax.devices())
+        if ndev >= 2 and cfg.num_key_value_heads % 2 == 0:
+            paddle.set_flags({flag: True, "FLAGS_devprof_sample_rate": 1.0})
+            eng_tp = ContinuousBatchingEngine(
+                model, max_slots=slots, block_size=bs, prompt_bucket=bucket,
+                tp=2,
+            )
+            for p, t in zip(prompts[:2], budgets[:2]):
+                eng_tp.add_request(p, max_new_tokens=t)
+            eng_tp.run()
+            dp = eng_tp.devprof_stats()
+            comm_share_measured = {
+                "value": dp.get("comm_share_measured", 0.0),
+                "comm_sources": dp.get("comm_sources", {}),
+                "sampled_steps": dp.get("sampled_steps", 0),
+                "tp_degree": 2,
+                "status": "measured",
+            }
+        else:
+            comm_share_measured = {
+                "status": "skipped",
+                "reason": f"needs >= 2 devices with shardable kv heads, "
+                          f"have {ndev} device(s)",
+            }
         if toks_f != toks_u:
             return {
                 "metric": metric,
@@ -859,11 +898,23 @@ def _bench_fused_decode_layer(paddle, platform: str) -> dict:
             },
             "byte_identical_fused_on_off": True,
             "compiled_signatures": {"fused": traces_f, "unfused": traces_u},
-            "allreduce_share": {
+            # labeled analytic so it can never be confused with the devprof
+            # MEASUREMENT next to it
+            "comm_share_analytic": {
                 "value": round(t_ar / (t_ar + t_mm), 4),
                 "method": "analytic_estimate",
                 "model": "2*H*itemsize bytes over ICI vs layer matmul FLOPs at peak",
             },
+            "comm_share_measured": comm_share_measured,
+            "host_bubble_fraction": (
+                {
+                    "value": devprof_f.get("mean_host_bubble_fraction", 0.0),
+                    "sampled_steps": devprof_f.get("sampled_steps", 0),
+                    "status": "measured",
+                }
+                if devprof_f.get("sampled_steps")
+                else {"status": "skipped", "reason": "no sampled steps"}
+            ),
         }
     except Exception as exc:  # noqa: BLE001 - secondary must never kill primary
         return {"metric": metric, "error": f"{exc!r}"[:300]}
@@ -877,10 +928,11 @@ def _bench_tp_decode(paddle, platform: str) -> dict:
     over the device mesh (``distributed/tp.py`` — head-parallel attention +
     per-device KV pool partition, Megatron MLP splits, vocab-sharded
     lm-head). Skips cleanly with fewer than 2 devices. Records per-chip and
-    aggregate decode tokens/s, the estimated all-reduce time share (from
-    scaling efficiency: ``1 - t1 / (tp * t_tp)`` — the gap between the
-    observed sharded step and perfect linear scaling, which on this
-    model is the per-layer all-reduce plus the lm-head combine), the
+    aggregate decode tokens/s, the all-reduce time share BOTH ways —
+    ``comm_share_analytic`` (from scaling efficiency: ``1 - t1/(tp*t_tp)``,
+    the gap between the observed sharded step and perfect linear scaling)
+    next to devprof's ``comm_share_measured`` (per-sampled-step attribution,
+    with its ``comm_source`` provenance) and ``host_bubble_fraction`` — the
     byte-identity of the sharded outputs, and the 1-compile-per-engine
     honesty field."""
     import jax as _jax
@@ -918,6 +970,8 @@ def _bench_tp_decode(paddle, platform: str) -> dict:
                            f"over {ndev} devices",
             }
         obs.GLOBAL_WATCHDOG.reset()
+        prior_dp = paddle.get_flags(["FLAGS_devprof_sample_rate"])
+        paddle.set_flags({"FLAGS_devprof_sample_rate": 1.0})
 
         def build(tp_degree: int):
             paddle.seed(0)
@@ -950,10 +1004,16 @@ def _bench_tp_decode(paddle, platform: str) -> dict:
             dt = time.perf_counter() - t0
             toks = sum(len(r.generated) for r in out.values())
             streams = [out[r].tokens().tolist() for r in rids]
-            return toks / dt, streams, engine.stats["step_traces"]
+            return (
+                toks / dt, streams, engine.stats["step_traces"],
+                engine.devprof_stats(),
+            )
 
-        tput1, streams1, compiles1 = run(build(1))
-        tput_tp, streams_tp, compiles_tp = run(build(tp))
+        try:
+            tput1, streams1, compiles1, devprof1 = run(build(1))
+            tput_tp, streams_tp, compiles_tp, devprof_tp = run(build(tp))
+        finally:
+            paddle.set_flags(prior_dp)
         # the watchdog ledger cross-checks the per-engine counters: exactly
         # one recorded step compile per engine, and none from anywhere else
         wd_steps = sum(
@@ -974,7 +1034,29 @@ def _bench_tp_decode(paddle, platform: str) -> dict:
             "per_chip_tokens_per_sec": round(tput_tp / tp, 2),
             "tp1_tokens_per_sec": round(tput1, 2),
             "speedup_vs_tp1": round(speedup, 4),
-            "all_reduce_time_share_est": round(share, 4),
+            # labeled analytic vs measured so the two can never be confused
+            # downstream: the estimate infers comm from scaling shortfall,
+            # the measurement attributes each sampled step's device segment
+            "comm_share_analytic": {
+                "value": round(share, 4),
+                "method": "analytic_estimate",
+                "model": "1 - tput_tp/(tp*tput1) scaling shortfall",
+            },
+            "comm_share_measured": (
+                {
+                    "value": devprof_tp.get("comm_share_measured", 0.0),
+                    "comm_sources": devprof_tp.get("comm_sources", {}),
+                    "sampled_steps": devprof_tp.get("sampled_steps", 0),
+                    "status": "measured",
+                }
+                if devprof_tp.get("sampled_steps")
+                else {"status": "skipped", "reason": "no sampled steps"}
+            ),
+            "host_bubble_fraction": {
+                "tp1": devprof1.get("mean_host_bubble_fraction"),
+                "tp": devprof_tp.get("mean_host_bubble_fraction"),
+                "status": "measured",
+            },
             "byte_identical_vs_tp1": streams_tp == streams1,
             # honesty: each engine compiled its unified step exactly once,
             # and the watchdog ledger agrees (catches stray compiles too)

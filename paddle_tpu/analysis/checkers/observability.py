@@ -44,11 +44,39 @@ receiver-shaped so ``dict.get("...")`` never false-positives:
   ``GLOBAL_METRICS``, contains ``registry`` (any case), or is a
   ``get_registry()`` call.
 
+**OB603** — async-dispatch-dishonest timing. jax dispatch is asynchronous:
+a jitted call returns as soon as the work is ENQUEUED, so a
+``time.perf_counter()`` / ``time.time()`` pair bracketing the call measures
+dispatch latency, not device time — the bug that turns a kernel benchmark
+into a noise generator (devprof's step decomposition exists precisely
+because the gap is routinely 10-100x). The check is statement-sequence
+shaped, scanning each suite for the timing-pair idiom:
+
+1. a start timestamp: ``t0 = time.perf_counter()`` (or ``time.time`` /
+   ``time.monotonic``) assigned to a plain name;
+2. a later statement dispatching a KNOWN-jitted callable — a name assigned
+   from ``jax.jit(...)`` / ``to_static(...)`` anywhere in the file
+   (``f = jax.jit(g)``, ``self._fn = jax.jit(...)``), a ``@jax.jit``-
+   decorated def, or a direct ``jax.jit(f)(x)`` double call;
+3. a stop timestamp taken before any sync reached the result. A sync is
+   ``block_until_ready`` (method or ``jax.block_until_ready``),
+   ``jax.device_get``, ``np.asarray``/``np.array``, or a ``.item()`` /
+   ``.tolist()`` / ``.numpy()`` / ``.copy_to_cpu()`` materialization — in
+   a statement between dispatch and stop, or fused into the dispatch
+   statement itself (``np.asarray(f(x))``).
+
+Receiver-shaped and file-local by construction: calls to names never
+assigned from a jit constructor are not dispatches, so ordinary helper
+calls between two timestamps can't false-positive.
+
 - OB601  tracer span opened outside ``with``, or tracer/flight-recorder
          emission inside a traced (``@jax.jit``/``to_static``) function or
          Pallas kernel body / index map.
 - OB602  metric family name read through the registry does not resolve to
          any registered family (silent-zero drift).
+- OB603  ``time.perf_counter()``/``time.time()`` pair times a jitted
+         dispatch with no device sync before the stop timestamp
+         (async-dispatch-dishonest timing).
 """
 
 from __future__ import annotations
@@ -60,7 +88,11 @@ from typing import Dict, FrozenSet, List, Optional, Set, Tuple
 
 from paddle_tpu.analysis.checkers._shared import attr_chain, body_walk
 from paddle_tpu.analysis.checkers.pallas_purity import _KernelCollector
-from paddle_tpu.analysis.checkers.trace_safety import _TracedFunctions
+from paddle_tpu.analysis.checkers.trace_safety import (
+    _JIT_CHAINS,
+    _TracedFunctions,
+    _is_jit_decorator,
+)
 from paddle_tpu.analysis.core import Checker, FileContext, Violation
 
 
@@ -162,6 +194,90 @@ def _is_flight_emit(node: ast.Call) -> bool:
     return False
 
 
+_OB603_TIME_CHAINS = {
+    "time.perf_counter", "time.time", "time.monotonic",
+    "perf_counter", "monotonic",
+}
+_OB603_SYNC_CHAINS = {
+    "jax.block_until_ready", "block_until_ready", "jax.device_get",
+    "device_get", "jax.effects_barrier",
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+    "onp.asarray", "onp.array",
+}
+_OB603_SYNC_ATTRS = {
+    "block_until_ready", "item", "tolist", "numpy", "copy_to_cpu",
+}
+
+
+def _collect_jitted_callables(tree: ast.AST) -> Set[str]:
+    """Names the file binds to jit-constructed callables: assignment targets
+    of ``jax.jit(...)``/``to_static(...)`` calls (plain names AND attribute
+    targets like ``self._step_fn``) plus ``@jax.jit``-decorated defs."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if attr_chain(node.value.func) in _JIT_CHAINS:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        names.add(tgt.id)
+                    elif isinstance(tgt, ast.Attribute):
+                        names.add(tgt.attr)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_is_jit_decorator(d) for d in node.decorator_list):
+                names.add(node.name)
+    return names
+
+
+def _ob603_timestamp_assign(stmt: ast.stmt) -> bool:
+    """``t = time.perf_counter()`` (a plain-name target — subscript/attr
+    targets are mark-dict bookkeeping, not the timing-pair idiom)."""
+    return (
+        isinstance(stmt, ast.Assign)
+        and len(stmt.targets) == 1
+        and isinstance(stmt.targets[0], ast.Name)
+        and isinstance(stmt.value, ast.Call)
+        and attr_chain(stmt.value.func) in _OB603_TIME_CHAINS
+    )
+
+
+def _ob603_dispatch(stmt: ast.stmt, jitted: Set[str]) -> Optional[ast.Call]:
+    """First call in ``stmt`` that dispatches a known-jitted callable (or a
+    direct ``jax.jit(f)(x)`` double call)."""
+    for node in ast.walk(stmt):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if isinstance(fn, ast.Call) and attr_chain(fn.func) in _JIT_CHAINS:
+            return node
+        chain = attr_chain(fn)
+        if chain and chain.rsplit(".", 1)[-1] in jitted:
+            return node
+    return None
+
+
+def _ob603_syncs(stmt: ast.stmt) -> bool:
+    for node in ast.walk(stmt):
+        if isinstance(node, ast.Call):
+            if attr_chain(node.func) in _OB603_SYNC_CHAINS:
+                return True
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _OB603_SYNC_ATTRS
+            ):
+                return True
+    return False
+
+
+def _statement_suites(tree: ast.AST):
+    """Every statement list (module/def bodies, if/for/while/with/try
+    suites) — the unit OB603's sequence scan runs over."""
+    for node in ast.walk(tree):
+        for field in ("body", "orelse", "finalbody"):
+            suite = getattr(node, field, None)
+            if isinstance(suite, list) and suite and isinstance(suite[0], ast.stmt):
+                yield suite
+
+
 class ObservabilityChecker(Checker):
     name = "observability-discipline"
     codes = {
@@ -172,11 +288,52 @@ class ObservabilityChecker(Checker):
         "OB602": "metric family name read through the registry does not "
                  "resolve to any registered family (a typo'd name silently "
                  "reads zeros)",
+        "OB603": "perf_counter/time pair times a jitted dispatch with no "
+                 "block_until_ready/sync before the stop timestamp "
+                 "(async dispatch returns at enqueue — this measures "
+                 "dispatch latency, not device time)",
     }
 
     def run(self, ctx: FileContext) -> List[Violation]:
         out = self._run_ob601(ctx)
         out.extend(self._run_ob602(ctx))
+        out.extend(self._run_ob603(ctx))
+        return out
+
+    def _run_ob603(self, ctx: FileContext) -> List[Violation]:
+        jitted = _collect_jitted_callables(ctx.tree)
+        out: List[Violation] = []
+        for suite in _statement_suites(ctx.tree):
+            started = False
+            pending: Optional[ast.Call] = None  # dispatch awaiting a sync
+            for stmt in suite:
+                if _ob603_timestamp_assign(stmt):
+                    if started and pending is not None:
+                        out.append(
+                            Violation(
+                                ctx.path, stmt.lineno, stmt.col_offset,
+                                "OB603",
+                                "stop timestamp taken with no device sync "
+                                "after the jitted dispatch on line "
+                                f"{pending.lineno}: the call returned at "
+                                "enqueue, so this pair measures dispatch "
+                                "latency, not device time — "
+                                "block_until_ready (or np.asarray / "
+                                ".item()) the result first",
+                            )
+                        )
+                        pending = None
+                    started = True
+                    continue
+                # a statement that both dispatches and syncs (e.g.
+                # ``np.asarray(f(x))``) is honest; sync wins
+                if _ob603_syncs(stmt):
+                    pending = None
+                    continue
+                if started:
+                    disp = _ob603_dispatch(stmt, jitted)
+                    if disp is not None:
+                        pending = disp
         return out
 
     def _run_ob602(self, ctx: FileContext) -> List[Violation]:

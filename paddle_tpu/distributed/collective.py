@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.observability import devprof as _devprof
 from paddle_tpu.observability import metrics as _obs
 from paddle_tpu.observability import tracing as _tracing
 from paddle_tpu.testing.faults import fault_point as _fault_point
@@ -55,7 +56,11 @@ def _instrumented(fn):
         # to sample against, so at a partial rate these spans would flood
         # the bounded ring and evict the sampled request trees
         traced = _tracing.tracing_full()
-        if not _obs.metrics_enabled() and not traced:
+        # devprof comm window: armed (thread-locally) only while a SAMPLED
+        # engine step is in flight — its per-op timings become that step's
+        # MEASURED collective share (comm_source: "wrapper")
+        comm_win = _devprof.comm_window_armed()
+        if not _obs.metrics_enabled() and not traced and not comm_win:
             return fn(*args, **kwargs)
         t0 = time.perf_counter()
         try:
@@ -67,6 +72,8 @@ def _instrumented(fn):
                 _coll_seconds.labels(op=op).inc(t1 - t0)
             if traced:
                 _tracing.GLOBAL_TRACER.add_span(span_name, start_s=t0, end_s=t1)
+            if comm_win:
+                _devprof.record_comm(op, t1 - t0)
 
     return wrapper
 

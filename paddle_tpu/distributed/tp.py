@@ -45,6 +45,7 @@ __all__ = [
     "ROW_PARALLEL_LEAVES",
     "TP_AXIS",
     "VOCAB_PARALLEL_EMBEDDINGS",
+    "analytic_cost_hints",
     "build_tp_mesh",
     "current_tp_mesh",
     "kv_cache_sharding",
@@ -212,3 +213,45 @@ def tp_shard_context(mesh: Optional[Mesh]) -> Iterator[None]:
         yield
     finally:
         _STATE.mesh = prev
+
+
+def analytic_cost_hints(
+    num_layers: int,
+    hidden: int,
+    intermediate: int,
+    vocab: int,
+    tokens: int,
+    kv_len: int,
+    tp: int = 1,
+    dtype_bytes: int = 2,
+    ici_bytes_per_s: float = 45e9,
+    peak_flops_per_s: float = 197e12,
+) -> dict:
+    """Analytic per-category weights seeding devprof's attribution prior
+    for one decode/prefill step over ``tokens`` query rows against a
+    ``kv_len`` context. All weights are FLOP-denominated so the XLA cost
+    model can reconcile against them: matmul and attention are literal flop
+    counts (Megatron accounting — qkv+o 4h² and the gated MLP 3h·i per
+    layer, plus the lm-head 2hV; attention 2·2·h·kv per layer); the
+    collective weight converts the per-layer all-reduce's wire time
+    (2 ramp-up·bytes/bw for a ring over ``tp`` shards) into
+    flop-equivalents at peak so the three shares stay in one unit. These
+    are the same ICI/MXU constants ``bench.py``'s analytic estimate uses —
+    the point is that devprof's MEASURED shares can now be laid against
+    this prior to validate it."""
+    matmul = float(tokens) * (
+        num_layers * 2.0 * (4.0 * hidden * hidden + 3.0 * hidden * intermediate)
+        + 2.0 * hidden * vocab
+    )
+    attention = float(tokens) * num_layers * 2.0 * 2.0 * hidden * float(kv_len)
+    collective = 0.0
+    if tp > 1:
+        # one row-parallel all-reduce per layer (o_proj + down_proj fold
+        # into the same ring pass in the overlap path): ring all-reduce
+        # moves 2*(tp-1)/tp of the activation per hop
+        ar_bytes = (
+            num_layers * float(tokens) * hidden * dtype_bytes
+            * 2.0 * (tp - 1) / tp * 2.0  # two row-parallel matmuls per layer
+        )
+        collective = (ar_bytes / ici_bytes_per_s) * peak_flops_per_s
+    return {"attention": attention, "matmul": matmul, "collective": collective}

@@ -185,6 +185,10 @@ def _define_builtin_flags() -> None:
     d("slo_min_terminals", int, 8, "Minimum terminals inside a window before its budget-based burn rates are trusted (the ttft signal is exempt); prevents paging on the first failed request of a quiet cluster.")
     d("incident_dir", str, "", "Directory for coordinated cluster incident snapshots (observability/aggregate.py): one sub-directory per incident with every replica's flight ring, the router's routing log, sampled spans and the cluster health view. Empty = flight_recorder_dir, else the system temp dir.")
     d("incident_cooldown_s", float, 30.0, "Minimum seconds between two incident snapshots for the SAME reason (a flapping replica must not fill the disk with identical postmortems).")
+    # device-time attribution (observability/devprof.py): per-step cost
+    # profiles, host-bubble decomposition, measured comm share
+    d("devprof_sample_rate", float, 0.0, "Fraction of engine steps profiled by the device-time attribution layer (observability/devprof.py): a sampled step is timed device-sync-honest, decomposed into host-prep / dispatch-gap / device segments, and its device time apportioned across attention/matmul/collective/other using the compile-time cost profile as the attribution prior. 0 (default) disables profiling entirely — every step then costs one cached-bool read — and deterministic stride sampling (no RNG draw) picks steps at partial rates. Rate > 0 also arms compile-time cost-profile capture (an introspective AOT lowering per compiled signature, paid once per compile).")
+    d("devprof_timeline_size", int, 256, "Capacity of each engine's bounded step-timeline ring (devprof): how many recent sampled step profiles are retained for /healthz, incident snapshots and the dump CLI; newest win.")
 
 
 _define_builtin_flags()

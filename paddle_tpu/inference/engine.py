@@ -113,6 +113,7 @@ from paddle_tpu.flags import GLOBAL_FLAGS
 from paddle_tpu.inference.kv_tier import HostKVTier, HostNode
 from paddle_tpu.inference.prefix_cache import ChainNode, PrefixCache, chain_digest
 from paddle_tpu.inference.spec_decode import NGramDrafter, count_accepted
+from paddle_tpu.observability import devprof as _devprof
 from paddle_tpu.observability import flight_recorder as _flight
 from paddle_tpu.observability import metrics as _obs
 from paddle_tpu.observability import tracing as _tracing
@@ -622,6 +623,29 @@ class ContinuousBatchingEngine:
             self._step_fn = jax.jit(
                 self._step_impl, donate_argnums=(1,) if donate else ()
             )
+        # device-time attribution (observability/devprof.py): deterministic
+        # stride sampler + bounded step-timeline ring per engine; _marks is
+        # non-None only while a SAMPLED step's dispatch is in flight (the
+        # off path through _dispatch reads one attribute, nothing else).
+        # The analytic attribution-prior hints are flop-denominated over the
+        # PADDED step shape — the compiled program computes all S*C rows and
+        # walks tables bounded by max_model_len, which is what the XLA cost
+        # model prices too.
+        self._devprof_gate = _devprof.SampleGate()
+        self._devprof_timeline = _devprof.StepTimeline()
+        self._devprof_marks: Optional[Dict[str, float]] = None
+        from paddle_tpu.distributed.tp import analytic_cost_hints
+
+        self._devprof_hints = analytic_cost_hints(
+            num_layers=self._num_layers,
+            hidden=cfg.hidden_size,
+            intermediate=getattr(cfg, "intermediate_size", 4 * cfg.hidden_size),
+            vocab=getattr(cfg, "vocab_size", 0),
+            tokens=self.max_slots * self.prefill_chunk,
+            kv_len=self.max_model_len,
+            tp=self.tp,
+            dtype_bytes=jnp.dtype(self._cache_dtype).itemsize,
+        )
 
     def _new_cache_pair(self) -> Tuple[Any, Any]:
         """One layer's (key, value) pool pair. Under a tp mesh the pair is
@@ -673,6 +697,14 @@ class ContinuousBatchingEngine:
             "per_shard_cache_shape": per_shard[0] if per_shard else [],
             "balanced": all(s == per_shard[0] for s in per_shard),
         }
+
+    def devprof_stats(self) -> Dict[str, Any]:
+        """Device-time attribution summary over this engine's step-timeline
+        ring (what /healthz and incident snapshots embed): mean segment
+        split, mean per-category device shares, measured comm share with
+        its source breakdown. ``{"enabled": False, "sampled_steps": 0}``
+        while ``FLAGS_devprof_sample_rate`` is 0 — valid, never raises."""
+        return _devprof.summarize_timeline(self._devprof_timeline.entries())
 
     def _bytes_per_token(self) -> int:
         """KV bytes across all layers for one token (sizes the bytes-saved
@@ -1428,6 +1460,41 @@ class ContinuousBatchingEngine:
                 out[s, : len(blocks)] = blocks
         return out
 
+    def _devprof_cost_thunk(
+        self, toks, tables, q_lens, active, cow_src, cow_dst
+    ) -> Callable[[], Any]:
+        """Zero-arg thunk handing devprof the just-compiled step program's
+        ``cost_analysis()``. It is an introspective AOT lowering — it re-runs
+        the ``_step_impl`` Python trace and pays one extra XLA compile — so
+        devprof only invokes it while ``FLAGS_devprof_sample_rate > 0``.
+        The re-trace bumps ``stats["step_traces"]``; save/restore keeps the
+        1-compile invariant (and the watchdog ledger it feeds) honest: this
+        trace produces a throwaway executable, not a new step program.
+        Lowered with the live committed arrays under the same shard context
+        as the real call, so under tp the analyzed program carries the real
+        GSPMD partitioning (and its inserted collectives)."""
+
+        def thunk():
+            traces_before = self.stats["step_traces"]
+            try:
+                tp_ctx = (
+                    self._tp_ctx(self._tp_mesh)
+                    if self._tp_mesh is not None
+                    else contextlib.nullcontext()
+                )
+                with tp_ctx:
+                    lowered = self._step_fn.lower(
+                        self._param_arrays(), self._caches, jnp.asarray(toks),
+                        jnp.asarray(tables), jnp.asarray(self._ntok.copy()),
+                        jnp.asarray(q_lens), jnp.asarray(active),
+                        jnp.asarray(cow_src), jnp.asarray(cow_dst),
+                    )
+                return lowered.compile().cost_analysis()
+            finally:
+                self.stats["step_traces"] = traces_before
+
+        return thunk
+
     def _dispatch(
         self,
         toks: np.ndarray,  # [S, C]
@@ -1468,6 +1535,9 @@ class ContinuousBatchingEngine:
                 if self._tp_mesh is not None
                 else contextlib.nullcontext()
             )
+            marks = self._devprof_marks  # non-None only on a sampled step
+            if marks is not None:
+                marks["call_s"] = time.perf_counter()
             with tp_ctx:
                 nxt, self._caches = self._step_fn(
                     self._param_arrays(), self._caches, jnp.asarray(toks),
@@ -1475,6 +1545,8 @@ class ContinuousBatchingEngine:
                     jnp.asarray(q_lens), jnp.asarray(active),
                     jnp.asarray(cow_src), jnp.asarray(cow_dst),
                 )
+            if marks is not None:
+                marks["ret_s"] = time.perf_counter()
         except BaseException:
             # roll the per-step allocations back so a transient failure
             # leaves the allocator in lockstep with _ntok (retried steps
@@ -1495,9 +1567,15 @@ class ContinuousBatchingEngine:
                 cause=CAUSE_FIRST_CALL
                 if not self._step_recorded
                 else CAUSE_NEW_SHAPE_DTYPE,
+                cost_thunk=self._devprof_cost_thunk(
+                    toks, tables, q_lens, active, cow_src, cow_dst
+                ),
+                cost_hints=self._devprof_hints,
             )
             self._step_recorded = True
         nxt = np.asarray(nxt)  # device sync: the step's tokens are real here
+        if marks is not None:
+            marks["sync_s"] = time.perf_counter()
         for i in active_slots:
             pending = self._pending_cow[i]
             if pending is not None:
@@ -1766,14 +1844,46 @@ class ContinuousBatchingEngine:
                         toks[i, 1 : 1 + k] = draft
                         q_lens[i] = 1 + k
                         drafts[i] = draft
+        # devprof sampling decision: one cached-bool read at rate 0 (the
+        # stride counter only advances while the flag is on, and the stride
+        # is deterministic — no RNG draw, seeded runs stay byte-identical)
+        dp_sampled = self._devprof_gate.should_sample()
+        comm_ops: Dict[str, float] = {}
+        if dp_sampled:
+            self._devprof_marks = {}
+            _devprof.begin_comm_window()
         t0 = time.perf_counter()
-        nxt = self._dispatch(toks, q_lens, active)
+        try:
+            nxt = self._dispatch(toks, q_lens, active)
+        except BaseException:
+            # re-raised below: only dropping the armed marks dict so a later
+            # non-sampled step's _dispatch can't write into stale state — a
+            # failed sampled step records nothing
+            self._devprof_marks = None
+            raise
+        finally:
+            if dp_sampled:
+                comm_ops = _devprof.end_comm_window()
         self.stats["steps"] += 1
         self.stats["prompt_tokens_computed"] += prefill_tokens
         if prefill_tokens:
             self._metrics["prefill_tokens"].inc(prefill_tokens)
         t1 = time.perf_counter()
         self._metrics["step"].observe(t1 - t0)
+        if dp_sampled:
+            marks, self._devprof_marks = self._devprof_marks or {}, None
+            if {"call_s", "ret_s", "sync_s"} <= marks.keys():
+                _devprof.record_step_profile(
+                    "ContinuousBatchingEngine.step",
+                    f"toks[{self.max_slots},{self.prefill_chunk}]"
+                    + (f"|tp{self.tp}" if self.tp > 1 else ""),
+                    t0, marks["call_s"], marks["ret_s"], marks["sync_s"],
+                    comm_ops=comm_ops,
+                    n_active=len(active_slots),
+                    step=self.stats["steps"],
+                    timeline=self._devprof_timeline,
+                    flight=self._flight,
+                )
         if _tracing.tracing_enabled():
             # per-request decode time in a continuous batch is a SHARE of
             # the batched step it rode; accumulate the even split on every

@@ -342,11 +342,34 @@ class StaticFunction:
             else:
                 cause = CAUSE_NEW_SHAPE_DTYPE
             self._compiled_keys.add(key)
+            jitted = self._cache.get(key)
+
+            def _cost_thunk(_jitted=jitted):
+                # devprof cost capture (runs only at devprof_sample_rate>0):
+                # an introspective AOT lowering of the program just compiled.
+                # Built from ShapeDtypeStructs, not the live arrays — argnums
+                # (0, 1) are donated, so on TPU the input buffers are already
+                # consumed; avals survive donation (shape/dtype metadata is
+                # readable on deleted arrays) and .lower takes them directly.
+                abst = lambda a: (  # noqa: E731 - local one-liner
+                    jax.ShapeDtypeStruct(a.shape, a.dtype)
+                    if hasattr(a, "shape") and hasattr(a, "dtype")
+                    else a
+                )
+                if _jitted is None:
+                    return None
+                return _jitted.lower(
+                    *jax.tree_util.tree_map(
+                        abst, (state_arrays, opt_states, rng_key, in_arrays)
+                    )
+                ).compile().cost_analysis()
+
             GLOBAL_WATCHDOG.record_compile(
                 getattr(self._fn, "__qualname__", None)
                 or getattr(self._fn, "__name__", "<fn>"),
                 signature=key[1],
                 cause=cause,
+                cost_thunk=_cost_thunk,
             )
         return jax.tree_util.tree_map(
             lambda o: Tensor(o) if isinstance(o, jax.Array) else o, out_arrays

@@ -17,6 +17,12 @@ Three pieces, one substrate every perf/robustness PR reports through:
   (trace/span/parent ids, traceparent propagation) with seeded head
   sampling via ``FLAGS_trace_sample_rate``, zero-cost when off, bounded
   span store, chrome-trace + JSONL export merged by ``profiler.export``;
+- a device-time attribution layer (:mod:`.devprof`): compile-time cost
+  profiles (``cost_analysis()`` keyed by watchdog signature, with a
+  cost-regression ledger), sampled step profiles decomposed into
+  host-prep / dispatch-gap / device segments with per-category device
+  shares, and a measured per-step collective share — all behind
+  ``FLAGS_devprof_sample_rate`` with the same cached-bool off-path;
 - an always-on flight recorder (:mod:`.flight_recorder`): lock-cheap ring
   of recent structured events (admits/evicts/recoveries/compiles/faults/
   overload transitions), dumped automatically — redacted — on engine
@@ -68,6 +74,17 @@ from paddle_tpu.observability.aggregate import (  # noqa: F401
     ClusterObserver,
     FLEET_COUNTER_FAMILIES,
     INCIDENT_SCHEMA,
+)
+from paddle_tpu.observability.devprof import (  # noqa: F401
+    CostLedger,
+    GLOBAL_COST_LEDGER,
+    SampleGate,
+    StepTimeline,
+    capture_cost_profile,
+    devprof_enabled,
+    normalize_cost_analysis,
+    record_step_profile,
+    summarize_timeline,
 )
 from paddle_tpu.observability.recompile import (  # noqa: F401
     CAUSE_FIRST_CALL,
@@ -123,6 +140,15 @@ __all__ = [
     "ClusterObserver",
     "FLEET_COUNTER_FAMILIES",
     "INCIDENT_SCHEMA",
+    "CostLedger",
+    "GLOBAL_COST_LEDGER",
+    "SampleGate",
+    "StepTimeline",
+    "capture_cost_profile",
+    "devprof_enabled",
+    "normalize_cost_analysis",
+    "record_step_profile",
+    "summarize_timeline",
     "CAUSE_FIRST_CALL",
     "CAUSE_MODE_FLIP",
     "CAUSE_NEW_SHAPE_DTYPE",

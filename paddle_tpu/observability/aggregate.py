@@ -45,6 +45,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from paddle_tpu.flags import GLOBAL_FLAGS
+from paddle_tpu.observability import devprof as _devprof
 from paddle_tpu.observability import flight_recorder as _flight
 from paddle_tpu.observability import metrics as _metrics
 from paddle_tpu.observability import tracing as _tracing
@@ -323,6 +324,20 @@ class ClusterObserver:
             files["spans"] = "spans.jsonl"
         else:
             os.remove(os.path.join(tmp, "spans.jsonl"))
+        # 4b) device-time attribution: the cost-regression ledger plus each
+        # replica engine's step-timeline summary (per-step devprof_step
+        # events already live in the flight rings above; this is the
+        # compile-time truth to line them up against)
+        devprof = {"cost_ledger": _devprof.GLOBAL_COST_LEDGER.snapshot()}
+        timelines: Dict[str, Any] = {}
+        for r in self.router.cluster:
+            eng = getattr(r.frontend, "engine", None)
+            if eng is not None and hasattr(eng, "devprof_stats"):
+                timelines[r.name] = eng.devprof_stats()
+        devprof["timelines"] = timelines
+        with open(os.path.join(tmp, "devprof.json"), "w") as f:
+            json.dump(devprof, f, indent=1, default=str)
+        files["devprof"] = "devprof.json"
         # 5) the manifest LAST (a dir without incident.json is visibly torn),
         # then the atomic directory commit
         manifest = {

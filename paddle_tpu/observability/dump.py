@@ -16,7 +16,13 @@ Postmortem reader for the observability artifacts:
   merged by timestamp with a source column, the router's recent routing
   decisions, the SLO state timeline, and the sampled span trees — a
   failed-over request's spans from BOTH replicas assemble into one tree by
-  trace_id, each span annotated with the replica that emitted it.
+  trace_id, each span annotated with the replica that emitted it;
+- ``--devprof`` renders the **device-time attribution** story instead: the
+  sampled step-timeline (t-rel, host-prep / dispatch-gap / device split,
+  host-bubble fraction, comm source, per-category device shares) from the
+  dump's ``devprof_step`` events, plus — for incident dirs — the cost
+  ledger and any cost regressions; exits 2 when the dump carries no
+  profiles or a profile row is malformed, never a vacuous pass.
 
 Exit status: 0 on success, 2 on a missing, empty or corrupt file or
 incident directory (including a manifest referencing a missing ring) — the
@@ -231,7 +237,25 @@ def _load_incident(dirpath: str) -> Dict[str, Any]:
                 routing = json.load(f)
         except ValueError as exc:
             raise _CorruptIncident(f"{routing_file} is not valid JSON: {exc}") from exc
-    return {"manifest": manifest, "rings": rings, "spans": spans, "routing": routing}
+    devprof: Optional[Dict[str, Any]] = None
+    devprof_file = files.get("devprof")
+    if devprof_file:
+        devprof_path = os.path.join(dirpath, devprof_file)
+        if not os.path.isfile(devprof_path):
+            raise _CorruptIncident(
+                f"manifest references missing devprof file {devprof_file}"
+            )
+        try:
+            with open(devprof_path) as f:
+                devprof = json.load(f)
+        except ValueError as exc:
+            raise _CorruptIncident(f"{devprof_file} is not valid JSON: {exc}") from exc
+        if not isinstance(devprof, dict):
+            raise _CorruptIncident(f"{devprof_file} is not a devprof section")
+    return {
+        "manifest": manifest, "rings": rings, "spans": spans,
+        "routing": routing, "devprof": devprof,
+    }
 
 
 def _ring_source(fname: str, ring: Dict[str, Any]) -> str:
@@ -317,6 +341,82 @@ def _print_incident(incident: Dict[str, Any]) -> None:
             _print_trace_tree(tid, traces[tid])
 
 
+def _devprof_steps(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Pull the sampled step profiles out of a flight event stream,
+    validating their shape — a malformed profile raises (exit 2 upstream),
+    it never renders as a half-empty row."""
+    steps = []
+    for e in events:
+        if e.get("kind") != "devprof_step":
+            continue
+        cats = e.get("categories")
+        if not isinstance(cats, dict) or "wall_ms" not in e:
+            raise ValueError(
+                f"corrupt devprof_step event (seq={e.get('seq')}): "
+                "missing categories/wall_ms"
+            )
+        steps.append(e)
+    return steps
+
+
+def _print_devprof(
+    steps: List[Dict[str, Any]], cost: Optional[Dict[str, Any]] = None
+) -> None:
+    """Render the step-timeline: one row per sampled step (t-rel, segment
+    split, comm source, per-category shares), then the top-category summary
+    and — when an incident carried one — the cost ledger + regressions."""
+    print(f"device-time attribution — {len(steps)} sampled steps")
+    newest = max(float(e.get("ts_us", 0.0)) for e in steps)
+    print(
+        f"{'t-rel':>10} {'step':>6} {'wall ms':>9} {'host ms':>9} "
+        f"{'disp ms':>9} {'dev ms':>9} {'bubble':>7} {'comm':<10} shares"
+    )
+    totals: Dict[str, float] = {}
+    for e in steps:
+        rel = (float(e.get("ts_us", 0.0)) - newest) / 1e6
+        cats = e["categories"]
+        for k, v in cats.items():
+            totals[k] = totals.get(k, 0.0) + float(v)
+        shares = " ".join(f"{k}={float(v):.2f}" for k, v in sorted(cats.items()))
+        print(
+            f"{rel:>+9.3f}s {e.get('step', '?'):>6} "
+            f"{float(e.get('wall_ms', 0.0)):>9.3f} "
+            f"{float(e.get('host_prep_ms', 0.0)):>9.3f} "
+            f"{float(e.get('dispatch_ms', 0.0)):>9.3f} "
+            f"{float(e.get('device_ms', 0.0)):>9.3f} "
+            f"{float(e.get('host_bubble_fraction', 0.0)):>7.3f} "
+            f"{str(e.get('comm_source', 'none')):<10} {shares}"
+        )
+    n = len(steps)
+    means = sorted(
+        ((k, v / n) for k, v in totals.items()), key=lambda kv: -kv[1]
+    )
+    top = means[0] if means else ("?", 0.0)
+    print(f"\ntop category: {top[0]} (mean device share {top[1]:.3f})")
+    print(
+        "mean shares: "
+        + "  ".join(f"{k}={v:.3f}" for k, v in means)
+    )
+    bubble = sum(float(e.get("host_bubble_fraction", 0.0)) for e in steps) / n
+    print(f"mean host-bubble fraction: {bubble:.3f}")
+    if cost:
+        ledger = cost.get("cost_ledger") or {}
+        profiles = ledger.get("profiles") or {}
+        for fn, by_sig in sorted(profiles.items()):
+            for sig, prof in sorted(by_sig.items()):
+                print(
+                    f"cost profile: {fn} [{sig}] flops={prof.get('flops')} "
+                    f"bytes={prof.get('bytes_accessed')} "
+                    f"model={prof.get('cost_model')}"
+                )
+        for r in ledger.get("regressions") or []:
+            print(
+                f"COST REGRESSION: {r.get('fn')} {r.get('prev_signature')} -> "
+                f"{r.get('signature')} drift_flops={r.get('drift_flops')} "
+                f"drift_bytes={r.get('drift_bytes')}"
+            )
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m paddle_tpu.observability.dump",
@@ -332,6 +432,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         metavar="OUT",
         help="convert a span JSONL to a chrome-trace JSON file",
     )
+    ap.add_argument(
+        "--devprof",
+        action="store_true",
+        help="render the device-time attribution story: the sampled "
+        "step-timeline (segment split + per-category shares) from a flight "
+        "dump's devprof_step events, plus the cost ledger/regressions when "
+        "reading an incident dir; exits 2 when the dump carries no profiles",
+    )
     args = ap.parse_args(argv)
 
     if os.path.isdir(args.path):
@@ -343,6 +451,24 @@ def main(argv: Optional[List[str]] = None) -> int:
                 file=sys.stderr,
             )
             return 2
+        if args.devprof:
+            try:
+                steps: List[Dict[str, Any]] = []
+                for _fname, ring in sorted(incident["rings"].items()):
+                    steps.extend(_devprof_steps(ring.get("events", [])))
+            except ValueError as exc:
+                print(f"error: {exc}", file=sys.stderr)
+                return 2
+            if not steps:
+                print(
+                    f"error: incident {args.path} carries no devprof_step "
+                    "profiles (was FLAGS_devprof_sample_rate 0?)",
+                    file=sys.stderr,
+                )
+                return 2
+            steps.sort(key=lambda e: float(e.get("ts_us", 0.0)))
+            _print_devprof(steps, cost=incident.get("devprof"))
+            return 0
         if args.to_chrome:
             # convert the incident's sampled span buffer (an explicitly
             # requested conversion must never be silently dropped)
@@ -366,6 +492,29 @@ def main(argv: Optional[List[str]] = None) -> int:
     except (ValueError, OSError) as exc:
         print(f"error: cannot read {args.path}: {exc}", file=sys.stderr)
         return 2
+
+    if args.devprof:
+        if which != "flight":
+            print(
+                "error: --devprof reads a flight dump or incident dir "
+                "(span JSONLs carry no devprof_step events)",
+                file=sys.stderr,
+            )
+            return 2
+        try:
+            steps = _devprof_steps(payload.get("events", []))
+        except ValueError as exc:
+            print(f"error: {exc}", file=sys.stderr)
+            return 2
+        if not steps:
+            print(
+                f"error: {args.path} carries no devprof_step profiles "
+                "(was FLAGS_devprof_sample_rate 0?)",
+                file=sys.stderr,
+            )
+            return 2
+        _print_devprof(steps)
+        return 0
 
     if args.to_chrome:
         if which == "flight":

@@ -27,10 +27,11 @@ from __future__ import annotations
 
 import threading
 import warnings
-from typing import Any, Dict, Optional
+from typing import Any, Callable, Dict, Optional
 
 from paddle_tpu.flags import GLOBAL_FLAGS
 
+from . import devprof as _devprof
 from . import flight_recorder as _flight
 from . import metrics as _metrics
 from . import tracing as _tracing
@@ -83,10 +84,24 @@ class RecompileWatchdog:
         )
 
     def record_compile(
-        self, fn: str, signature: Any = None, cause: str = CAUSE_NEW_SHAPE_DTYPE
+        self,
+        fn: str,
+        signature: Any = None,
+        cause: str = CAUSE_NEW_SHAPE_DTYPE,
+        cost_thunk: Optional[Callable[[], Any]] = None,
+        cost_hints: Optional[Dict[str, float]] = None,
     ) -> int:
         """Record one compilation of ``fn``; returns its total compile count.
-        Called once per actual trace (cache miss), never per call."""
+        Called once per actual trace (cache miss), never per call.
+
+        ``cost_thunk``, when the call site can supply one, is a zero-arg
+        callable returning ``compiled.cost_analysis()`` raw output for the
+        program just compiled; devprof captures it into the cost-regression
+        ledger keyed by this same ``fn``/``signature``. It only runs while
+        ``FLAGS_devprof_sample_rate > 0`` (an introspective AOT lowering
+        costs a second compile) and never raises. ``cost_hints`` are the
+        site's analytic per-category weights (attention/matmul/collective)
+        seeding the attribution prior."""
         with self._lock:
             rec = self._fns.setdefault(
                 fn, {"count": 0, "causes": {}, "signatures": []}
@@ -106,6 +121,9 @@ class RecompileWatchdog:
         # trace instant when tracing is on (a compile mid-serve explains a
         # latency cliff no span arithmetic can)
         _flight.record_event("compile", fn=fn, cause=cause, count=count)
+        if cost_thunk is not None and _devprof.devprof_enabled():
+            sig = signature if isinstance(signature, str) else repr(signature)
+            _devprof.capture_cost_profile(fn, sig, cost_thunk, cost_hints)
         if _tracing.tracing_enabled():
             _tracing.GLOBAL_TRACER.add_event(
                 "jit.compile", attrs={"fn": fn, "cause": cause, "count": count}

@@ -282,6 +282,15 @@ class Profiler:
             events = events + GLOBAL_TRACER.drain_chrome_events()
         except ImportError:  # tracing unavailable mid-teardown: spans still export
             pass
+        try:
+            # devprof counter tracks (per-category device ms + segment split
+            # per sampled step) ride the same perf_counter timeline as "C"
+            # events, so the attribution overlays the span stream
+            from paddle_tpu.observability import devprof as _devprof
+
+            events = events + _devprof.drain_chrome_events()
+        except ImportError:  # devprof unavailable mid-teardown
+            pass
         with open(path, "w") as f:
             json.dump({"traceEvents": events}, f)
 

@@ -802,4 +802,11 @@ class ServingFrontend:
                     if hasattr(self.engine, "tp_stats")
                     else {"tp_degree": 1}
                 ),
+                # device-time attribution over the step-timeline ring
+                # (enabled: False == FLAGS_devprof_sample_rate=0)
+                "devprof": (
+                    self.engine.devprof_stats()
+                    if hasattr(self.engine, "devprof_stats")
+                    else {"enabled": False, "sampled_steps": 0}
+                ),
             }

@@ -761,6 +761,114 @@ def test_ob602_fleet_family_list_resolves():
     assert not missing, f"fleet families not registered anywhere: {missing}"
 
 
+def test_ob603_timed_dispatch_without_sync_fires():
+    # perf_counter pair brackets a jitted call with no device sync before
+    # the stop timestamp: the "measured" time is dispatch, not execution
+    assert codes(
+        "import jax, time\n"
+        "def g(x):\n"
+        "    return x\n"
+        "f = jax.jit(g)\n"
+        "def bench(x):\n"
+        "    t0 = time.perf_counter()\n"
+        "    y = f(x)\n"
+        "    t1 = time.perf_counter()\n"
+        "    return t1 - t0, y\n"
+    ) == ["OB603"]
+
+
+def test_ob603_self_attribute_jitted_callable():
+    assert codes(
+        "import jax, time\n"
+        "class Engine:\n"
+        "    def __init__(self):\n"
+        "        self._fn = jax.jit(lambda x: x)\n"
+        "    def step(self, x):\n"
+        "        t0 = time.time()\n"
+        "        y = self._fn(x)\n"
+        "        t1 = time.time()\n"
+        "        return t1 - t0, y\n"
+    ) == ["OB603"]
+
+
+def test_ob603_sync_before_stop_is_honest():
+    assert codes(
+        "import jax, time\n"
+        "def g(x):\n"
+        "    return x\n"
+        "f = jax.jit(g)\n"
+        "def bench(x):\n"
+        "    t0 = time.perf_counter()\n"
+        "    y = f(x)\n"
+        "    jax.block_until_ready(y)\n"
+        "    t1 = time.perf_counter()\n"
+        "    return t1 - t0, y\n"
+    ) == []
+
+
+def test_ob603_fused_dispatch_and_sync_in_one_statement():
+    # np.asarray(f(x)) blocks on the result in the same statement: honest
+    assert codes(
+        "import jax, time\n"
+        "import numpy as np\n"
+        "def g(x):\n"
+        "    return x\n"
+        "f = jax.jit(g)\n"
+        "def bench(x):\n"
+        "    t0 = time.perf_counter()\n"
+        "    y = np.asarray(f(x))\n"
+        "    t1 = time.perf_counter()\n"
+        "    return t1 - t0, y\n"
+    ) == []
+
+
+def test_ob603_non_jitted_call_not_confused():
+    assert codes(
+        "import time\n"
+        "def helper(x):\n"
+        "    return x + 1\n"
+        "def bench(x):\n"
+        "    t0 = time.perf_counter()\n"
+        "    y = helper(x)\n"
+        "    t1 = time.perf_counter()\n"
+        "    return t1 - t0, y\n"
+    ) == []
+
+
+def test_ob603_dispatch_before_first_timestamp_not_flagged():
+    # a jitted warmup call ahead of the timing window is fine
+    assert codes(
+        "import jax, time\n"
+        "def g(x):\n"
+        "    return x\n"
+        "f = jax.jit(g)\n"
+        "def bench(x):\n"
+        "    y = f(x)\n"
+        "    jax.block_until_ready(y)\n"
+        "    t0 = time.perf_counter()\n"
+        "    t1 = time.perf_counter()\n"
+        "    return t1 - t0\n"
+    ) == []
+
+
+def test_ob603_suppressible_with_reason():
+    vs = analyze_source(
+        "import jax, time\n"
+        "def g(x):\n"
+        "    return x\n"
+        "f = jax.jit(g)\n"
+        "def bench(x):\n"
+        "    t0 = time.perf_counter()\n"
+        "    y = f(x)\n"
+        "    # analysis: disable=OB603 dispatch cost is the quantity under test\n"
+        "    t1 = time.perf_counter()\n"
+        "    return t1 - t0, y\n"
+    )
+    ob = [v for v in vs if v.code == "OB603"]
+    assert len(ob) == 1
+    assert ob[0].suppressed and ob[0].reason
+
+
 # -- suppressions ------------------------------------------------------------
 
 def test_suppression_with_reason():

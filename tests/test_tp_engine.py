@@ -328,4 +328,9 @@ def test_bench_tp_decode_cpu_smoke():
     assert rec["per_chip_tokens_per_sec"] == pytest.approx(
         rec["value"] / rec["tp_degree"], abs=0.02
     )
-    assert 0.0 <= rec["all_reduce_time_share_est"] <= 1.0
+    # analytic vs measured comm share, each labeled with its provenance
+    assert rec["comm_share_analytic"]["method"] == "analytic_estimate"
+    assert 0.0 <= rec["comm_share_analytic"]["value"] <= 1.0
+    assert rec["comm_share_measured"]["status"] == "measured"
+    assert 0.0 <= rec["comm_share_measured"]["value"] <= 1.0
+    assert rec["host_bubble_fraction"]["status"] == "measured"
