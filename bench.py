@@ -282,6 +282,44 @@ def _assert_grad_coverage(paddle, model, ids, labels) -> None:
     print(f"bench: grad-coverage ok ({len(names)} trainable params)", file=sys.stderr)
 
 
+# secondaries whose measured path dispatches kernels from paddle_tpu/kernels/
+# (directly or through the serving engine's decode step) — each of their
+# records carries the PG preflight verdict so a hardware run never burns its
+# rare TPU window on a kernel the analyzer already knows cannot lower
+_KERNEL_BEARING_METRICS = {
+    "int8_decode_matmul_ms",
+    "paged_decode_step_ms",
+    "engine_decode_tokens_per_sec",
+    "fused_decode_layer_dispatches_per_layer",
+    "tp_decode_tokens_per_sec",
+    "shared_prefix_ttft_speedup",
+    "kv_tier_multi_turn_ttft",
+    "spec_decode_tokens_per_sec",
+    "engine_fault_recovery_tokens_per_sec",
+    "serving_goodput_tokens_per_sec",
+    "cluster_goodput_tokens_per_sec",
+}
+
+
+def _kernel_geometry_clean() -> bool:
+    """PG (Pallas kernel geometry) preflight over the kernels package: rank
+    discipline, in-bounds proofs, VMEM budgets, scalar-prefetch, fallback
+    lockstep. In-process ``--select PG`` equivalent; an analyzer crash counts
+    as NOT clean (never vacuously green)."""
+    try:
+        from paddle_tpu.analysis import analyze_paths
+
+        pkg = os.path.join(os.path.dirname(os.path.abspath(__file__)), "paddle_tpu", "kernels")
+        vs = analyze_paths([pkg], select=["PG"])
+        n = sum(1 for v in vs if not v.suppressed)
+        if n:
+            print(f"bench: PG geometry preflight: {n} finding(s)", file=sys.stderr)
+        return n == 0
+    except Exception as exc:  # noqa: BLE001 - preflight must never kill the bench
+        print(f"bench: PG geometry preflight failed: {exc!r}", file=sys.stderr)
+        return False
+
+
 def main() -> None:
     # backend watchdog must run before `import paddle_tpu` — the framework
     # import itself touches the backend, which hangs if the tunnel is down
@@ -421,6 +459,7 @@ def _main_timed(platform, paddle, cfg, batch, seq, steps, warmup) -> None:
     # returned an "error" field (or skipped itself, e.g. tp under 2
     # devices) did not measure anything — trajectory tooling must never
     # average its value as a real zero
+    geometry_clean = _kernel_geometry_clean()
     for rec in secondary:
         rec.setdefault(
             "status",
@@ -428,6 +467,8 @@ def _main_timed(platform, paddle, cfg, batch, seq, steps, warmup) -> None:
             else "skipped" if "skipped" in rec
             else "measured",
         )
+        if rec.get("metric") in _KERNEL_BEARING_METRICS:
+            rec["geometry_clean"] = geometry_clean
     print(
         json.dumps(
             {

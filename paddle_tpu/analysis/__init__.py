@@ -11,6 +11,14 @@ Checker families:
   traced functions (:mod:`.checkers.trace_safety`);
 - **PK** Pallas purity — impure kernel bodies / BlockSpec index maps
   (:mod:`.checkers.pallas_purity`);
+- **PG** Pallas kernel geometry — abstract evaluation of every
+  ``pl.pallas_call`` site (:mod:`.kernel_geometry`, memoized in the
+  ``PackageIndex``): BlockSpec rank discipline (PG901), in-bounds proofs at
+  the grid corners with symbolic axes reported ``unproven`` (PG902),
+  per-grid-step VMEM window vs the per-target budget incl. autotune
+  candidate configs (PG903), scalar-prefetch discipline (PG904), and the
+  kernel↔XLA fallback lockstep contract (PG905)
+  (:mod:`.checkers.pallas_geometry`);
 - **FD** flag discipline — unresolvable flag strings, un-cached registry
   reads in hot-path loops (:mod:`.checkers.flag_discipline`);
 - **EH** exception hygiene — bare/silent/unannotated broad excepts

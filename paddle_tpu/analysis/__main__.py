@@ -10,11 +10,13 @@ held to zero while accepted debt burns down)."""
 from __future__ import annotations
 
 import argparse
+import subprocess
 import sys
-from typing import List, Optional
+from pathlib import Path
+from typing import List, Optional, Set
 
-from paddle_tpu.analysis.checkers import all_codes
-from paddle_tpu.analysis.core import analyze_paths
+from paddle_tpu.analysis.checkers import all_checkers, all_codes
+from paddle_tpu.analysis.core import analyze_paths, iter_python_files
 from paddle_tpu.analysis.reporters import (
     load_baseline,
     new_violations,
@@ -25,13 +27,30 @@ from paddle_tpu.analysis.reporters import (
 )
 
 
+def _git_changed_files(ref: str) -> Optional[Set[Path]]:
+    """Resolved paths changed vs ``ref`` plus untracked files, or None when
+    git is unusable (not a repo, binary missing, bad ref)."""
+    def _run(*argv: str) -> str:
+        return subprocess.run(
+            ["git", *argv], capture_output=True, text=True, check=True, timeout=30
+        ).stdout
+
+    try:
+        root = Path(_run("rev-parse", "--show-toplevel").strip())
+        names = _run("diff", "--name-only", ref).splitlines()
+        names += _run("ls-files", "--others", "--exclude-standard").splitlines()
+    except (OSError, subprocess.SubprocessError):
+        return None
+    return {(root / n.strip()).resolve() for n in names if n.strip()}
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m paddle_tpu.analysis",
         description="AST static analysis: trace-safety (TS), Pallas purity (PK), "
-        "flag discipline (FD), exception hygiene (EH), robustness (RB), "
-        "observability (OB), concurrency (CC), donation/lifetime (DN), "
-        "tape backward discipline (TB).",
+        "Pallas geometry (PG), flag discipline (FD), exception hygiene (EH), "
+        "robustness (RB), observability (OB), concurrency (CC), "
+        "donation/lifetime (DN), tape backward discipline (TB).",
     )
     ap.add_argument("paths", nargs="*", help="files or directories to analyze")
     ap.add_argument("--format", choices=("text", "json", "sarif"), default="text")
@@ -48,6 +67,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--write-baseline", metavar="FILE",
         help="write the current unsuppressed findings as a baseline snapshot "
         "and exit 0 (combine with --select to scope it)",
+    )
+    ap.add_argument(
+        "--changed-only", nargs="?", const="HEAD", default=None, metavar="REF",
+        help="scope the run to files changed vs a git ref (default HEAD) plus "
+        "untracked files; falls back to a full run with a warning when git "
+        "is unavailable — the pre-commit hook mode (tools/pre-commit-analysis)",
+    )
+    ap.add_argument(
+        "--vmem-budget", type=int, default=None, metavar="BYTES",
+        help="per-grid-step VMEM budget for PG903 in bytes "
+        "(default 16 MiB/core)",
     )
     ap.add_argument(
         "--show-suppressed", action="store_true",
@@ -67,8 +97,54 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
 
     select = [s.strip() for s in args.select.split(",")] if args.select else None
+    if select is not None:
+        # never-vacuous rule (same as missing-path and corrupt-baseline): a
+        # typo'd prefix that matches nothing must not pass silently
+        codes = all_codes()
+        bad = [s for s in select if not s or not any(c.startswith(s) for c in codes)]
+        if bad:
+            print(
+                f"error: --select matched no registered codes: "
+                f"{', '.join(repr(s) for s in bad)}\n"
+                f"valid codes: {', '.join(sorted(codes))}",
+                file=sys.stderr,
+            )
+            return 2
+
+    paths = list(args.paths)
+    if args.changed_only is not None:
+        changed = _git_changed_files(args.changed_only)
+        if changed is None:
+            print(
+                "warning: git unavailable; --changed-only falling back to a "
+                "full run",
+                file=sys.stderr,
+            )
+        else:
+            try:
+                scoped = [
+                    f for f in iter_python_files(paths) if f.resolve() in changed
+                ]
+            except FileNotFoundError as exc:
+                print(f"error: {exc}", file=sys.stderr)
+                return 2
+            if not scoped:
+                print(
+                    f"no Python files changed vs {args.changed_only} under "
+                    f"the given paths"
+                )
+                return 0
+            paths = [str(f) for f in scoped]
+
+    checkers = None
+    if args.vmem_budget is not None:
+        checkers = all_checkers()
+        for c in checkers:
+            if c.name == "pallas_geometry":
+                c.vmem_budget = int(args.vmem_budget)
+
     try:
-        violations = analyze_paths(args.paths, select=select)
+        violations = analyze_paths(paths, checkers=checkers, select=select)
     except FileNotFoundError as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 2

@@ -11,6 +11,7 @@ from paddle_tpu.analysis.checkers.donation import DonationChecker
 from paddle_tpu.analysis.checkers.exception_hygiene import ExceptionHygieneChecker
 from paddle_tpu.analysis.checkers.flag_discipline import FlagDisciplineChecker
 from paddle_tpu.analysis.checkers.observability import ObservabilityChecker
+from paddle_tpu.analysis.checkers.pallas_geometry import PallasGeometryChecker
 from paddle_tpu.analysis.checkers.pallas_purity import PallasPurityChecker
 from paddle_tpu.analysis.checkers.robustness import RobustnessChecker
 from paddle_tpu.analysis.checkers.tape_backward import TapeBackwardChecker
@@ -22,6 +23,7 @@ __all__ = ["CHECKER_CLASSES", "all_checkers", "all_codes"]
 CHECKER_CLASSES: List[Type[Checker]] = [
     TraceSafetyChecker,
     PallasPurityChecker,
+    PallasGeometryChecker,
     FlagDisciplineChecker,
     ExceptionHygieneChecker,
     RobustnessChecker,

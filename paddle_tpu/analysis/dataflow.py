@@ -103,6 +103,31 @@ def _dotted(node: ast.AST) -> Optional[str]:
     return None
 
 
+def _warn_fallback_callees(tree: ast.Module) -> Set[str]:
+    """Simple names of every function called inside a function whose body
+    calls ``warn_fallback`` with a literal kernel label (the PG905 coverage
+    contribution of one module)."""
+    covered: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        names: Set[str] = set()
+        has_wf = False
+        for c in ast.walk(node):
+            if not isinstance(c, ast.Call):
+                continue
+            chain = _dotted(c.func)
+            simple = chain.split(".")[-1] if chain else None
+            if simple == "warn_fallback":
+                if c.args and isinstance(c.args[0], ast.Constant):
+                    has_wf = True
+            elif simple:
+                names.add(simple)
+        if has_wf:
+            covered |= names
+    return covered
+
+
 @dataclass
 class CallSite:
     """One call expression with its interprocedural context."""
@@ -672,6 +697,9 @@ class PackageIndex:
         self._loop_reachable: Optional[Set[str]] = None
         self._edges: Optional[Dict[str, List[CallSite]]] = None
         self._lock_pairs: Optional[Dict[Tuple[str, str], List[Tuple[str, int, str]]]] = None
+        # memoized per-module Pallas geometry reports (analysis.kernel_geometry)
+        self._geometry: Dict[str, object] = {}
+        self._fallback_labels: Optional[Set[str]] = None
 
     # -- module memoization ---------------------------------------------------
     def add_module(self, path: str, tree: ast.Module) -> ModuleGraph:
@@ -683,6 +711,8 @@ class PackageIndex:
             self._loop_reachable = None
             self._edges = None
             self._lock_pairs = None
+            self._geometry.clear()
+            self._fallback_labels = None
         return self._modules[path]
 
     def module(self, path: str) -> Optional[ModuleGraph]:
@@ -690,6 +720,68 @@ class PackageIndex:
 
     def modules(self) -> Iterable[ModuleGraph]:
         return self._modules.values()
+
+    # -- Pallas kernel geometry (analysis.kernel_geometry) --------------------
+    def kernel_geometry(self, path: str, tree: Optional[ast.Module] = None):
+        """The module's abstract Pallas-geometry report, evaluated once per
+        (module set, path) — the PG checkers all read this memo, keeping the
+        single-pass and wall-time CI gates honest."""
+        if path not in self._geometry:
+            if tree is None:
+                g = self._modules.get(path)
+                if g is None:
+                    raise KeyError(f"module not indexed: {path}")
+                tree = g.tree
+            from paddle_tpu.analysis.kernel_geometry import evaluate_module
+
+            self._geometry[path] = evaluate_module(path, tree, self)
+        return self._geometry[path]
+
+    def fallback_covered(self) -> Set[str]:
+        """Simple names of every function called inside any indexed function
+        whose body calls ``warn_fallback`` with a literal kernel label — the
+        PG905 coverage set: a kernel entry called from such a function
+        degrades to XLA with a counted, scrapeable fallback."""
+        if self._fallback_labels is None:
+            covered: Set[str] = set()
+            for g in self._modules.values():
+                covered |= _warn_fallback_callees(g.tree)
+            covered |= self._package_fallback_callees()
+            self._fallback_labels = covered
+        return self._fallback_labels
+
+    def _package_fallback_callees(self) -> Set[str]:
+        """PG905's coverage universe is the PACKAGE, not the analyzed file
+        set: a run scoped to ``kernels/`` (the bench geometry preflight, or
+        ``--changed-only`` touching a kernel module) must still see the
+        fallback-wrapping dispatch layer living outside it. When every
+        on-disk indexed module sits under a ``kernels`` directory, the rest
+        of the package is lazily parsed from disk for its warn_fallback
+        wrappers — nothing else about unindexed modules is consulted."""
+        from pathlib import Path
+
+        pkg_root = None
+        for p in self._modules:
+            path = Path(p)
+            if not path.is_file():
+                continue  # fixture/snippet paths keep module-local semantics
+            parts = path.resolve().parts
+            if "kernels" not in parts:
+                return set()  # the index already spans the package
+            pkg_root = Path(*parts[: parts.index("kernels")])
+        if pkg_root is None:
+            return set()
+        indexed = {str(Path(p).resolve()) for p in self._modules}
+        out: Set[str] = set()
+        for f in sorted(pkg_root.rglob("*.py")):
+            if str(f.resolve()) in indexed:
+                continue
+            try:
+                tree = ast.parse(f.read_text(encoding="utf-8", errors="replace"))
+            except (OSError, SyntaxError):
+                continue
+            out |= _warn_fallback_callees(tree)
+        return out
 
     # -- cross-module resolution ----------------------------------------------
     def _resolve_key(self, key: str) -> List[str]:

@@ -28,6 +28,7 @@ __all__ = [
     "fused_dropout_add",
     "masked_multihead_attention",
     "block_multihead_attention",
+    "block_multihead_attention_fused",
     "block_multihead_chunk_attention",
     "block_multihead_chunk_attention_fused",
     "block_cache_prefill",
@@ -45,6 +46,7 @@ from paddle_tpu.incubate.nn.functional.block_attention import (  # noqa: E402,F4
     block_cache_cow_copy,
     block_cache_prefill,
     block_multihead_attention,
+    block_multihead_attention_fused,
     block_multihead_chunk_attention,
     block_multihead_chunk_attention_fused,
 )

@@ -132,6 +132,7 @@ def _tp_sharded_flash_chunk_fused(
 __all__ = [
     "BlockKVCache",
     "block_multihead_attention",
+    "block_multihead_attention_fused",
     "block_multihead_chunk_attention",
     "block_multihead_chunk_attention_fused",
     "block_cache_prefill",
@@ -748,6 +749,86 @@ def block_multihead_attention(
             )
     # the decode step IS the C == 1 chunk: one new row per sequence whose
     # causal limit is seq_lens + 1 (attend_lens), masked slots exact zeros
+    out = _gather_chunk_attend(
+        q, key_cache, value_cache, block_tables, seq_lens,
+        attend_lens - seq_lens, scale,
+    )
+    return out.astype(q.dtype), key_cache, value_cache
+
+
+def block_multihead_attention_fused(
+    q: jax.Array,  # [B, 1, HQ, D] PRE-rope decode query
+    k: jax.Array,  # [B, 1, HKV, D] PRE-rope new key
+    v: jax.Array,  # [B, 1, HKV, D] new value
+    cos: jax.Array,  # [B, 1, 1, D] offset-gathered rope rows (model layout)
+    sin: jax.Array,
+    key_cache: jax.Array,  # [NB, HKV, BS, D]
+    value_cache: jax.Array,
+    block_tables: jax.Array,  # [B, MBS] int32
+    seq_lens: jax.Array,  # [B] tokens already cached (EXCLUDING this one)
+    scale: Optional[float] = None,
+    slot_mask: Optional[jax.Array] = None,  # [B] bool; False = padded slot
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """:func:`block_multihead_attention` with RoPE folded in — the pure-decode
+    counterpart of :func:`block_multihead_chunk_attention_fused`.
+
+    Takes PRE-rope q/k plus the per-slot rope rows: k is rotated by the same
+    XLA elementwise composition the unfused path uses (it fuses into the
+    cache-append scatter) while q's rotation moves INSIDE the flash-decode
+    block walk (``paged_flash_decode_fused``). The XLA fallback applies the
+    identical ``_rope_apply_xla`` to q before the shared dense-gather
+    attention, so fused on/off execute the same op composition off-TPU and
+    outputs are byte-identical by construction.
+    """
+    from paddle_tpu.incubate.nn.functional import _rope_apply_xla
+
+    b, one, hq, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d**0.5)
+    k = _rope_apply_xla(k, sin, cos, True)
+    key_cache, value_cache = block_cache_append(
+        key_cache, value_cache, k[:, 0], v[:, 0], block_tables, seq_lens,
+        slot_mask=slot_mask,
+    )
+    # length INCLUDING the freshly appended token; 0 for padded slots
+    attend_lens = seq_lens + 1
+    if slot_mask is not None:
+        attend_lens = jnp.where(slot_mask, attend_lens, 0)
+    from paddle_tpu.kernels.select import pallas_enabled, warn_fallback
+
+    if pallas_enabled("use_pallas_paged_attention"):
+        # rope-fused flash-decode kernel; same cached host-side lowering
+        # probe contract as the unfused decode dispatch above — a Mosaic
+        # error inside the jitted decode step is uncatchable at run time
+        from paddle_tpu.kernels.paged_attention import (
+            decode_fused_lowering_supported,
+            paged_flash_decode_fused,
+        )
+
+        nb, hkv_c, bs, d_c = key_cache.shape
+        cos3 = cos.reshape(b, 1, d)
+        sin3 = sin.reshape(b, 1, d)
+        if decode_fused_lowering_supported(
+            b, hq, hkv_c, d_c, nb, bs, block_tables.shape[1], str(q.dtype)
+        ):
+            try:
+                out = paged_flash_decode_fused(
+                    q[:, 0], cos3, sin3, key_cache, value_cache,
+                    block_tables,
+                    attend_lens,  # kernel masks pos < len INCLUDING this token
+                    scale=scale,
+                )
+                return out[:, None], key_cache, value_cache
+            except Exception as exc:  # noqa: BLE001 - XLA fallback below
+                warn_fallback("paged_flash_decode_fused", exc)
+        else:
+            warn_fallback(
+                "paged_flash_decode_fused",
+                RuntimeError("Mosaic lowering unsupported for geometry"),
+            )
+    # lockstep fallback: the SAME rope composition the unfused path applies,
+    # then the shared dense-gather attention (C == 1 chunk)
+    q = _rope_apply_xla(q, sin, cos, True)
     out = _gather_chunk_attend(
         q, key_cache, value_cache, block_tables, seq_lens,
         attend_lens - seq_lens, scale,

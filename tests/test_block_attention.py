@@ -223,3 +223,71 @@ class TestSlotMask:
         np.testing.assert_allclose(
             np.asarray(out_k), np.asarray(out_xla), rtol=2e-5, atol=2e-5
         )
+
+
+class TestFusedDecodeWrapper:
+    """``block_multihead_attention_fused``: the rope-fused counterpart of the
+    decode wrapper. On a backend without the kernel, fused on/off must
+    execute the SAME op composition (byte-identical outputs); with the
+    kernel forced on (interpret mode), numerics stay in lockstep with the
+    XLA fallback."""
+
+    def _setup(self, seed=13):
+        rng = np.random.default_rng(seed)
+        nb, mbs = 8, 2
+        q = jnp.asarray(rng.normal(size=(B, 1, HQ, D)), jnp.float32)
+        k1 = jnp.asarray(rng.normal(size=(B, 1, HKV, D)), jnp.float32)
+        v1 = jnp.asarray(rng.normal(size=(B, 1, HKV, D)), jnp.float32)
+        cos = jnp.asarray(np.cos(rng.normal(size=(B, 1, 1, D))), jnp.float32)
+        sin = jnp.asarray(np.sin(rng.normal(size=(B, 1, 1, D))), jnp.float32)
+        kc = jnp.asarray(rng.normal(size=(nb, HKV, BS, D)), jnp.float32)
+        vc = jnp.asarray(rng.normal(size=(nb, HKV, BS, D)), jnp.float32)
+        tables = jnp.asarray([[2, 3], [4, 5]], jnp.int32)
+        lens = jnp.asarray([5, 3], jnp.int32)
+        return q, k1, v1, cos, sin, kc, vc, tables, lens
+
+    def test_fallback_byte_identical_to_unfused_composition(self):
+        from paddle_tpu.incubate.nn.functional import (
+            _rope_apply_xla,
+            block_multihead_attention_fused,
+        )
+
+        q, k1, v1, cos, sin, kc, vc, tables, lens = self._setup()
+        out_f, kc_f, vc_f = block_multihead_attention_fused(
+            q, k1, v1, cos, sin, kc, vc, tables, lens
+        )
+        q_r = _rope_apply_xla(q, sin, cos, True)
+        k_r = _rope_apply_xla(k1, sin, cos, True)
+        out_u, kc_u, vc_u = block_multihead_attention(
+            q_r, k_r, v1, kc, vc, tables, lens
+        )
+        np.testing.assert_array_equal(np.asarray(out_f), np.asarray(out_u))
+        np.testing.assert_array_equal(np.asarray(kc_f), np.asarray(kc_u))
+        np.testing.assert_array_equal(np.asarray(vc_f), np.asarray(vc_u))
+
+    def test_kernel_lockstep_with_xla_fallback(self, monkeypatch):
+        import paddle_tpu.kernels.paged_attention as pa
+        import paddle_tpu.kernels.select as sel
+        from paddle_tpu.incubate.nn.functional import (
+            block_multihead_attention_fused,
+        )
+
+        q, k1, v1, cos, sin, kc, vc, tables, lens = self._setup(seed=14)
+        mask = jnp.asarray([False, True])
+        out_xla, _, _ = block_multihead_attention_fused(
+            q, k1, v1, cos, sin, kc, vc, tables, lens, slot_mask=mask
+        )
+        monkeypatch.setattr(sel, "pallas_enabled", lambda flag: True)
+        real = pa.paged_flash_decode_fused
+        monkeypatch.setattr(
+            pa, "paged_flash_decode_fused",
+            lambda *a, **kw: real(*a, interpret=True, **kw),
+        )
+        out_k, _, _ = block_multihead_attention_fused(
+            q, k1, v1, cos, sin, kc, vc, tables, lens, slot_mask=mask
+        )
+        assert (np.asarray(out_k)[0] == 0.0).all()
+        assert np.abs(np.asarray(out_k)[1]).sum() > 0
+        np.testing.assert_allclose(
+            np.asarray(out_k), np.asarray(out_xla), rtol=2e-5, atol=2e-5
+        )

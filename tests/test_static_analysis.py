@@ -989,6 +989,73 @@ def test_cli_missing_path_is_a_usage_error(tmp_path):
     assert r.returncode == 2 and "no Python files" in r.stderr
 
 
+def test_cli_select_unknown_code_is_a_usage_error(tmp_path):
+    # the same never-vacuous rule: a typo'd --select used to filter every
+    # finding and exit 0, so a CI invocation passed without checking anything
+    bad = tmp_path / "bad.py"
+    bad.write_text("try:\n    f()\nexcept:\n    pass\n")
+    r = _run_cli(["--select", "EH999", str(bad)])
+    assert r.returncode == 2
+    assert "EH999" in r.stderr and "valid codes" in r.stderr
+    assert "EH401" in r.stderr  # the list names what IS registered
+    # a valid prefix mixed with a bogus one still errors (no partial pass)
+    r = _run_cli(["--select", "EH,TYPO", str(bad)])
+    assert r.returncode == 2 and "TYPO" in r.stderr
+    # family prefixes and exact codes stay accepted
+    r = _run_cli(["--select", "EH", str(bad)])
+    assert r.returncode == 1 and "EH401" in r.stdout
+    r = _run_cli(["--select", "EH401", str(bad)])
+    assert r.returncode == 1
+
+
+def _run_cli_in(cwd, args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=str(REPO))
+    return subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.analysis", *args],
+        cwd=cwd, env=env, capture_output=True, text=True, timeout=300,
+    )
+
+
+def test_cli_changed_only_scopes_to_git_diff(tmp_path):
+    def git(*argv):
+        subprocess.run(
+            ["git", *argv], cwd=tmp_path, check=True, capture_output=True,
+            env=dict(
+                os.environ,
+                GIT_AUTHOR_NAME="t", GIT_AUTHOR_EMAIL="t@t",
+                GIT_COMMITTER_NAME="t", GIT_COMMITTER_EMAIL="t@t",
+            ),
+        )
+
+    git("init", "-q")
+    clean = tmp_path / "clean.py"
+    clean.write_text("try:\n    f()\nexcept:\n    pass\n")  # committed finding
+    git("add", "clean.py")
+    git("commit", "-qm", "base")
+    bad = tmp_path / "bad.py"
+    bad.write_text("try:\n    g()\nexcept:\n    pass\n")  # untracked finding
+    # only the changed file is analyzed: clean.py's finding does not gate
+    r = _run_cli_in(tmp_path, ["--changed-only=HEAD", "."])
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "bad.py" in r.stdout and "clean.py" not in r.stdout
+    # everything committed: nothing changed -> clean exit, nothing analyzed
+    git("add", "bad.py")
+    git("commit", "-qm", "rest")
+    r = _run_cli_in(tmp_path, ["--changed-only=HEAD", "."])
+    assert r.returncode == 0 and "no Python files changed" in r.stdout
+
+
+def test_cli_changed_only_falls_back_without_git(tmp_path):
+    # outside any repo (or with a bad ref) the mode must degrade to a FULL
+    # run with a warning — never a vacuous zero-file pass
+    bad = tmp_path / "bad.py"
+    bad.write_text("try:\n    f()\nexcept:\n    pass\n")
+    r = _run_cli_in(tmp_path, ["--changed-only=not-a-real-ref", "."])
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "falling back to a full run" in r.stderr
+    assert "bad.py" in r.stdout
+
+
 def test_autotune_verbose_handler_follows_the_flag():
     import logging
 
@@ -1610,6 +1677,332 @@ def test_tb901_kernel_package_self_run_clean():
     assert [v for v in vs if not v.suppressed] == []
 
 
+# -- PG: Pallas kernel geometry ----------------------------------------------
+
+_PG_PRELUDE = (
+    "import jax\n"
+    "import jax.numpy as jnp\n"
+    "from jax.experimental import pallas as pl\n"
+    "def k(x_ref, o_ref):\n"
+    "    o_ref[...] = x_ref[...]\n"
+)
+
+
+def _pg_site(shape_in, shape_out, grid="(4,)", map_in="lambda i: (i, 0)"):
+    return (
+        _PG_PRELUDE
+        + "def f():\n"
+        "    x = jnp.zeros((256, 8), jnp.float32)\n"
+        "    return pl.pallas_call(\n"
+        "        k,\n"
+        f"        grid={grid},\n"
+        f"        in_specs=[pl.BlockSpec({shape_in}, {map_in})],\n"
+        f"        out_specs=pl.BlockSpec({shape_out}, lambda i: (i, 0)),\n"
+        "        out_shape=jax.ShapeDtypeStruct((256, 8), jnp.float32),\n"
+        "    )(x)\n"
+    )
+
+
+def test_pg901_block_rank_vs_map_arity():
+    # 3-dim block shape against a 2-tuple index map: Mosaic would reject it
+    # at first lowering; here it fails at lint time
+    assert "PG901" in codes(_pg_site("(64, 8, 1)", "(64, 8)"))
+
+
+def test_pg901_negative_consistent_geometry():
+    assert codes(_pg_site("(64, 8)", "(64, 8)")) == []
+
+
+def test_pg901_block_rank_vs_operand_rank():
+    src = (
+        _PG_PRELUDE
+        + "def f():\n"
+        "    x = jnp.zeros((256, 8, 4), jnp.float32)\n"
+        "    return pl.pallas_call(\n"
+        "        k,\n"
+        "        grid=(4,),\n"
+        "        in_specs=[pl.BlockSpec((64, 8), lambda i: (i, 0))],\n"
+        "        out_specs=pl.BlockSpec((64, 8), lambda i: (i, 0)),\n"
+        "        out_shape=jax.ShapeDtypeStruct((256, 8), jnp.float32),\n"
+        "    )(x)\n"
+    )
+    assert "PG901" in codes(src)
+
+
+def test_pg902_window_overrun_at_grid_corner():
+    # 4 grid steps of a 96-row block over 256 rows: corner i=3 ends at 384
+    found = codes(_pg_site("(96, 8)", "(96, 8)"))
+    assert "PG902" in found
+
+
+def test_pg902_negative_exact_tiling():
+    # 4 x 64 == 256: the corner window ends exactly at the boundary
+    assert codes(_pg_site("(64, 8)", "(64, 8)")) == []
+
+
+def test_pg902_intentional_clamp_is_reason_suppressed():
+    src = (
+        _PG_PRELUDE
+        + "def f():\n"
+        "    x = jnp.zeros((256, 8), jnp.float32)\n"
+        "    return pl.pallas_call(\n"
+        "        k,\n"
+        "        grid=(4,),\n"
+        "        in_specs=[pl.BlockSpec((96, 8), lambda i: (i, 0))],"
+        "  # analysis: disable=PG902 index map clamps the tail block\n"
+        "        out_specs=pl.BlockSpec((96, 8), lambda i: (i, 0)),"
+        "  # analysis: disable=PG902 index map clamps the tail block\n"
+        "        out_shape=jax.ShapeDtypeStruct((256, 8), jnp.float32),\n"
+        "    )(x)\n"
+    )
+    vs = analyze_source(src)
+    assert [v.code for v in vs if not v.suppressed] == []
+    assert {v.code for v in vs if v.suppressed} == {"PG902"}
+    assert all(v.reason for v in vs if v.suppressed)
+
+
+def test_pg903_vmem_budget_exceeded():
+    src = (
+        _PG_PRELUDE
+        + "def f():\n"
+        "    x = jnp.zeros((8192, 8192), jnp.float32)\n"
+        "    return pl.pallas_call(\n"
+        "        k,\n"
+        "        grid=(2,),\n"
+        "        in_specs=[pl.BlockSpec((4096, 8192), lambda i: (i, 0))],\n"
+        "        out_specs=pl.BlockSpec((4096, 8192), lambda i: (i, 0)),\n"
+        "        out_shape=jax.ShapeDtypeStruct((8192, 8192), jnp.float32),\n"
+        "    )(x)\n"
+    )
+    assert "PG903" in codes(src)
+
+
+def test_pg903_negative_fits_budget():
+    # 2 x 64 x 8 x 4B = 4 KiB per grid step: far under 16 MiB
+    assert codes(_pg_site("(64, 8)", "(64, 8)")) == []
+
+
+def test_pg903_budget_is_tunable():
+    from paddle_tpu.analysis.checkers.pallas_geometry import PallasGeometryChecker
+
+    chk = PallasGeometryChecker()
+    chk.vmem_budget = 1024  # 2 x 64 x 8 x 4B = 4096 > 1 KiB
+    vs = analyze_source(_pg_site("(64, 8)", "(64, 8)"), checkers=[chk])
+    assert "PG903" in {v.code for v in vs}
+
+
+_PG_PREFETCH = (
+    "import jax\n"
+    "import jax.numpy as jnp\n"
+    "from jax.experimental import pallas as pl\n"
+    "from jax.experimental.pallas import tpu as pltpu\n"
+    "def k(ids_ref, x_ref, o_ref):\n"
+    "    o_ref[...] = x_ref[...]\n"
+)
+
+
+def _pg_prefetch_site(map_in):
+    return (
+        _PG_PREFETCH
+        + "def f(x, ids):\n"
+        "    return pl.pallas_call(\n"
+        "        k,\n"
+        "        grid_spec=pltpu.PrefetchScalarGridSpec(\n"
+        "            num_scalar_prefetch=1,\n"
+        "            grid=(4,),\n"
+        f"            in_specs=[pl.BlockSpec((8, 8), {map_in})],\n"
+        "            out_specs=pl.BlockSpec((8, 8), lambda i, ids: (i, 0)),\n"
+        "        ),\n"
+        "        out_shape=jax.ShapeDtypeStruct((32, 8), jnp.float32),\n"
+        "    )(ids, x)\n"
+    )
+
+
+def test_pg904_prefetch_ref_indexed_by_non_grid_value():
+    found = codes(_pg_prefetch_site("lambda i, ids: (ids[j], 0)"))
+    assert "PG904" in found
+
+
+def test_pg904_negative_grid_indexed_prefetch():
+    assert codes(_pg_prefetch_site("lambda i, ids: (ids[i], 0)")) == []
+
+
+def test_pg904_prefetch_arity_mismatch():
+    # index maps take grid rank + num_scalar_prefetch args; one short fires
+    found = codes(_pg_prefetch_site("lambda i: (i, 0)"))
+    assert "PG904" in found
+
+
+def test_pg905_gated_dispatch_without_fallback_counter():
+    src = (
+        "from paddle_tpu.kernels.select import pallas_enabled\n"
+        "def dispatch(x):\n"
+        "    if pallas_enabled('use_pallas_paged_attention'):\n"
+        "        return fast_kernel(x)\n"
+        "    return slow_path(x)\n"
+    )
+    assert "PG905" in codes(src)
+
+
+def test_pg905_negative_warn_fallback_registered():
+    src = (
+        "from paddle_tpu.kernels.select import pallas_enabled, warn_fallback\n"
+        "def dispatch(x):\n"
+        "    if pallas_enabled('use_pallas_paged_attention'):\n"
+        "        try:\n"
+        "            return fast_kernel(x)\n"
+        "        except Exception as exc:"
+        "  # analysis: disable=EH403 fixture: XLA fallback below\n"
+        "            warn_fallback('fast_kernel', exc)\n"
+        "    return slow_path(x)\n"
+    )
+    assert codes(src) == []
+
+
+def test_pg905_public_kernel_entry_needs_coverage():
+    # a public pallas_call-lowering entry in kernels/ nobody fallback-wraps
+    src = _pg_site("(64, 8)", "(64, 8)").replace("def f():", "def public_kernel():")
+    found = codes(src, path="paddle_tpu/kernels/pg_snippet.py")
+    assert "PG905" in found
+    # the same module-private entry is some wrapper's implementation detail
+    src_private = _pg_site("(64, 8)", "(64, 8)").replace("def f():", "def _impl():")
+    assert codes(src_private, path="paddle_tpu/kernels/pg_snippet.py") == []
+
+
+def test_pg905_self_wrapping_entry_is_covered():
+    src = (
+        _PG_PRELUDE
+        + "from paddle_tpu.kernels.select import warn_fallback\n"
+        "def public_kernel():\n"
+        "    x = jnp.zeros((256, 8), jnp.float32)\n"
+        "    try:\n"
+        "        return pl.pallas_call(\n"
+        "            k,\n"
+        "            grid=(4,),\n"
+        "            in_specs=[pl.BlockSpec((64, 8), lambda i: (i, 0))],\n"
+        "            out_specs=pl.BlockSpec((64, 8), lambda i: (i, 0)),\n"
+        "            out_shape=jax.ShapeDtypeStruct((256, 8), jnp.float32),\n"
+        "        )(x)\n"
+        "    except Exception as exc:"
+        "  # analysis: disable=EH403 fixture: XLA fallback below\n"
+        "        warn_fallback('public_kernel', exc)\n"
+        "    return x\n"
+    )
+    assert codes(src, path="paddle_tpu/kernels/pg_snippet.py") == []
+
+
+# -- kernel_geometry resolution edge cases -----------------------------------
+
+def _geom(src, path="geom_snippet.py"):
+    import ast as _ast
+
+    from paddle_tpu.analysis.kernel_geometry import evaluate_module
+
+    return evaluate_module(path, _ast.parse(src))
+
+
+def test_geometry_autotune_candidates_and_cdiv_grid():
+    """Block sizes flowing from autotune candidate tuples stay correlated
+    per configuration (a ``pl.cdiv`` grid derived from the same candidate),
+    so a bad candidate is named concretely instead of smearing every
+    config to unproven."""
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "from jax.experimental import pallas as pl\n"
+        "from paddle_tpu.kernels.autotune import autotune\n"
+        "ROWS = 256\n"
+        "def k(x_ref, o_ref):\n"
+        "    o_ref[...] = x_ref[...]\n"
+        "def build(blk):\n"
+        "    x = jnp.zeros((ROWS, 8), jnp.float32)\n"
+        "    return pl.pallas_call(\n"
+        "        k,\n"
+        "        grid=(pl.cdiv(ROWS, blk),),\n"
+        "        in_specs=[pl.BlockSpec((blk, 8), lambda i: (i, 0))],\n"
+        "        out_specs=pl.BlockSpec((blk, 8), lambda i: (i, 0)),\n"
+        "        out_shape=jax.ShapeDtypeStruct((ROWS, 8), jnp.float32),\n"
+        "    )(x)\n"
+        "impl = autotune('thing', 'key', (64, 96), build, default=64)\n"
+    )
+    site = _geom(src).sites[0]
+    # cdiv folded per candidate: 256/64 -> 4 steps, 256/96 -> 3 steps
+    assert site.grid[0].values == frozenset({3, 4})
+    # the 96 candidate's last block ends at 288 > 256 — named, not smeared
+    overruns = [p for p in site.axis_proofs if p.status == "overrun"]
+    assert overruns and all("blk=96" in p.detail for p in overruns)
+    # VMEM footprint tracked per candidate config (in + out, f32)
+    per_cfg = {
+        cfg.binding["blk"]: cfg.bytes_per_step.concrete()
+        for cfg in site.vmem_configs
+    }
+    assert per_cfg == {64: 2 * 64 * 8 * 4, 96: 2 * 96 * 8 * 4}
+
+
+def test_geometry_named_index_map_function():
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "from jax.experimental import pallas as pl\n"
+        "def _row_map(i):\n"
+        "    return (i, 0)\n"
+        "def k(x_ref, o_ref):\n"
+        "    o_ref[...] = x_ref[...]\n"
+        "def f():\n"
+        "    x = jnp.zeros((256, 8), jnp.float32)\n"
+        "    return pl.pallas_call(\n"
+        "        k,\n"
+        "        grid=(4,),\n"
+        "        in_specs=[pl.BlockSpec((64, 8), _row_map)],\n"
+        "        out_specs=pl.BlockSpec((64, 8), _row_map),\n"
+        "        out_shape=jax.ShapeDtypeStruct((256, 8), jnp.float32),\n"
+        "    )(x)\n"
+    )
+    site = _geom(src).sites[0]
+    spec = site.in_specs[0]
+    assert spec.map_params == ["i"] and spec.ret_arity == 2
+    assert {p.status for p in site.axis_proofs} == {"proven"}
+
+
+def test_geometry_symbolic_grid_axis_is_unproven_not_passed():
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "from jax.experimental import pallas as pl\n"
+        "def k(x_ref, o_ref):\n"
+        "    o_ref[...] = x_ref[...]\n"
+        "def f(x, n):\n"
+        "    return pl.pallas_call(\n"
+        "        k,\n"
+        "        grid=(n // 64,),\n"
+        "        in_specs=[pl.BlockSpec((64, 8), lambda i: (i, 0))],\n"
+        "        out_specs=pl.BlockSpec((64, 8), lambda i: (i, 0)),\n"
+        "        out_shape=jax.ShapeDtypeStruct((256, 8), jnp.float32),\n"
+        "    )(x)\n"
+    )
+    site = _geom(src).sites[0]
+    assert not site.grid[0].known  # symbolic residue, honestly reported
+    dim0 = [p for p in site.axis_proofs if p.dim == 0]
+    assert dim0 and {p.status for p in dim0} == {"unproven"}
+    # unproven is NOT a finding — but it is never silently "proven" either
+    assert "PG902" not in codes(src)
+
+
+def test_geometry_is_memoized_in_package_index():
+    """The PG layer rides the PR 9 memoization contract: one evaluation per
+    module per PackageIndex, however many checkers ask."""
+    import ast as _ast
+
+    from paddle_tpu.analysis import dataflow as _df
+
+    idx = _df.PackageIndex()
+    tree = _ast.parse(_pg_site("(64, 8)", "(64, 8)"))
+    idx.add_module("geom_memo.py", tree)
+    g1 = idx.kernel_geometry("geom_memo.py")
+    g2 = idx.kernel_geometry("geom_memo.py")
+    assert g1 is g2 and len(g1.sites) == 1
+
+
 # -- SARIF + baseline ---------------------------------------------------------
 
 def test_sarif_output_shape_and_rule_ids():
@@ -1631,6 +2024,8 @@ def test_sarif_output_shape_and_rule_ids():
     run = doc["runs"][0]
     rules = {r["id"] for r in run["tool"]["driver"]["rules"]}
     assert "EH401" in rules and "CC701" in rules and "DN802" in rules
+    # the PG family rides the same schema: rule ids only, no shape change
+    assert {"PG901", "PG902", "PG903", "PG904", "PG905"} <= rules
     results = run["results"]
     live = [r for r in results if "suppressions" not in r]
     sup = [r for r in results if "suppressions" in r]
