@@ -33,7 +33,7 @@
 
 namespace {
 
-enum Cmd : uint8_t { kSet = 1, kGet = 2, kAdd = 3, kWait = 4, kPing = 5 };
+enum Cmd : uint8_t { kSet = 1, kGet = 2, kAdd = 3, kWait = 4, kPing = 5, kDelete = 6 };
 
 struct Master {
   int listen_fd = -1;
@@ -139,6 +139,16 @@ void serve_conn(Master* m, int fd) {
       }
       m->cv.notify_all();
       if (!write_full(fd, &now, 8)) break;
+    } else if (cmd == kDelete) {
+      // GC primitive for generation-namespaced keys (elastic manager): the
+      // waiters' predicate only tests presence, so erasing never wakes a
+      // kGet/kWait spuriously — no notify needed
+      uint8_t existed;
+      {
+        std::lock_guard<std::mutex> lk(m->mu);
+        existed = m->kv.erase(key) > 0 ? 1 : 0;
+      }
+      if (!write_full(fd, &existed, 1)) break;
     } else if (cmd == kPing) {
       uint8_t ok = 1;
       if (!write_full(fd, &ok, 1)) break;
@@ -297,6 +307,15 @@ int64_t tcpstore_add(int fd, const char* key, int64_t delta) {
   if (!write_full(fd, &delta, 8)) return -1;
   int64_t now = 0;
   return read_full(fd, &now, 8) ? now : -1;
+}
+
+// 1 key existed, 0 key absent, -1 error
+int tcpstore_delete(int fd, const char* key) {
+  uint8_t cmd = kDelete;
+  if (!write_full(fd, &cmd, 1)) return -1;
+  if (!write_blob(fd, key)) return -1;
+  uint8_t existed = 0;
+  return read_full(fd, &existed, 1) ? existed : -1;
 }
 
 // 0 ok, -1 error, -3 timed out

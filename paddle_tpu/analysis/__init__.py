@@ -37,11 +37,26 @@ Checker families:
   ``jax.jit(fn, donate_argnums=...)`` bindings (DN801), host numpy buffer
   mutated while a dispatch still aliases it, before any sync point (DN802 —
   the recovery-replay race class), watchdog/metrics record sequenced before
-  the donated-state commit (DN803) (:mod:`.checkers.donation`).
+  the donated-state commit (DN803) (:mod:`.checkers.donation`);
+- **OB** observability discipline — tracer spans opened outside a ``with``,
+  span/flight emission in traced or kernel code, un-synced device timing
+  (:mod:`.checkers.observability`);
+- **TB** tape backward discipline — autodiff requested over an explicit
+  tape-GradNode kernel whose backward jax cannot derive
+  (:mod:`.checkers.tape_backward`);
+- **CM** distributed protocol (interprocedural, over the ``ProtocolCall``
+  record in :mod:`.dataflow`) — rank-divergent collective with no rejoin
+  (CM1001), collective/blocking store op under a lock a thread entry also
+  acquires (CM1002), coordination-store key hygiene: counter keys need an
+  exit-dominating delete, generation families need GC, dynamic keys need a
+  namespace (CM1003), collective in except/finally of a raising try
+  (CM1004), ``PartitionSpec`` axes outside the package mesh universe and
+  donating jits with ``in_shardings`` but no ``out_shardings`` (CM1005)
+  (:mod:`.checkers.distributed_protocol`).
 
 CLI: ``python -m paddle_tpu.analysis [--format json|sarif] [--baseline
-known.json] paddle_tpu/`` — exits non-zero on any NEW unsuppressed
-violation.
+known.json] [--timings] paddle_tpu/`` — exits non-zero on any NEW
+unsuppressed violation.
 """
 
 from paddle_tpu.analysis.checkers import CHECKER_CLASSES, all_checkers, all_codes  # noqa: F401

@@ -50,7 +50,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         description="AST static analysis: trace-safety (TS), Pallas purity (PK), "
         "Pallas geometry (PG), flag discipline (FD), exception hygiene (EH), "
         "robustness (RB), observability (OB), concurrency (CC), "
-        "donation/lifetime (DN), tape backward discipline (TB).",
+        "donation/lifetime (DN), tape backward discipline (TB), "
+        "distributed protocol (CM).",
     )
     ap.add_argument("paths", nargs="*", help="files or directories to analyze")
     ap.add_argument("--format", choices=("text", "json", "sarif"), default="text")
@@ -82,6 +83,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument(
         "--show-suppressed", action="store_true",
         help="include suppressed violations in text output",
+    )
+    ap.add_argument(
+        "--timings", action="store_true",
+        help="print per-phase (parse / index build / dataflow / geometry) "
+        "and per-checker wall time to stderr, so the 30s tier-1 budget is "
+        "attributable when a checker family blows it",
     )
     ap.add_argument(
         "--list-checkers", action="store_true", help="print codes and exit"
@@ -143,11 +150,19 @@ def main(argv: Optional[List[str]] = None) -> int:
             if c.name == "pallas_geometry":
                 c.vmem_budget = int(args.vmem_budget)
 
+    timings = {} if args.timings else None
     try:
-        violations = analyze_paths(paths, checkers=checkers, select=select)
+        violations = analyze_paths(
+            paths, checkers=checkers, select=select, timings=timings
+        )
     except FileNotFoundError as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 2
+    if timings is not None:
+        print("timings:", file=sys.stderr)
+        for key in sorted(timings, key=lambda k: (not k.startswith("phase:"), -timings[k])):
+            group, name = key.split(":", 1)
+            print(f"  {group:8s}{name:24s}{timings[key]:8.3f}s", file=sys.stderr)
 
     if args.write_baseline:
         write_baseline(args.write_baseline, violations)

@@ -7,6 +7,7 @@ from __future__ import annotations
 from typing import Dict, List, Type
 
 from paddle_tpu.analysis.checkers.concurrency import ConcurrencyChecker
+from paddle_tpu.analysis.checkers.distributed_protocol import DistributedProtocolChecker
 from paddle_tpu.analysis.checkers.donation import DonationChecker
 from paddle_tpu.analysis.checkers.exception_hygiene import ExceptionHygieneChecker
 from paddle_tpu.analysis.checkers.flag_discipline import FlagDisciplineChecker
@@ -29,6 +30,7 @@ CHECKER_CLASSES: List[Type[Checker]] = [
     RobustnessChecker,
     ObservabilityChecker,
     ConcurrencyChecker,
+    DistributedProtocolChecker,
     DonationChecker,
     TapeBackwardChecker,
 ]

@@ -1,0 +1,744 @@
+"""CM — distributed-protocol checker (collective matching, store hygiene,
+mesh/sharding discipline).
+
+Built on :mod:`paddle_tpu.analysis.dataflow`'s :class:`ProtocolCall` record
+(collectives and coordination-store ops identified by import/receiver shape,
+with lock context and enclosing function) plus the package-level memos
+(``thread_lock_acquirers``, ``mesh_axes``) — so the whole-package run stays
+one index build and the <30s wall gate holds. Every rule under-approximates:
+unresolvable receivers, keys and axis names produce silence, not spam.
+
+**Rank-divergent collective (CM1001)** — a collective reachable only under a
+branch conditioned on rank-/replica-local state (``get_rank``/
+``process_index`` results, ``.rank`` attributes, coordination-store probe
+results) with no balanced collective of the same op in the sibling arm:
+ranks that skip the call leave every peer parked in the collective forever.
+The fix shape is the rejoin-after-branch idiom — branch on rank for the
+*payload*, issue the collective unconditionally after the join. Except-arm
+divergence is CM1004's territory and excluded here.
+
+**Collective under a thread-shared lock (CM1002)** — a collective or
+blocking store ``get``/``wait`` issued while holding a host lock that a
+discovered thread entry (probe loop, HTTP handler, flag listener) or
+anything reachable from one also acquires: the collective blocks on remote
+ranks while the thread blocks on the lock — the PR 13
+blocking-collective-under-lock deadlock, proven statically from the lock
+regions and thread-entry discovery instead of at rendezvous timeout.
+
+**Store key hygiene (CM1003)** — the PR 13 unbounded-store lesson. A store
+``set`` whose key embeds a per-call counter must pair with a ``delete`` on a
+path dominating function exit (``finally`` or unconditional top-level); a
+key namespaced by a generation-style binding must have a same-module
+``delete`` covering its key family (the generation-GC shape); a key with a
+dynamic component that is neither counter/generation-scoped, rank-bounded,
+nor a caller-supplied parameter grows the store without bound and is flagged
+as un-namespaced. Fully-literal keys are bounded overwrites and exempt.
+
+**Collective in an exception arm (CM1004)** — a collective inside an
+``except`` body whose try-block can raise on a data-dependent path, or
+inside a ``finally`` whose try-block both raises and issues collectives:
+only the ranks that took the exception path run the handler's collective —
+protocol skew against every rank that didn't.
+
+**Mesh/sharding discipline (CM1005)** — (a) a literal ``PartitionSpec`` axis
+name that resolves against no mesh axis defined anywhere in the package
+(axes come from ``Mesh``/``make_mesh``/``init_mesh``/``ProcessMesh``/
+``new_group`` definitions, through module string constants like
+``TP_AXIS``); silently unresolvable axes shard nothing. (b) a ``jax.jit``
+with ``donate_argnums`` and ``in_shardings`` but no ``out_shardings``: the
+output sharding is then inferred per-call, and a second layout materializes
+a silent second executable that today only the recompile watchdog's 1-compile
+tests catch at runtime.
+
+- CM1001  collective under a rank-local branch without an all-ranks rejoin
+- CM1002  collective/blocking store op while holding a thread-shared lock
+- CM1003  coordination-store key without bounded lifetime (counter key
+          lacking a dominating delete / generation key lacking family GC /
+          un-namespaced dynamic key)
+- CM1004  collective inside an except/finally arm of a raising try block
+- CM1005  PartitionSpec axis not defined by any mesh, or donating sharded
+          jit without pinned out_shardings
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from paddle_tpu.analysis.checkers._shared import attr_chain, const_str, func_params
+from paddle_tpu.analysis.core import Checker, FileContext, Violation
+from paddle_tpu.analysis.dataflow import (
+    ModuleGraph,
+    PackageIndex,
+    ProtocolCall,
+    _store_receiver,
+)
+
+# calls whose result is rank-/replica-local state
+_RANK_CALLS = {
+    "get_rank", "process_index", "local_rank", "get_local_rank", "axis_index",
+    "node_rank", "replica_id", "get_world_rank", "task_id",
+}
+# attribute/name leaves that denote rank-local state
+_RANK_ATTRS = {
+    "rank", "local_rank", "process_index", "replica_id", "my_rank",
+    "node_rank", "rank_id", "proc_id",
+}
+# store probe methods whose results are rank-local (each rank sees its own
+# answer at its own time)
+_PROBE_METHODS = {"get", "check", "blocking_key_value_get", "key_value_try_get"}
+
+# CM1003 placeholder classification
+_GEN_RE = re.compile(
+    r"gen|generation|epoch|seq|count|counter|version|round|step|attempt"
+    r"|call|nonce|uid|lease|ticket"
+)
+_RANKLIKE_RE = re.compile(
+    r"rank|world|host|node|proc|pid|local|worker|device|replica|index|idx"
+)
+
+# a skeleton part is ("lit", text, None) or ("ph", name, resolved_src_node)
+_Part = Tuple[str, str, Optional[ast.AST]]
+
+
+class DistributedProtocolChecker(Checker):
+    name = "distributed_protocol"
+    codes = {
+        "CM1001": "collective reachable only under a rank-/replica-local "
+                  "branch without an all-ranks rejoin (mismatched collective "
+                  "sequences deadlock every peer)",
+        "CM1002": "collective or blocking store op issued while holding a "
+                  "lock that a thread entry also acquires (remote-blocking "
+                  "call under a host lock: deadlock shape)",
+        "CM1003": "coordination-store key without a bounded lifetime "
+                  "(counter-namespaced key lacking a delete that dominates "
+                  "function exit, generation key lacking family GC, or a "
+                  "dynamic key not namespaced at all)",
+        "CM1004": "collective inside an except/finally arm of a try block "
+                  "that can raise data-dependently (only some ranks enter "
+                  "the handler: protocol skew)",
+        "CM1005": "PartitionSpec axis name that no mesh in the package "
+                  "defines, or a donating jit over sharded inputs without "
+                  "pinned out_shardings (silent second executable)",
+    }
+
+    def run(self, ctx: FileContext) -> List[Violation]:
+        index = ctx.project.dataflow()
+        graph = index.module(ctx.path)
+        if graph is None:
+            graph = index.add_module(ctx.path, ctx.tree)
+        collectives = [p for p in graph.protocol_calls if p.kind == "collective"]
+        out: List[Violation] = []
+        out.extend(self._check_rank_divergence(ctx, graph, collectives))
+        out.extend(self._check_lock_deadlock(ctx, index, graph))
+        out.extend(self._check_store_hygiene(ctx, graph))
+        out.extend(self._check_exception_skew(ctx, collectives))
+        out.extend(self._check_mesh_discipline(ctx, index, graph))
+        return out
+
+    # -- CM1001 ---------------------------------------------------------------
+    def _check_rank_divergence(
+        self, ctx: FileContext, graph: ModuleGraph, collectives: List[ProtocolCall]
+    ) -> List[Violation]:
+        out: List[Violation] = []
+        rank_names_memo: Dict[int, Set[str]] = {}
+        for pc in collectives:
+            fn = self._enclosing_function(ctx, pc.node)
+            if fn is None:
+                continue
+            rank_names = rank_names_memo.get(id(fn))
+            if rank_names is None:
+                rank_names = _rank_local_names(fn)
+                rank_names_memo[id(fn)] = rank_names
+            cur: ast.AST = pc.node
+            fired = False
+            for anc in ctx.ancestors(pc.node):
+                if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                    break
+                if isinstance(anc, ast.ExceptHandler):
+                    fired = True  # CM1004's territory — never double-report
+                    break
+                sibling = self._divergent_sibling(anc, cur, rank_names)
+                if sibling is not None and not self._balanced(
+                    sibling, pc, collectives
+                ):
+                    out.append(
+                        Violation(
+                            ctx.path, pc.lineno, pc.col, "CM1001",
+                            f"collective '{pc.chain}' in {pc.func} runs only "
+                            "under a branch conditioned on rank-local state "
+                            f"(line {anc.lineno}): ranks that skip it leave "
+                            "every peer parked in the collective — issue the "
+                            "collective on all ranks and branch on the "
+                            "payload instead",
+                        )
+                    )
+                    fired = True
+                if fired:
+                    break
+                cur = anc
+        return out
+
+    def _divergent_sibling(
+        self, anc: ast.AST, cur: ast.AST, rank_names: Set[str]
+    ) -> Optional[Sequence[ast.AST]]:
+        """When ``anc`` is a rank-local branch and ``cur`` sits in one arm,
+        the statements of the other arm (the rejoin search space); None when
+        ``anc`` is not a diverging construct. A while-loop body has no
+        sibling arm — rank-local iteration counts always diverge — so it
+        returns an empty sequence."""
+        if isinstance(anc, ast.If):
+            if not _is_rank_local(anc.test, rank_names):
+                return None
+            if any(cur is s for s in anc.body):
+                return anc.orelse
+            if any(cur is s for s in anc.orelse):
+                return anc.body
+            return None  # inside the test expression itself
+        if isinstance(anc, ast.IfExp):
+            if not _is_rank_local(anc.test, rank_names):
+                return None
+            if cur is anc.body:
+                return [anc.orelse]
+            if cur is anc.orelse:
+                return [anc.body]
+            return None
+        if isinstance(anc, ast.While):
+            if _is_rank_local(anc.test, rank_names) and any(
+                cur is s for s in anc.body
+            ):
+                return []
+            return None
+        return None
+
+    def _balanced(
+        self,
+        sibling: Sequence[ast.AST],
+        pc: ProtocolCall,
+        collectives: List[ProtocolCall],
+    ) -> bool:
+        """The other arm re-issues the same collective op — both sides of the
+        branch keep the protocol sequence aligned."""
+        ids: Set[int] = set()
+        for s in sibling:
+            ids.update(id(n) for n in ast.walk(s))
+        return any(
+            other.op == pc.op and id(other.node) in ids
+            for other in collectives
+            if other is not pc
+        )
+
+    def _enclosing_function(self, ctx: FileContext, node: ast.AST) -> Optional[ast.AST]:
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return anc
+        return None
+
+    # -- CM1002 ---------------------------------------------------------------
+    def _check_lock_deadlock(
+        self, ctx: FileContext, index: PackageIndex, graph: ModuleGraph
+    ) -> List[Violation]:
+        acquirers = index.thread_lock_acquirers()
+        out: List[Violation] = []
+        for pc in graph.protocol_calls:
+            if pc.kind not in ("collective", "store_get") or not pc.locks_held:
+                continue
+            for lk in sorted(pc.locks_held):
+                others = [
+                    (p, q) for p, q in acquirers.get(lk, [])
+                    if not (p == ctx.path and q == pc.func)
+                ]
+                if not others:
+                    continue
+                p, q = others[0]
+                kindname = "collective" if pc.kind == "collective" else "blocking store op"
+                out.append(
+                    Violation(
+                        ctx.path, pc.lineno, pc.col, "CM1002",
+                        f"{kindname} '{pc.chain}' in {pc.func} is issued "
+                        f"while holding {lk}, which {q} (thread-side, "
+                        f"{p}) also acquires: the call blocks on remote "
+                        "ranks while the thread blocks on the lock — move "
+                        "the call outside the locked region",
+                    )
+                )
+                break
+        return out
+
+    # -- CM1003 ---------------------------------------------------------------
+    def _check_store_hygiene(
+        self, ctx: FileContext, graph: ModuleGraph
+    ) -> List[Violation]:
+        out: List[Violation] = []
+        deletes = [p for p in graph.protocol_calls if p.kind == "store_delete"]
+        delete_heads = [
+            self._family_head(self._key_parts(ctx, graph, d)) for d in deletes
+        ]
+        for pc in graph.protocol_calls:
+            if pc.kind != "store_set":
+                continue
+            parts = self._key_parts(ctx, graph, pc)
+            if parts is None:
+                continue
+            finfo = graph.functions.get(pc.func)
+            fn = finfo.node if finfo is not None else None
+            params = func_params(fn) if fn is not None else set()
+            phs = [p for p in parts if p[0] == "ph"]
+            if not phs:
+                continue  # fully-literal key: bounded overwrite
+            counter_phs = [
+                p for p in phs if fn is not None and _is_per_call_counter(fn, p)
+            ]
+            gen_phs = [
+                p for p in phs
+                if p not in counter_phs and _GEN_RE.search(_norm(p[1]))
+            ]
+            loose = [
+                p for p in phs
+                if p not in counter_phs and p not in gen_phs
+                and not _RANKLIKE_RE.search(_norm(p[1]))
+                and p[1] not in params
+            ]
+            if counter_phs:
+                dom = [
+                    d for d in deletes
+                    if d.func == pc.func and self._dominates_exit(ctx, d.node, fn)
+                ]
+                if not dom:
+                    out.append(
+                        Violation(
+                            ctx.path, pc.lineno, pc.col, "CM1003",
+                            f"store key in {pc.func} is namespaced by the "
+                            f"per-call counter '{counter_phs[0][1]}' but no "
+                            "delete dominates function exit: every call "
+                            "leaves a fresh key behind — delete it in a "
+                            "finally (the all_gather_object shape)",
+                        )
+                    )
+            elif gen_phs:
+                head = self._family_head(parts)
+                covered = any(
+                    dh is None or head is None or dh == head
+                    for dh in delete_heads
+                )
+                if not covered:
+                    out.append(
+                        Violation(
+                            ctx.path, pc.lineno, pc.col, "CM1003",
+                            f"store key in {pc.func} is namespaced by "
+                            f"generation-style binding '{gen_phs[0][1]}' but "
+                            "this module never deletes keys of the "
+                            f"'{head or '?'}' family: every generation bump "
+                            "strands the previous generation's keys — GC the "
+                            "old generation where the binding advances",
+                        )
+                    )
+            elif loose:
+                out.append(
+                    Violation(
+                        ctx.path, pc.lineno, pc.col, "CM1003",
+                        f"store key in {pc.func} embeds dynamic component "
+                        f"'{loose[0][1]}' that is neither counter/generation-"
+                        "namespaced nor rank-bounded: the store grows by one "
+                        "key per distinct value with nothing to GC it — "
+                        "namespace the key by a generation/counter and pair "
+                        "it with a delete",
+                    )
+                )
+        return out
+
+    def _key_parts(
+        self, ctx: FileContext, graph: ModuleGraph, pc: ProtocolCall
+    ) -> Optional[List[_Part]]:
+        if not pc.node.args:
+            return None
+        finfo = graph.functions.get(pc.func)
+        fn = finfo.node if finfo is not None else None
+        cls = finfo.class_name if finfo is not None else None
+        return _key_skeleton(pc.node.args[0], fn, graph, cls, {}, 0)
+
+    def _family_head(self, parts: Optional[List[_Part]]) -> Optional[str]:
+        """First literal key segment ("elastic" for ``elastic/{gen}/...``);
+        None when the key opens with a placeholder — which then matches any
+        family (under-approximation keeps unresolvable deletes counting)."""
+        if not parts or parts[0][0] != "lit":
+            return None
+        return parts[0][1].split("/", 1)[0]
+
+    def _dominates_exit(
+        self, ctx: FileContext, node: ast.AST, fn: Optional[ast.AST]
+    ) -> bool:
+        """The statement holding ``node`` runs on every path out of ``fn``:
+        every ancestor up to the function is plain sequencing, a with-block,
+        or the *finalbody* of a try. Any If/loop/handler/try-body ancestor
+        means a path can skip it."""
+        if fn is None:
+            return False
+        cur: ast.AST = node
+        for anc in ctx.ancestors(node):
+            if anc is fn:
+                return True
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                return False
+            if isinstance(
+                anc,
+                (ast.If, ast.IfExp, ast.While, ast.For, ast.AsyncFor,
+                 ast.ExceptHandler),
+            ):
+                return False
+            if isinstance(anc, ast.Try) and not any(
+                cur is s for s in anc.finalbody
+            ):
+                return False
+            cur = anc
+        return False
+
+    # -- CM1004 ---------------------------------------------------------------
+    def _check_exception_skew(
+        self, ctx: FileContext, collectives: List[ProtocolCall]
+    ) -> List[Violation]:
+        out: List[Violation] = []
+        for pc in collectives:
+            cur: ast.AST = pc.node
+            for anc in ctx.ancestors(pc.node):
+                if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    break
+                if isinstance(anc, ast.ExceptHandler):
+                    try_node = ctx.parents.get(anc)
+                    if isinstance(try_node, ast.Try) and _can_raise(try_node.body):
+                        out.append(
+                            Violation(
+                                ctx.path, pc.lineno, pc.col, "CM1004",
+                                f"collective '{pc.chain}' in {pc.func} runs "
+                                "inside an except arm of a try block that "
+                                "can raise data-dependently: only the ranks "
+                                "that hit the exception issue it — protocol "
+                                "skew against every other rank",
+                            )
+                        )
+                    break
+                if isinstance(anc, ast.Try) and any(
+                    cur is s for s in anc.finalbody
+                ):
+                    if _can_raise(anc.body) and self._body_has_collective(
+                        anc.body, pc, collectives
+                    ):
+                        out.append(
+                            Violation(
+                                ctx.path, pc.lineno, pc.col, "CM1004",
+                                f"collective '{pc.chain}' in {pc.func} runs "
+                                "in a finally whose try block also issues "
+                                "collectives and can raise: a mid-sequence "
+                                "raise leaves ranks disagreeing on how many "
+                                "collectives ran before this one",
+                            )
+                        )
+                    break
+                cur = anc
+        return out
+
+    def _body_has_collective(
+        self,
+        body: Sequence[ast.AST],
+        pc: ProtocolCall,
+        collectives: List[ProtocolCall],
+    ) -> bool:
+        ids: Set[int] = set()
+        for s in body:
+            ids.update(id(n) for n in ast.walk(s))
+        return any(
+            id(other.node) in ids for other in collectives if other is not pc
+        )
+
+    # -- CM1005 ---------------------------------------------------------------
+    def _check_mesh_discipline(
+        self, ctx: FileContext, index: PackageIndex, graph: ModuleGraph
+    ) -> List[Violation]:
+        out: List[Violation] = []
+        universe = index.mesh_axes()
+        pspec_locals = {
+            local
+            for local, (_m, orig) in graph.from_imports.items()
+            if orig == "PartitionSpec"
+        } | {"PartitionSpec"}
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            is_pspec = (isinstance(f, ast.Name) and f.id in pspec_locals) or (
+                isinstance(f, ast.Attribute) and f.attr == "PartitionSpec"
+            )
+            if is_pspec and universe:
+                for arg in node.args:
+                    elts = arg.elts if isinstance(arg, (ast.Tuple, ast.List)) else [arg]
+                    for el in elts:
+                        s = const_str(el)
+                        if s is None and isinstance(el, ast.Name):
+                            s = graph.str_consts.get(el.id)
+                        if s is not None and s not in universe:
+                            out.append(
+                                Violation(
+                                    ctx.path, el.lineno, el.col_offset, "CM1005",
+                                    f"PartitionSpec axis '{s}' is not an "
+                                    "axis of any mesh defined in the "
+                                    "package (known axes: "
+                                    f"{', '.join(sorted(universe))}): the "
+                                    "spec silently resolves to no sharding",
+                                )
+                            )
+            chain = attr_chain(f)
+            if chain in ("jax.jit", "jit"):
+                kwargs = {kw.arg for kw in node.keywords if kw.arg}
+                donated = False
+                for kw in node.keywords:
+                    if kw.arg == "donate_argnums":
+                        donated = any(
+                            isinstance(n, ast.Constant)
+                            and isinstance(n.value, int)
+                            and not isinstance(n.value, bool)
+                            for n in ast.walk(kw.value)
+                        )
+                if donated and "in_shardings" in kwargs and "out_shardings" not in kwargs:
+                    out.append(
+                        Violation(
+                            ctx.path, node.lineno, node.col_offset, "CM1005",
+                            "jit with donate_argnums over sharded inputs "
+                            "(in_shardings) but no out_shardings: the output "
+                            "layout is re-inferred per call and a second "
+                            "layout compiles a silent second executable — "
+                            "pin out_shardings",
+                        )
+                    )
+        return out
+
+
+# -- rank-locality inference ---------------------------------------------------
+
+def _norm(name: str) -> str:
+    return name.lstrip("_").lower()
+
+
+def _is_rank_source(expr: ast.AST, rank_names: Set[str]) -> bool:
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Call):
+            chain = attr_chain(n.func)
+            if chain is None:
+                continue
+            parts = chain.split(".")
+            if parts[-1] in _RANK_CALLS:
+                return True
+            if parts[-1] in _PROBE_METHODS and len(parts) >= 2 and _store_receiver(
+                parts[-2]
+            ):
+                return True
+        elif isinstance(n, ast.Attribute) and n.attr in _RANK_ATTRS:
+            return True
+        elif isinstance(n, ast.Name) and (
+            n.id in _RANK_ATTRS or n.id in rank_names
+        ):
+            return True
+    return False
+
+
+def _rank_local_names(fn: ast.AST) -> Set[str]:
+    """Names in ``fn`` assigned (directly or one propagation step) from
+    rank-local sources — ``rank = jax.process_index()`` then
+    ``is_main = rank == 0``."""
+    names: Set[str] = set()
+    for _ in range(2):
+        grew = False
+        for node in ast.walk(fn):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            value = getattr(node, "value", None)
+            if value is None or not _is_rank_source(value, names):
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Name) and t.id not in names:
+                    names.add(t.id)
+                    grew = True
+        if not grew:
+            break
+    return names
+
+
+def _is_rank_local(test: ast.AST, rank_names: Set[str]) -> bool:
+    return _is_rank_source(test, rank_names)
+
+
+def _can_raise(body: Sequence[ast.AST]) -> bool:
+    """A try body that contains a call, subscript or explicit raise can fail
+    on a data-dependent path; constant-only bodies cannot."""
+    for s in body:
+        for n in ast.walk(s):
+            if isinstance(n, (ast.Raise, ast.Call, ast.Subscript)):
+                return True
+    return False
+
+
+# -- store-key skeleton resolution (CM1003) ------------------------------------
+
+def _ph_name(expr: ast.AST) -> str:
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Subscript):
+        return _ph_name(expr.value)
+    if isinstance(expr, ast.Call):
+        chain = attr_chain(expr.func)
+        return chain.split(".")[-1] if chain else "expr"
+    return "expr"
+
+
+_CTX_RE = re.compile(r"ctx=(?:Load|Store|Del)\(\)")
+
+
+def _dump_noctx(node: ast.AST) -> str:
+    """Expression identity modulo Load/Store context — ``x[0]`` read in an
+    assignment must match ``x[0] += 1``'s store target."""
+    return _CTX_RE.sub("ctx=*", ast.dump(node))
+
+
+def _single_assign(fn: ast.AST, name: str) -> Optional[ast.AST]:
+    found: List[ast.AST] = []
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            value = getattr(node, "value", None)
+            if value is None:
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            if any(isinstance(t, ast.Name) and t.id == name for t in targets):
+                found.append(value)
+    return found[0] if len(found) == 1 else None
+
+
+def _key_skeleton(
+    expr: ast.AST,
+    fn: Optional[ast.AST],
+    graph: ModuleGraph,
+    class_name: Optional[str],
+    env: Dict[str, List[_Part]],
+    depth: int,
+) -> List[_Part]:
+    """Resolve a store-key expression to literal/placeholder parts, chasing
+    single-assignment locals, module string constants, string concatenation
+    and single-return key-helper methods (``self._beat_key(rank)``) with
+    caller-argument substitution. Anything unresolvable becomes a named
+    placeholder — classification, not parsing, decides what fires."""
+    if depth > 6:
+        return [("ph", _ph_name(expr), None)]
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return [("lit", expr.value, None)]
+    if isinstance(expr, ast.JoinedStr):
+        parts: List[_Part] = []
+        for v in expr.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(("lit", v.value, None))
+            elif isinstance(v, ast.FormattedValue):
+                parts.extend(
+                    _key_skeleton(v.value, fn, graph, class_name, env, depth + 1)
+                )
+        return parts
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+        return _key_skeleton(expr.left, fn, graph, class_name, env, depth + 1) + \
+            _key_skeleton(expr.right, fn, graph, class_name, env, depth + 1)
+    if isinstance(expr, ast.Name):
+        if expr.id in env:
+            return env[expr.id]
+        if expr.id in graph.str_consts:
+            return [("lit", graph.str_consts[expr.id], None)]
+        if fn is not None:
+            src = _single_assign(fn, expr.id)
+            if src is not None:
+                resolved = _key_skeleton(src, fn, graph, class_name, env, depth + 1)
+                # keep the local's own name on a still-opaque placeholder so
+                # counter detection sees both the name and the source expr
+                if len(resolved) == 1 and resolved[0][0] == "ph" and resolved[0][2] is src:
+                    return [("ph", expr.id, src)]
+                return resolved
+        return [("ph", expr.id, expr)]
+    if isinstance(expr, ast.Call):
+        callee = _resolve_key_helper(expr, graph, class_name)
+        if callee is not None:
+            finfo_node, callee_cls, ret = callee
+            params = [
+                a.arg
+                for a in (*finfo_node.args.posonlyargs, *finfo_node.args.args)
+                if a.arg != "self"
+            ]
+            newenv: Dict[str, List[_Part]] = {}
+            for pname, arg in zip(params, expr.args):
+                newenv[pname] = _key_skeleton(
+                    arg, fn, graph, class_name, env, depth + 1
+                )
+            for kw in expr.keywords:
+                if kw.arg:
+                    newenv[kw.arg] = _key_skeleton(
+                        kw.value, fn, graph, class_name, env, depth + 1
+                    )
+            return _key_skeleton(
+                ret, finfo_node, graph, callee_cls, newenv, depth + 1
+            )
+        return [("ph", _ph_name(expr), expr)]
+    return [("ph", _ph_name(expr), expr)]
+
+
+def _resolve_key_helper(
+    call: ast.Call, graph: ModuleGraph, class_name: Optional[str]
+) -> Optional[Tuple[ast.AST, Optional[str], ast.AST]]:
+    """``self._key(...)`` / ``_key(...)`` where the callee is a local
+    single-return function: (callee node, callee class, returned expr)."""
+    f = call.func
+    qual: Optional[str] = None
+    if (
+        isinstance(f, ast.Attribute)
+        and isinstance(f.value, ast.Name)
+        and f.value.id == "self"
+        and class_name is not None
+    ):
+        qual = f"{class_name}.{f.attr}"
+    elif isinstance(f, ast.Name):
+        qual = f.id
+    finfo = graph.functions.get(qual) if qual else None
+    if finfo is None:
+        return None
+    returns = [
+        n for n in ast.walk(finfo.node)
+        if isinstance(n, ast.Return) and n.value is not None
+    ]
+    if len(returns) != 1:
+        return None
+    return finfo.node, finfo.class_name, returns[0].value
+
+
+def _is_per_call_counter(fn: ast.AST, ph: _Part) -> bool:
+    """The placeholder advances once per call of ``fn`` itself: its resolved
+    source (or its own name) is the target of an AugAssign increment in the
+    same function, or it is bound from ``next(...)``."""
+    _kind, name, src = ph
+    if name == "next":
+        return True
+    src_dump = _dump_noctx(src) if src is not None else None
+    for node in ast.walk(fn):
+        if isinstance(node, ast.AugAssign):
+            tgt = node.target
+            if isinstance(tgt, ast.Name) and tgt.id == name:
+                return True
+            if src_dump is not None and _dump_noctx(tgt) == src_dump:
+                return True
+        elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+            value = getattr(node, "value", None)
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            if (
+                isinstance(value, ast.Call)
+                and isinstance(value.func, ast.Name)
+                and value.func.id == "next"
+                and any(isinstance(t, ast.Name) and t.id == name for t in targets)
+            ):
+                return True
+    return False

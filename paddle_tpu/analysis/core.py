@@ -259,10 +259,22 @@ def analyze_paths(
     paths: Sequence[str],
     checkers: Optional[Sequence[Checker]] = None,
     select: Optional[Sequence[str]] = None,
+    timings: Optional[Dict[str, float]] = None,
 ) -> List[Violation]:
     """Analyze files/directories. ``select`` filters by checker-code prefix
-    (e.g. ``["TS", "EH401"]``). Unparseable files yield a single ``GEN001``."""
+    (e.g. ``["TS", "EH401"]``). Unparseable files yield a single ``GEN001``.
+    ``timings``, when given, is filled with per-phase (``phase:parse`` /
+    ``phase:index-build`` / ``phase:dataflow`` / ``phase:geometry``) and
+    per-checker (``checker:<name>``) wall seconds — the ``--timings`` budget
+    attribution; phase time spent lazily inside a checker run (geometry,
+    package closures) is counted in both views."""
+    import time
+
     checkers = list(checkers) if checkers is not None else _default_checkers()
+    if timings is not None:
+        for c in checkers:
+            timings.setdefault(f"checker:{c.name}", 0.0)
+    t0 = time.perf_counter()
     files = iter_python_files(paths)
     parsed: List[Tuple[Path, str, ast.Module]] = []
     violations: List[Violation] = []
@@ -278,6 +290,8 @@ def analyze_paths(
             continue
         parsed.append((f, src, tree))
     project = build_project_context(tree for _, _, tree in parsed)
+    if timings is not None:
+        timings["phase:parse"] = time.perf_counter() - t0
     # build the interprocedural index ONCE over the whole file set (cross-
     # module call edges need every tree); checkers get the memoized graphs
     index = project.dataflow()
@@ -285,8 +299,12 @@ def analyze_paths(
         index.add_module(str(f), tree)
     for f, src, tree in parsed:
         violations.extend(
-            _run_checkers(tree, src, str(f), project, _is_hot_path(f), checkers, select)
+            _run_checkers(tree, src, str(f), project, _is_hot_path(f), checkers,
+                          select, timings)
         )
+    if timings is not None:
+        for phase, secs in index.phase_seconds.items():
+            timings[f"phase:{phase}"] = secs
     return violations
 
 
@@ -320,7 +338,10 @@ def _run_checkers(
     hot_path: bool,
     checkers: Sequence[Checker],
     select: Optional[Sequence[str]],
+    timings: Optional[Dict[str, float]] = None,
 ) -> List[Violation]:
+    import time
+
     lines = source.splitlines()
     ctx = FileContext(
         path=path, lines=lines, tree=tree, project=project,
@@ -328,7 +349,11 @@ def _run_checkers(
     )
     violations: List[Violation] = []
     for checker in checkers:
+        t0 = time.perf_counter()
         found = checker.run(ctx)
+        if timings is not None:
+            key = f"checker:{checker.name}"
+            timings[key] = timings.get(key, 0.0) + (time.perf_counter() - t0)
         if select is not None:
             found = [v for v in found if any(v.code.startswith(s) for s in select)]
         violations.extend(found)

@@ -38,6 +38,7 @@ rest of the analysis package.
 from __future__ import annotations
 
 import ast
+import time
 from dataclasses import dataclass, field
 from typing import Dict, FrozenSet, Iterable, List, Optional, Sequence, Set, Tuple
 
@@ -49,6 +50,7 @@ __all__ = [
     "JitWrapper",
     "ModuleGraph",
     "PackageIndex",
+    "ProtocolCall",
     "receiver_key",
 ]
 
@@ -76,6 +78,43 @@ _NUMPY_CTORS = {
     "concatenate", "frombuffer", "copy", "zeros_like", "ones_like",
 }
 _HTTP_HANDLER_BASES = {"BaseHTTPRequestHandler", "SimpleHTTPRequestHandler"}
+
+# -- distributed-protocol vocabulary (the CM checker family) -------------------
+# python-level collective entry points (distributed/collective.py parity
+# surface) — only a collective when the call resolves through a distributed
+# import, so a local function that happens to be named `barrier` never counts
+_COLLECTIVE_OPS = {
+    "all_reduce", "all_gather", "all_gather_object", "reduce", "reduce_scatter",
+    "broadcast", "scatter", "alltoall", "alltoall_single", "send", "recv",
+    "isend", "irecv", "ppermute", "batch_isend_irecv", "barrier",
+}
+# shard_map-level primitives: unambiguous under a jax.lax / lax chain
+_LAX_COLLECTIVES = {
+    "psum", "pmean", "pmax", "pmin", "ppermute", "all_gather", "all_to_all",
+    "psum_scatter", "pshuffle",
+}
+# coordination-service KV names are globally unambiguous; the short
+# set/get/wait/delete forms additionally need a store-shaped receiver
+_STORE_METHOD_KINDS = {
+    "set": "store_set", "key_value_set": "store_set",
+    "get": "store_get", "wait": "store_get",
+    "blocking_key_value_get": "store_get", "key_value_get": "store_get",
+    "key_value_try_get": "store_get", "wait_at_barrier": "store_get",
+    "delete": "store_delete", "key_value_delete": "store_delete",
+    "delete_key": "store_delete",
+}
+_STORE_UNAMBIGUOUS = {
+    "key_value_set", "blocking_key_value_get", "key_value_get",
+    "key_value_try_get", "wait_at_barrier", "key_value_delete",
+}
+
+
+def _store_receiver(name: str) -> bool:
+    """A receiver segment that denotes a coordination store/KV client —
+    deliberately narrow so `self._store` deques in observability modules
+    (append/clear only) and dict `.get` on arbitrary names stay out."""
+    n = name.lstrip("_").lower()
+    return "store" in n or n in ("client", "kv")
 
 
 def receiver_key(node: ast.AST) -> Optional[str]:
@@ -128,6 +167,44 @@ def _warn_fallback_callees(tree: ast.Module) -> Set[str]:
     return covered
 
 
+def _mesh_axes_of_tree(tree: ast.Module) -> Set[str]:
+    """Standalone mesh-axis collection for lazily-parsed package files (the
+    scoped-run fallback in :meth:`PackageIndex.mesh_axes`) — same rules as
+    :meth:`ModuleGraph._collect_mesh_axes` without building a full graph."""
+    str_consts: Dict[str, str] = {}
+    for node in tree.body:
+        if isinstance(node, (ast.Assign, ast.AnnAssign)) and getattr(node, "value", None):
+            val = node.value
+            if isinstance(val, ast.Constant) and isinstance(val.value, str):
+                targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        str_consts[t.id] = val.value
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = fn.id if isinstance(fn, ast.Name) else (
+            fn.attr if isinstance(fn, ast.Attribute) else None
+        )
+        exprs: List[ast.AST] = []
+        if name in ("Mesh", "make_mesh") and len(node.args) >= 2:
+            exprs.append(node.args[1])
+        if name == "init_mesh" and node.args:
+            exprs.append(node.args[0])
+        for kw in node.keywords:
+            if kw.arg in ("axis_name", "axis_names", "dim_names"):
+                exprs.append(kw.value)
+        for e in exprs:
+            for n in ast.walk(e):
+                if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                    out.add(n.value)
+                elif isinstance(n, ast.Name) and n.id in str_consts:
+                    out.add(str_consts[n.id])
+    return out
+
+
 @dataclass
 class CallSite:
     """One call expression with its interprocedural context."""
@@ -137,6 +214,24 @@ class CallSite:
     lineno: int
     in_loop: bool  # lexically inside for/while/comprehension in the caller
     locks_held: FrozenSet[str]
+
+
+@dataclass
+class ProtocolCall:
+    """One distributed-protocol operation (collective or coordination-store
+    op) with the context the CM checkers consume. Recorded whether or not the
+    call graph can resolve the callee — protocol identity comes from the
+    import/receiver shape, not from resolution."""
+
+    kind: str  # "collective" | "store_set" | "store_get" | "store_delete"
+    op: str  # simple op name ("all_reduce", "key_value_set", ...)
+    chain: str  # dotted call chain as written ("dist.all_reduce")
+    node: ast.Call
+    lineno: int
+    col: int
+    func: str  # qualname of the enclosing function
+    locks_held: FrozenSet[str]
+    in_loop: bool
 
 
 @dataclass
@@ -217,6 +312,12 @@ class ModuleGraph:
         # jit wrappers visible module-wide (self.attr ones are class-scoped
         # but donation is keyed by receiver, which includes the class context)
         self.jit_wrappers: Dict[Tuple[Optional[str], str], JitWrapper] = {}
+        # distributed-protocol ops (collectives + coordination-store calls)
+        self.protocol_calls: List[ProtocolCall] = []
+        # module-level NAME = "string" constants (TP_AXIS = "tp" style)
+        self.str_consts: Dict[str, str] = {}
+        # mesh axis names defined in this module (Mesh/ProcessMesh/new_group)
+        self.mesh_axes: Set[str] = set()
         self._build()
 
     # -- construction --------------------------------------------------------
@@ -244,6 +345,7 @@ class ModuleGraph:
         for fn, class_name in to_walk:
             self._walk_function(fn, class_name)
         self._collect_thread_entries()
+        self._collect_mesh_axes()
 
     def _collect_imports(self) -> None:
         for node in ast.walk(self.tree):
@@ -272,6 +374,10 @@ class ModuleGraph:
                                 key=t.id, target=target_fn, donated=donated,
                                 lineno=node.lineno,
                             )
+                if isinstance(val, ast.Constant) and isinstance(val.value, str):
+                    for t in targets:
+                        if isinstance(t, ast.Name):
+                            self.str_consts[t.id] = val.value
                 if isinstance(val, ast.Call):
                     ctor = self._ctor_name(val.func)
                     for t in targets:
@@ -289,6 +395,34 @@ class ModuleGraph:
         if isinstance(fn, ast.Attribute):
             return fn.attr
         return None
+
+    def _collect_mesh_axes(self) -> None:
+        """Axis names this module *defines*: ``Mesh(devices, (names...))`` /
+        ``jax.make_mesh(shape, names)`` second argument, ``init_mesh(names,
+        shape)`` first argument, and any ``axis_name=``/``axis_names=``/
+        ``dim_names=`` keyword anywhere (``ProcessMesh``, ``new_group``,
+        ``shard_map``). String constants are collected from anywhere inside
+        the argument expression; names resolve through module-level string
+        constants (the ``TP_AXIS = "tp"`` idiom). Over-collection only makes
+        CM1005 quieter — the axis universe is a membership check."""
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = self._ctor_name(node.func)
+            exprs: List[ast.AST] = []
+            if name in ("Mesh", "make_mesh") and len(node.args) >= 2:
+                exprs.append(node.args[1])
+            if name == "init_mesh" and node.args:
+                exprs.append(node.args[0])
+            for kw in node.keywords:
+                if kw.arg in ("axis_name", "axis_names", "dim_names"):
+                    exprs.append(kw.value)
+            for e in exprs:
+                for n in ast.walk(e):
+                    if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                        self.mesh_axes.add(n.value)
+                    elif isinstance(n, ast.Name) and n.id in self.str_consts:
+                        self.mesh_axes.add(self.str_consts[n.id])
 
     def _register_class(self, cls: ast.ClassDef) -> None:
         self.classes[cls.name] = ClassInfo(
@@ -565,6 +699,16 @@ class ModuleGraph:
         locks: FrozenSet[str],
         in_loop: bool,
     ) -> None:
+        ident = self._protocol_identity(node)
+        if ident is not None:
+            kind, op, chain = ident
+            self.protocol_calls.append(
+                ProtocolCall(
+                    kind=kind, op=op, chain=chain, node=node,
+                    lineno=node.lineno, col=node.col_offset,
+                    func=finfo.qualname, locks_held=locks, in_loop=in_loop,
+                )
+            )
         target = self.resolve_call(node, cls)
         if target is None:
             return
@@ -574,6 +718,54 @@ class ModuleGraph:
                 in_loop=in_loop, locks_held=locks,
             )
         )
+
+    def _protocol_identity(self, call: ast.Call) -> Optional[Tuple[str, str, str]]:
+        """``(kind, op, chain)`` when the call is a distributed-protocol
+        operation, else None. Identity is import/receiver-shaped — never a
+        bare-name match — so the record under-approximates like the rest of
+        the graph: a local helper named ``barrier`` or a dict ``.get`` never
+        registers."""
+        chain = _dotted(call.func)
+        if chain is None:
+            return None
+        parts = chain.split(".")
+        op = parts[-1]
+        # coordination-store ops: the long KV names are unambiguous, the
+        # short ones need a store/client/kv-shaped receiver segment
+        kind = _STORE_METHOD_KINDS.get(op)
+        if kind is not None:
+            if op in _STORE_UNAMBIGUOUS:
+                return kind, op, chain
+            if len(parts) >= 2 and _store_receiver(parts[-2]):
+                return kind, op, chain
+        # shard_map-level primitives under a lax chain
+        if op in _LAX_COLLECTIVES and (
+            chain.startswith("jax.lax.") or chain.startswith("lax.")
+        ):
+            return "collective", op, chain
+        # python-level entry points, resolved through a distributed import
+        if op in _COLLECTIVE_OPS:
+            if len(parts) == 1:
+                fi = self.from_imports.get(op)
+                if fi is not None and (
+                    "distributed" in fi[0] or "collective" in fi[0]
+                ):
+                    return "collective", op, chain
+                if op in self.functions and self.dotted_name is not None \
+                        and "distributed" in self.dotted_name:
+                    # intra-module call inside the collectives package itself
+                    return "collective", op, chain
+                return None
+            if "distributed" in chain:  # paddle.distributed.all_reduce
+                return "collective", op, chain
+            root = parts[0]
+            mod = self.module_aliases.get(root)
+            if mod is not None and "distributed" in mod:
+                return "collective", op, chain
+            fi = self.from_imports.get(root)
+            if fi is not None and "distributed" in f"{fi[0]}.{fi[1]}":
+                return "collective", op, chain
+        return None
 
     # -- call resolution ------------------------------------------------------
     def node_key(self, qualname: str) -> str:
@@ -700,11 +892,23 @@ class PackageIndex:
         # memoized per-module Pallas geometry reports (analysis.kernel_geometry)
         self._geometry: Dict[str, object] = {}
         self._fallback_labels: Optional[Set[str]] = None
+        self._mesh_axes: Optional[Set[str]] = None
+        self._thread_acquirers: Optional[Dict[str, List[Tuple[str, str]]]] = None
+        # accumulated wall time per phase ("index-build" / "dataflow" /
+        # "geometry") — the CLI --timings attribution source
+        self.phase_seconds: Dict[str, float] = {}
+
+    def _timed(self, phase: str, t0: float) -> None:
+        self.phase_seconds[phase] = (
+            self.phase_seconds.get(phase, 0.0) + (time.perf_counter() - t0)
+        )
 
     # -- module memoization ---------------------------------------------------
     def add_module(self, path: str, tree: ast.Module) -> ModuleGraph:
         if path not in self._modules:
+            t0 = time.perf_counter()
             self._modules[path] = ModuleGraph(path, tree, _dotted_name_of(path))
+            self._timed("index-build", t0)
             self.build_count += 1
             # package-level closures are stale once the module set changes
             self._thread_reachable = None
@@ -713,6 +917,8 @@ class PackageIndex:
             self._lock_pairs = None
             self._geometry.clear()
             self._fallback_labels = None
+            self._mesh_axes = None
+            self._thread_acquirers = None
         return self._modules[path]
 
     def module(self, path: str) -> Optional[ModuleGraph]:
@@ -734,7 +940,9 @@ class PackageIndex:
                 tree = g.tree
             from paddle_tpu.analysis.kernel_geometry import evaluate_module
 
+            t0 = time.perf_counter()
             self._geometry[path] = evaluate_module(path, tree, self)
+            self._timed("geometry", t0)
         return self._geometry[path]
 
     def fallback_covered(self) -> Set[str]:
@@ -783,6 +991,73 @@ class PackageIndex:
             out |= _warn_fallback_callees(tree)
         return out
 
+    # -- distributed-protocol closures (CM family) -----------------------------
+    def mesh_axes(self) -> Set[str]:
+        """Every mesh axis name defined anywhere in the PACKAGE — the CM1005
+        membership universe. Like the PG905 coverage set, the universe is the
+        package, not the analyzed file set: a ``--changed-only`` run touching
+        one module with a ``PartitionSpec("tp")`` must still see the mesh
+        that defines ``tp`` elsewhere, so unindexed on-disk package files are
+        lazily parsed for their axis definitions only (memoized; a
+        whole-package run parses nothing extra). Empty when no indexed
+        module defines a mesh — CM1005 then stays silent rather than
+        guessing."""
+        if self._mesh_axes is None:
+            t0 = time.perf_counter()
+            axes: Set[str] = set()
+            for g in self._modules.values():
+                axes |= g.mesh_axes
+            axes |= self._package_mesh_axes()
+            self._timed("dataflow", t0)
+            self._mesh_axes = axes
+        return self._mesh_axes
+
+    def _package_mesh_axes(self) -> Set[str]:
+        from pathlib import Path
+
+        pkg_root: Optional[Path] = None
+        for p in self._modules:
+            path = Path(p)
+            if not path.is_file():
+                continue  # fixture/snippet paths keep module-local semantics
+            parts = path.resolve().parts
+            if "paddle_tpu" in parts:
+                idx = len(parts) - 1 - tuple(reversed(parts)).index("paddle_tpu")
+                pkg_root = Path(*parts[: idx + 1])
+                break
+        if pkg_root is None:
+            return set()
+        indexed = {str(Path(p).resolve()) for p in self._modules}
+        out: Set[str] = set()
+        for f in sorted(pkg_root.rglob("*.py")):
+            if str(f.resolve()) in indexed:
+                continue
+            try:
+                tree = ast.parse(f.read_text(encoding="utf-8", errors="replace"))
+            except (OSError, SyntaxError):
+                continue
+            out |= _mesh_axes_of_tree(tree)
+        return out
+
+    def thread_lock_acquirers(self) -> Dict[str, List[Tuple[str, str]]]:
+        """lock key -> [(path, qualname)] of functions that acquire it AND
+        are thread entries or thread-reachable — the CM1002 deadlock partner
+        set (a collective issued under such a lock can park forever behind
+        the probe loop / HTTP handler holding it)."""
+        if self._thread_acquirers is None:
+            t0 = time.perf_counter()
+            reach = self.thread_reachable()
+            out: Dict[str, List[Tuple[str, str]]] = {}
+            for g in self._modules.values():
+                for qual, finfo in g.functions.items():
+                    if g.node_key(qual) not in reach:
+                        continue
+                    for lk, _held, _n in finfo.acquires:
+                        out.setdefault(lk, []).append((g.path, qual))
+            self._timed("dataflow", t0)
+            self._thread_acquirers = out
+        return self._thread_acquirers
+
     # -- cross-module resolution ----------------------------------------------
     def _resolve_key(self, key: str) -> List[str]:
         """Resolve an ``@module::name`` cross-module reference against the
@@ -828,6 +1103,7 @@ class PackageIndex:
 
     def _all_edges(self) -> Dict[str, List[CallSite]]:
         if self._edges is None:
+            t0 = time.perf_counter()
             edges: Dict[str, List[CallSite]] = {}
             for g in self._modules.values():
                 for qual, finfo in g.functions.items():
@@ -842,6 +1118,7 @@ class PackageIndex:
                             )
                     edges[g.node_key(qual)] = resolved
             self._edges = edges
+            self._timed("dataflow", t0)
         return self._edges
 
     # -- reachability closures -------------------------------------------------

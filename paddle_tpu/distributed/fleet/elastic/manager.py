@@ -211,13 +211,25 @@ class ElasticManager:
         are published to the store so every relaunched worker agrees."""
         alive = self.alive_workers()
         mapping = {old: new for new, old in enumerate(sorted(alive))}
-        gen = self._read_gen() + 1
+        old_gen = self._read_gen()
+        gen = old_gen + 1
         self._store.set("elastic/generation", str(gen).encode())
         self._store.set(
             "elastic/world",
             ",".join(str(r) for r in sorted(alive)).encode(),
         )
-        # the bump invalidates every beat/fault key of the old topology
+        # the bump invalidates every beat/fault key of the old topology —
+        # and must also GC them: each generation writes up to 2*max_np keys,
+        # so without deletes the store grows by a full topology per restart
+        # for the life of the job. Best-effort: a store without delete (or
+        # one tearing down mid-rebuild) only costs the bounded leak back.
+        if hasattr(self._store, "delete"):
+            for r in range(self.max_np):
+                try:
+                    self._store.delete(f"elastic/{old_gen}/beat/{r}")
+                    self._store.delete(f"elastic/{old_gen}/fault/{r}")
+                except Exception:  # store down: the relaunch path handles it
+                    break
         self._gen = gen
         self.world_size = len(alive)
         return {

@@ -79,6 +79,13 @@ def load_native(build: bool = True) -> Optional[ctypes.CDLL]:
     lib.tcpstore_add.argtypes = [ctypes.c_int, ctypes.c_char_p, ctypes.c_int64]
     lib.tcpstore_wait.restype = ctypes.c_int
     lib.tcpstore_wait.argtypes = [ctypes.c_int, ctypes.c_char_p, ctypes.c_int]
+    try:
+        # guarded: a prebuilt .so from before the delete op may lack the
+        # symbol; TCPStore.delete degrades to a no-op in that case
+        lib.tcpstore_delete.restype = ctypes.c_int
+        lib.tcpstore_delete.argtypes = [ctypes.c_int, ctypes.c_char_p]
+    except AttributeError:
+        pass
     lib.tcpstore_close.argtypes = [ctypes.c_int]
     # host tracer
     lib.het_enable.argtypes = [ctypes.c_int]

@@ -41,6 +41,14 @@ class Store:
         answer, not something to wait for."""
         raise NotImplementedError
 
+    def delete(self, key: str) -> bool:
+        """Remove ``key``; True when it existed. The GC primitive for
+        counter/generation-namespaced keys (elastic beat/fault leases,
+        ``all_gather_object`` slots): without it every generation bump or
+        gather call strands keys in the store forever — the unbounded-store
+        failure the CM1003 analyzer rule gates on."""
+        raise NotImplementedError
+
 
 class _PyMaster:
     """Pure-python master fallback (same wire behavior, in-process only)."""
@@ -73,6 +81,13 @@ class _PyMaster:
     def check(self, key: str) -> bool:
         with self._cond:
             return key in self._kv
+
+    def delete(self, key: str) -> bool:
+        # no notify: get/wait predicates only test presence, so removal can
+        # never satisfy a sleeping waiter (same contract as the native side)
+        with self._cond:
+            self._counters.pop(key, None)
+            return self._kv.pop(key, None) is not None
 
 
 class TCPStore(Store):
@@ -169,6 +184,20 @@ class TCPStore(Store):
         if self._py is not None:
             return self._py.check(key)
         return self._lib.tcpstore_wait(self._fd, key.encode(), 1) == 0
+
+    def delete(self, key: str) -> bool:
+        if self._py is not None:
+            return self._py.delete(key)
+        fn = getattr(self._lib, "tcpstore_delete", None)
+        if fn is None:
+            # stale prebuilt .so without the delete op: GC degrades to a
+            # no-op rather than failing the caller (callers treat delete as
+            # best-effort cleanup, never as a correctness dependency)
+            return False
+        rc = fn(self._fd, key.encode())
+        if rc < 0:
+            raise RuntimeError(f"TCPStore.delete({key!r}) failed")
+        return bool(rc)
 
     def wait(self, key: str) -> None:
         if self._py is not None:

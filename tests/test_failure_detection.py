@@ -318,6 +318,52 @@ class TestElasticScaling:
         assert sorted(mgr.alive_workers()) == [0, 1]
         w1.stop()
 
+    def test_rebuild_garbage_collects_old_generation_keys(self):
+        """CM1003 sweep fix: the generation bump namespaces beat/fault keys
+        but used to strand the old generation's keys in the store forever —
+        2*max_np keys leaked per restart for the life of the job. Rebuild
+        must delete the superseded family."""
+        mgr, store = self._mgr(world="2:4", ttl=30.0)
+        now = str(time.time()).encode()
+        for r in (0, 1, 3):
+            store.set(f"elastic/0/beat/{r}", now)
+        store.set("elastic/0/fault/2", b"1.0|hang")
+        assert store.check("elastic/0/beat/0")
+        mgr.rebuild_endpoints()
+        # every gen-0 beat/fault key is gone, not merely ignored
+        for r in range(4):
+            assert not store.check(f"elastic/0/beat/{r}"), r
+            assert not store.check(f"elastic/0/fault/{r}"), r
+        # the published topology survives the GC
+        assert store.check("elastic/generation")
+        assert store.check("elastic/world")
+
+    def test_rebuild_tolerates_store_without_delete(self):
+        """Duck-typed stores without ``delete`` (older deployments) keep the
+        pre-GC behavior: rebuild succeeds, keys merely leak."""
+        mgr, store = self._mgr(world="2:4", ttl=30.0)
+
+        class NoDelete:
+            def __init__(self, inner):
+                self._inner = inner
+
+            def set(self, k, v):
+                return self._inner.set(k, v)
+
+            def get(self, k):
+                return self._inner.get(k)
+
+            def check(self, k):
+                return self._inner.check(k)
+
+        mgr._store = NoDelete(store)
+        now = str(time.time()).encode()
+        for r in (0, 1):
+            store.set(f"elastic/0/beat/{r}", now)
+        topo = mgr.rebuild_endpoints()
+        assert topo["generation"] == 1 and topo["world_size"] == 2
+        assert store.check("elastic/0/beat/0")  # leaked, by design
+
 
 # -- PR 6 fault-tolerance layer ----------------------------------------------
 

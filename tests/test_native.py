@@ -135,6 +135,25 @@ class TestTCPStoreEdgeCases:
         assert client.check("present") is True
         assert client.check("never-set") is False
 
+    def test_delete_removes_key_and_reports_existence(self):
+        """The GC primitive for counter/generation-namespaced keys (elastic
+        beat/fault leases): delete over the wire, True iff the key existed,
+        and counters restart from zero once reclaimed."""
+        store = TCPStore("127.0.0.1", 0, is_master=True, timeout=5.0)
+        store.set("k", b"v")
+        assert store.delete("k") is True
+        assert store.check("k") is False
+        assert store.delete("k") is False  # already gone
+        # counter keys are reclaimed too: add restarts from the base
+        assert store.add("cnt", 5) == 5
+        assert store.delete("cnt") is True
+        assert store.add("cnt", 2) == 2
+        # a second client sees the deletion (server-side, not a local cache)
+        client = TCPStore("127.0.0.1", store.port, is_master=False, timeout=5.0)
+        store.set("shared", b"1")
+        assert client.delete("shared") is True
+        assert store.check("shared") is False
+
     def test_hostname_resolution(self):
         store = TCPStore("127.0.0.1", 0, is_master=True)
         store.set("h", b"1")
@@ -177,6 +196,9 @@ class TestTCPStoreFallback:
         s.set("k", b"v")
         assert s.get("k") == b"v"
         assert s.add("c", 2) == 2
+        assert s.delete("k") is True and s.delete("k") is False
+        assert s.check("k") is False
+        assert s.delete("c") is True and s.add("c", 1) == 1
 
 
 class TestStoreRuntimeDecoupling:

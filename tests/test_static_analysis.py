@@ -2003,6 +2003,249 @@ def test_geometry_is_memoized_in_package_index():
     assert g1 is g2 and len(g1.sites) == 1
 
 
+# -- CM: distributed protocol -------------------------------------------------
+
+def test_cm1001_rank_divergent_collective():
+    assert "CM1001" in codes(
+        "import paddle_tpu.distributed as dist\n"
+        "import jax\n"
+        "def sync(x):\n"
+        "    rank = jax.process_index()\n"
+        "    if rank == 0:\n"
+        "        dist.broadcast(x, src=0)\n",
+        select=["CM"],
+    )
+
+
+def test_cm1001_negative_rejoin_after_branch():
+    """The branch touches rank-local state but EVERY rank reaches the
+    collective afterwards — the canonical checkpoint-then-sync shape."""
+    assert codes(
+        "import paddle_tpu.distributed as dist\n"
+        "import jax\n"
+        "def sync(x):\n"
+        "    rank = jax.process_index()\n"
+        "    if rank == 0:\n"
+        "        x = x + 1\n"
+        "    dist.broadcast(x, src=0)\n",
+        select=["CM"],
+    ) == []
+
+
+def test_cm1001_negative_balanced_arms():
+    """Both arms issue the same collective: every rank participates
+    whichever way the rank test goes."""
+    assert codes(
+        "import paddle_tpu.distributed as dist\n"
+        "import jax\n"
+        "def sync(x, y):\n"
+        "    rank = jax.process_index()\n"
+        "    if rank == 0:\n"
+        "        dist.broadcast(x, src=0)\n"
+        "    else:\n"
+        "        dist.broadcast(y, src=0)\n",
+        select=["CM"],
+    ) == []
+
+
+def test_cm1002_collective_under_thread_shared_lock():
+    assert "CM1002" in codes(
+        "import threading\n"
+        "import paddle_tpu.distributed as dist\n"
+        "class Manager:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._t = threading.Thread(target=self._probe_loop)\n"
+        "    def _probe_loop(self):\n"
+        "        with self._lock:\n"
+        "            self._n = 1\n"
+        "    def sync(self, x):\n"
+        "        with self._lock:\n"
+        "            dist.all_reduce(x)\n",
+        select=["CM"],
+    )
+
+
+def test_cm1002_negative_lock_not_thread_shared():
+    assert codes(
+        "import threading\n"
+        "import paddle_tpu.distributed as dist\n"
+        "class Manager:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def sync(self, x):\n"
+        "        with self._lock:\n"
+        "            dist.all_reduce(x)\n",
+        select=["CM"],
+    ) == []
+
+
+def test_cm1003_counter_key_without_delete():
+    """Minimized ``all_gather_object`` replica: a per-call counter namespaces
+    the store key, so every call strands a fresh key forever unless a
+    dominating delete reclaims it (the unbounded-store failure)."""
+    assert "CM1003" in codes(
+        "_calls = [0]\n"
+        "def gather(client, rank, payload):\n"
+        "    n = _calls[0]\n"
+        "    _calls[0] += 1\n"
+        "    prefix = f\"gather/{n}\"\n"
+        "    client.key_value_set(f\"{prefix}/{rank}\", payload)\n",
+        select=["CM"],
+    )
+
+
+def test_cm1003_negative_finally_deleted_counter_key():
+    assert codes(
+        "_calls = [0]\n"
+        "def gather(client, rank, payload):\n"
+        "    n = _calls[0]\n"
+        "    _calls[0] += 1\n"
+        "    prefix = f\"gather/{n}\"\n"
+        "    try:\n"
+        "        client.key_value_set(f\"{prefix}/{rank}\", payload)\n"
+        "    finally:\n"
+        "        client.key_value_delete(f\"{prefix}/{rank}\")\n",
+        select=["CM"],
+    ) == []
+
+
+def test_cm1004_collective_in_except_arm():
+    assert "CM1004" in codes(
+        "import paddle_tpu.distributed as dist\n"
+        "def step(x):\n"
+        "    try:\n"
+        "        y = x.compute()\n"
+        "    except ValueError:\n"
+        "        dist.barrier()\n",
+        select=["CM"],
+    )
+
+
+def test_cm1004_negative_try_body_cannot_raise():
+    assert codes(
+        "import paddle_tpu.distributed as dist\n"
+        "def step(x):\n"
+        "    try:\n"
+        "        y = 1\n"
+        "    except ValueError:\n"
+        "        dist.barrier()\n",
+        select=["CM"],
+    ) == []
+
+
+def test_cm1005_partition_spec_axis_outside_mesh():
+    assert "CM1005" in codes(
+        "import numpy as np\n"
+        "from jax.sharding import Mesh, PartitionSpec as P\n"
+        "mesh = Mesh(np.array([]), (\"dp\", \"tp\"))\n"
+        "def spec():\n"
+        "    return P(\"model\")\n",
+        select=["CM"],
+    )
+
+
+def test_cm1005_negative_axis_in_mesh_universe():
+    assert codes(
+        "import numpy as np\n"
+        "from jax.sharding import Mesh, PartitionSpec as P\n"
+        "mesh = Mesh(np.array([]), (\"dp\", \"tp\"))\n"
+        "def spec():\n"
+        "    return P(\"tp\", None)\n",
+        select=["CM"],
+    ) == []
+
+
+def test_cm1005_donating_jit_without_out_shardings():
+    assert "CM1005" in codes(
+        "import jax\n"
+        "def build(fn, shardings):\n"
+        "    return jax.jit(fn, donate_argnums=(1,), in_shardings=shardings)\n",
+        select=["CM"],
+    )
+
+
+def test_cm1005_negative_out_shardings_pinned():
+    assert codes(
+        "import jax\n"
+        "def build(fn, shardings):\n"
+        "    return jax.jit(fn, donate_argnums=(1,), in_shardings=shardings,\n"
+        "                   out_shardings=shardings)\n",
+        select=["CM"],
+    ) == []
+
+
+def test_cm_protocol_calls_memoized_in_package_index():
+    """CM rides the PR 9 memoization contract like PG: the module graph (and
+    its recorded protocol calls) is built once per PackageIndex, however
+    many checkers ask for it."""
+    import ast as _ast
+
+    from paddle_tpu.analysis import dataflow as _df
+
+    idx = _df.PackageIndex()
+    tree = _ast.parse(
+        "import paddle_tpu.distributed as dist\n"
+        "def f(x):\n"
+        "    dist.all_reduce(x)\n"
+    )
+    idx.add_module("cm_memo.py", tree)
+    g1 = idx.module("cm_memo.py")
+    g2 = idx.module("cm_memo.py")
+    assert g1 is g2
+    assert [p.op for p in g1.protocol_calls if p.kind == "collective"] == ["all_reduce"]
+    # the thread-acquirer closure is memoized too (CM1002's partner set)
+    a1 = idx.thread_lock_acquirers()
+    a2 = idx.thread_lock_acquirers()
+    assert a1 is a2
+
+
+def test_cm_baseline_accepts_known_finding(tmp_path):
+    """A baselined CM finding stops gating; a new one past the baseline
+    gates again — same contract as every other family."""
+    bad = tmp_path / "proto.py"
+    bad.write_text(
+        "import paddle_tpu.distributed as dist\n"
+        "def step(x):\n"
+        "    try:\n"
+        "        y = x.compute()\n"
+        "    except ValueError:\n"
+        "        dist.barrier()\n"
+    )
+    r = _run_cli(["--select", "CM", str(bad)])
+    assert r.returncode == 1 and "CM1004" in r.stdout
+    base = tmp_path / "base.json"
+    r = _run_cli(["--select", "CM", "--write-baseline", str(base), str(bad)])
+    assert r.returncode == 0
+    r = _run_cli(["--select", "CM", "--baseline", str(base), str(bad)])
+    assert r.returncode == 0
+    bad.write_text(
+        bad.read_text()
+        + "def step2(x):\n"
+        "    try:\n"
+        "        y = x.compute()\n"
+        "    except ValueError:\n"
+        "        dist.barrier()\n"
+    )
+    r = _run_cli(["--select", "CM", "--baseline", str(base), str(bad)])
+    assert r.returncode == 1
+
+
+def test_timings_flag_names_every_checker_and_phase(tmp_path):
+    """--timings must attribute the 30s budget: one ``checker:`` line per
+    registered checker (zero-cost ones included) and the index phases."""
+    f = tmp_path / "ok.py"
+    f.write_text("import paddle_tpu.distributed as dist\ndef f(x):\n    dist.all_reduce(x)\n")
+    r = _run_cli(["--timings", str(f)])
+    assert r.returncode == 0
+    assert "timings:" in r.stderr
+    for checker in all_checkers():
+        assert f"checker {checker.name}" in " ".join(r.stderr.split()), (
+            f"--timings output missing checker {checker.name!r}:\n{r.stderr}"
+        )
+    assert "phase" in r.stderr and "parse" in r.stderr
+
+
 # -- SARIF + baseline ---------------------------------------------------------
 
 def test_sarif_output_shape_and_rule_ids():
@@ -2026,6 +2269,8 @@ def test_sarif_output_shape_and_rule_ids():
     assert "EH401" in rules and "CC701" in rules and "DN802" in rules
     # the PG family rides the same schema: rule ids only, no shape change
     assert {"PG901", "PG902", "PG903", "PG904", "PG905"} <= rules
+    # the CM family too
+    assert {"CM1001", "CM1002", "CM1003", "CM1004", "CM1005"} <= rules
     results = run["results"]
     live = [r for r in results if "suppressions" not in r]
     sup = [r for r in results if "suppressions" in r]
@@ -2096,10 +2341,17 @@ def test_cli_sarif_and_baseline_gate(tmp_path):
 def test_analyzer_wall_time_and_single_dataflow_pass():
     """The tier-1 gate runs every checker family over the whole package; the
     dataflow graphs must be built once per module (memoized in the
-    PackageIndex) and the whole run must stay under 30 s."""
+    PackageIndex) and the whole run must stay under 30 s — including the
+    interprocedural CM family, which must ride the shared index rather
+    than build its own."""
     import time as _time
 
     from paddle_tpu.analysis import dataflow as _df
+
+    # the budget is only meaningful if the expensive families are actually in
+    # the run — guard against the gate going vacuous via deregistration
+    names = {c.name for c in all_checkers()}
+    assert {"distributed_protocol", "pallas_geometry", "concurrency"} <= names
 
     builds = {"n": 0}
     orig = _df.ModuleGraph._build
