@@ -298,6 +298,7 @@ _KERNEL_BEARING_METRICS = {
     "engine_fault_recovery_tokens_per_sec",
     "serving_goodput_tokens_per_sec",
     "cluster_goodput_tokens_per_sec",
+    "quantized_kv_decode_tokens_per_sec",
 }
 
 
@@ -443,6 +444,7 @@ def _main_timed(platform, paddle, cfg, batch, seq, steps, warmup) -> None:
         _bench_sd_unet(paddle, platform),
         _bench_resnet_pipeline(paddle, platform),
         _bench_int8_decode(paddle, platform),
+        _bench_quantized_kv_decode(paddle, platform),
         _bench_paged_decode(paddle, platform),
         _bench_engine_decode(paddle, platform),
         _bench_fused_decode_layer(paddle, platform),
@@ -642,6 +644,91 @@ def _bench_int8_decode(paddle, platform: str) -> dict:
         }
     except Exception as exc:  # noqa: BLE001
         return {"metric": "int8_decode_matmul_ms", "error": f"{exc!r}"[:300]}
+
+
+def _bench_quantized_kv_decode(paddle, platform: str) -> dict:
+    """Quantized serving (FLAGS_kv_cache_dtype=int8 + weight-only int8):
+    decode throughput and EFFECTIVE KV bytes/token against the bf16 engine,
+    with the measured quality delta riding the record — greedy token-match
+    rate through the full paged plane and max logit error of the quantized
+    projections (inference.quality, the same harness the tier-1 tolerance
+    gate asserts on). A quantized config that is fast but wrong shows up
+    HERE, not in an incident."""
+    from paddle_tpu.inference import ContinuousBatchingEngine
+    from paddle_tpu.inference.quality import quality_delta
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    try:
+        if platform == "tpu":
+            cfg = LlamaConfig(
+                vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+                num_hidden_layers=8, num_attention_heads=16,
+                num_key_value_heads=16, max_position_embeddings=1024,
+            )
+            slots, bs, bucket, n_req, max_new = 8, 16, 128, 16, 48
+        else:
+            cfg = LlamaConfig.tiny()
+            slots, bs, bucket, n_req, max_new = 2, 4, 16, 4, 8
+
+        def build():
+            paddle.seed(0)
+            m = LlamaForCausalLM(cfg)
+            if platform == "tpu":
+                m = m.to(dtype="bfloat16")
+            m.eval()
+            return m
+
+        ekw = dict(max_slots=slots, block_size=bs, prompt_bucket=bucket)
+        rng = np.random.default_rng(11)
+        prompts = [
+            rng.integers(
+                0, cfg.vocab_size, (int(rng.integers(bucket // 2, bucket + 1)),)
+            ).astype(np.int32)
+            for _ in range(n_req)
+        ]
+        quality = quality_delta(build, prompts, max_new, ekw)
+
+        def timed(quant: bool) -> tuple:
+            eng = ContinuousBatchingEngine(
+                build(),
+                kv_cache_dtype="int8" if quant else "bf16",
+                weight_only_int8=quant,
+                **ekw,
+            )
+            for p in prompts:
+                eng.add_request(p, max_new_tokens=max_new)
+            t0 = time.perf_counter()
+            out = eng.run()
+            dt = time.perf_counter() - t0
+            toks = sum(len(r.generated) for r in out.values())
+            return toks / dt, eng.pool_stats(), eng.stats["step_traces"]
+
+        tps_bf16, _, traces_bf16 = timed(False)
+        tps_q, qstats, traces_q = timed(True)
+        return {
+            "metric": "quantized_kv_decode_tokens_per_sec",
+            "value": round(tps_q, 2),
+            "unit": "tokens/s",
+            "kv_cache_dtype": qstats["kv_cache_dtype"],
+            "weight_only_int8": True,
+            "bf16_tokens_per_sec": round(tps_bf16, 2),
+            "speedup_vs_bf16": round(tps_q / tps_bf16, 3),
+            "kv_bytes_per_token_bf16": quality["kv_bytes_per_token_bf16"],
+            "kv_bytes_per_token_quant": quality["kv_bytes_per_token_quant"],
+            "kv_bytes_reduction": round(quality["kv_bytes_reduction"], 3),
+            # honesty: quantization is data + placements, never shapes —
+            # each configuration compiles exactly one step signature
+            "one_compile_per_engine": bool(traces_bf16 == 1 and traces_q == 1),
+            "quality": {
+                "token_match_rate": round(quality["token_match_rate"], 4),
+                "tokens_compared": quality["tokens_compared"],
+                "max_logit_error": round(
+                    float(quality.get("max_logit_error", 0.0)), 5
+                ),
+            },
+        }
+    except Exception as exc:  # noqa: BLE001 - secondary must never kill primary
+        return {"metric": "quantized_kv_decode_tokens_per_sec", "error": f"{exc!r}"[:300]}
 
 
 def _bench_paged_decode(paddle, platform: str) -> dict:

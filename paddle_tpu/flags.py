@@ -166,6 +166,12 @@ def _define_builtin_flags() -> None:
     d("spec_decode", bool, False, "Self-speculative decoding on the continuous-batching engine: an n-gram prompt-lookup drafter proposes draft tokens per decode slot; drafts ride the SAME [max_slots, prefill_chunk] compiled step as prompt chunks (verification is data — zero new compiled signatures), accepted tokens commit in bulk, the first rejection rewinds the slot's block table. Greedy outputs are byte-identical on or off.")
     d("spec_decode_ngram", int, 3, "Longest n-gram of the request's prompt+generated history the speculative drafter matches (walks down to 1); read at engine construction.")
     d("spec_decode_tokens", int, 4, "Max draft tokens proposed per slot per step, capped at prefill_chunk - 1 so the draft plus the mandatory last-token row fit the engine's compiled chunk width.")
+    # quantized serving (inference/engine.py + kernels/quant.py): int8 KV
+    # blocks with in-kernel dequant, and weight-only int8 projections; both
+    # read at engine construction — the compiled step signature stays ONE
+    # either way (dtype changes the pool buffers, never the step shape)
+    d("kv_cache_dtype", str, "bf16", "Storage dtype of the paged KV block pool: 'bf16' (default; byte-identical to the unquantized path) or 'int8' (symmetric per-token absmax quant applied inside the same fused append/CoW/prefetch writes; a per-block-per-head-per-slot fp32 scale table rides the pool through every lifecycle seam — refcounts, CoW, spill/prefetch, recovery, tp head-sharding — and dequant folds into the paged attention block walk, so no dequantized copy ever materializes). Halves KV HBM and host-tier bytes; greedy quality is gated by the bench quality-delta record.")
+    d("weight_only_int8", bool, False, "Weight-only int8 for the lm-head and MLP projections (inference-only): matching nn.Linear weights are quantized once host-side with per-output-channel scales, the scales ride the compiled step as extra trailing params (signature stays fixed), and matmuls dispatch to the Pallas int8xbf16 dot kernel (kernels/quant.py) with an XLA dequant-matmul fallback in numeric lockstep.")
     # tensor-parallel serving (distributed/tp.py): shard the engine's one
     # compiled step over a ['tp'] device mesh; read at engine construction
     # (per-engine override via the tp kwarg)

@@ -50,12 +50,16 @@ def _tp_sharded_flash_chunk(
     scale: float,
     mesh: Any,
     interpret: bool = False,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Run the mixed ragged Pallas kernel PER SHARD over the head partition:
     a ``pallas_call`` has no SPMD partitioning rule, so under a tp mesh the
     kernel must be shard_mapped — each shard walks its own head slice of its
     own pool partition (head-parallel attention needs no communication
     inside the paged block walk; tables/lens are replicated host data).
+    Quantization scale planes ([NB, KVH, BS]) partition on the SAME head
+    axis as the KV planes they describe — scales are just more pool data.
     ``interpret`` runs the per-shard kernel in Pallas interpret mode so the
     shard split itself is testable off-TPU."""
     from jax.sharding import PartitionSpec as P
@@ -63,26 +67,33 @@ def _tp_sharded_flash_chunk(
     from paddle_tpu.distributed.fleet.meta_parallel.spmd_pipeline import shard_map
     from paddle_tpu.kernels.paged_attention import paged_flash_chunk
 
-    def _shard_chunk_attend(q_l, kc_l, vc_l, tables_l, lens_l, qlens_l):
+    in_specs = [
+        P(None, None, "tp", None),  # q [B, C, HQ, D]: heads split
+        P(None, "tp", None, None),  # key_cache [NB, KVH, BS, D]
+        P(None, "tp", None, None),  # value_cache
+        P(None, None),  # block_tables: replicated host truth
+        P(None),  # seq_lens
+        P(None),  # q_lens
+    ]
+    operands = [q, key_cache, value_cache, block_tables, seq_lens, q_lens]
+    if k_scale is not None:
+        in_specs += [P(None, "tp", None), P(None, "tp", None)]
+        operands += [k_scale, v_scale]
+
+    def _shard_chunk_attend(q_l, kc_l, vc_l, tables_l, lens_l, qlens_l,
+                            ks_l=None, vs_l=None):
         return paged_flash_chunk(
             q_l, kc_l, vc_l, tables_l, lens_l, qlens_l, scale=scale,
-            interpret=interpret,
+            interpret=interpret, k_scale=ks_l, v_scale=vs_l,
         )
 
     return shard_map(
         _shard_chunk_attend,
         mesh=mesh,
-        in_specs=(
-            P(None, None, "tp", None),  # q [B, C, HQ, D]: heads split
-            P(None, "tp", None, None),  # key_cache [NB, KVH, BS, D]
-            P(None, "tp", None, None),  # value_cache
-            P(None, None),  # block_tables: replicated host truth
-            P(None),  # seq_lens
-            P(None),  # q_lens
-        ),
+        in_specs=tuple(in_specs),
         out_specs=P(None, None, "tp", None),
         check_vma=False,
-    )(q, key_cache, value_cache, block_tables, seq_lens, q_lens)
+    )(*operands)
 
 def _tp_sharded_flash_chunk_fused(
     q: jax.Array,
@@ -96,37 +107,47 @@ def _tp_sharded_flash_chunk_fused(
     scale: float,
     mesh: Any,
     interpret: bool = False,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """:func:`_tp_sharded_flash_chunk` for the rope-fused kernel: the rope
     rows are position data shared by every head, so they ride replicated
-    while q/caches split over the head partition."""
+    while q/caches (and scale planes) split over the head partition."""
     from jax.sharding import PartitionSpec as P
 
     from paddle_tpu.distributed.fleet.meta_parallel.spmd_pipeline import shard_map
     from paddle_tpu.kernels.paged_attention import paged_flash_chunk_fused
 
-    def _shard_chunk_attend(q_l, cos_l, sin_l, kc_l, vc_l, tables_l, lens_l, qlens_l):
+    in_specs = [
+        P(None, None, "tp", None),  # q [B, C, HQ, D]: heads split
+        P(None, None, None),  # cos [B, C, D]: replicated position data
+        P(None, None, None),  # sin
+        P(None, "tp", None, None),  # key_cache [NB, KVH, BS, D]
+        P(None, "tp", None, None),  # value_cache
+        P(None, None),  # block_tables: replicated host truth
+        P(None),  # seq_lens
+        P(None),  # q_lens
+    ]
+    operands = [q, cos, sin, key_cache, value_cache, block_tables,
+                seq_lens, q_lens]
+    if k_scale is not None:
+        in_specs += [P(None, "tp", None), P(None, "tp", None)]
+        operands += [k_scale, v_scale]
+
+    def _shard_chunk_attend(q_l, cos_l, sin_l, kc_l, vc_l, tables_l, lens_l,
+                            qlens_l, ks_l=None, vs_l=None):
         return paged_flash_chunk_fused(
             q_l, cos_l, sin_l, kc_l, vc_l, tables_l, lens_l, qlens_l,
-            scale=scale, interpret=interpret,
+            scale=scale, interpret=interpret, k_scale=ks_l, v_scale=vs_l,
         )
 
     return shard_map(
         _shard_chunk_attend,
         mesh=mesh,
-        in_specs=(
-            P(None, None, "tp", None),  # q [B, C, HQ, D]: heads split
-            P(None, None, None),  # cos [B, C, D]: replicated position data
-            P(None, None, None),  # sin
-            P(None, "tp", None, None),  # key_cache [NB, KVH, BS, D]
-            P(None, "tp", None, None),  # value_cache
-            P(None, None),  # block_tables: replicated host truth
-            P(None),  # seq_lens
-            P(None),  # q_lens
-        ),
+        in_specs=tuple(in_specs),
         out_specs=P(None, None, "tp", None),
         check_vma=False,
-    )(q, cos, sin, key_cache, value_cache, block_tables, seq_lens, q_lens)
+    )(*operands)
 
 
 __all__ = [
@@ -209,6 +230,37 @@ class BlockKVCache:
     @value_cache.setter
     def value_cache(self, v: Any) -> None:
         self._value_cache = v
+
+    # -- quantized-pool surface (FLAGS_kv_cache_dtype=int8) ------------------
+    @property
+    def quantized(self) -> bool:
+        """True when the pool stores int8 blocks with companion scale planes."""
+        return jnp.dtype(self._dtype) == jnp.int8
+
+    @property
+    def key_scale(self) -> Any:
+        """Per-block-per-head-per-token fp32 scales ``[NB, H, BS]`` addressed
+        by the SAME physical block ids as ``key_cache`` — every lifecycle seam
+        (refcount, CoW, spill, recovery) moves cache rows and scale rows
+        together. Initialized to ONES: ``quantize(zeros)`` yields ``q=0,
+        scale=1``, so a fresh pool is byte-identical to a quantized empty one."""
+        if getattr(self, "_key_scale", None) is None:
+            self._key_scale = jnp.ones(self._shape[:3], jnp.float32)
+        return self._key_scale
+
+    @key_scale.setter
+    def key_scale(self, v: Any) -> None:
+        self._key_scale = v
+
+    @property
+    def value_scale(self) -> Any:
+        if getattr(self, "_value_scale", None) is None:
+            self._value_scale = jnp.ones(self._shape[:3], jnp.float32)
+        return self._value_scale
+
+    @value_scale.setter
+    def value_scale(self, v: Any) -> None:
+        self._value_scale = v
 
     # -- allocator ----------------------------------------------------------
     def allocate(self, seq_id: int, num_tokens: int) -> None:
@@ -350,6 +402,21 @@ class BlockKVCache:
             return dict(self._ref)
 
 
+def _quantize_kv_rows(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric per-token absmax int8 quantization over the head dim: each
+    ``[..., D]`` row gets its own fp32 scale (``absmax / 127``; 1.0 for an
+    all-zero row so dequant stays exact), so an incremental decode append
+    never forces requantizing tokens already in the block. This is THE
+    canonical quant composition: the write kernels, the host-tier capture
+    and the recovery replay all call it, which is what makes replay
+    deterministic to the byte."""
+    xf = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
 def block_cache_append(
     key_cache: jax.Array,  # [NB, H, BS, D]
     value_cache: jax.Array,
@@ -358,19 +425,34 @@ def block_cache_append(
     block_tables: jax.Array,  # [B, MBS]
     positions: jax.Array,  # [B] token index being written (0-based)
     slot_mask: Optional[jax.Array] = None,  # [B] bool; False = padded slot
-) -> Tuple[jax.Array, jax.Array]:
+    key_scale: Optional[jax.Array] = None,  # [NB, H, BS] fp32 (int8 cache)
+    value_scale: Optional[jax.Array] = None,
+):
     """Scatter one new KV token per sequence into its physical block slot.
 
     With ``slot_mask``, masked-off (padded) batch slots write NOTHING: their
     block-table row may alias physical blocks owned by live sequences (the
     engine keeps evicted rows at 0), so their scatter is routed out of bounds
-    and dropped instead of clobbering another sequence's KV."""
+    and dropped instead of clobbering another sequence's KV.
+
+    With ``key_scale``/``value_scale`` (the int8 pool), quantization happens
+    INSIDE this fused write: the same scatter indices that place the int8
+    rows place their per-token scales, so the scale table rides every
+    lifecycle seam the KV planes do. Returns 4 arrays instead of 2."""
     nb, _h, bs, _d = key_cache.shape
     blk_idx = positions // bs
     off = positions % bs
     phys = jnp.take_along_axis(block_tables, blk_idx[:, None], axis=1)[:, 0]
     if slot_mask is not None:
         phys = jnp.where(slot_mask, phys, nb)
+    if key_scale is not None:
+        qk, sk = _quantize_kv_rows(k)  # [B, H, D] int8, [B, H] f32
+        qv, sv = _quantize_kv_rows(v)
+        key_cache = key_cache.at[phys, :, off].set(qk, mode="drop")
+        value_cache = value_cache.at[phys, :, off].set(qv, mode="drop")
+        key_scale = key_scale.at[phys, :, off].set(sk, mode="drop")
+        value_scale = value_scale.at[phys, :, off].set(sv, mode="drop")
+        return key_cache, value_cache, key_scale, value_scale
     key_cache = key_cache.at[phys, :, off].set(k.astype(key_cache.dtype), mode="drop")
     value_cache = value_cache.at[phys, :, off].set(v.astype(value_cache.dtype), mode="drop")
     return key_cache, value_cache
@@ -383,10 +465,13 @@ def block_cache_prefill(
     v: jax.Array,
     block_tables: jax.Array,  # [B, MBS]
     seq_lens: jax.Array,  # [B] prompt lengths (<= S)
-) -> Tuple[jax.Array, jax.Array]:
+    key_scale: Optional[jax.Array] = None,  # [NB, H, BS] fp32 (int8 cache)
+    value_scale: Optional[jax.Array] = None,
+):
     """Write whole prompts into the paged cache (encoder phase of the
     reference kernel). Positions past ``seq_lens`` scatter into a scratch
-    slot (block 0 / slot recomputed) are avoided via clamping + final mask."""
+    slot (block 0 / slot recomputed) are avoided via clamping + final mask.
+    With scale planes the write quantizes in-flight (returns 4 arrays)."""
     b, s, h, d = k.shape
     nb, bs = key_cache.shape[0], key_cache.shape[2]
     t = jnp.arange(s)[None, :]  # [1, S]
@@ -400,6 +485,14 @@ def block_cache_prefill(
     phys = jnp.where(valid, phys, nb)
     flat_phys = phys.reshape(-1)
     flat_off = jnp.broadcast_to(off, phys.shape).reshape(-1)
+    if key_scale is not None:
+        qk, sk = _quantize_kv_rows(k.reshape(b * s, h, d))
+        qv, sv = _quantize_kv_rows(v.reshape(b * s, h, d))
+        key_cache = key_cache.at[flat_phys, :, flat_off].set(qk, mode="drop")
+        value_cache = value_cache.at[flat_phys, :, flat_off].set(qv, mode="drop")
+        key_scale = key_scale.at[flat_phys, :, flat_off].set(sk, mode="drop")
+        value_scale = value_scale.at[flat_phys, :, flat_off].set(sv, mode="drop")
+        return key_cache, value_cache, key_scale, value_scale
     flat_k = k.reshape(b * s, h, d).astype(key_cache.dtype)
     flat_v = v.reshape(b * s, h, d).astype(value_cache.dtype)
     key_cache = key_cache.at[flat_phys, :, flat_off].set(flat_k, mode="drop")
@@ -412,7 +505,9 @@ def block_cache_cow_copy(
     value_cache: jax.Array,
     src: jax.Array,  # [B] int32 physical block to fork from
     dst: jax.Array,  # [B] int32 private destination (== NB: no-op, dropped)
-) -> Tuple[jax.Array, jax.Array]:
+    key_scale: Optional[jax.Array] = None,  # [NB, H, BS] fp32 (int8 cache)
+    value_scale: Optional[jax.Array] = None,
+):
     """Copy-on-write fork: duplicate whole physical blocks ``src`` into
     ``dst`` so a request that diverges inside a shared (refcounted) block can
     reuse its cached prefix KV without ever writing to the shared copy.
@@ -421,15 +516,34 @@ def block_cache_cow_copy(
     num_blocks``), so the same compiled program serves steps with and without
     forks — the fork set is data, never shape. The whole copy is skipped via
     ``lax.cond`` when no slot forks this step (the overwhelmingly common
-    decode-only step pays one predicate, not a gather/scatter per layer)."""
+    decode-only step pays one predicate, not a gather/scatter per layer).
+
+    With scale planes the SAME fork copies them too (inside the one
+    ``lax.cond``): a forked int8 block is bit-identical to its source, scales
+    included — no requantization on CoW. Returns 4 arrays then."""
     nb = key_cache.shape[0]
     src = jnp.asarray(src, jnp.int32)
     dst = jnp.asarray(dst, jnp.int32)
+    csrc = jnp.clip(src, 0, nb - 1)
+
+    if key_scale is not None:
+        def _copy4(kv):
+            kc, vc, ks, vs = kv
+            kc = kc.at[dst].set(kc[csrc], mode="drop")
+            vc = vc.at[dst].set(vc[csrc], mode="drop")
+            ks = ks.at[dst].set(ks[csrc], mode="drop")
+            vs = vs.at[dst].set(vs[csrc], mode="drop")
+            return kc, vc, ks, vs
+
+        return jax.lax.cond(
+            jnp.any(dst < nb), _copy4, lambda kv: kv,
+            (key_cache, value_cache, key_scale, value_scale),
+        )
 
     def _copy(kv):
         kc, vc = kv
-        kc = kc.at[dst].set(kc[jnp.clip(src, 0, nb - 1)], mode="drop")
-        vc = vc.at[dst].set(vc[jnp.clip(src, 0, nb - 1)], mode="drop")
+        kc = kc.at[dst].set(kc[csrc], mode="drop")
+        vc = vc.at[dst].set(vc[csrc], mode="drop")
         return kc, vc
 
     return jax.lax.cond(
@@ -446,12 +560,17 @@ def block_cache_append_chunk(
     seq_lens: jax.Array,  # [B] tokens already stored (chunk writes AFTER them)
     q_lens: jax.Array,  # [B] valid new tokens this step (<= C; 0 = none)
     slot_mask: Optional[jax.Array] = None,  # [B] bool; False = padded slot
-) -> Tuple[jax.Array, jax.Array]:
+    key_scale: Optional[jax.Array] = None,  # [NB, H, BS] fp32 (int8 cache)
+    value_scale: Optional[jax.Array] = None,
+):
     """Scatter a ragged chunk of new KV per sequence into its physical
     blocks: token ``j`` of sequence ``b`` lands at logical position
     ``seq_lens[b] + j``. Rows past ``q_lens`` (and masked-off slots) are
     routed out of bounds and dropped — a decode row (``q_lens == 1``) and a
-    prompt-chunk row (``q_lens == C``) ride the same scatter."""
+    prompt-chunk row (``q_lens == C``) ride the same scatter. With scale
+    planes the write quantizes in-flight per token row (returns 4 arrays):
+    the scale scatter uses the SAME out-of-bounds routing, so dropped KV rows
+    drop their scales with them."""
     b, c, h, d = k.shape
     nb, bs = key_cache.shape[0], key_cache.shape[2]
     j = jnp.arange(c)[None, :]  # [1, C]
@@ -468,6 +587,14 @@ def block_cache_append_chunk(
     phys = jnp.where(valid, phys, nb)
     flat_phys = phys.reshape(-1)
     flat_off = off.reshape(-1)
+    if key_scale is not None:
+        qk, sk = _quantize_kv_rows(k.reshape(b * c, h, d))
+        qv, sv = _quantize_kv_rows(v.reshape(b * c, h, d))
+        key_cache = key_cache.at[flat_phys, :, flat_off].set(qk, mode="drop")
+        value_cache = value_cache.at[flat_phys, :, flat_off].set(qv, mode="drop")
+        key_scale = key_scale.at[flat_phys, :, flat_off].set(sk, mode="drop")
+        value_scale = value_scale.at[flat_phys, :, flat_off].set(sv, mode="drop")
+        return key_cache, value_cache, key_scale, value_scale
     flat_k = k.reshape(b * c, h, d).astype(key_cache.dtype)
     flat_v = v.reshape(b * c, h, d).astype(value_cache.dtype)
     key_cache = key_cache.at[flat_phys, :, flat_off].set(flat_k, mode="drop")
@@ -483,12 +610,16 @@ def _gather_chunk_attend(
     seq_lens: jax.Array,  # [B] tokens cached BEFORE the new rows
     attend_q: jax.Array,  # [B] valid new rows (0 = masked slot: exact zeros)
     scale: float,
+    k_scale: Optional[jax.Array] = None,  # [NB, HKV, BS] fp32 (int8 cache)
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """The ONE XLA dense-gather attention fallback shared by the decode and
     chunked paths: gather each sequence's physical blocks, mask each query
     row to its causal limit (``seq_lens + j + 1`` for row ``j``), fp32
     softmax. Rows past ``attend_q`` return exact zeros — lockstep with the
-    Pallas kernels' skip, so slot padding never changes numerics."""
+    Pallas kernels' skip, so slot padding never changes numerics. With scale
+    planes, dequant (``x.astype(f32) * scale`` — the kernels' exact op
+    composition) is applied right after the gather."""
     b, c, hq, d = q.shape
     hkv = key_cache.shape[1]
     # gather each sequence's blocks: [B, MBS, HKV, BS, D] -> [B, L, HKV, D]
@@ -498,6 +629,12 @@ def _gather_chunk_attend(
     L = mbs * bs
     gk = gk.reshape(b, L, hkv, d)
     gv = gv.reshape(b, L, hkv, d)
+    if k_scale is not None:
+        # per-token scales ride the same block-table gather as the KV rows
+        gks = jnp.moveaxis(k_scale[block_tables], 2, 3).reshape(b, L, hkv)
+        gvs = jnp.moveaxis(v_scale[block_tables], 2, 3).reshape(b, L, hkv)
+        gk = gk.astype(jnp.float32) * gks[..., None]
+        gv = gv.astype(jnp.float32) * gvs[..., None]
     if hkv != hq:
         if hq % hkv != 0:
             raise ValueError(f"q heads {hq} not a multiple of kv heads {hkv}")
@@ -531,7 +668,9 @@ def block_multihead_chunk_attention(
     q_lens: jax.Array,  # [B] valid new tokens this step (1 = decode row)
     scale: Optional[float] = None,
     slot_mask: Optional[jax.Array] = None,  # [B] bool; False = padded slot
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    key_scale: Optional[jax.Array] = None,  # [NB, HKV, BS] fp32 (int8 cache)
+    value_scale: Optional[jax.Array] = None,
+):
     """One MIXED prefill/decode step over the paged cache — the chunked-
     prefill dispatch ("Ragged Paged Attention", arxiv 2604.15464): every
     batch row carries up to ``C`` new tokens; a decode row has ``q_lens ==
@@ -541,20 +680,35 @@ def block_multihead_chunk_attention(
     history before it. Rows past ``q_lens`` and masked-off slots return
     exactly zeros (lockstep with the Pallas kernel's skip).
 
-    Returns ``(out [B, C, HQ, D], key_cache, value_cache)``.
+    Returns ``(out [B, C, HQ, D], key_cache, value_cache)``, plus the
+    updated ``(key_scale, value_scale)`` planes when given (the int8 pool:
+    quantize-on-write in the same fused append, dequant inside the kernel's
+    block walk — or the identical composition in the XLA fallback).
     """
     b, c, hq, d = q.shape
     hkv = k.shape[2]
     if scale is None:
         scale = 1.0 / (d**0.5)
-    key_cache, value_cache = block_cache_append_chunk(
-        key_cache, value_cache, k, v, block_tables, seq_lens, q_lens,
-        slot_mask=slot_mask,
-    )
+    quantized = key_scale is not None
+    if quantized:
+        key_cache, value_cache, key_scale, value_scale = block_cache_append_chunk(
+            key_cache, value_cache, k, v, block_tables, seq_lens, q_lens,
+            slot_mask=slot_mask, key_scale=key_scale, value_scale=value_scale,
+        )
+    else:
+        key_cache, value_cache = block_cache_append_chunk(
+            key_cache, value_cache, k, v, block_tables, seq_lens, q_lens,
+            slot_mask=slot_mask,
+        )
     attend_q = q_lens
     if slot_mask is not None:
         attend_q = jnp.where(slot_mask, attend_q, 0)
     from paddle_tpu.kernels.select import pallas_enabled, warn_fallback
+
+    def _ret(out):
+        if quantized:
+            return out, key_cache, value_cache, key_scale, value_scale
+        return out, key_cache, value_cache
 
     if pallas_enabled("use_pallas_paged_attention"):
         # ragged mixed prefill/decode kernel: one grid walks each sequence's
@@ -574,19 +728,27 @@ def block_multihead_chunk_attention(
         if chunk_lowering_supported(
             b, c, hq // ntp, hkv_c // ntp, d_c, nb, bs,
             block_tables.shape[1], str(q.dtype),
+            kv_dtype=str(key_cache.dtype) if quantized else "",
         ):
             try:
+                if quantized:
+                    # injected dequant failure degrades THIS dispatch to the
+                    # XLA fallback below (counted), never the engine's
+                    # recovery path — the except arm swallows it
+                    _fault_point("quant.dequant")
                 if tp_mesh is not None:
                     out = _tp_sharded_flash_chunk(
                         q, key_cache, value_cache, block_tables,
                         seq_lens, attend_q, scale, tp_mesh,
+                        k_scale=key_scale, v_scale=value_scale,
                     )
                 else:
                     out = paged_flash_chunk(
                         q, key_cache, value_cache, block_tables,
                         seq_lens, attend_q, scale=scale,
+                        k_scale=key_scale, v_scale=value_scale,
                     )
-                return out, key_cache, value_cache
+                return _ret(out)
             except Exception as exc:  # noqa: BLE001 - XLA fallback below
                 warn_fallback("paged_flash_chunk", exc)
         else:
@@ -595,9 +757,10 @@ def block_multihead_chunk_attention(
                 RuntimeError("Mosaic lowering unsupported for geometry"),
             )
     out = _gather_chunk_attend(
-        q, key_cache, value_cache, block_tables, seq_lens, attend_q, scale
+        q, key_cache, value_cache, block_tables, seq_lens, attend_q, scale,
+        k_scale=key_scale, v_scale=value_scale,
     )
-    return out, key_cache, value_cache
+    return _ret(out)
 
 
 def block_multihead_chunk_attention_fused(
@@ -613,7 +776,9 @@ def block_multihead_chunk_attention_fused(
     q_lens: jax.Array,  # [B] valid new tokens this step (1 = decode row)
     scale: Optional[float] = None,
     slot_mask: Optional[jax.Array] = None,  # [B] bool; False = padded slot
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    key_scale: Optional[jax.Array] = None,  # [NB, HKV, BS] fp32 (int8 cache)
+    value_scale: Optional[jax.Array] = None,
+):
     """:func:`block_multihead_chunk_attention` with RoPE folded in — the
     fused decode layer's attention entry (``FLAGS_use_fused_decode_layer``).
 
@@ -625,21 +790,35 @@ def block_multihead_chunk_attention_fused(
     ``_rope_apply_xla`` to q before the shared dense-gather attention — so on
     a backend without the kernel (CPU reference), fused on/off execute the
     SAME op composition and outputs are byte-identical by construction.
+    Scale planes follow the :func:`block_multihead_chunk_attention` contract
+    (quantize AFTER the rope — the cache stores roped, quantized keys).
     """
     from paddle_tpu.incubate.nn.functional import _rope_apply_xla
 
     b, c, hq, d = q.shape
     if scale is None:
         scale = 1.0 / (d**0.5)
+    quantized = key_scale is not None
     k = _rope_apply_xla(k, sin, cos, True)
-    key_cache, value_cache = block_cache_append_chunk(
-        key_cache, value_cache, k, v, block_tables, seq_lens, q_lens,
-        slot_mask=slot_mask,
-    )
+    if quantized:
+        key_cache, value_cache, key_scale, value_scale = block_cache_append_chunk(
+            key_cache, value_cache, k, v, block_tables, seq_lens, q_lens,
+            slot_mask=slot_mask, key_scale=key_scale, value_scale=value_scale,
+        )
+    else:
+        key_cache, value_cache = block_cache_append_chunk(
+            key_cache, value_cache, k, v, block_tables, seq_lens, q_lens,
+            slot_mask=slot_mask,
+        )
     attend_q = q_lens
     if slot_mask is not None:
         attend_q = jnp.where(slot_mask, attend_q, 0)
     from paddle_tpu.kernels.select import pallas_enabled, warn_fallback
+
+    def _ret(out):
+        if quantized:
+            return out, key_cache, value_cache, key_scale, value_scale
+        return out, key_cache, value_cache
 
     if pallas_enabled("use_pallas_paged_attention"):
         from paddle_tpu.kernels.paged_attention import (
@@ -655,19 +834,24 @@ def block_multihead_chunk_attention_fused(
         if chunk_fused_lowering_supported(
             b, c, hq // ntp, hkv_c // ntp, d_c, nb, bs,
             block_tables.shape[1], str(q.dtype),
+            kv_dtype=str(key_cache.dtype) if quantized else "",
         ):
             try:
+                if quantized:
+                    _fault_point("quant.dequant")
                 if tp_mesh is not None:
                     out = _tp_sharded_flash_chunk_fused(
                         q, cos3, sin3, key_cache, value_cache, block_tables,
                         seq_lens, attend_q, scale, tp_mesh,
+                        k_scale=key_scale, v_scale=value_scale,
                     )
                 else:
                     out = paged_flash_chunk_fused(
                         q, cos3, sin3, key_cache, value_cache, block_tables,
                         seq_lens, attend_q, scale=scale,
+                        k_scale=key_scale, v_scale=value_scale,
                     )
-                return out, key_cache, value_cache
+                return _ret(out)
             except Exception as exc:  # noqa: BLE001 - XLA fallback below
                 warn_fallback("paged_flash_chunk_fused", exc)
         else:
@@ -679,9 +863,10 @@ def block_multihead_chunk_attention_fused(
     # then the shared dense-gather attention
     q = _rope_apply_xla(q, sin, cos, True)
     out = _gather_chunk_attend(
-        q, key_cache, value_cache, block_tables, seq_lens, attend_q, scale
+        q, key_cache, value_cache, block_tables, seq_lens, attend_q, scale,
+        k_scale=key_scale, v_scale=value_scale,
     )
-    return out, key_cache, value_cache
+    return _ret(out)
 
 
 def block_multihead_attention(
@@ -694,11 +879,13 @@ def block_multihead_attention(
     seq_lens: jax.Array,  # [B] tokens already cached (EXCLUDING this one)
     scale: Optional[float] = None,
     slot_mask: Optional[jax.Array] = None,  # [B] bool; False = padded slot
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    key_scale: Optional[jax.Array] = None,  # [NB, HKV, BS] fp32 (int8 cache)
+    value_scale: Optional[jax.Array] = None,
+):
     """One paged-cache decode step: append the new KV, attend over the
     sequence's blocks. Returns ``(out [B, 1, HQ, D], key_cache, value_cache)``
     — pass donated caches under jit for true in-place update (the reference
-    op is declared ``inplace``).
+    op is declared ``inplace``) — plus the updated scale planes when given.
 
     ``slot_mask`` is the continuous-batching engine's ragged-batch contract:
     masked-off slots append nothing, attend over nothing (their effective
@@ -709,15 +896,27 @@ def block_multihead_attention(
     hkv = k.shape[2]
     if scale is None:
         scale = 1.0 / (d**0.5)
-    key_cache, value_cache = block_cache_append(
-        key_cache, value_cache, k[:, 0], v[:, 0], block_tables, seq_lens,
-        slot_mask=slot_mask,
-    )
+    quantized = key_scale is not None
+    if quantized:
+        key_cache, value_cache, key_scale, value_scale = block_cache_append(
+            key_cache, value_cache, k[:, 0], v[:, 0], block_tables, seq_lens,
+            slot_mask=slot_mask, key_scale=key_scale, value_scale=value_scale,
+        )
+    else:
+        key_cache, value_cache = block_cache_append(
+            key_cache, value_cache, k[:, 0], v[:, 0], block_tables, seq_lens,
+            slot_mask=slot_mask,
+        )
     # length INCLUDING the freshly appended token; 0 for padded slots
     attend_lens = seq_lens + 1
     if slot_mask is not None:
         attend_lens = jnp.where(slot_mask, attend_lens, 0)
     from paddle_tpu.kernels.select import pallas_enabled, warn_fallback
+
+    def _ret(out):
+        if quantized:
+            return out, key_cache, value_cache, key_scale, value_scale
+        return out, key_cache, value_cache
 
     if pallas_enabled("use_pallas_paged_attention"):
         # block-table flash-decode kernel: streams only this sequence's
@@ -732,15 +931,19 @@ def block_multihead_attention(
 
         nb, hkv_c, bs, d_c = key_cache.shape
         if lowering_supported(
-            b, hq, hkv_c, d_c, nb, bs, block_tables.shape[1], str(q.dtype)
+            b, hq, hkv_c, d_c, nb, bs, block_tables.shape[1], str(q.dtype),
+            kv_dtype=str(key_cache.dtype) if quantized else "",
         ):
             try:
+                if quantized:
+                    _fault_point("quant.dequant")
                 out = paged_flash_decode(
                     q[:, 0], key_cache, value_cache, block_tables,
                     attend_lens,  # kernel masks pos < len INCLUDING this token
                     scale=scale,
+                    k_scale=key_scale, v_scale=value_scale,
                 )
-                return out[:, None], key_cache, value_cache
+                return _ret(out[:, None])
             except Exception as exc:  # noqa: BLE001 - XLA fallback below
                 warn_fallback("paged_flash_decode", exc)
         else:
@@ -752,8 +955,9 @@ def block_multihead_attention(
     out = _gather_chunk_attend(
         q, key_cache, value_cache, block_tables, seq_lens,
         attend_lens - seq_lens, scale,
+        k_scale=key_scale, v_scale=value_scale,
     )
-    return out.astype(q.dtype), key_cache, value_cache
+    return _ret(out.astype(q.dtype))
 
 
 def block_multihead_attention_fused(
@@ -768,7 +972,9 @@ def block_multihead_attention_fused(
     seq_lens: jax.Array,  # [B] tokens already cached (EXCLUDING this one)
     scale: Optional[float] = None,
     slot_mask: Optional[jax.Array] = None,  # [B] bool; False = padded slot
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    key_scale: Optional[jax.Array] = None,  # [NB, HKV, BS] fp32 (int8 cache)
+    value_scale: Optional[jax.Array] = None,
+):
     """:func:`block_multihead_attention` with RoPE folded in — the pure-decode
     counterpart of :func:`block_multihead_chunk_attention_fused`.
 
@@ -785,16 +991,28 @@ def block_multihead_attention_fused(
     b, one, hq, d = q.shape
     if scale is None:
         scale = 1.0 / (d**0.5)
+    quantized = key_scale is not None
     k = _rope_apply_xla(k, sin, cos, True)
-    key_cache, value_cache = block_cache_append(
-        key_cache, value_cache, k[:, 0], v[:, 0], block_tables, seq_lens,
-        slot_mask=slot_mask,
-    )
+    if quantized:
+        key_cache, value_cache, key_scale, value_scale = block_cache_append(
+            key_cache, value_cache, k[:, 0], v[:, 0], block_tables, seq_lens,
+            slot_mask=slot_mask, key_scale=key_scale, value_scale=value_scale,
+        )
+    else:
+        key_cache, value_cache = block_cache_append(
+            key_cache, value_cache, k[:, 0], v[:, 0], block_tables, seq_lens,
+            slot_mask=slot_mask,
+        )
     # length INCLUDING the freshly appended token; 0 for padded slots
     attend_lens = seq_lens + 1
     if slot_mask is not None:
         attend_lens = jnp.where(slot_mask, attend_lens, 0)
     from paddle_tpu.kernels.select import pallas_enabled, warn_fallback
+
+    def _ret(out):
+        if quantized:
+            return out, key_cache, value_cache, key_scale, value_scale
+        return out, key_cache, value_cache
 
     if pallas_enabled("use_pallas_paged_attention"):
         # rope-fused flash-decode kernel; same cached host-side lowering
@@ -809,16 +1027,20 @@ def block_multihead_attention_fused(
         cos3 = cos.reshape(b, 1, d)
         sin3 = sin.reshape(b, 1, d)
         if decode_fused_lowering_supported(
-            b, hq, hkv_c, d_c, nb, bs, block_tables.shape[1], str(q.dtype)
+            b, hq, hkv_c, d_c, nb, bs, block_tables.shape[1], str(q.dtype),
+            kv_dtype=str(key_cache.dtype) if quantized else "",
         ):
             try:
+                if quantized:
+                    _fault_point("quant.dequant")
                 out = paged_flash_decode_fused(
                     q[:, 0], cos3, sin3, key_cache, value_cache,
                     block_tables,
                     attend_lens,  # kernel masks pos < len INCLUDING this token
                     scale=scale,
+                    k_scale=key_scale, v_scale=value_scale,
                 )
-                return out[:, None], key_cache, value_cache
+                return _ret(out[:, None])
             except Exception as exc:  # noqa: BLE001 - XLA fallback below
                 warn_fallback("paged_flash_decode_fused", exc)
         else:
@@ -832,5 +1054,6 @@ def block_multihead_attention_fused(
     out = _gather_chunk_attend(
         q, key_cache, value_cache, block_tables, seq_lens,
         attend_lens - seq_lens, scale,
+        k_scale=key_scale, v_scale=value_scale,
     )
-    return out.astype(q.dtype), key_cache, value_cache
+    return _ret(out.astype(q.dtype))

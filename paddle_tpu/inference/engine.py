@@ -250,6 +250,18 @@ def _engine_metrics() -> Dict[str, Any]:
             "Per-speculated-step acceptance fraction: accepted / drafted "
             "(1.0 = the whole draft committed).",
         ),
+        "kv_bytes_per_token": reg.gauge(
+            "kv_pool_bytes_per_token",
+            "Effective KV-pool bytes stored per token across all layers "
+            "(int8 pools count the payload plus their fp32 scale bytes).",
+        ),
+        "kv_quant": reg.counter(
+            "kv_quant_dequant_total",
+            "Quantized-KV plane traffic attributed per successful step: "
+            "'quant' counts tokens quantized on write, 'dequant' counts "
+            "slot block-walks dequantizing on read. Always 0 under bf16.",
+            labelnames=("op",),
+        ),
     }
 
 
@@ -273,6 +285,20 @@ def _prefetch_fold(kc, vc, dst, hk, hv):
     kc = kc.at[dst].set(hk.astype(kc.dtype))
     vc = vc.at[dst].set(hv.astype(vc.dtype))
     return kc, vc, kc[dst, 0, 0, 0]
+
+
+def _prefetch_fold_q(kc, vc, ks, vs, dst, hk, hv, hks, hvs):
+    """Quantized-tier variant of :func:`_prefetch_fold`: a host block
+    carries int8 KV planes plus their fp32 scale rows, and all four pool
+    planes land in ONE program — the scale rows can never lag the payload
+    they dequantize. Same marker discipline (scalar from the updated key
+    plane; the scale planes are earlier outputs of the same program, so the
+    marker's readiness implies theirs)."""
+    kc = kc.at[dst].set(hk.astype(kc.dtype))
+    vc = vc.at[dst].set(hv.astype(vc.dtype))
+    ks = ks.at[dst].set(hks.astype(ks.dtype))
+    vs = vs.at[dst].set(hvs.astype(vs.dtype))
+    return kc, vc, ks, vs, kc[dst, 0, 0, 0]
 
 
 class InferenceRequest:
@@ -396,6 +422,8 @@ class ContinuousBatchingEngine:
         spec_decode: Optional[bool] = None,
         tp: Optional[int] = None,
         kv_host_tier_bytes: Optional[int] = None,
+        kv_cache_dtype: Optional[str] = None,
+        weight_only_int8: Optional[bool] = None,
     ) -> None:
         from paddle_tpu.incubate.nn.functional import BlockKVCache
 
@@ -431,6 +459,43 @@ class ContinuousBatchingEngine:
         # (identical shapes/dtypes/shardings -> the compiled program is reused)
         self._kvh, self._hd, self._cache_dtype = kvh, hd, dtype
         self._cache_shape = (self.num_blocks, kvh, self.block_size, hd)
+        # quantized KV plane (FLAGS_kv_cache_dtype="int8"): the pool stores
+        # int8 blocks plus per-block-per-head-per-token fp32 scale planes
+        # [NB, KVH, BS] addressed by the SAME physical block ids — every
+        # lifecycle seam (refcount, CoW, spill/prefetch, recovery replay, tp
+        # head-sharding) moves cache rows and scale rows together. "bf16"
+        # (the default) leaves the whole plane byte-identical to the
+        # unquantized engine: no scale planes exist anywhere.
+        kvd = str(
+            GLOBAL_FLAGS.get("kv_cache_dtype")
+            if kv_cache_dtype is None
+            else kv_cache_dtype
+        )
+        if kvd not in ("bf16", "int8"):
+            raise ValueError(
+                f"kv_cache_dtype must be 'bf16' or 'int8', got {kvd!r}"
+            )
+        self.kv_cache_dtype = kvd
+        self._quant_kv = kvd == "int8"
+        if self._quant_kv:
+            self._cache_dtype = jnp.int8
+        self._scale_shape = (self.num_blocks, kvh, self.block_size)
+        # weight-only int8 (FLAGS_weight_only_int8): quantize the MLP and
+        # lm-head projection weights IN PLACE, before tp sharding, so the
+        # per-output-channel scales are computed over the FULL contraction
+        # dim — under GSPMD the replicated [N] scale row next to the sharded
+        # int8 weight is then globally exact. Inference-only (serving owns
+        # the model); the scales become extra step operands below.
+        wq = bool(
+            GLOBAL_FLAGS.get("weight_only_int8")
+            if weight_only_int8 is None
+            else weight_only_int8
+        )
+        self._wq_params: List[Any] = []
+        if wq:
+            from paddle_tpu.kernels.quant import quantize_module_weights
+
+            self._wq_params = quantize_module_weights(model)
         # tensor parallelism: commit params + caches onto a ['tp'] mesh; the
         # sharding lives in input PLACEMENTS, never in shapes, so the one
         # compiled signature (and every host-side invariant) is unchanged
@@ -458,6 +523,22 @@ class ContinuousBatchingEngine:
                 lambda: jnp.zeros(self._cache_shape, self._cache_dtype),
                 out_shardings=self._cache_sharding,
             )
+            if self._quant_kv:
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                # scale planes shard on the SAME head axis as the caches:
+                # every shard owns the scales for exactly its head slice
+                self._scale_sharding = NamedSharding(
+                    self._tp_mesh, PartitionSpec(None, "tp", None)
+                )
+                # ones, not zeros: quantize(zeros) -> q=0, scale=1, so an
+                # empty quantized pool dequantizes to exact zeros
+                self._shard_zeros_scale = jax.jit(
+                    lambda: jnp.ones(self._scale_shape, jnp.float32),
+                    out_shardings=self._scale_sharding,
+                )
+            else:
+                self._scale_sharding = None
             self._tp_ctx = tp_shard_context
             # serving owns the model: params are committed onto the shard
             # group in place (Megatron column/row splits, vocab-parallel
@@ -466,12 +547,13 @@ class ContinuousBatchingEngine:
         else:
             self._tp_mesh = None
             self._cache_sharding = None
+            self._scale_sharding = None
             self._tp_ctx = None
             self._tp_split_params = 0
         # host-side refcounted block pool; the device pool lives below
         self._mgr = BlockKVCache(
             self.num_blocks, self.block_size, kvh, hd,
-            self.max_blocks_per_seq, dtype=dtype,
+            self.max_blocks_per_seq, dtype=self._cache_dtype,
         )
         self._use_prefix_cache = bool(
             GLOBAL_FLAGS.get("enable_prefix_cache")
@@ -508,14 +590,22 @@ class ContinuousBatchingEngine:
                 # marker is replicated (it is host-polled every boundary).
                 from jax.sharding import NamedSharding, PartitionSpec
 
-                fold_kw["out_shardings"] = (
-                    self._cache_sharding, self._cache_sharding,
-                    NamedSharding(self._tp_mesh, PartitionSpec()),
-                )
+                repl = NamedSharding(self._tp_mesh, PartitionSpec())
+                if self._quant_kv:
+                    fold_kw["out_shardings"] = (
+                        self._cache_sharding, self._cache_sharding,
+                        self._scale_sharding, self._scale_sharding, repl,
+                    )
+                else:
+                    fold_kw["out_shardings"] = (
+                        self._cache_sharding, self._cache_sharding, repl,
+                    )
+            fold_impl = _prefetch_fold_q if self._quant_kv else _prefetch_fold
+            fold_donate: Tuple[int, ...] = ()
+            if jax.default_backend() != "cpu":
+                fold_donate = (0, 1, 2, 3) if self._quant_kv else (0, 1)
             self._fold_fn = jax.jit(
-                _prefetch_fold,
-                donate_argnums=(0, 1) if jax.default_backend() != "cpu" else (),
-                **fold_kw,
+                fold_impl, donate_argnums=fold_donate, **fold_kw
             )
         # per-slot prefetch gate: (marker_array, n_blocks, tokens) while an
         # H2D prefetch is in flight — the slot contributes NO rows to the
@@ -614,10 +704,15 @@ class ContinuousBatchingEngine:
 
             repl = NamedSharding(self._tp_mesh, PartitionSpec())
             cs = self._cache_sharding
+            if self._quant_kv:
+                ss = self._scale_sharding
+                cache_sh = [(cs, cs, ss, ss)] * self._num_layers
+            else:
+                cache_sh = [(cs, cs)] * self._num_layers
             self._step_fn = jax.jit(
                 self._step_impl,
                 donate_argnums=(1,) if donate else (),
-                out_shardings=(repl, [(cs, cs)] * self._num_layers),
+                out_shardings=(repl, cache_sh),
             )
         else:
             self._step_fn = jax.jit(
@@ -647,17 +742,29 @@ class ContinuousBatchingEngine:
             dtype_bytes=jnp.dtype(self._cache_dtype).itemsize,
         )
 
-    def _new_cache_pair(self) -> Tuple[Any, Any]:
-        """One layer's (key, value) pool pair. Under a tp mesh the pair is
-        committed head-sharded (``[NB, KVH/tp, BS, D]`` per shard) — the
-        pool PARTITION: every shard holds the same logical block ids for
-        its own head slice, so the host-side allocator needs no per-shard
-        state. Same shapes/dtypes/shardings on every call, so recover()'s
-        rebuilt pools reuse the compiled program."""
+    def _new_cache_pair(self) -> Tuple[Any, ...]:
+        """One layer's (key, value) pool pair — under ``kv_cache_dtype=int8``
+        a (key, value, key_scale, value_scale) QUAD, the scale planes
+        ``[NB, KVH, BS]`` fp32 initialized to ONES (``quantize(zeros)`` is
+        ``q=0, scale=1``, so a fresh pool dequantizes to exact zeros). Under
+        a tp mesh everything is committed head-sharded (``[NB, KVH/tp, ...]``
+        per shard) — the pool PARTITION: every shard holds the same logical
+        block ids for its own head slice, so the host-side allocator needs
+        no per-shard state. Same shapes/dtypes/shardings on every call, so
+        recover()'s rebuilt pools reuse the compiled program."""
         if self._cache_sharding is not None:
+            if self._quant_kv:
+                return (
+                    self._shard_zeros(), self._shard_zeros(),
+                    self._shard_zeros_scale(), self._shard_zeros_scale(),
+                )
             return self._shard_zeros(), self._shard_zeros()
         kc = jnp.zeros(self._cache_shape, self._cache_dtype)
         vc = jnp.zeros(self._cache_shape, self._cache_dtype)
+        if self._quant_kv:
+            ks = jnp.ones(self._scale_shape, jnp.float32)
+            vs = jnp.ones(self._scale_shape, jnp.float32)
+            return kc, vc, ks, vs
         return kc, vc
 
     @property
@@ -708,7 +815,12 @@ class ContinuousBatchingEngine:
 
     def _bytes_per_token(self) -> int:
         """KV bytes across all layers for one token (sizes the bytes-saved
-        gauge and the host tier's per-block cost)."""
+        gauge and the host tier's per-block cost). Quantized pools count the
+        TRUE footprint: the int8 payload plus one fp32 scale per (token,
+        head) — ``2·L·KVH·(D+4)`` vs bf16's ``2·L·KVH·2D``, a ``2D/(D+4)``
+        reduction (1.94x at D=128)."""
+        if self._quant_kv:
+            return 2 * self._num_layers * self._kvh * (self._hd + 4)
         return (
             2 * self._num_layers * self._kvh * self._hd
             * jnp.dtype(self._cache_dtype).itemsize
@@ -736,18 +848,34 @@ class ContinuousBatchingEngine:
         the slot can be reallocated and overwritten (the caller holds that
         ordering). Under tensor parallelism the head shards gather here —
         the host tier always holds the full-head view."""
+        if self._quant_kv:
+            # quantized capture: ONE int8 ndarray [L, 2, KVH, BS, D+4] — the
+            # fp32 scale rides as 4 trailing bytes per (head, token) row, so
+            # the host tier's byte budget sees the true halved footprint and
+            # spill/prefetch move payload + scales as one unit
+            parts = []
+            for kc, vc, ks, vs in self._caches:
+                kv = np.asarray(jnp.stack((kc[block], vc[block])))
+                sc = np.asarray(
+                    jnp.stack((ks[block], vs[block])), dtype=np.float32
+                )
+                sc_bytes = np.ascontiguousarray(sc[..., None]).view(np.int8)
+                parts.append(np.concatenate([kv, sc_bytes], axis=-1))
+            return np.stack(parts)
         parts = [
             jnp.stack((kc[block], vc[block])) for kc, vc in self._caches
         ]
         return np.asarray(jnp.stack(parts))
 
     # -- pool accounting -----------------------------------------------------
-    def pool_stats(self) -> Dict[str, int]:
+    def pool_stats(self) -> Dict[str, Any]:
         free = self._mgr.free_blocks
         return {
             "total": self.num_blocks,
             "free": free,
             "allocated": self.num_blocks - free,
+            "kv_cache_dtype": self.kv_cache_dtype,
+            "bytes_per_token": self._bytes_per_token(),
             # blocks the prefix cache retains warm but surrenders under
             # pressure: reclaimable, so admission/overload math treats them
             # as headroom, not load
@@ -790,6 +918,7 @@ class ContinuousBatchingEngine:
         m = self._metrics
         m["blocks_alloc"].set(s["allocated"])
         m["blocks_free"].set(s["free"])
+        m["kv_bytes_per_token"].set(s["bytes_per_token"])
         m["blocks_reserved"].set(int(self._reserved.sum()))
         live = s["allocated"] - s["cached_reusable"]
         m["util"].set(live / s["total"] if s["total"] else 0.0)
@@ -813,8 +942,8 @@ class ContinuousBatchingEngine:
     def _buffers_lost(self) -> bool:
         return any(
             getattr(a, "is_deleted", lambda: False)()
-            for kc, vc in self._caches
-            for a in (kc, vc)
+            for entry in self._caches
+            for a in entry
         )
 
     def _check_usable(self) -> None:
@@ -1016,8 +1145,13 @@ class ContinuousBatchingEngine:
     # -- the compiled program (traces exactly ONCE per engine) ---------------
     def _param_arrays(self) -> List[Any]:
         # re-read each call: weight updates after construction are served
-        # without retraces (same shapes/dtypes -> same compiled program)
-        return [p._data for _, p in self._named]
+        # without retraces (same shapes/dtypes -> same compiled program).
+        # Quantized projections contribute their per-output-channel scales
+        # as EXTRA operands — the count is fixed per configuration, so the
+        # ONE compiled step signature is unchanged.
+        return [p._data for _, p in self._named] + [
+            p._quant_scale for p in self._wq_params
+        ]
 
     def _step_impl(
         self, param_arrays, caches, toks, tables, lens, q_lens, active,
@@ -1038,21 +1172,47 @@ class ContinuousBatchingEngine:
         import paddle_tpu
         from paddle_tpu.core.tensor import Tensor
         from paddle_tpu.incubate.nn.functional import block_cache_cow_copy
-        from paddle_tpu.nn.layer.layers import bind_param_arrays
+        from paddle_tpu.nn.layer.layers import (
+            bind_param_arrays,
+            bind_quant_scales,
+        )
 
         self.stats["step_traces"] += 1  # Python side: counts TRACES only
-        with bind_param_arrays(self._named, param_arrays):
-            forked = [
-                block_cache_cow_copy(kc, vc, cow_src, cow_dst)
-                for kc, vc in caches
-            ]
-            pkv = [
-                (
-                    Tensor(kc), Tensor(vc), Tensor(tables), Tensor(lens),
-                    Tensor(active), Tensor(q_lens),
-                )
-                for kc, vc in forked
-            ]
+        n_named = len(self._named)
+        weights, wq_scales = param_arrays[:n_named], param_arrays[n_named:]
+        with bind_param_arrays(self._named, weights), bind_quant_scales(
+            self._wq_params, wq_scales
+        ):
+            if self._quant_kv:
+                # scale planes ride the same CoW fork set as their payload:
+                # a forked block gets its source's scales in the same step
+                forked = [
+                    block_cache_cow_copy(
+                        kc, vc, cow_src, cow_dst,
+                        key_scale=ks, value_scale=vs,
+                    )
+                    for kc, vc, ks, vs in caches
+                ]
+                pkv = [
+                    (
+                        Tensor(kc), Tensor(vc), Tensor(tables), Tensor(lens),
+                        Tensor(active), Tensor(q_lens),
+                        Tensor(ks), Tensor(vs),
+                    )
+                    for kc, vc, ks, vs in forked
+                ]
+            else:
+                forked = [
+                    block_cache_cow_copy(kc, vc, cow_src, cow_dst)
+                    for kc, vc in caches
+                ]
+                pkv = [
+                    (
+                        Tensor(kc), Tensor(vc), Tensor(tables), Tensor(lens),
+                        Tensor(active), Tensor(q_lens),
+                    )
+                    for kc, vc in forked
+                ]
             with paddle_tpu.no_grad():
                 logits, new_pkv = self.model(
                     Tensor(toks),
@@ -1063,6 +1223,12 @@ class ContinuousBatchingEngine:
             nxt = jnp.argmax(
                 logits._data.astype(jnp.float32), axis=-1
             ).astype(jnp.int32)  # [S, C] per-row argmax
+            if self._quant_kv:
+                # quantized pasts are 8-tuples; scales come back at 6/7
+                return nxt, [
+                    (c[0]._data, c[1]._data, c[6]._data, c[7]._data)
+                    for c in new_pkv
+                ]
             return nxt, [(c[0]._data, c[1]._data) for c in new_pkv]
 
     # -- scheduling ----------------------------------------------------------
@@ -1200,15 +1366,37 @@ class ContinuousBatchingEngine:
                 if host_partial is not None:
                     copies.append(host_partial[0])
                 marker = None
+                hd = self._hd
                 for hn, blk in zip(copies, blocks):
                     dst = jnp.asarray(np.int32(blk))
                     for li in range(self._num_layers):
-                        kc, vc = self._caches[li]
-                        kc, vc, marker = self._fold_fn(
-                            kc, vc, dst,
-                            jnp.asarray(hn.kv[li, 0]), jnp.asarray(hn.kv[li, 1]),
-                        )
-                        self._caches[li] = (kc, vc)
+                        if self._quant_kv:
+                            # packed host block [2, KVH, BS, D+4] int8: split
+                            # the payload from the 4 trailing scale bytes and
+                            # land all four planes in one fold program
+                            kc, vc, ks, vs = self._caches[li]
+                            kv = hn.kv[li]
+                            hks = np.ascontiguousarray(
+                                kv[0, ..., hd:]
+                            ).view(np.float32)[..., 0]
+                            hvs = np.ascontiguousarray(
+                                kv[1, ..., hd:]
+                            ).view(np.float32)[..., 0]
+                            kc, vc, ks, vs, marker = self._fold_fn(
+                                kc, vc, ks, vs, dst,
+                                jnp.asarray(kv[0, ..., :hd]),
+                                jnp.asarray(kv[1, ..., :hd]),
+                                jnp.asarray(hks), jnp.asarray(hvs),
+                            )
+                            self._caches[li] = (kc, vc, ks, vs)
+                        else:
+                            kc, vc = self._caches[li]
+                            kc, vc, marker = self._fold_fn(
+                                kc, vc, dst,
+                                jnp.asarray(hn.kv[li, 0]),
+                                jnp.asarray(hn.kv[li, 1]),
+                            )
+                            self._caches[li] = (kc, vc)
             except Exception as exc:  # noqa: BLE001 - degrade to recompute
                 for blk in blocks:  # reserved but never mapped: hand back
                     self._mgr.decref(blk)
@@ -1576,6 +1764,17 @@ class ContinuousBatchingEngine:
         nxt = np.asarray(nxt)  # device sync: the step's tokens are real here
         if marks is not None:
             marks["sync_s"] = time.perf_counter()
+        if self._quant_kv and _obs.metrics_enabled():
+            # host-side attribution of the step's quantized-plane traffic:
+            # every new token was quantized on write, every active slot's
+            # block walk dequantized on read (one cached-bool check + two
+            # counter adds per STEP — nothing per token)
+            self._metrics["kv_quant"].labels(op="quant").inc(
+                float(sum(int(q_lens[i]) for i in active_slots))
+            )
+            self._metrics["kv_quant"].labels(op="dequant").inc(
+                float(len(active_slots))
+            )
         for i in active_slots:
             pending = self._pending_cow[i]
             if pending is not None:

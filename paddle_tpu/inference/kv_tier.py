@@ -92,6 +92,16 @@ def _tier_metrics() -> Dict[str, Any]:
             "kv_tier_host_bytes",
             "Bytes of KV currently resident in the host tier.",
         ),
+        "spilled_bytes": reg.counter(
+            "kv_tier_spilled_bytes_total",
+            "Bytes spilled D2H into the host tier (block count x the true "
+            "per-block cost — a quantized pool spills packed int8+scale "
+            "blocks at roughly half the bf16 bytes).",
+        ),
+        "prefetched_bytes": reg.counter(
+            "kv_tier_prefetched_bytes_total",
+            "Bytes prefetched H2D out of the host tier on prefix matches.",
+        ),
     }
 
 
@@ -181,6 +191,9 @@ class HostKVTier:
                 "prefetched_blocks": self._prefetched,
                 "dropped_blocks": self._dropped,
                 "refused_spills": self._refused,
+                "block_nbytes": self.block_nbytes,
+                "spilled_bytes": self._spilled * self.block_nbytes,
+                "prefetched_bytes": self._prefetched * self.block_nbytes,
             }
 
     # -- spill ---------------------------------------------------------------
@@ -219,6 +232,7 @@ class HostKVTier:
             self._bytes += self.block_nbytes
             self._spilled += 1
             self._metrics["spilled"].inc()
+            self._metrics["spilled_bytes"].inc(float(self.block_nbytes))
             self._metrics["host_bytes"].set(self._bytes)
             return True
 
@@ -278,6 +292,9 @@ class HostKVTier:
         with self._lock:
             self._prefetched += int(n_blocks)
         self._metrics["prefetched"].inc(int(n_blocks))
+        self._metrics["prefetched_bytes"].inc(
+            float(int(n_blocks) * self.block_nbytes)
+        )
 
     # -- drop ----------------------------------------------------------------
     def drop_lru(self, n: int) -> int:

@@ -194,11 +194,75 @@ def _reference_path(x2, w, lab, *, v, h, ignore_index, reduction, vocab_major):
 
 
 # --------------------------------------------------------------------------
+# weight-only int8 lm-head variant (inference-only: no VJP)
+# --------------------------------------------------------------------------
+
+
+def _quant_epilogue(lse, tl, lab, ignore_index, reduction):
+    """Same reduction semantics as ``_build_core``'s shell — duplicated here
+    because the quantized walk is forward-only (weight-only int8 is an
+    inference feature; nothing differentiates through an int8 weight)."""
+    valid = lab != ignore_index
+    per = jnp.where(valid, lse - tl, 0.0)
+    if reduction == "mean":
+        denom = jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+        return jnp.sum(per) / denom
+    if reduction == "sum":
+        return jnp.sum(per)
+    return per
+
+
+def _reference_quant_path(x2, w, scale, lab, *, v, h, ignore_index, reduction, vocab_major):
+    """Scan walk over int8 vocab chunks, dequantizing each chunk's LOGITS
+    (``(x @ w8ᵀ) * scale_col`` — the per-output-channel scale factors out of
+    the contraction, same canonical composition as ``kernels.quant``). The
+    dequantized weight is never materialized."""
+    wc = w if vocab_major else jnp.swapaxes(w, 0, 1)  # [V, H] int8
+    vp = _round_up(v, _REF_BLOCK)
+    sp = scale.astype(jnp.float32)
+    if vp > v:
+        wc = jnp.pad(wc, ((0, vp - v), (0, 0)))
+        sp = jnp.pad(sp, (0, vp - v))
+    nv = vp // _REF_BLOCK
+    wb = wc.reshape(nv, _REF_BLOCK, h)
+    sb = sp.reshape(nv, _REF_BLOCK)
+    cols0 = jnp.arange(_REF_BLOCK)
+    n = x2.shape[0]
+    xf = x2.astype(jnp.float32)
+
+    def step(carry, inp):
+        m, l, tl = carry
+        wj, sj, j = inp
+        logits = jax.lax.dot_general(
+            xf, wj.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sj[None, :]
+        cols = j * _REF_BLOCK + cols0
+        logits = jnp.where((cols < v)[None, :], logits, NEG_INF)
+        m_new = jnp.maximum(m, logits.max(axis=-1))
+        l_new = l * jnp.exp(m - m_new) + jnp.exp(logits - m_new[:, None]).sum(axis=-1)
+        tl_new = tl + jnp.where(cols[None, :] == lab[:, None], logits, 0.0).sum(axis=-1)
+        return (m_new, l_new, tl_new), None
+
+    init = (
+        jnp.full((n,), NEG_INF, jnp.float32),
+        jnp.zeros((n,), jnp.float32),
+        jnp.zeros((n,), jnp.float32),
+    )
+    (m, l, tl), _ = jax.lax.scan(step, init, (wb, sb, jnp.arange(nv)))
+    return _quant_epilogue(m + jnp.log(l), tl, lab, ignore_index, reduction)
+
+
+# --------------------------------------------------------------------------
 # Pallas kernels
 # --------------------------------------------------------------------------
 
 
-def _flxent_fwd_kernel(x_ref, w_ref, lab_ref, m_ref, l_ref, tl_ref, *, v, blk_v, vocab_major):
+def _flxent_fwd_kernel(x_ref, w_ref, lab_ref, *rest, v, blk_v, vocab_major, quantized=False):
+    if quantized:
+        s_ref, m_ref, l_ref, tl_ref = rest
+    else:
+        (m_ref, l_ref, tl_ref), s_ref = rest, None
     j = pl.program_id(1)
 
     @pl.when(j == 0)
@@ -209,6 +273,9 @@ def _flxent_fwd_kernel(x_ref, w_ref, lab_ref, m_ref, l_ref, tl_ref, *, v, blk_v,
 
     x = x_ref[...]  # [blk_rows, H] native dtype — bf16 MXU, fp32 accumulation
     w = w_ref[...]
+    if quantized:  # int8 weight block: upcast for the dot, scale the logits
+        x = x.astype(jnp.float32)
+        w = w.astype(jnp.float32)
     if vocab_major:  # w [blk_v, H]
         logits = jax.lax.dot_general(
             x, w, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -217,6 +284,10 @@ def _flxent_fwd_kernel(x_ref, w_ref, lab_ref, m_ref, l_ref, tl_ref, *, v, blk_v,
         logits = jax.lax.dot_general(
             x, w, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
+    if s_ref is not None:
+        # per-output-channel dequant factors out of the contraction: scaling
+        # the logits column equals dequantizing the whole weight column
+        logits = logits * s_ref[...].astype(jnp.float32)  # [1, blk_v] broadcast
     cols = j * blk_v + jax.lax.broadcasted_iota(jnp.int32, (1, blk_v), 1)
     logits = jnp.where(cols < v, logits, NEG_INF)
     m = m_ref[...]  # [blk_rows, 1]
@@ -389,6 +460,63 @@ def _pallas_path(x2, w, lab, *, v, h, ignore_index, reduction, vocab_major, inte
     return loss
 
 
+@functools.lru_cache(maxsize=None)
+def _make_pallas_quant_fwd(n_pad, v, vp, h, blk_rows, blk_v, vocab_major, interpret):
+    """Forward-only quantized engine: the fwd kernel with a scale input."""
+    nr = n_pad // blk_rows
+    nv = vp // blk_v
+    row_spec = pl.BlockSpec((blk_rows, h), lambda i, j: (i, 0))
+    col_spec = pl.BlockSpec((blk_rows, 1), lambda i, j: (i, 0))
+    if vocab_major:
+        w_spec = pl.BlockSpec((blk_v, h), lambda i, j: (j, 0))
+    else:
+        w_spec = pl.BlockSpec((h, blk_v), lambda i, j: (0, j))
+    s_spec = pl.BlockSpec((1, blk_v), lambda i, j: (0, j))
+
+    def engine_fwd(x2, wp, sp, lab):
+        m, l, tl = pl.pallas_call(
+            functools.partial(
+                _flxent_fwd_kernel, v=v, blk_v=blk_v, vocab_major=vocab_major,
+                quantized=True,
+            ),
+            grid=(nr, nv),
+            compiler_params=_CompilerParams(dimension_semantics=("parallel", "arbitrary")),
+            in_specs=[row_spec, w_spec, col_spec, s_spec],
+            out_specs=[col_spec, col_spec, col_spec],
+            out_shape=[jax.ShapeDtypeStruct((n_pad, 1), jnp.float32)] * 3,
+            interpret=interpret,
+        )(x2, wp, lab.reshape(n_pad, 1), sp.reshape(1, vp))
+        return (m + jnp.log(l))[:, 0], tl[:, 0]
+
+    return engine_fwd
+
+
+def _pallas_quant_path(
+    x2, w, scale, lab, *, v, h, ignore_index, reduction, vocab_major, interpret, block
+):
+    n = x2.shape[0]
+    blk_rows, blk_v = block
+    blk_rows = min(blk_rows, _round_up(n, 16))
+    n_pad = _round_up(n, blk_rows)
+    vp = _round_up(v, blk_v)
+    x2p = jnp.pad(x2, ((0, n_pad - n), (0, 0))) if n_pad > n else x2
+    labp = (
+        jnp.pad(lab, (0, n_pad - n), constant_values=ignore_index) if n_pad > n else lab
+    )
+    sp = scale.astype(jnp.float32)
+    if vp > v:
+        w = jnp.pad(w, ((0, vp - v), (0, 0)) if vocab_major else ((0, 0), (0, vp - v)))
+        sp = jnp.pad(sp, (0, vp - v))
+    engine = _make_pallas_quant_fwd(
+        n_pad, v, vp, h, blk_rows, blk_v, vocab_major, interpret
+    )
+    lse, tl = engine(x2p, w, sp, labp)
+    loss = _quant_epilogue(lse, tl, labp, ignore_index, reduction)
+    if reduction == "none":
+        loss = loss[:n]
+    return loss
+
+
 # --------------------------------------------------------------------------
 # block-size autotuning + public entry
 # --------------------------------------------------------------------------
@@ -459,6 +587,7 @@ def fused_linear_cross_entropy(
     vocab_major: bool = False,
     interpret: bool = False,
     block: Optional[Tuple[int, int]] = None,
+    weight_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """``cross_entropy(x @ Wᵀ, labels)`` without materializing ``[N, V]``.
 
@@ -470,6 +599,11 @@ def fused_linear_cross_entropy(
     ``max(#non-ignored, 1)``). ``interpret=True`` forces the Pallas path in
     interpreter mode (tests); ``block`` overrides the autotuned
     ``(row_block, vocab_block)``.
+
+    ``weight_scale`` (``[V]`` fp32, with ``weight`` int8) switches to the
+    weight-only int8 lm-head walk: each vocab chunk's logits are scaled by
+    its per-channel factors inside the walk, so the dequantized weight never
+    materializes. Inference-only — the quantized walk has no VJP.
     """
     if reduction not in ("mean", "sum", "none"):
         raise ValueError(f"unsupported reduction {reduction!r}")
@@ -481,6 +615,29 @@ def fused_linear_cross_entropy(
         n *= int(s)
     x2 = x.reshape(n, h)
     lab = labels.reshape(n).astype(jnp.int32)
+
+    if weight_scale is not None:
+        loss = None
+        if bool(interpret) or (pallas_enabled("use_fused_loss") and h % 128 == 0):
+            blk = tuple(block) if block is not None else _default_block(h, 1)
+            try:
+                loss = _pallas_quant_path(
+                    x2, weight, weight_scale, lab, v=v, h=h,
+                    ignore_index=int(ignore_index), reduction=reduction,
+                    vocab_major=bool(vocab_major), interpret=bool(interpret),
+                    block=blk,
+                )
+            except Exception as exc:  # noqa: BLE001 - scan fallback below
+                warn_fallback("fused_linear_xent_quant", exc)
+        if loss is None:
+            loss = _reference_quant_path(
+                x2, weight, weight_scale, lab, v=v, h=h,
+                ignore_index=int(ignore_index), reduction=reduction,
+                vocab_major=bool(vocab_major),
+            )
+        if reduction == "none":
+            return loss.reshape(lead)
+        return loss
 
     loss = None
     # pre-trace applicability: lane-aligned hidden (see kernels/select.py)

@@ -9,6 +9,15 @@ map so only that sequence's physical KV blocks are streamed HBM -> VMEM
 (never the dense ``[B, MBS*BS, H, D]`` gather), and an online softmax
 accumulates in fp32 VMEM scratch. Grouped-query attention keeps the G query
 heads of one KV head together as the kernel's row dimension.
+
+Quantized KV (``FLAGS_kv_cache_dtype=int8``): every kernel accepts optional
+``k_scale``/``v_scale`` planes (``[NB, HKV, BS]`` fp32 — per block, per head,
+per token slot, addressed by the SAME block ids the KV planes use), streamed
+through the identical block-table-steered index map. The dequant epilogue
+lives inside the block walk: int8 loads, one fp32 multiply per (BS, D) tile,
+fp32 accumulate — no dequantized copy of the cache ever materializes. The
+dequant composition (``x.astype(f32) * scale``) is the byte-for-byte op
+sequence the XLA gather fallback applies, keeping the two paths in lockstep.
 """
 
 from __future__ import annotations
@@ -27,21 +36,38 @@ NEG_INF = -1e30
 from paddle_tpu.kernels.select import _CompilerParams
 
 
+def _dequant_tile(k_ref, v_ref, ks_ref, vs_ref):
+    """The in-walk dequant epilogue shared by every paged kernel: one fp32
+    multiply per (BS, D) tile against this block's per-token scale rows. The
+    scale planes ride as [NB, HKV, BS, 1] (the trailing 1 keeps the (1, 1,
+    bs, 1) block legal under the TPU last-two-dims tiling rule), so the
+    [BS, 1] tile broadcasts over D. With no scale refs this is the plain
+    fp32 upcast — the bf16 path's op sequence, untouched."""
+    k = k_ref[0, 0].astype(jnp.float32)  # [BS, D]
+    v = v_ref[0, 0].astype(jnp.float32)
+    if ks_ref is not None:
+        k = k * ks_ref[0, 0].astype(jnp.float32)  # [BS, 1] broadcast over D
+        v = v * vs_ref[0, 0].astype(jnp.float32)
+    return k, v
+
+
 def _decode_kernel(
     tables_ref,  # scalar prefetch: [B, MBS] int32
     lens_ref,  # scalar prefetch: [B] int32 (length INCLUDING current token)
     q_ref,  # [1, 1, G, D]
     k_ref,  # [1, 1, BS, D] this logical block's physical KV (one head)
     v_ref,
-    o_ref,  # [1, 1, G, D]
-    m_ref,  # VMEM [G, 1] running max
-    l_ref,  # VMEM [G, 1] running denom
-    acc_ref,  # VMEM [G, D] running numerator
-    *,
+    *rest,  # quantized: ks_ref, vs_ref [1, 1, BS] then outputs/scratch
     scale: float,
     block_size: int,
     num_blocks: int,
+    quantized: bool = False,
 ):
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        o_ref, m_ref, l_ref, acc_ref = rest
+        ks_ref = vs_ref = None
     bi = pl.program_id(0)
     i = pl.program_id(2)
 
@@ -59,8 +85,7 @@ def _decode_kernel(
     @pl.when(i * block_size < lens_ref[bi])
     def _attend():
         q = q_ref[0, 0].astype(jnp.float32) * scale  # [G, D]
-        k = k_ref[0, 0].astype(jnp.float32)  # [BS, D]
-        v = v_ref[0, 0].astype(jnp.float32)
+        k, v = _dequant_tile(k_ref, v_ref, ks_ref, vs_ref)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [G, BS]
@@ -90,22 +115,32 @@ def _decode_kernel(
 
 @functools.lru_cache(maxsize=64)
 def lowering_supported(b: int, hq: int, hkv: int, d: int, nb: int, bs: int, mbs: int,
-                       dtype: str) -> bool:
+                       dtype: str, kv_dtype: str = "") -> bool:
     """Static Mosaic-lowering probe, cached per geometry. A lowering error
     inside a captured (jitted) decode step is uncatchable at run time — this
     check runs host-side at TRACE time so the caller can route to the XLA
-    path instead (same rule as the bench preflight)."""
+    path instead (same rule as the bench preflight). ``kv_dtype`` names the
+    cache storage dtype when it differs from ``dtype`` (the quantized path);
+    empty = cache stores ``dtype``, the historical geometry."""
     import numpy as np
 
     q = jax.ShapeDtypeStruct((b, hq, d), np.dtype(dtype))
-    kc = jax.ShapeDtypeStruct((nb, hkv, bs, d), np.dtype(dtype))
+    kc = jax.ShapeDtypeStruct((nb, hkv, bs, d), np.dtype(kv_dtype or dtype))
     tb = jax.ShapeDtypeStruct((b, mbs), np.int32)
     ln = jax.ShapeDtypeStruct((b,), np.int32)
     try:
-        jax.export.export(
-            jax.jit(lambda q, kc, vc, t, l: paged_flash_decode(q, kc, vc, t, l)),
-            platforms=["tpu"],
-        )(q, kc, kc, tb, ln)
+        if kv_dtype:
+            sc = jax.ShapeDtypeStruct((nb, hkv, bs), np.float32)
+            jax.export.export(
+                jax.jit(lambda q, kc, vc, ks, vs, t, l: paged_flash_decode(
+                    q, kc, vc, t, l, k_scale=ks, v_scale=vs)),
+                platforms=["tpu"],
+            )(q, kc, kc, sc, sc, tb, ln)
+        else:
+            jax.export.export(
+                jax.jit(lambda q, kc, vc, t, l: paged_flash_decode(q, kc, vc, t, l)),
+                platforms=["tpu"],
+            )(q, kc, kc, tb, ln)
         return True
     except Exception:  # noqa: BLE001 - any lowering failure means "don't"
         return False
@@ -119,6 +154,8 @@ def paged_flash_decode(
     seq_lens: jax.Array,  # [B] length INCLUDING the current token
     scale: Optional[float] = None,
     interpret: bool = False,
+    k_scale: Optional[jax.Array] = None,  # [NB, HKV, BS] fp32 (int8 cache)
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Flash decode over the paged cache. Returns ``[B, HQ, D]``."""
     b, hq, d = q.shape
@@ -130,10 +167,12 @@ def paged_flash_decode(
     if scale is None:
         scale = 1.0 / (d**0.5)
     qg = q.reshape(b, hkv, g, d)
+    quantized = k_scale is not None
 
     grid = (b, hkv, mbs)
     kernel = functools.partial(
-        _decode_kernel, scale=float(scale), block_size=bs, num_blocks=mbs
+        _decode_kernel, scale=float(scale), block_size=bs, num_blocks=mbs,
+        quantized=quantized,
     )
 
     def _kv_index(bi, hi, i, tables, lens):
@@ -147,16 +186,30 @@ def paged_flash_decode(
         last = jnp.maximum((lens[bi] + bs - 1) // bs - 1, 0)
         return (tables[bi, jnp.minimum(i, last)], hi, 0, 0)
 
+    def _scale_index(bi, hi, i, tables, lens):
+        # the scale plane is addressed by the SAME physical block id
+        last = jnp.maximum((lens[bi] + bs - 1) // bs - 1, 0)
+        return (tables[bi, jnp.minimum(i, last)], hi, 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, g, d), lambda bi, hi, i, tables, lens: (bi, hi, 0, 0)),
+        pl.BlockSpec((1, 1, bs, d), _kv_index),
+        pl.BlockSpec((1, 1, bs, d), _kv_index),
+    ]
+    operands = [qg, key_cache, value_cache]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, 1, bs, 1), _scale_index),
+            pl.BlockSpec((1, 1, bs, 1), _scale_index),
+        ]
+        operands += [k_scale[..., None], v_scale[..., None]]
+
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=grid,
-            in_specs=[
-                pl.BlockSpec((1, 1, g, d), lambda bi, hi, i, tables, lens: (bi, hi, 0, 0)),
-                pl.BlockSpec((1, 1, bs, d), _kv_index),
-                pl.BlockSpec((1, 1, bs, d), _kv_index),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec(
                 (1, 1, g, d), lambda bi, hi, i, tables, lens: (bi, hi, 0, 0)
             ),
@@ -172,7 +225,7 @@ def paged_flash_decode(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(block_tables.astype(jnp.int32), seq_lens.astype(jnp.int32), qg, key_cache, value_cache)
+    )(block_tables.astype(jnp.int32), seq_lens.astype(jnp.int32), *operands)
     return out.reshape(b, hq, d)
 
 
@@ -197,16 +250,18 @@ def _chunk_kernel(
     q_ref,  # [1, 1, C*G, D] chunk-major packed rows (row = j*G + g)
     k_ref,  # [1, 1, BS, D] this logical block's physical KV (one head)
     v_ref,
-    o_ref,  # [1, 1, C*G, D]
-    m_ref,  # VMEM [C*G, 1] running max
-    l_ref,  # VMEM [C*G, 1] running denom
-    acc_ref,  # VMEM [C*G, D] running numerator
-    *,
+    *rest,  # quantized: ks_ref, vs_ref [1, 1, BS] then outputs/scratch
     scale: float,
     block_size: int,
     num_blocks: int,
     group: int,
+    quantized: bool = False,
 ):
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        o_ref, m_ref, l_ref, acc_ref = rest
+        ks_ref = vs_ref = None
     bi = pl.program_id(0)
     i = pl.program_id(2)
     rows = q_ref.shape[2]
@@ -225,8 +280,7 @@ def _chunk_kernel(
     @pl.when(i * block_size < lens_ref[bi] + qlens_ref[bi])
     def _attend():
         q = q_ref[0, 0].astype(jnp.float32) * scale  # [C*G, D]
-        k = k_ref[0, 0].astype(jnp.float32)  # [BS, D]
-        v = v_ref[0, 0].astype(jnp.float32)
+        k, v = _dequant_tile(k_ref, v_ref, ks_ref, vs_ref)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [C*G, BS]
@@ -267,22 +321,31 @@ def _chunk_kernel(
 
 @functools.lru_cache(maxsize=64)
 def chunk_lowering_supported(b: int, c: int, hq: int, hkv: int, d: int, nb: int,
-                             bs: int, mbs: int, dtype: str) -> bool:
+                             bs: int, mbs: int, dtype: str,
+                             kv_dtype: str = "") -> bool:
     """Static Mosaic-lowering probe for the mixed prefill/decode kernel,
     cached per geometry (same rule as :func:`lowering_supported`)."""
     import numpy as np
 
     q = jax.ShapeDtypeStruct((b, c, hq, d), np.dtype(dtype))
-    kc = jax.ShapeDtypeStruct((nb, hkv, bs, d), np.dtype(dtype))
+    kc = jax.ShapeDtypeStruct((nb, hkv, bs, d), np.dtype(kv_dtype or dtype))
     tb = jax.ShapeDtypeStruct((b, mbs), np.int32)
     ln = jax.ShapeDtypeStruct((b,), np.int32)
     try:
-        jax.export.export(
-            jax.jit(
-                lambda q, kc, vc, t, l, ql: paged_flash_chunk(q, kc, vc, t, l, ql)
-            ),
-            platforms=["tpu"],
-        )(q, kc, kc, tb, ln, ln)
+        if kv_dtype:
+            sc = jax.ShapeDtypeStruct((nb, hkv, bs), np.float32)
+            jax.export.export(
+                jax.jit(lambda q, kc, vc, ks, vs, t, l, ql: paged_flash_chunk(
+                    q, kc, vc, t, l, ql, k_scale=ks, v_scale=vs)),
+                platforms=["tpu"],
+            )(q, kc, kc, sc, sc, tb, ln, ln)
+        else:
+            jax.export.export(
+                jax.jit(
+                    lambda q, kc, vc, t, l, ql: paged_flash_chunk(q, kc, vc, t, l, ql)
+                ),
+                platforms=["tpu"],
+            )(q, kc, kc, tb, ln, ln)
         return True
     except Exception:  # noqa: BLE001 - any lowering failure means "don't"
         return False
@@ -297,6 +360,8 @@ def paged_flash_chunk(
     q_lens: jax.Array,  # [B] valid new tokens (0 = inactive slot)
     scale: Optional[float] = None,
     interpret: bool = False,
+    k_scale: Optional[jax.Array] = None,  # [NB, HKV, BS] fp32 (int8 cache)
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Flash attention for one mixed prefill/decode step over the paged
     cache. Returns ``[B, C, HQ, D]`` with rows past ``q_lens`` exactly 0."""
@@ -310,11 +375,12 @@ def paged_flash_chunk(
         scale = 1.0 / (d**0.5)
     # pack rows chunk-major per KV head: [B, C, HKV, G, D] -> [B, HKV, C*G, D]
     qg = q.reshape(b, c, hkv, g, d).transpose(0, 2, 1, 3, 4).reshape(b, hkv, c * g, d)
+    quantized = k_scale is not None
 
     grid = (b, hkv, mbs)
     kernel = functools.partial(
         _chunk_kernel, scale=float(scale), block_size=bs, num_blocks=mbs,
-        group=g,
+        group=g, quantized=quantized,
     )
 
     def _kv_index(bi, hi, i, tables, lens, qlens):
@@ -326,19 +392,33 @@ def paged_flash_chunk(
         last = jnp.maximum((lens[bi] + qlens[bi] + bs - 1) // bs - 1, 0)
         return (tables[bi, jnp.minimum(i, last)], hi, 0, 0)
 
+    def _scale_index(bi, hi, i, tables, lens, qlens):
+        # the scale plane is addressed by the SAME physical block id
+        last = jnp.maximum((lens[bi] + qlens[bi] + bs - 1) // bs - 1, 0)
+        return (tables[bi, jnp.minimum(i, last)], hi, 0, 0)
+
+    in_specs = [
+        pl.BlockSpec(
+            (1, 1, c * g, d),
+            lambda bi, hi, i, tables, lens, qlens: (bi, hi, 0, 0),
+        ),
+        pl.BlockSpec((1, 1, bs, d), _kv_index),
+        pl.BlockSpec((1, 1, bs, d), _kv_index),
+    ]
+    operands = [qg, key_cache, value_cache]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, 1, bs, 1), _scale_index),
+            pl.BlockSpec((1, 1, bs, 1), _scale_index),
+        ]
+        operands += [k_scale[..., None], v_scale[..., None]]
+
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=3,
             grid=grid,
-            in_specs=[
-                pl.BlockSpec(
-                    (1, 1, c * g, d),
-                    lambda bi, hi, i, tables, lens, qlens: (bi, hi, 0, 0),
-                ),
-                pl.BlockSpec((1, 1, bs, d), _kv_index),
-                pl.BlockSpec((1, 1, bs, d), _kv_index),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec(
                 (1, 1, c * g, d),
                 lambda bi, hi, i, tables, lens, qlens: (bi, hi, 0, 0),
@@ -359,9 +439,7 @@ def paged_flash_chunk(
         block_tables.astype(jnp.int32),
         seq_lens.astype(jnp.int32),
         q_lens.astype(jnp.int32),
-        qg,
-        key_cache,
-        value_cache,
+        *operands,
     )
     # [B, HKV, C*G, D] -> [B, C, HQ, D]
     return out.reshape(b, hkv, c, g, d).transpose(0, 2, 1, 3, 4).reshape(b, c, hq, d)
@@ -399,15 +477,17 @@ def _decode_fused_kernel(
     sin_ref,
     k_ref,  # [1, 1, BS, D]
     v_ref,
-    o_ref,  # [1, 1, G, D]
-    m_ref,
-    l_ref,
-    acc_ref,
-    *,
+    *rest,  # quantized: ks_ref, vs_ref [1, 1, BS] then outputs/scratch
     scale: float,
     block_size: int,
     num_blocks: int,
+    quantized: bool = False,
 ):
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        o_ref, m_ref, l_ref, acc_ref = rest
+        ks_ref = vs_ref = None
     bi = pl.program_id(0)
     i = pl.program_id(2)
 
@@ -429,8 +509,7 @@ def _decode_fused_kernel(
         s_t = jnp.broadcast_to(sin_ref[0], (g_rows, d)).astype(q_ref.dtype)
         q = _rope_rows(q_ref[0, 0], c, s_t, d // 2)  # [G, D] in q.dtype
         q = q.astype(jnp.float32) * scale
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
+        k, v = _dequant_tile(k_ref, v_ref, ks_ref, vs_ref)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -457,7 +536,8 @@ def _decode_fused_kernel(
 
 @functools.lru_cache(maxsize=64)
 def decode_fused_lowering_supported(b: int, hq: int, hkv: int, d: int, nb: int,
-                                    bs: int, mbs: int, dtype: str) -> bool:
+                                    bs: int, mbs: int, dtype: str,
+                                    kv_dtype: str = "") -> bool:
     """Static Mosaic-lowering probe for the rope-fused decode kernel (the
     lane-dim concat split can fail lowering for some D — same routing rule
     as :func:`lowering_supported`)."""
@@ -465,18 +545,27 @@ def decode_fused_lowering_supported(b: int, hq: int, hkv: int, d: int, nb: int,
 
     q = jax.ShapeDtypeStruct((b, hq, d), np.dtype(dtype))
     cs = jax.ShapeDtypeStruct((b, 1, d), np.dtype(dtype))
-    kc = jax.ShapeDtypeStruct((nb, hkv, bs, d), np.dtype(dtype))
+    kc = jax.ShapeDtypeStruct((nb, hkv, bs, d), np.dtype(kv_dtype or dtype))
     tb = jax.ShapeDtypeStruct((b, mbs), np.int32)
     ln = jax.ShapeDtypeStruct((b,), np.int32)
     try:
-        jax.export.export(
-            jax.jit(
-                lambda q, c, s, kc, vc, t, l: paged_flash_decode_fused(
-                    q, c, s, kc, vc, t, l
-                )
-            ),
-            platforms=["tpu"],
-        )(q, cs, cs, kc, kc, tb, ln)
+        if kv_dtype:
+            sc = jax.ShapeDtypeStruct((nb, hkv, bs), np.float32)
+            jax.export.export(
+                jax.jit(lambda q, c, s, kc, vc, ks, vs, t, l:
+                        paged_flash_decode_fused(
+                            q, c, s, kc, vc, t, l, k_scale=ks, v_scale=vs)),
+                platforms=["tpu"],
+            )(q, cs, cs, kc, kc, sc, sc, tb, ln)
+        else:
+            jax.export.export(
+                jax.jit(
+                    lambda q, c, s, kc, vc, t, l: paged_flash_decode_fused(
+                        q, c, s, kc, vc, t, l
+                    )
+                ),
+                platforms=["tpu"],
+            )(q, cs, cs, kc, kc, tb, ln)
         return True
     except Exception:  # noqa: BLE001 - any lowering failure means "don't"
         return False
@@ -492,6 +581,8 @@ def paged_flash_decode_fused(
     seq_lens: jax.Array,
     scale: Optional[float] = None,
     interpret: bool = False,
+    k_scale: Optional[jax.Array] = None,  # [NB, HKV, BS] fp32 (int8 cache)
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """:func:`paged_flash_decode` with q-RoPE folded into the block walk —
     one dispatch replaces the rope pass + attention pair."""
@@ -504,27 +595,42 @@ def paged_flash_decode_fused(
     if scale is None:
         scale = 1.0 / (d**0.5)
     qg = q.reshape(b, hkv, g, d)
+    quantized = k_scale is not None
 
     kernel = functools.partial(
-        _decode_fused_kernel, scale=float(scale), block_size=bs, num_blocks=mbs
+        _decode_fused_kernel, scale=float(scale), block_size=bs, num_blocks=mbs,
+        quantized=quantized,
     )
 
     def _kv_index(bi, hi, i, tables, lens):
         last = jnp.maximum((lens[bi] + bs - 1) // bs - 1, 0)
         return (tables[bi, jnp.minimum(i, last)], hi, 0, 0)
 
+    def _scale_index(bi, hi, i, tables, lens):
+        last = jnp.maximum((lens[bi] + bs - 1) // bs - 1, 0)
+        return (tables[bi, jnp.minimum(i, last)], hi, 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, g, d), lambda bi, hi, i, tables, lens: (bi, hi, 0, 0)),
+        pl.BlockSpec((1, 1, d), lambda bi, hi, i, tables, lens: (bi, 0, 0)),
+        pl.BlockSpec((1, 1, d), lambda bi, hi, i, tables, lens: (bi, 0, 0)),
+        pl.BlockSpec((1, 1, bs, d), _kv_index),
+        pl.BlockSpec((1, 1, bs, d), _kv_index),
+    ]
+    operands = [qg, cos, sin, key_cache, value_cache]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, 1, bs, 1), _scale_index),
+            pl.BlockSpec((1, 1, bs, 1), _scale_index),
+        ]
+        operands += [k_scale[..., None], v_scale[..., None]]
+
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(b, hkv, mbs),
-            in_specs=[
-                pl.BlockSpec((1, 1, g, d), lambda bi, hi, i, tables, lens: (bi, hi, 0, 0)),
-                pl.BlockSpec((1, 1, d), lambda bi, hi, i, tables, lens: (bi, 0, 0)),
-                pl.BlockSpec((1, 1, d), lambda bi, hi, i, tables, lens: (bi, 0, 0)),
-                pl.BlockSpec((1, 1, bs, d), _kv_index),
-                pl.BlockSpec((1, 1, bs, d), _kv_index),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec(
                 (1, 1, g, d), lambda bi, hi, i, tables, lens: (bi, hi, 0, 0)
             ),
@@ -542,11 +648,7 @@ def paged_flash_decode_fused(
     )(
         block_tables.astype(jnp.int32),
         seq_lens.astype(jnp.int32),
-        qg,
-        cos,
-        sin,
-        key_cache,
-        value_cache,
+        *operands,
     )
     return out.reshape(b, hq, d)
 
@@ -560,16 +662,18 @@ def _chunk_fused_kernel(
     sin_ref,
     k_ref,
     v_ref,
-    o_ref,
-    m_ref,
-    l_ref,
-    acc_ref,
-    *,
+    *rest,  # quantized: ks_ref, vs_ref [1, 1, BS] then outputs/scratch
     scale: float,
     block_size: int,
     num_blocks: int,
     group: int,
+    quantized: bool = False,
 ):
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        o_ref, m_ref, l_ref, acc_ref = rest
+        ks_ref = vs_ref = None
     bi = pl.program_id(0)
     i = pl.program_id(2)
     rows = q_ref.shape[2]
@@ -594,8 +698,7 @@ def _chunk_fused_kernel(
         ).reshape(rows, d).astype(q_ref.dtype)
         q = _rope_rows(q_ref[0, 0], c, s_t, d // 2)  # [C*G, D] in q.dtype
         q = q.astype(jnp.float32) * scale
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
+        k, v = _dequant_tile(k_ref, v_ref, ks_ref, vs_ref)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -628,25 +731,35 @@ def _chunk_fused_kernel(
 
 @functools.lru_cache(maxsize=64)
 def chunk_fused_lowering_supported(b: int, c: int, hq: int, hkv: int, d: int,
-                                   nb: int, bs: int, mbs: int, dtype: str) -> bool:
+                                   nb: int, bs: int, mbs: int, dtype: str,
+                                   kv_dtype: str = "") -> bool:
     """Static Mosaic-lowering probe for the rope-fused mixed kernel, cached
     per geometry (same rule as :func:`chunk_lowering_supported`)."""
     import numpy as np
 
     q = jax.ShapeDtypeStruct((b, c, hq, d), np.dtype(dtype))
     cs = jax.ShapeDtypeStruct((b, c, d), np.dtype(dtype))
-    kc = jax.ShapeDtypeStruct((nb, hkv, bs, d), np.dtype(dtype))
+    kc = jax.ShapeDtypeStruct((nb, hkv, bs, d), np.dtype(kv_dtype or dtype))
     tb = jax.ShapeDtypeStruct((b, mbs), np.int32)
     ln = jax.ShapeDtypeStruct((b,), np.int32)
     try:
-        jax.export.export(
-            jax.jit(
-                lambda q, c, s, kc, vc, t, l, ql: paged_flash_chunk_fused(
-                    q, c, s, kc, vc, t, l, ql
-                )
-            ),
-            platforms=["tpu"],
-        )(q, cs, cs, kc, kc, tb, ln, ln)
+        if kv_dtype:
+            sc = jax.ShapeDtypeStruct((nb, hkv, bs), np.float32)
+            jax.export.export(
+                jax.jit(lambda q, c, s, kc, vc, ks, vs, t, l, ql:
+                        paged_flash_chunk_fused(
+                            q, c, s, kc, vc, t, l, ql, k_scale=ks, v_scale=vs)),
+                platforms=["tpu"],
+            )(q, cs, cs, kc, kc, sc, sc, tb, ln, ln)
+        else:
+            jax.export.export(
+                jax.jit(
+                    lambda q, c, s, kc, vc, t, l, ql: paged_flash_chunk_fused(
+                        q, c, s, kc, vc, t, l, ql
+                    )
+                ),
+                platforms=["tpu"],
+            )(q, cs, cs, kc, kc, tb, ln, ln)
         return True
     except Exception:  # noqa: BLE001 - any lowering failure means "don't"
         return False
@@ -663,6 +776,8 @@ def paged_flash_chunk_fused(
     q_lens: jax.Array,  # [B] valid new tokens (0 = inactive slot)
     scale: Optional[float] = None,
     interpret: bool = False,
+    k_scale: Optional[jax.Array] = None,  # [NB, HKV, BS] fp32 (int8 cache)
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """:func:`paged_flash_chunk` with q-RoPE folded into the block walk —
     the decode layer's rope pass + attention collapse to ONE dispatch."""
@@ -675,35 +790,49 @@ def paged_flash_chunk_fused(
     if scale is None:
         scale = 1.0 / (d**0.5)
     qg = q.reshape(b, c, hkv, g, d).transpose(0, 2, 1, 3, 4).reshape(b, hkv, c * g, d)
+    quantized = k_scale is not None
 
     kernel = functools.partial(
         _chunk_fused_kernel, scale=float(scale), block_size=bs, num_blocks=mbs,
-        group=g,
+        group=g, quantized=quantized,
     )
 
     def _kv_index(bi, hi, i, tables, lens, qlens):
         last = jnp.maximum((lens[bi] + qlens[bi] + bs - 1) // bs - 1, 0)
         return (tables[bi, jnp.minimum(i, last)], hi, 0, 0)
 
+    def _scale_index(bi, hi, i, tables, lens, qlens):
+        last = jnp.maximum((lens[bi] + qlens[bi] + bs - 1) // bs - 1, 0)
+        return (tables[bi, jnp.minimum(i, last)], hi, 0, 0)
+
+    in_specs = [
+        pl.BlockSpec(
+            (1, 1, c * g, d),
+            lambda bi, hi, i, tables, lens, qlens: (bi, hi, 0, 0),
+        ),
+        pl.BlockSpec(
+            (1, c, d), lambda bi, hi, i, tables, lens, qlens: (bi, 0, 0)
+        ),
+        pl.BlockSpec(
+            (1, c, d), lambda bi, hi, i, tables, lens, qlens: (bi, 0, 0)
+        ),
+        pl.BlockSpec((1, 1, bs, d), _kv_index),
+        pl.BlockSpec((1, 1, bs, d), _kv_index),
+    ]
+    operands = [qg, cos, sin, key_cache, value_cache]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, 1, bs, 1), _scale_index),
+            pl.BlockSpec((1, 1, bs, 1), _scale_index),
+        ]
+        operands += [k_scale[..., None], v_scale[..., None]]
+
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=3,
             grid=(b, hkv, mbs),
-            in_specs=[
-                pl.BlockSpec(
-                    (1, 1, c * g, d),
-                    lambda bi, hi, i, tables, lens, qlens: (bi, hi, 0, 0),
-                ),
-                pl.BlockSpec(
-                    (1, c, d), lambda bi, hi, i, tables, lens, qlens: (bi, 0, 0)
-                ),
-                pl.BlockSpec(
-                    (1, c, d), lambda bi, hi, i, tables, lens, qlens: (bi, 0, 0)
-                ),
-                pl.BlockSpec((1, 1, bs, d), _kv_index),
-                pl.BlockSpec((1, 1, bs, d), _kv_index),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec(
                 (1, 1, c * g, d),
                 lambda bi, hi, i, tables, lens, qlens: (bi, hi, 0, 0),
@@ -723,10 +852,6 @@ def paged_flash_chunk_fused(
         block_tables.astype(jnp.int32),
         seq_lens.astype(jnp.int32),
         q_lens.astype(jnp.int32),
-        qg,
-        cos,
-        sin,
-        key_cache,
-        value_cache,
+        *operands,
     )
     return out.reshape(b, hkv, c, g, d).transpose(0, 2, 1, 3, 4).reshape(b, c, hq, d)

@@ -183,6 +183,7 @@ class ErnieModel(nn.Layer):
                 loss = F.fused_linear_cross_entropy(
                     h, w, labels, ignore_index=-100, reduction="mean",
                     weight_vocab_major=True,
+                    weight_scale=getattr(w, "_quant_scale", None),
                 )
             else:
                 scores = paddle_tpu.matmul(h, w, transpose_y=True)

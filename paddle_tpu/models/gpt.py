@@ -169,6 +169,7 @@ class GPTForPretraining(nn.Layer):
                 loss = F.fused_linear_cross_entropy(
                     h, w, labels, ignore_index=-100, reduction="mean",
                     weight_vocab_major=True,
+                    weight_scale=getattr(w, "_quant_scale", None),
                 )
                 return loss, None
             logits = paddle_tpu.matmul(h, w, transpose_y=True)

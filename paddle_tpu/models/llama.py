@@ -155,7 +155,7 @@ class LlamaAttention(nn.Layer):
         if (
             cache_position is not None
             and past_key_value is not None
-            and len(past_key_value) in (4, 5, 6)
+            and len(past_key_value) in (4, 5, 6, 8)
         ):
             # paged serving: past is (key_cache [NB,HK,BS,D], value_cache,
             # block_tables [B,MBS], seq_lens [B][, slot_mask [B][, q_lens
@@ -168,7 +168,11 @@ class LlamaAttention(nn.Layer):
             # changes. The optional 6th element is the CHUNKED-PREFILL row
             # count: each slot carries up to ``s`` new tokens (a decode row
             # has q_lens == 1, a prompt chunk up to s) through ONE mixed
-            # ragged dispatch — the engine's single compiled signature.
+            # ragged dispatch — the engine's single compiled signature. An
+            # 8-tuple past (FLAGS_kv_cache_dtype=int8) additionally carries
+            # the pool's per-block-per-head fp32 scale planes; quantize-on-
+            # write/dequant-on-read ride the same kernels, still one
+            # signature.
             from paddle_tpu.core.tensor import Tensor as _T
             from paddle_tpu.incubate.nn.functional import (
                 block_multihead_attention,
@@ -177,7 +181,9 @@ class LlamaAttention(nn.Layer):
 
             kc, vc, tables, lens = past_key_value[:4]
             slot_mask = past_key_value[4] if len(past_key_value) >= 5 else None
-            q_lens = past_key_value[5] if len(past_key_value) == 6 else None
+            q_lens = past_key_value[5] if len(past_key_value) >= 6 else None
+            k_scale = past_key_value[6] if len(past_key_value) == 8 else None
+            v_scale = past_key_value[7] if len(past_key_value) == 8 else None
             lens_t = lens if isinstance(lens, _T) else _T(lens)
             lens_arr = lens_t._data
             cos, sin = self.rotary_emb(s, lens_t)  # ragged: [B, s, 1, D]
@@ -185,8 +191,10 @@ class LlamaAttention(nn.Layer):
             q, k, _ = fused_rotary_position_embedding(q, k, None, sin=sin, cos=cos)
             count_dispatch("unfused:rope_apply")
             mask_arr = slot_mask._data if isinstance(slot_mask, _T) else slot_mask
+            ks_arr = k_scale._data if isinstance(k_scale, _T) else k_scale
+            vs_arr = v_scale._data if isinstance(v_scale, _T) else v_scale
             if q_lens is not None:
-                out_a, kc2, vc2 = block_multihead_chunk_attention(
+                res = block_multihead_chunk_attention(
                     q._data,
                     k._data,
                     v._data,
@@ -196,9 +204,11 @@ class LlamaAttention(nn.Layer):
                     lens_arr,
                     q_lens._data if isinstance(q_lens, _T) else q_lens,
                     slot_mask=mask_arr,
+                    key_scale=ks_arr,
+                    value_scale=vs_arr,
                 )
             else:
-                out_a, kc2, vc2 = block_multihead_attention(
+                res = block_multihead_attention(
                     q._data,
                     k._data,
                     v._data,
@@ -208,6 +218,10 @@ class LlamaAttention(nn.Layer):
                     lens_arr,
                     slot_mask=mask_arr,
                 )
+            if ks_arr is not None:
+                out_a, kc2, vc2, ks2, vs2 = res
+            else:
+                out_a, kc2, vc2 = res
             count_dispatch("unfused:attend")
             out = self.o_proj(reshape(_T(out_a), [b, s, self.num_heads * self.head_dim]))
             count_dispatch("unfused:o_proj")
@@ -216,8 +230,10 @@ class LlamaAttention(nn.Layer):
             new_past = (_T(kc2), _T(vc2), tables, lens)
             if len(past_key_value) >= 5:
                 new_past = new_past + (slot_mask,)
-            if len(past_key_value) == 6:
+            if len(past_key_value) >= 6:
                 new_past = new_past + (q_lens,)
+            if ks_arr is not None:
+                new_past = new_past + (_T(ks2), _T(vs2))
             return out, new_past
         if cache_position is not None and past_key_value is not None:
             # static-cache decode: past is a FIXED [B, S_max, HK, D] buffer
@@ -292,8 +308,14 @@ class LlamaAttention(nn.Layer):
         q = reshape(self.q_proj(hidden_states), [b, s, self.num_heads, self.head_dim])
         k = reshape(self.k_proj(hidden_states), [b, s, self.num_kv_heads, self.head_dim])
         v = reshape(self.v_proj(hidden_states), [b, s, self.num_kv_heads, self.head_dim])
-        kc, vc, tables, lens, slot_mask, q_lens = past_key_value
-        out_a, kc2, vc2 = block_multihead_chunk_attention_fused(
+        if len(past_key_value) == 8:
+            kc, vc, tables, lens, slot_mask, q_lens, k_scale, v_scale = past_key_value
+        else:
+            kc, vc, tables, lens, slot_mask, q_lens = past_key_value
+            k_scale = v_scale = None
+        ks_arr = k_scale._data if isinstance(k_scale, _T) else k_scale
+        vs_arr = v_scale._data if isinstance(v_scale, _T) else v_scale
+        res = block_multihead_chunk_attention_fused(
             q._data,
             k._data,
             v._data,
@@ -305,7 +327,13 @@ class LlamaAttention(nn.Layer):
             lens._data if isinstance(lens, _T) else lens,
             q_lens._data if isinstance(q_lens, _T) else q_lens,
             slot_mask=slot_mask._data if isinstance(slot_mask, _T) else slot_mask,
+            key_scale=ks_arr,
+            value_scale=vs_arr,
         )
+        if ks_arr is not None:
+            out_a, kc2, vc2, ks2, vs2 = res
+        else:
+            out_a, kc2, vc2 = res
         count_dispatch("fused:attend")
         out_t = reshape(_T(out_a), [b, s, self.num_heads * self.head_dim])
         mesh = _armed_tp_mesh()
@@ -317,6 +345,8 @@ class LlamaAttention(nn.Layer):
             out = _T(row_parallel_overlap_matmul(out_t._data, self.o_proj.weight._data))
         count_dispatch("fused:o_proj")
         new_past = (_T(kc2), _T(vc2), tables, lens, slot_mask, q_lens)
+        if ks_arr is not None:
+            new_past = new_past + (_T(ks2), _T(vs2))
         return out, new_past
 
 
@@ -391,7 +421,7 @@ class LlamaModel(nn.Layer):
             and past_key_values is not None
             and GLOBAL_FLAGS.get("use_fused_decode_layer")
             and len(past_key_values) == len(self.layers)
-            and all(p is not None and len(p) == 6 for p in past_key_values)
+            and all(p is not None and len(p) in (6, 8) for p in past_key_values)
         ):
             # the continuous-batching engine's one-signature mixed ragged
             # step (6-tuple paged past): run the FUSED decode layer loop —
@@ -495,10 +525,21 @@ class LlamaModel(nn.Layer):
                 from paddle_tpu.distributed.tp import row_parallel_overlap_matmul
 
                 inner = F.swiglu(layer.mlp.gate_proj(h), layer.mlp.up_proj(h))
+                dw = layer.mlp.down_proj.weight
+                dscale = getattr(dw, "_quant_scale", None)
+                if dscale is None:
+                    dw_data = dw._data
+                else:
+                    # weight-only int8 under tp: dequantize the LOCAL K-shard
+                    # before the overlapped reduce — per-output-channel scales
+                    # span the full K, so per-shard dequant-then-reduce is
+                    # exact (the scale factors out of the K-sum); XLA fuses
+                    # the convert into the tile matmul, no resident bf16 copy
+                    dw_data = (
+                        dw._data.astype(jnp.float32) * dscale[None, :]
+                    ).astype(inner._data.dtype)
                 mlp_out = _T(
-                    row_parallel_overlap_matmul(
-                        inner._data, layer.mlp.down_proj.weight._data
-                    )
+                    row_parallel_overlap_matmul(inner._data, dw_data)
                 )
             count_dispatch("fused:mlp")
             next_norm = layers[i + 1].input_layernorm if i + 1 < n else self.norm
@@ -556,7 +597,9 @@ class LlamaForCausalLM(nn.Layer, GenerationMixin):
         if labels is not None and GLOBAL_FLAGS.get("use_fused_loss"):
             if self.lm_head is not None:
                 loss = F.fused_linear_cross_entropy(
-                    out, self.lm_head.weight, labels, ignore_index=-100, reduction="mean"
+                    out, self.lm_head.weight, labels, ignore_index=-100,
+                    reduction="mean",
+                    weight_scale=getattr(self.lm_head.weight, "_quant_scale", None),
                 )
             else:
                 loss = F.fused_linear_cross_entropy(

@@ -18,6 +18,7 @@ from paddle_tpu.ops.registry import defop
 
 __all__ = [
     "linear",
+    "weight_only_linear",
     "embedding",
     "one_hot",
     "dropout",
@@ -47,6 +48,20 @@ def linear(x, weight, bias=None):
     """y = x @ W (+ b). Weight layout [in, out] (paddle convention, reference
     ``python/paddle/nn/functional/common.py`` linear)."""
     out = jnp.matmul(x, weight)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@defop("weight_only_linear", tensor_method=None)
+def weight_only_linear(x, weight, weight_scale, bias=None):
+    """y = x @ dequant(W) (+ b) with W stored int8 and per-output-channel
+    fp32 scales (reference ``paddle.nn.quant.weight_only_linear``). The
+    dequant happens inside the matmul (``kernels.quant.int8_weight_matmul``)
+    — a bf16 copy of the weight never materializes. Inference-only."""
+    from paddle_tpu.kernels.quant import int8_weight_matmul
+
+    out = int8_weight_matmul(x, weight, weight_scale)
     if bias is not None:
         out = out + bias
     return out

@@ -106,6 +106,7 @@ def fused_linear_cross_entropy(
     ignore_index=-100,
     reduction="mean",
     weight_vocab_major=False,
+    weight_scale=None,
 ):
     """Fused lm-head + softmax cross entropy: ``cross_entropy(input @ Wᵀ,
     label)`` computed vocab-chunk-wise so the ``[.., V]`` logits are never
@@ -126,6 +127,7 @@ def fused_linear_cross_entropy(
         ignore_index=ignore_index,
         reduction=reduction,
         vocab_major=weight_vocab_major,
+        weight_scale=weight_scale,
     )
 
 

@@ -34,6 +34,13 @@ class Linear(Layer):
             self.bias = None
 
     def forward(self, x: Any) -> Any:
+        # weight-only int8 (engine-applied, FLAGS_weight_only_int8): the
+        # Parameter carries its per-output-channel scales; the defop below
+        # unwraps Tensor args, so the dispatch decision must happen HERE,
+        # where the Parameter (and its _quant_scale) is still visible
+        scale = getattr(self.weight, "_quant_scale", None)
+        if scale is not None:
+            return F.weight_only_linear(x, self.weight, scale, self.bias)
         return F.linear(x, self.weight, self.bias)
 
     def extra_repr(self) -> str:

@@ -43,6 +43,24 @@ def bind_param_arrays(named, param_arrays):
             p._data = s
 
 
+@contextlib.contextmanager
+def bind_quant_scales(params, scales):
+    """Temporarily point each quantized Parameter's ``_quant_scale`` at the
+    corresponding raw jax array (usually a tracer), restoring the originals
+    on exit — the scale-side companion of :func:`bind_param_arrays`. The
+    engine threads weight-only int8 scales through its jitted step this way,
+    so the scales are trace INPUTS (one compiled signature, donation-safe)
+    rather than baked-in constants."""
+    saved = [p._quant_scale for p in params]
+    for p, s in zip(params, scales):
+        p._quant_scale = s
+    try:
+        yield
+    finally:
+        for p, s in zip(params, saved):
+            p._quant_scale = s
+
+
 class HookRemoveHelper:
     def __init__(self, hooks: Dict[int, Callable], hook_id: int) -> None:
         self._hooks = hooks

@@ -775,6 +775,10 @@ class ServingFrontend:
                 "kv_utilization": round(
                     live / stats["total"] if stats["total"] else 0.0, 4
                 ),
+                # quantized serving surface: the pool's storage dtype and the
+                # effective bytes one cached token costs across all layers
+                "kv_cache_dtype": stats.get("kv_cache_dtype", "bf16"),
+                "kv_bytes_per_token": stats.get("bytes_per_token", 0),
                 "ttft_p99_s": round(self._ttft_p99(), 4),
                 "failed": self._failed,
                 "prefix_cache": {

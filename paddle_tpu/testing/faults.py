@@ -103,6 +103,14 @@ KNOWN_SITES = (
     # tokens are lost and no refcount/reservation accounting drifts; pinned
     # by tests/test_spec_decode.py and zero-cost-when-empty like the rest.
     "spec.verify",
+    # quantized-KV dequant seam (incubate/.../block_attention.py): fires at
+    # trace time inside each quantized Pallas-kernel dispatch, BEFORE the
+    # kernel is baked into the step. A trigger is swallowed by the kernel
+    # dispatch's existing except→warn_fallback arm, degrading that dispatch
+    # to the XLA dequant-gather fallback — counted in
+    # paddle_tpu_kernel_fallbacks_total, never a recovery trigger (the
+    # engine's step never sees the exception). Pinned zero-cost-when-empty.
+    "quant.dequant",
 )
 
 

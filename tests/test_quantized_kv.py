@@ -1,0 +1,641 @@
+"""Quantized serving plane: ``FLAGS_kv_cache_dtype=int8`` KV pool +
+``FLAGS_weight_only_int8`` projections.
+
+The contract under test (engine ``kv_cache_dtype=`` / ``weight_only_int8=``
++ ``kernels/quant.py`` + the scale-threaded block-attention dispatchers):
+
+- the bf16 DEFAULT is byte-identical to the pre-quantization engine: 2-tuple
+  caches, no scale planes, the same ONE compiled step signature;
+- the int8 pool is 4-tuples ``(kc, vc, ks, vs)`` with fp32 scale planes
+  ``[NB, KVH, BS]`` addressed by the SAME block ids — the scales ride every
+  lifecycle seam (refcounts, CoW, rewind, spill/prefetch, recovery, tp) the
+  200-op churn property exercises, still under ONE compiled signature;
+- quality is MEASURED, not assumed: greedy token-match vs the bf16 engine
+  ≥ 0.99 and a hard max-logit-error tolerance (the same numbers bench
+  records), with KV bytes/token reduced ≥ 1.5x;
+- ``quant.dequant`` is a fault SITE that degrades one dispatch to the XLA
+  gather fallback (counted) — never the engine's recovery path;
+- the weight-only int8 kernel (interpret mode) stays in numeric lockstep
+  with its canonical XLA composition, and tied/shared weights are never
+  quantized.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference import ContinuousBatchingEngine
+from paddle_tpu.kernels.quant import (
+    int8_weight_matmul,
+    quantize_module_weights,
+    quantize_weight_int8,
+)
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.testing import faults
+
+from conftest import assert_engine_pool_exact as _assert_pool_exact
+from conftest import assert_kv_tier_exact
+
+
+def _model(seed=0, **cfg_over):
+    paddle.seed(seed)
+    cfg = LlamaConfig.tiny()
+    for k, v in cfg_over.items():
+        setattr(cfg, k, v)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m, cfg
+
+
+def _workload(cfg, seed=0):
+    rng = np.random.default_rng(seed)
+    specs = [(5, 6), (7, 4), (3, 8), (6, 2), (2, 7)]
+    return [
+        (rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32), t)
+        for n, t in specs
+    ]
+
+
+def _run(m, work, **kw):
+    eng = ContinuousBatchingEngine(
+        m, max_slots=3, block_size=4, prompt_bucket=16, **kw
+    )
+    rids = [eng.add_request(p, max_new_tokens=t) for p, t in work]
+    out = eng.run()
+    return eng, [out[r].tokens() for r in rids]
+
+
+def _assert_scale_planes(eng):
+    """The quantized-pool structural invariant: every layer entry is a
+    4-tuple, the scale planes are fp32 ``[NB, KVH, BS]`` over the SAME block
+    ids as the int8 KV arrays (entry exists iff the pool has the block), and
+    every scale is finite and strictly positive — the quantize-on-write rule
+    (``absmax/127`` or the 1.0 identity) can produce nothing else, so a
+    zero/NaN scale is a leak from an uninitialized or torn write."""
+    nb, kvh, bs, _hd = eng._cache_shape
+    assert eng._quant_kv
+    for entry in eng._caches:
+        assert len(entry) == 4
+        kc, vc, ks, vs = entry
+        assert kc.dtype == jnp.int8 and vc.dtype == jnp.int8
+        for sc in (ks, vs):
+            assert sc.shape == (nb, kvh, bs)
+            assert sc.dtype == jnp.float32
+            a = np.asarray(sc)
+            assert np.isfinite(a).all()
+            assert (a > 0).all()
+
+
+class TestBf16DefaultUnchanged:
+    def test_default_engine_has_no_scale_planes(self):
+        m, cfg = _model(seed=1)
+        eng, toks = _run(m, _workload(cfg, 1))
+        assert eng.kv_cache_dtype == "bf16"
+        assert not eng._quant_kv
+        for entry in eng._caches:
+            assert len(entry) == 2
+        s = eng.pool_stats()
+        assert s["kv_cache_dtype"] == "bf16"
+        assert s["bytes_per_token"] > 0
+        assert eng.stats["step_traces"] == 1
+        assert all(len(t) > 0 for t in toks)
+
+    def test_invalid_dtype_rejected(self):
+        m, _cfg = _model(seed=1)
+        with pytest.raises(ValueError, match="kv_cache_dtype"):
+            ContinuousBatchingEngine(
+                m, max_slots=2, block_size=4, kv_cache_dtype="fp4"
+            )
+
+
+class TestQuantizedPoolStructure:
+    def test_int8_pool_scale_planes_and_one_signature(self):
+        m, cfg = _model(seed=2)
+        eng, toks = _run(m, _workload(cfg, 2), kv_cache_dtype="int8")
+        assert eng.kv_cache_dtype == "int8"
+        _assert_scale_planes(eng)
+        _assert_pool_exact(eng)
+        assert eng.pool_stats()["kv_cache_dtype"] == "int8"
+        # the whole mixed prefill/decode workload through ONE compiled step
+        assert eng.stats["step_traces"] == 1
+        assert all(len(t) > 0 for t in toks)
+
+    def test_bytes_per_token_reduction(self):
+        """The tentpole's accounting claim: int8 bytes/token = 2·L·KVH·(D+4)
+        (one scale fp32 per token-row per head riding along) — ≥ 1.5x under
+        the bf16/f32 pool's 2·L·KVH·D·itemsize."""
+        m, cfg = _model(seed=3)
+        hd = cfg.hidden_size // cfg.num_attention_heads
+        base = ContinuousBatchingEngine(m, max_slots=2, block_size=4)
+        quant = ContinuousBatchingEngine(
+            m, max_slots=2, block_size=4, kv_cache_dtype="int8"
+        )
+        bpt_b = base.pool_stats()["bytes_per_token"]
+        bpt_q = quant.pool_stats()["bytes_per_token"]
+        expect_q = 2 * cfg.num_hidden_layers * cfg.num_key_value_heads * (hd + 4)
+        assert bpt_q == expect_q
+        assert bpt_b / bpt_q >= 1.5
+
+
+class TestQuantizedChurnProperty:
+    def test_200_op_seeded_churn_quantized_pool(self):
+        """The prefix-cache churn property test on the INT8 pool: seeded
+        admit/decode/cancel/evict churn with heavy prefix sharing — pool
+        refcounts exact AND the scale-plane invariant after EVERY op, every
+        request delivered exactly once, one compiled signature. Then the
+        leak probe: a fresh request through the churned pool must emit the
+        same tokens as on a pristine engine — a scale row leaking across
+        free/CoW/rewind would corrupt it."""
+        m, cfg = _model(seed=40)
+        rng = np.random.default_rng(40)
+        eng = ContinuousBatchingEngine(
+            m, max_slots=3, block_size=4, num_blocks=24, prompt_bucket=16,
+            max_model_len=32, kv_cache_dtype="int8",
+        )
+        families = [
+            rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+            for n in (9, 6, 12)
+        ]
+
+        def make_prompt():
+            fam = families[int(rng.integers(0, len(families)))]
+            tail_n = int(rng.integers(0, 4))
+            tail = rng.integers(0, cfg.vocab_size, (tail_n,)).astype(np.int32)
+            return np.concatenate([fam, tail])[:16]
+
+        submitted, done = {}, {}
+        cancelled = 0
+        for _op in range(200):
+            r = rng.random()
+            if r < 0.40 and len(eng._waiting) < 6:
+                rid = eng.add_request(
+                    make_prompt(), max_new_tokens=int(rng.integers(1, 6))
+                )
+                submitted[rid] = True
+            elif r < 0.85:
+                if eng.has_work():
+                    for req in eng.step():
+                        assert req.req_id not in done, "delivered twice"
+                        done[req.req_id] = req
+            elif r < 0.93:
+                live = [q.req_id for q in eng.live_requests()] + [
+                    q.req_id for q in eng._waiting
+                ]
+                if live:
+                    rid = int(rng.choice(live))
+                    req = eng.cancel_request(rid)
+                    assert req is not None and req.finished
+                    done[rid] = req
+                    cancelled += 1
+            else:
+                if eng._cache is not None:
+                    eng._cache.evict_blocks(1)  # external pressure
+            _assert_pool_exact(eng)
+            _assert_scale_planes(eng)
+        while eng.has_work():
+            for req in eng.step():
+                assert req.req_id not in done
+                done[req.req_id] = req
+            _assert_pool_exact(eng)
+            _assert_scale_planes(eng)
+        assert set(done) == set(submitted)
+        assert cancelled > 0
+        assert eng.stats["step_traces"] == 1
+
+        # scale-leak probe: fresh prompt through the churned pool vs a
+        # pristine engine with the same seeded weights — byte-identical
+        probe = rng.integers(0, cfg.vocab_size, (7,)).astype(np.int32)
+        r_churn = eng.add_request(probe, max_new_tokens=5)
+        out_churn = eng.run()
+        m2, _ = _model(seed=40)
+        fresh = ContinuousBatchingEngine(
+            m2, max_slots=3, block_size=4, num_blocks=24, prompt_bucket=16,
+            max_model_len=32, kv_cache_dtype="int8",
+        )
+        r_fresh = fresh.add_request(probe, max_new_tokens=5)
+        out_fresh = fresh.run()
+        np.testing.assert_array_equal(
+            out_churn[r_churn].tokens(), out_fresh[r_fresh].tokens()
+        )
+
+    def test_200_op_churn_quantized_host_tier_spill_prefetch(self):
+        """The hierarchical-KV churn extended to the int8 pool: the host
+        tier stores the PACKED block representation (int8 KV + the scale
+        planes viewed as 4 trailing bytes), so ``block_nbytes`` is the
+        packed size — and the dual-residency equality in
+        ``assert_kv_tier_exact`` checks the packed capture byte-for-byte
+        through spill AND prefetch after every op."""
+        m, cfg = _model(seed=52)
+        rng = np.random.default_rng(52)
+        hd = cfg.hidden_size // cfg.num_attention_heads
+        # packed int8 block: [L, 2, KVH, BS, D+4] x 1 byte
+        bpb = cfg.num_hidden_layers * 2 * cfg.num_key_value_heads * 4 * (hd + 4)
+        eng = ContinuousBatchingEngine(
+            m, max_slots=3, block_size=4, num_blocks=20, prompt_bucket=24,
+            max_model_len=40, kv_host_tier_bytes=6 * bpb,
+            kv_cache_dtype="int8",
+        )
+        assert eng._host_tier.block_nbytes == bpb
+        families = [
+            rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+            for n in (9, 12)
+        ]
+        finished_streams = []
+
+        def make_prompt():
+            if finished_streams and rng.random() < 0.5:
+                base = finished_streams[int(rng.integers(0, len(finished_streams)))]
+            else:
+                base = families[int(rng.integers(0, len(families)))]
+            tail_n = int(rng.integers(0, 4))
+            tail = rng.integers(0, cfg.vocab_size, (tail_n,)).astype(np.int32)
+            return np.concatenate([base, tail])[:20]
+
+        submitted, done = {}, {}
+        for _op in range(200):
+            r = rng.random()
+            if r < 0.35 and len(eng._waiting) < 6:
+                rid = eng.add_request(
+                    make_prompt(), max_new_tokens=int(rng.integers(1, 6))
+                )
+                submitted[rid] = True
+            elif r < 0.80:
+                if eng.has_work():
+                    for req in eng.step():
+                        assert req.req_id not in done, "delivered twice"
+                        done[req.req_id] = req
+                        if len(finished_streams) < 6:
+                            finished_streams.append(req.tokens())
+            elif r < 0.88:
+                live = [q.req_id for q in eng.live_requests()] + [
+                    q.req_id for q in eng._waiting
+                ]
+                if live:
+                    rid = int(rng.choice(live))
+                    req = eng.cancel_request(rid)
+                    assert req is not None and req.finished
+                    done[rid] = req
+            elif r < 0.96:
+                eng._cache.evict_blocks(1)  # device pressure -> SPILL
+            else:
+                eng._host_tier.drop_lru(1)
+            _assert_pool_exact(eng)
+            _assert_scale_planes(eng)
+            assert_kv_tier_exact(eng)
+        while eng.has_work():
+            for req in eng.step():
+                assert req.req_id not in done
+                done[req.req_id] = req
+            _assert_pool_exact(eng)
+            assert_kv_tier_exact(eng)
+        assert set(done) == set(submitted)
+        s = eng._host_tier.stats_snapshot()
+        assert s["spilled_blocks"] > 0  # the churn actually spilled
+        assert s["prefetched_blocks"] > 0  # ... and came back
+        # the byte counters advertise the PACKED (halved) traffic
+        assert s["spilled_bytes"] == s["spilled_blocks"] * bpb
+        assert s["prefetched_bytes"] == s["prefetched_blocks"] * bpb
+        assert eng.stats["step_traces"] == 1
+
+
+class TestQualityGate:
+    def test_greedy_token_match_and_logit_error_within_tolerance(self):
+        """The measured quality numbers bench records, asserted as a HARD
+        tier-1 gate: greedy token-match ≥ 0.99 on the seeded workload,
+        weight-only max logit error bounded, KV bytes/token ≥ 1.5x down."""
+        from paddle_tpu.inference.quality import quality_delta
+
+        # the EXACT seeded CPU workload bench.py's quantized record runs —
+        # the gate asserts on the number the bench reports, not a cousin
+        rng = np.random.default_rng(11)
+        cfg = LlamaConfig.tiny()
+        prompts = [
+            rng.integers(
+                0, cfg.vocab_size, (int(rng.integers(8, 17)),)
+            ).astype(np.int32)
+            for _ in range(4)
+        ]
+        q = quality_delta(
+            lambda: _model(seed=0)[0],
+            prompts,
+            max_new_tokens=8,
+            engine_kwargs=dict(max_slots=2, block_size=4, prompt_bucket=16),
+            kv_cache_dtype="int8",
+            weight_only_int8=True,
+        )
+        assert q["tokens_compared"] >= 20
+        assert q["token_match_rate"] >= 0.99, q
+        assert q["max_logit_error"] <= 0.25, q
+        assert q["kv_bytes_reduction"] >= 1.5, q
+
+
+class TestRecoveryReplayParity:
+    def test_decode_fault_replays_quantized_pool_to_parity(self):
+        """A decode-step fault on the int8 engine: ONE recovery, replay
+        re-prefills through the same quantize-on-write path, and the final
+        streams equal the un-faulted quantized run exactly — quantization is
+        deterministic per token row, so replay parity is byte parity."""
+        m, cfg = _model(seed=20)
+        work = _workload(cfg, 20)
+        eng_a, toks_a = _run(m, work, kv_cache_dtype="int8")
+        assert eng_a.stats["recoveries"] == 0
+
+        m2, _ = _model(seed=20)
+        eng_b = ContinuousBatchingEngine(
+            m2, max_slots=3, block_size=4, prompt_bucket=16,
+            kv_cache_dtype="int8",
+        )
+        rids = [eng_b.add_request(p, max_new_tokens=t) for p, t in work]
+        with faults.inject(faults.FaultPlan.single("engine.decode", 3)):
+            out_b = eng_b.run()
+        assert eng_b.stats["recoveries"] == 1
+        for ta, rb in zip(toks_a, rids):
+            np.testing.assert_array_equal(ta, out_b[rb].tokens())
+        # the recovered pool kept the quantized structure (and one program)
+        _assert_scale_planes(eng_b)
+        assert eng_b.stats["step_traces"] == 1
+
+
+@pytest.mark.skipif(len(jax.devices()) < 2, reason="tp tests need >= 2 devices")
+class TestTpScaleConsistency:
+    def test_tp2_scale_planes_head_sharded_and_byte_consistent(self):
+        """``tp=2`` over the int8 pool: the scale planes shard over the SAME
+        head axis as the KV arrays (each device holds KVH/tp full scale
+        rows), outputs stay byte-identical to ``tp=1``, and the GLOBAL scale
+        planes are byte-identical too — head-sharding must not change a
+        single quantization decision."""
+        m1, cfg = _model(seed=30)
+        eng1, toks1 = _run(m1, _workload(cfg, 30), kv_cache_dtype="int8")
+        m2, _ = _model(seed=30)
+        eng2, toks2 = _run(m2, _workload(cfg, 30), kv_cache_dtype="int8", tp=2)
+        for ta, tb in zip(toks1, toks2):
+            np.testing.assert_array_equal(ta, tb)
+        nb, kvh, bs, hd = eng2._cache_shape
+        for (kc1, vc1, ks1, vs1), (kc2, vc2, ks2, vs2) in zip(
+            eng1._caches, eng2._caches
+        ):
+            for arr in (kc2, vc2):
+                shards = {
+                    s.device.id: s.data.shape for s in arr.addressable_shards
+                }
+                assert len(shards) == 2, shards
+                for shape in shards.values():
+                    assert tuple(shape) == (nb, kvh // 2, bs, hd), shards
+            for sc in (ks2, vs2):
+                # every device holds its head slice of the global plane,
+                # BYTE-identical — sharding must never reshuffle or
+                # re-derive a single scale
+                g = np.asarray(sc)
+                shards = list(sc.addressable_shards)
+                assert len(shards) == 2, shards
+                for s in shards:
+                    assert tuple(s.data.shape) == (nb, kvh // 2, bs)
+                    h0 = s.index[1].start or 0
+                    np.testing.assert_array_equal(
+                        np.asarray(s.data), g[:, h0 : h0 + kvh // 2, :]
+                    )
+            # across topologies the floats agree to reduction-order noise
+            # (the tokens above are BYTE-identical): same quantization
+            # decisions, ULP-level scale differences only
+            np.testing.assert_allclose(
+                np.asarray(ks1), np.asarray(ks2), rtol=1e-5, atol=1e-8
+            )
+            np.testing.assert_allclose(
+                np.asarray(vs1), np.asarray(vs2), rtol=1e-5, atol=1e-8
+            )
+            # dequantized KV differs by at most one quantization step
+            dk = np.abs(
+                np.asarray(kc1, np.float32) * np.asarray(ks1)[..., None]
+                - np.asarray(kc2, np.float32) * np.asarray(ks2)[..., None]
+            )
+            assert (dk <= np.asarray(ks1)[..., None] * 1.001).all()
+        _assert_scale_planes(eng2)
+        assert eng2.stats["step_traces"] == 1
+
+
+class TestQuantDequantFaultSite:
+    """``quant.dequant``: a counted degradation site INSIDE the Pallas try —
+    an injected dequant failure falls back to the XLA gather for that one
+    dispatch (warn_fallback-counted), and is never a recovery trigger."""
+
+    def _setup(self, seed=60):
+        from paddle_tpu.incubate.nn.functional import (
+            block_multihead_chunk_attention,
+        )
+
+        rng = np.random.default_rng(seed)
+        nb, hkv, bs, d, b, hq = 8, 2, 4, 16, 2, 4
+        q = jnp.asarray(rng.normal(size=(b, 1, hq, d)), jnp.float32)
+        k1 = jnp.asarray(rng.normal(size=(b, 1, hkv, d)), jnp.float32)
+        v1 = jnp.asarray(rng.normal(size=(b, 1, hkv, d)), jnp.float32)
+        kc = jnp.asarray(
+            rng.integers(-127, 128, (nb, hkv, bs, d)), jnp.int8
+        )
+        vc = jnp.asarray(
+            rng.integers(-127, 128, (nb, hkv, bs, d)), jnp.int8
+        )
+        ks = jnp.asarray(rng.uniform(0.5, 1.5, (nb, hkv, bs)), jnp.float32)
+        vs = jnp.asarray(rng.uniform(0.5, 1.5, (nb, hkv, bs)), jnp.float32)
+        tables = jnp.asarray([[2, 3], [4, 5]], jnp.int32)
+        lens = jnp.asarray([5, 3], jnp.int32)
+        q_lens = jnp.asarray([1, 1], jnp.int32)
+
+        def call():
+            return block_multihead_chunk_attention(
+                q, k1, v1, kc, vc, tables, lens, q_lens,
+                key_scale=ks, value_scale=vs,
+            )
+
+        return call
+
+    def test_site_is_known_and_zero_cost_without_plan(self):
+        assert "quant.dequant" in faults.KNOWN_SITES
+        call = self._setup()
+        call()  # no plan installed: one cached-bool read per dispatch
+        assert faults.site_call_count("quant.dequant") == 0
+
+    def test_injected_fault_degrades_to_xla_fallback_not_recovery(
+        self, monkeypatch
+    ):
+        import paddle_tpu.kernels.paged_attention as pa
+        import paddle_tpu.kernels.select as sel
+
+        call = self._setup(seed=61)
+        out_xla = np.asarray(call()[0])  # CPU backend: the gather fallback
+
+        monkeypatch.setattr(sel, "pallas_enabled", lambda flag: True)
+        real = pa.paged_flash_chunk
+        monkeypatch.setattr(
+            pa, "paged_flash_chunk",
+            lambda *a, **kw: real(*a, interpret=True, **kw),
+        )
+        # never-firing plan proves the Pallas try actually engages (the
+        # site is only declared inside it) — and the kernel stays lockstep
+        with faults.inject(faults.FaultPlan.single("quant.dequant", 99)):
+            out_k = np.asarray(call()[0])
+            assert faults.site_call_count("quant.dequant") == 1
+        np.testing.assert_allclose(out_k, out_xla, rtol=2e-5, atol=2e-5)
+
+        prior = paddle.get_flags(["FLAGS_enable_metrics"])["FLAGS_enable_metrics"]
+        paddle.set_flags({"FLAGS_enable_metrics": True})
+        try:
+            before = sel._fallbacks_total.value(kernel="paged_flash_chunk")
+            with faults.inject(faults.FaultPlan.single("quant.dequant", 0)):
+                out_f = np.asarray(call()[0])  # no exception escapes
+            after = sel._fallbacks_total.value(kernel="paged_flash_chunk")
+            assert after == before + 1  # the degradation is counted
+        finally:
+            paddle.set_flags({"FLAGS_enable_metrics": prior})
+        # the degraded dispatch IS the XLA fallback, byte for byte
+        np.testing.assert_array_equal(out_f, out_xla)
+
+    def test_engine_completes_with_zero_recoveries_under_plan(self):
+        m, cfg = _model(seed=62)
+        work = _workload(cfg, 62)[:3]
+        eng = ContinuousBatchingEngine(
+            m, max_slots=3, block_size=4, prompt_bucket=16,
+            kv_cache_dtype="int8",
+        )
+        rids = [eng.add_request(p, max_new_tokens=t) for p, t in work]
+        with faults.inject(faults.FaultPlan.single("quant.dequant", 0)):
+            out = eng.run()
+        assert set(out) == set(rids)
+        assert eng.stats["recoveries"] == 0  # degradation, never recovery
+
+
+class TestWeightOnlyInt8:
+    def test_quantize_roundtrip_error_bound(self):
+        rng = np.random.default_rng(70)
+        w = jnp.asarray(rng.normal(size=(64, 32)), jnp.float32)
+        w8, scale = quantize_weight_int8(w)
+        assert w8.dtype == jnp.int8 and scale.shape == (32,)
+        assert (np.asarray(scale) > 0).all()
+        err = np.abs(np.asarray(w) - np.asarray(w8, np.float32) * np.asarray(scale)[None, :])
+        # symmetric rounding: at most half an LSB per column
+        assert (err <= np.asarray(scale)[None, :] * 0.5 + 1e-7).all()
+
+    def test_int8_matmul_interpret_lockstep_with_xla(self):
+        rng = np.random.default_rng(71)
+        x = jnp.asarray(rng.normal(size=(8, 64)), jnp.float32)
+        w = jnp.asarray(rng.normal(size=(64, 32)), jnp.float32)
+        w8, scale = quantize_weight_int8(w)
+        out_xla = np.asarray(int8_weight_matmul(x, w8, scale))  # CPU: XLA path
+        out_pal = np.asarray(int8_weight_matmul(x, w8, scale, interpret=True))
+        np.testing.assert_allclose(out_pal, out_xla, rtol=1e-5, atol=1e-5)
+        ref = (
+            np.asarray(x) @ np.asarray(w8, np.float32)
+        ) * np.asarray(scale)[None, :]
+        np.testing.assert_allclose(out_xla, ref, rtol=1e-5, atol=1e-5)
+
+    def test_quantize_module_targets_projections_only(self):
+        m, cfg = _model(seed=72)
+        quantized = quantize_module_weights(m)
+        # 3 MLP projections per layer + the untied lm-head
+        assert len(quantized) == 3 * cfg.num_hidden_layers + 1
+        for layer in m.llama.layers:
+            for name in ("gate_proj", "up_proj", "down_proj"):
+                w = getattr(layer.mlp, name).weight
+                assert w._data.dtype == jnp.int8
+                assert w._quant_scale is not None
+            for name in ("q_proj", "k_proj", "v_proj", "o_proj"):
+                w = getattr(layer.self_attn, name).weight
+                assert jnp.issubdtype(w._data.dtype, jnp.floating)
+                assert getattr(w, "_quant_scale", None) is None
+        assert m.lm_head.weight._data.dtype == jnp.int8
+        emb = m.llama.embed_tokens.weight
+        assert jnp.issubdtype(emb._data.dtype, jnp.floating)
+        # idempotent: a second pass finds nothing left to quantize
+        assert quantize_module_weights(m) == []
+
+    def test_tied_and_shared_weights_never_quantized(self):
+        from paddle_tpu import nn
+
+        # llama with tied embeddings: no lm_head Parameter exists at all,
+        # and the embedding weight (which feeds the token gather) stays full
+        # precision
+        m, cfg = _model(seed=73, tie_word_embeddings=True)
+        quantized = quantize_module_weights(m)
+        assert len(quantized) == 3 * cfg.num_hidden_layers  # MLP only
+        emb = m.llama.embed_tokens.weight
+        assert jnp.issubdtype(emb._data.dtype, jnp.floating)
+        assert getattr(emb, "_quant_scale", None) is None
+
+        # a Parameter SHARED between an lm_head and a non-target layer must
+        # be skipped — the other consumer needs the full-precision array
+        class _Tied(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lm_head = nn.Linear(8, 16, bias_attr=False)
+                self.proj = nn.Linear(8, 16, bias_attr=False)
+                self.proj.weight = self.lm_head.weight
+
+        t = _Tied()
+        assert quantize_module_weights(t) == []
+        assert jnp.issubdtype(t.lm_head.weight._data.dtype, jnp.floating)
+
+    def test_weight_only_engine_one_signature(self):
+        m, cfg = _model(seed=74)
+        eng, toks = _run(m, _workload(cfg, 74), weight_only_int8=True)
+        assert eng._wq_params  # the engine actually quantized projections
+        assert eng.stats["step_traces"] == 1
+        assert all(len(t) > 0 for t in toks)
+
+    def test_quantized_fused_loss_interpret_matches_reference(self):
+        """Quantized lm-head fused loss: the interpret-mode Pallas chunk
+        walk, the scan fallback (the CPU default), and a dense dequantized
+        cross-entropy all agree."""
+        from paddle_tpu.kernels.fused_loss import fused_linear_cross_entropy
+
+        rng = np.random.default_rng(75)
+        x = jnp.asarray(rng.normal(size=(6, 32)), jnp.float32)
+        w = jnp.asarray(rng.normal(size=(32, 64)), jnp.float32)
+        w8, scale = quantize_weight_int8(w)
+        labels_np = rng.integers(0, 64, (6,)).astype(np.int32)
+        labels_np[2] = -100
+        labels = jnp.asarray(labels_np)
+
+        loss_scan = fused_linear_cross_entropy(
+            x, w8, labels, weight_scale=scale
+        )
+        loss_interp = fused_linear_cross_entropy(
+            x, w8, labels, weight_scale=scale, interpret=True
+        )
+        dense_w = w8.astype(jnp.float32) * scale[None, :]
+        loss_dense = fused_linear_cross_entropy(x, dense_w, labels)
+        np.testing.assert_allclose(
+            np.asarray(loss_scan), np.asarray(loss_dense), rtol=1e-5, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(loss_interp), np.asarray(loss_dense), rtol=1e-5, atol=1e-6
+        )
+
+
+class TestQuantObservability:
+    def test_quant_metrics_and_pool_stats_surface(self):
+        """``kv_pool_bytes_per_token`` gauge tracks the pool's accounting,
+        ``kv_quant_dequant_total`` counts quantize-on-write tokens and
+        dequant dispatches, and ``pool_stats``/healthz carry the dtype."""
+        prior = paddle.get_flags(["FLAGS_enable_metrics"])["FLAGS_enable_metrics"]
+        paddle.set_flags({"FLAGS_enable_metrics": True})
+        try:
+            m, cfg = _model(seed=80)
+            eng = ContinuousBatchingEngine(
+                m, max_slots=3, block_size=4, prompt_bucket=16,
+                kv_cache_dtype="int8",
+            )
+            q_before = eng._metrics["kv_quant"].value(op="quant")
+            d_before = eng._metrics["kv_quant"].value(op="dequant")
+            for p, t in _workload(cfg, 80)[:3]:
+                eng.add_request(p, max_new_tokens=t)
+            eng.run()
+            s = eng.pool_stats()
+            assert s["kv_cache_dtype"] == "int8"
+            # every prompt + generated token was quantized on write exactly
+            # once; every dispatched step dequantized
+            assert eng._metrics["kv_quant"].value(op="quant") > q_before
+            assert eng._metrics["kv_quant"].value(op="dequant") > d_before
+            assert eng._metrics["kv_bytes_per_token"].value() == s["bytes_per_token"]
+        finally:
+            paddle.set_flags({"FLAGS_enable_metrics": prior})

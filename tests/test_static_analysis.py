@@ -1791,6 +1791,67 @@ def test_pg903_budget_is_tunable():
     assert "PG903" in {v.code for v in vs}
 
 
+def _pg903_dtype_site(dtype: str) -> str:
+    # one (512, 8192) block in + out: 4 MiB each at 1 byte/elt, 16 MiB each
+    # at 4 bytes/elt — the SAME geometry crosses the 16 MiB budget purely on
+    # the element width, so the audit must price narrow dtypes truthfully
+    return (
+        _PG_PRELUDE
+        + "def f():\n"
+        f"    x = jnp.zeros((8192, 8192), {dtype})\n"
+        "    return pl.pallas_call(\n"
+        "        k,\n"
+        "        grid=(16,),\n"
+        "        in_specs=[pl.BlockSpec((512, 8192), lambda i: (i, 0))],\n"
+        "        out_specs=pl.BlockSpec((512, 8192), lambda i: (i, 0)),\n"
+        f"        out_shape=jax.ShapeDtypeStruct((8192, 8192), {dtype}),\n"
+        "    )(x)\n"
+    )
+
+
+def test_pg903_int8_true_width_fits_budget():
+    """The quantized-kernel case (kernels/quant.py): an int8 window the
+    audit would flag at an assumed 4-byte width fits comfortably at its TRUE
+    1-byte width — narrow dtypes must not produce false PG903 positives."""
+    assert codes(_pg903_dtype_site("jnp.int8")) == []
+
+
+def test_pg903_fp8_true_width_fits_budget():
+    assert codes(_pg903_dtype_site("jnp.float8_e4m3fn")) == []
+
+
+def test_pg903_fp32_same_geometry_exceeds_budget():
+    """Negative control for the pair above: the identical block geometry at
+    4 bytes/elt crosses the 16 MiB budget — the dtype is the only delta."""
+    assert "PG903" in codes(_pg903_dtype_site("jnp.float32"))
+
+
+def test_pg903_int8_width_not_assumed():
+    """int8 is a KNOWN width (DTYPE_BYTES), not the assumed-1-byte fallback:
+    the VMEM config must not carry the ``assumed_width`` caveat."""
+    from paddle_tpu.analysis.kernel_geometry import DTYPE_BYTES, evaluate_module
+    import ast
+
+    assert DTYPE_BYTES["int8"] == 1
+    assert DTYPE_BYTES["float8_e4m3fn"] == 1
+    src = _pg903_dtype_site("jnp.int8")
+    mod = evaluate_module("x.py", ast.parse(src))
+    sites = mod.sites
+    assert sites, "fixture must contain a pallas_call site"
+    for site in sites:
+        for vc in site.vmem_configs:
+            assert not vc.assumed_width
+
+
+def test_pg_sweep_quant_kernel_clean():
+    """The weight-only int8 kernel ships PG-clean: a full checker sweep over
+    kernels/quant.py (geometry, prefetch, dispatch discipline) reports zero
+    unsuppressed violations."""
+    vs = analyze_paths([str(PKG / "kernels" / "quant.py")])
+    bad = [v for v in vs if not v.suppressed]
+    assert bad == [], [f"{v.code}:{v.line}" for v in bad]
+
+
 _PG_PREFETCH = (
     "import jax\n"
     "import jax.numpy as jnp\n"
